@@ -1,0 +1,255 @@
+"""Encoder: the reference codec interface over TPU-batched stripes.
+
+Semantics mirror blobstore/common/ec/encoder.go:41-62 (Encoder interface:
+Encode/Verify/Reconstruct/ReconstructData/Split/Join/GetDataShards/
+GetParityShards/GetLocalShards/GetShardsInIdc) and lrcencoder.go (two-level
+LRC: global N+M stripe plus per-AZ local parity). The data model is
+TPU-first: a stripe is ONE (total, S) uint8 ndarray (and batched
+(B, total, S) stacks for the repair/migrate fleet), not a []][]byte —
+device kernels see large contiguous batches, never per-shard slices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops import rs_kernel
+from . import codemode as cm
+from .engine import Engine, get_engine
+
+
+class ECError(Exception):
+    pass
+
+
+class ShortDataError(ECError):
+    pass
+
+
+class VerifyError(ECError):
+    pass
+
+
+@dataclass
+class CodecConfig:
+    """ec.Config analog (blobstore/common/ec/encoder.go:64-69)."""
+
+    mode: cm.CodeMode
+    enable_verify: bool = False
+    engine: str | None = None  # --ec-engine; None -> env default
+
+
+def new_encoder(cfg: CodecConfig) -> "Encoder":
+    t = cm.tactic(cfg.mode)
+    eng = get_engine(cfg.engine)
+    if t.l != 0:
+        return LrcEncoder(cfg, t, eng)
+    return Encoder(cfg, t, eng)
+
+
+class Encoder:
+    """Plain N+M Reed-Solomon codec over stripe arrays."""
+
+    def __init__(self, cfg: CodecConfig, t: cm.Tactic, engine: Engine):
+        self.cfg = cfg
+        self.t = t
+        self.engine = engine
+
+    # -- shape helpers ---------------------------------------------------
+    def _check(self, shards: np.ndarray, total: int | None = None) -> np.ndarray:
+        total = total if total is not None else self.t.total
+        shards = np.asarray(shards)
+        if shards.dtype != np.uint8:
+            # a silent asarray copy would break the in-place mutation
+            # contract of encode/reconstruct — reject instead
+            raise ECError(f"stripe dtype must be uint8, got {shards.dtype}")
+        if shards.shape[-2] != total:
+            raise ECError(
+                f"stripe has {shards.shape[-2]} shards, want {total} for {self.t}"
+            )
+        return shards
+
+    def shard_size(self, data_len: int) -> int:
+        """Per-shard size for a payload: max(ceil(len/N), min_shard_size)
+        (Tactic.MinShardSize semantics, codemode.go MinShardSize doc)."""
+        per = -(-data_len // self.t.n)
+        return max(per, self.t.min_shard_size)
+
+    # -- reference Encoder interface ------------------------------------
+    def encode(self, shards: np.ndarray) -> np.ndarray:
+        """Fill parity rows from data rows; returns the same array."""
+        shards = self._check(shards)
+        n, m = self.t.n, self.t.m
+        if m:
+            shards[..., n : n + m, :] = self.engine.encode_parity(
+                shards[..., :n, :], m
+            )
+        if self.cfg.enable_verify and not self.verify(shards):
+            raise VerifyError("parity verify failed after encode")
+        return shards
+
+    def verify(self, shards: np.ndarray) -> bool:
+        shards = self._check(shards)
+        n, m = self.t.n, self.t.m
+        if not m:
+            return True
+        parity = self.engine.encode_parity(shards[..., :n, :], m)
+        return bool(np.array_equal(parity, shards[..., n : n + m, :]))
+
+    def reconstruct(self, shards: np.ndarray, bad_idx: list[int]) -> np.ndarray:
+        return self._reconstruct(shards, bad_idx, wanted=sorted(set(bad_idx)))
+
+    def reconstruct_data(self, shards: np.ndarray, bad_idx: list[int]) -> np.ndarray:
+        wanted = sorted({i for i in bad_idx if i < self.t.n})
+        return self._reconstruct(shards, bad_idx, wanted=wanted)
+
+    def _reconstruct(
+        self, shards: np.ndarray, bad_idx: list[int], wanted: list[int]
+    ) -> np.ndarray:
+        shards = self._check(shards, total=self.t.n + self.t.m)
+        if not wanted:
+            return shards
+        n, total = self.t.n, self.t.n + self.t.m
+        bad = set(bad_idx)
+        present = [i for i in range(total) if i not in bad]
+        if len(present) < n:
+            raise ECError(f"unrecoverable: only {len(present)} of {n} shards")
+        rows = rs_kernel.reconstruct_rows(n, total, present, wanted)
+        rec = self.engine.matrix_apply(rows, shards[..., present[:n], :])
+        shards[..., wanted, :] = rec
+        return shards
+
+    def split(self, data: bytes | np.ndarray) -> np.ndarray:
+        """Lay a payload into a zero-padded (total, S) stripe (data rows
+        filled, parity rows zero until encode)."""
+        buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else np.asarray(data, dtype=np.uint8).ravel()
+        if buf.size == 0:
+            raise ShortDataError("empty payload")
+        s = self.shard_size(buf.size)
+        stripe = np.zeros((self.t.total, s), dtype=np.uint8)
+        flat = stripe.reshape(-1)
+        flat[: buf.size] = buf
+        return stripe.reshape(self.t.total, s)
+
+    def join(self, shards: np.ndarray, out_size: int) -> bytes:
+        shards = self._check(shards)
+        if shards.ndim != 2:
+            raise ECError("join takes a single (total, S) stripe, not a batch")
+        flat = np.ascontiguousarray(shards[: self.t.n]).reshape(-1)
+        if out_size > flat.size:
+            raise ECError(f"out_size {out_size} exceeds data capacity {flat.size}")
+        return flat[:out_size].tobytes()
+
+    def get_data_shards(self, shards: np.ndarray) -> np.ndarray:
+        return shards[..., : self.t.n, :]
+
+    def get_parity_shards(self, shards: np.ndarray) -> np.ndarray:
+        return shards[..., self.t.n : self.t.n + self.t.m, :]
+
+    def get_local_shards(self, shards: np.ndarray) -> np.ndarray:
+        return shards[..., self.t.total : self.t.total, :]  # empty
+
+    def get_shards_in_idc(self, shards: np.ndarray, az: int) -> np.ndarray:
+        n, m, azc = self.t.n, self.t.m, self.t.az_count
+        ln, lm = n // azc, m // azc
+        idx = list(range(az * ln, (az + 1) * ln)) + list(
+            range(n + lm * az, n + lm * (az + 1))
+        )
+        return shards[..., idx, :]
+
+
+class LrcEncoder(Encoder):
+    """Two-level LRC codec: global RS(N+M) plus per-AZ local parity
+    RS((N+M)/az, L/az). Local stripes allow intra-AZ reconstruction
+    without crossing the DCN (lrcencoder.go:133-186 semantics)."""
+
+    @property
+    def _local_nm(self) -> tuple[int, int]:
+        t = self.t
+        return (t.n + t.m) // t.az_count, t.l // t.az_count
+
+    def encode(self, shards: np.ndarray) -> np.ndarray:
+        shards = self._check(shards)
+        t = self.t
+        shards[..., t.n : t.n + t.m, :] = self.engine.encode_parity(
+            shards[..., : t.n, :], t.m
+        )
+        ln, lm = self._local_nm
+        for az in range(t.az_count):
+            stripe_idx, _, _ = t.local_stripe_in_az(az)
+            local_data = shards[..., stripe_idx[:ln], :]
+            shards[..., stripe_idx[ln:], :] = self.engine.encode_parity(local_data, lm)
+        if self.cfg.enable_verify and not self.verify(shards):
+            raise VerifyError("parity verify failed after encode")
+        return shards
+
+    def verify(self, shards: np.ndarray) -> bool:
+        shards = np.asarray(shards, dtype=np.uint8)
+        t = self.t
+        ln, lm = self._local_nm
+        if shards.shape[-2] == ln + lm:  # a bare local stripe
+            parity = self.engine.encode_parity(shards[..., :ln, :], lm)
+            return bool(np.array_equal(parity, shards[..., ln:, :]))
+        shards = self._check(shards)
+        parity = self.engine.encode_parity(shards[..., : t.n, :], t.m)
+        if not np.array_equal(parity, shards[..., t.n : t.n + t.m, :]):
+            return False
+        for az in range(t.az_count):
+            stripe_idx, _, _ = t.local_stripe_in_az(az)
+            local_parity = self.engine.encode_parity(shards[..., stripe_idx[:ln], :], lm)
+            if not np.array_equal(local_parity, shards[..., stripe_idx[ln:], :]):
+                return False
+        return True
+
+    def reconstruct(self, shards: np.ndarray, bad_idx: list[int]) -> np.ndarray:
+        shards = np.asarray(shards, dtype=np.uint8)
+        t = self.t
+        ln, lm = self._local_nm
+        if shards.shape[-2] == ln + lm:
+            # intra-AZ repair on a bare local stripe (saves DCN bandwidth)
+            bad = sorted(set(bad_idx))
+            if not bad:
+                return shards
+            present = [i for i in range(ln + lm) if i not in bad]
+            if len(present) < ln:
+                raise ECError(
+                    f"unrecoverable local stripe: only {len(present)} of {ln} shards"
+                )
+            rows = rs_kernel.reconstruct_rows(ln, ln + lm, present, bad)
+            shards[..., bad, :] = self.engine.matrix_apply(
+                rows, shards[..., present[:ln], :]
+            )
+            return shards
+        shards = self._check(shards)
+        global_bad = sorted({i for i in bad_idx if i < t.n + t.m})
+        if global_bad:
+            self._reconstruct(
+                shards[..., : t.n + t.m, :], global_bad, wanted=global_bad
+            )
+        # local parities are recomputed from their (now complete) stripes
+        local_bad_azs = sorted(
+            {(i - t.n - t.m) * t.az_count // t.l for i in bad_idx if i >= t.n + t.m}
+        )
+        for az in local_bad_azs:
+            stripe_idx, _, _ = t.local_stripe_in_az(az)
+            local_data = shards[..., stripe_idx[:ln], :]
+            shards[..., stripe_idx[ln:], :] = self.engine.encode_parity(local_data, lm)
+        return shards
+
+    def reconstruct_data(self, shards: np.ndarray, bad_idx: list[int]) -> np.ndarray:
+        t = self.t
+        shards = self._check(shards)
+        global_bad = [i for i in bad_idx if i < t.n + t.m]
+        wanted = sorted({i for i in global_bad if i < t.n})
+        if wanted:
+            self._reconstruct(shards[..., : t.n + t.m, :], global_bad, wanted=wanted)
+        return shards
+
+    def get_local_shards(self, shards: np.ndarray) -> np.ndarray:
+        return shards[..., self.t.n + self.t.m :, :]
+
+    def get_shards_in_idc(self, shards: np.ndarray, az: int) -> np.ndarray:
+        stripe_idx, _, _ = self.t.local_stripe_in_az(az)
+        return shards[..., stripe_idx, :]

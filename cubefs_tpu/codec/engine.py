@@ -1,0 +1,87 @@
+"""Pluggable codec engines — the `--ec-engine={numpy,tpu,...}` analog.
+
+The reference hard-wires one SIMD CPU engine (klauspost/reedsolomon behind
+blobstore/common/ec/encoder.go); BASELINE.json's north star is a pluggable
+`codec.Engine` where the TPU path is selectable. Engines expose the raw
+shard-math primitives; cubefs_tpu/codec/encoder.py layers the reference's
+Encoder semantics (Split/Verify/Reconstruct/...) on top.
+
+Engines:
+  * ``numpy`` — table-driven GF(2^8) on host; the in-process CPU baseline
+    and the golden for bit-identity tests.
+  * ``tpu``  — JAX bit-matmul kernels (cubefs_tpu/ops/rs_kernel.py); runs
+    on whatever backend jax selects (TPU on hardware, CPU in tests).
+  * ``cpp``  — native C++ engine (cubefs_tpu/runtime), registered when the
+    shared library has been built.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Protocol
+
+import numpy as np
+
+from ..ops import gf256, rs_kernel
+
+
+class Engine(Protocol):
+    """Shard-level GF(2^8) math over (..., B, S) uint8 arrays."""
+
+    name: str
+
+    def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        """(R, C) GF matrix x (..., C, S) shards -> (..., R, S)."""
+
+    def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
+        """(..., N, S) data -> (..., M, S) parity."""
+
+
+class NumpyEngine:
+    name = "numpy"
+
+    def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        coeff = np.asarray(coeff, dtype=np.uint8)
+        shards = np.asarray(shards, dtype=np.uint8)
+        if shards.ndim == 2:
+            return gf256.gf_matmul(coeff, shards)
+        flat = shards.reshape(-1, *shards.shape[-2:])
+        out = np.stack([gf256.gf_matmul(coeff, s) for s in flat])
+        return out.reshape(*shards.shape[:-2], coeff.shape[0], shards.shape[-1])
+
+    def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
+        return self.matrix_apply(gf256.parity_matrix(data.shape[-2], n_parity), data)
+
+
+class JaxEngine:
+    name = "tpu"
+
+    def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        return np.asarray(rs_kernel.gf_matrix_apply(coeff, np.asarray(shards)))
+
+    def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
+        return np.asarray(rs_kernel.encode_parity(np.asarray(data), n_parity))
+
+
+_REGISTRY: dict[str, Callable[[], Engine]] = {
+    "numpy": NumpyEngine,
+    "tpu": JaxEngine,
+}
+
+
+def register_engine(name: str, factory: Callable[[], Engine]) -> None:
+    _REGISTRY[name] = factory
+
+
+_instances: dict[str, Engine] = {}
+
+
+def get_engine(name: str | None = None) -> Engine:
+    """Resolve an engine by name; default from CUBEFS_TPU_EC_ENGINE
+    (the --ec-engine flag analog), falling back to the TPU path."""
+    name = name or os.environ.get("CUBEFS_TPU_EC_ENGINE", "tpu")
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown ec engine {name!r}; have {sorted(_REGISTRY)}")
+    if name not in _instances:
+        _instances[name] = _REGISTRY[name]()
+    return _instances[name]

@@ -1,0 +1,49 @@
+"""GF(2)-linear reformulation of GF(2^8) codes — the TPU-first trick.
+
+A GF(2^8) multiply by a fixed coefficient c is linear over GF(2): there is
+an 8x8 bit-matrix L_c with byte_out_bits = L_c @ byte_in_bits (mod 2).
+Therefore a whole Reed-Solomon encode  parity = C (MxN over GF(256)) x
+shards  is ONE bit-matrix multiply  (8M x 8N) @ (8N x S)  with mod-2
+accumulation. That removes every byte-table gather (hostile on TPU — the
+reference instead uses AVX2 nibble shuffles, vendor/github.com/klauspost/
+reedsolomon/galois_amd64.s) and maps the hot loop directly onto the MXU as
+an int8 matmul followed by a parity (&1) and a bit-pack.
+
+Bit order convention: LSB-first within each byte; row index b*8+k holds
+bit k of byte b.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf256
+
+
+def coeff_bitmatrix(c: int) -> np.ndarray:
+    """8x8 GF(2) matrix L_c for y = gf_mul(c, x): column j holds the bits
+    of gf_mul(c, 1 << j)."""
+    cols = gf256.gf_mul(np.full(8, c, np.uint8), (1 << np.arange(8)).astype(np.uint8))
+    return ((cols[None, :] >> np.arange(8)[:, None]) & 1).astype(np.int8)
+
+
+def gf_matrix_to_bits(m: np.ndarray) -> np.ndarray:
+    """Expand an (R, C) GF(2^8) matrix into its (8R, 8C) GF(2) form."""
+    m = np.asarray(m, dtype=np.uint8)
+    r, c = m.shape
+    out = np.zeros((8 * r, 8 * c), dtype=np.int8)
+    for i in range(r):
+        for j in range(c):
+            out[8 * i : 8 * i + 8, 8 * j : 8 * j + 8] = coeff_bitmatrix(int(m[i, j]))
+    return out
+
+
+def unpack_bits_np(x: np.ndarray) -> np.ndarray:
+    """(..., B, S) uint8 -> (..., 8B, S) int8 bit planes (numpy golden)."""
+    bits = (x[..., :, None, :] >> np.arange(8)[None, :, None]) & 1
+    return bits.reshape(*x.shape[:-2], x.shape[-2] * 8, x.shape[-1]).astype(np.int8)
+
+
+def pack_bits_np(bits: np.ndarray) -> np.ndarray:
+    b8 = bits.reshape(*bits.shape[:-2], bits.shape[-2] // 8, 8, bits.shape[-1])
+    return (b8.astype(np.uint16) << np.arange(8)[None, :, None]).sum(-2).astype(np.uint8)

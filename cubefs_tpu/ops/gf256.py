@@ -1,0 +1,146 @@
+"""GF(2^8) arithmetic and Reed-Solomon matrix construction (host-side, numpy).
+
+Implements the same field and encode-matrix math as the reference's
+Reed-Solomon engine (klauspost/reedsolomon as used by CubeFS at
+blobstore/common/ec/encoder.go:86 via reedsolomon.New(N, M) with default
+options): GF(2^8) with the 0x11D field polynomial, and the systematic
+Backblaze-style matrix built as ``V * inv(V_top)`` from the Vandermonde
+matrix ``V[r][c] = r^c`` (reference: vendor/github.com/klauspost/
+reedsolomon/matrix.go:271 vandermonde, reedsolomon.go:472 buildMatrix).
+
+Everything here is tiny, exact integer math that runs once per codemode on
+the host; the byte-throughput work happens in the TPU kernels
+(cubefs_tpu/ops/rs_kernel.py), which consume the matrices built here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+FIELD_POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1, generator 2
+FIELD_SIZE = 256
+
+
+def _build_tables() -> tuple[np.ndarray, np.ndarray]:
+    exp = np.zeros(512, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= FIELD_POLY
+    exp[255:510] = exp[0:255]
+    return exp, log
+
+
+EXP, LOG = _build_tables()
+
+
+@functools.cache
+def mul_table() -> np.ndarray:
+    """Full 256x256 GF(2^8) multiplication table (row a, col b)."""
+    a = np.arange(256)
+    log_sum = LOG[a][:, None] + LOG[a][None, :]
+    t = EXP[log_sum % 255].copy()
+    t[0, :] = 0
+    t[:, 0] = 0
+    return t
+
+
+@functools.cache
+def inv_table() -> np.ndarray:
+    t = np.zeros(256, dtype=np.uint8)
+    t[1:] = EXP[(255 - LOG[np.arange(1, 256)]) % 255]
+    return t
+
+
+def gf_mul(a, b):
+    """Element-wise GF(2^8) multiply of arrays/scalars of uint8."""
+    return mul_table()[np.asarray(a, dtype=np.uint8), np.asarray(b, dtype=np.uint8)]
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a^n in GF(2^8) with the reference's galExp conventions:
+    a^0 == 1 for every a (including 0); 0^n == 0 for n > 0."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP[(int(LOG[a]) * n) % 255])
+
+
+def gf_matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """GF(2^8) matrix product (small matrices; also the numpy golden path
+    for whole-shard encoding in tests). A: (m, k) uint8, B: (k, n) uint8."""
+    A = np.asarray(A, dtype=np.uint8)
+    B = np.asarray(B, dtype=np.uint8)
+    mt = mul_table()
+    out = np.zeros((A.shape[0], B.shape[1]), dtype=np.uint8)
+    for j in range(A.shape[1]):  # k is tiny (<= 256); vectorize over n
+        out ^= mt[A[:, j][:, None], B[j][None, :]]
+    return out
+
+
+def gf_inv_matrix(M: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2^8) matrix by Gauss-Jordan elimination."""
+    M = np.asarray(M, dtype=np.uint8)
+    n = M.shape[0]
+    if M.shape != (n, n):
+        raise ValueError("matrix must be square")
+    mt = mul_table()
+    inv = inv_table()
+    work = np.concatenate([M.copy(), np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        pivot = col
+        while pivot < n and work[pivot, col] == 0:
+            pivot += 1
+        if pivot == n:
+            raise np.linalg.LinAlgError("singular GF(2^8) matrix")
+        if pivot != col:
+            work[[col, pivot]] = work[[pivot, col]]
+        scale = inv[work[col, col]]
+        work[col] = mt[work[col], scale]
+        for r in range(n):
+            if r != col and work[r, col] != 0:
+                work[r] ^= mt[work[col], work[r, col]]
+    return work[:, n:].copy()
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    v = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            v[r, c] = gf_exp(r, c)
+    return v
+
+
+@functools.cache
+def encode_matrix(n_data: int, n_total: int) -> np.ndarray:
+    """Systematic (n_total, n_data) encode matrix; identical to the
+    reference engine's default for reedsolomon.New(n_data, n_total-n_data):
+    top n_data rows are the identity, bottom rows generate parity."""
+    if not (0 < n_data <= n_total <= FIELD_SIZE):
+        raise ValueError(f"invalid shard counts n={n_data} total={n_total}")
+    v = vandermonde(n_total, n_data)
+    top_inv = gf_inv_matrix(v[:n_data])
+    m = gf_matmul(v, top_inv)
+    m.setflags(write=False)
+    return m
+
+
+def parity_matrix(n_data: int, n_parity: int) -> np.ndarray:
+    """(n_parity, n_data) rows that produce parity shards from data."""
+    return encode_matrix(n_data, n_data + n_parity)[n_data:]
+
+
+def decode_matrix(n_data: int, n_total: int, present: list[int]) -> np.ndarray:
+    """(n_data, n_data) matrix recovering all data shards from the first
+    n_data present shards (indices into the full shard list, sorted)."""
+    if len(present) < n_data:
+        raise ValueError(f"need {n_data} shards, have {len(present)}")
+    rows = encode_matrix(n_data, n_total)[np.asarray(present[:n_data])]
+    return gf_inv_matrix(rows)

@@ -1,0 +1,24 @@
+"""pytest bootstrap plugin (loaded via `-p testenv` in pytest.ini).
+
+Imported during plugin registration — BEFORE pytest installs fd-level
+output capture and before jax is imported anywhere — which is the only
+window where we can (a) scrub the axon real-TPU tunnel env (its
+sitecustomize-registered plugin can hang backend init when the tunnel is
+down, even for CPU), and (b) pin the virtual 8-device CPU mesh the test
+suite runs on. Scrubbing requires re-exec'ing the interpreter because
+sitecustomize already ran; doing it here (not conftest.py) keeps the
+child's stdout on the real terminal fds.
+"""
+
+import os
+import sys
+
+if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get("_CUBEFS_TPU_REEXEC"):
+    env = {k: v for k, v in os.environ.items() if not k.startswith(("PALLAS_AXON", "AXON_"))}
+    env["_CUBEFS_TPU_REEXEC"] = "1"
+    os.execve(sys.executable, list(sys.orig_argv), env)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,11 @@
+"""Test fixtures. Env setup (CPU mesh, axon-tunnel scrub) lives in
+testenv.py, which pytest.ini loads as a `-p` plugin before capture and
+before any jax import — see its docstring for why it can't live here."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0xC0DEC)
