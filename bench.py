@@ -1,0 +1,126 @@
+"""North-star benchmark: EC encode+repair GiB/s/chip + CRC GB/s.
+
+Replicates BASELINE.json's judged configs on whatever backend jax
+resolves (the real TPU chip under the driver; CPU as fallback):
+
+  * RS(12+4), 4MiB shards: batched encode GiB/s (data bytes / s)
+  * RS(12+4), 4MiB shards: reconstruct 2 missing data shards GiB/s
+  * 128KiB-block CRC32 verify GB/s
+
+Prints ONE JSON line. `value` is the repair number (the judged metric);
+vs_baseline is value / 8 GiB/s — the BASELINE.json target for v5e-1
+(the reference publishes no EC kernel benchmark; 8 GiB/s/chip ≈ the
+AVX2-path target multiple it names).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+
+def _backend_watchdog(seconds: float = 180.0) -> None:
+    """If the axon tunnel is wedged, backend init hangs forever inside
+    jax.devices(); re-exec on CPU instead of hanging the driver."""
+
+    if os.environ.get("_CUBEFS_BENCH_CPU"):
+        return
+    done = threading.Event()
+
+    def arm():
+        if not done.wait(seconds):
+            env = {
+                k: v
+                for k, v in os.environ.items()
+                if not k.startswith(("PALLAS_AXON", "AXON_"))
+            }
+            env["_CUBEFS_BENCH_CPU"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            sys.stderr.write("bench: backend init timed out; rerunning on CPU\n")
+            sys.stderr.flush()
+            os.execve(sys.executable, list(sys.orig_argv), env)
+
+    threading.Thread(target=arm, daemon=True).start()
+    import jax
+
+    jax.devices()
+    done.set()
+
+
+def _time_fn(fn, *args, iters: int = 5) -> float:
+    import jax
+
+    out = fn(*args)  # compile + warmup
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    _backend_watchdog()
+    import jax
+    import numpy as np
+
+    from cubefs_tpu.models import repair
+    from cubefs_tpu.ops import crc32_kernel, gf256, rs_kernel
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    on_tpu = "tpu" in str(dev).lower() or platform in ("tpu", "axon")
+
+    S = 4 << 20 if on_tpu else 1 << 18  # 4MiB shards (scaled down on CPU)
+    B = 4 if on_tpu else 2  # stripes per step
+    n, m = 12, 4
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (B, n, S), dtype=np.uint8)
+
+    # --- encode ---------------------------------------------------------
+    x = jax.device_put(data, dev)
+    dt = _time_fn(lambda a: rs_kernel.encode_parity(a, m), x)
+    encode_gibs = B * n * S / dt / (1 << 30)
+
+    # --- repair: 2 missing data shards ----------------------------------
+    plan = repair.make_plan(n, m, bad=[1, 7])
+    rows = plan.rows
+    surv = jax.device_put(
+        rng.integers(0, 256, (B, n, S), dtype=np.uint8), dev
+    )  # any bytes; throughput only (math is data-independent)
+    dt = _time_fn(lambda a: rs_kernel.gf_matrix_apply(rows, a), surv)
+    repair_gibs = B * n * S / dt / (1 << 30)
+
+    # --- CRC32, 128KiB blocks -------------------------------------------
+    nblk = 256 if on_tpu else 32
+    blocks = jax.device_put(
+        rng.integers(0, 256, (nblk, 128 << 10), dtype=np.uint8), dev
+    )
+    dt = _time_fn(lambda a: crc32_kernel.crc32_blocks(a, chunk_len=4096), blocks)
+    crc_gbs = nblk * (128 << 10) / dt / 1e9
+
+    target_gibs = 8.0  # BASELINE.json: >=8 GiB/s/chip RS(12+4) repair on v5e-1
+    print(
+        json.dumps(
+            {
+                "metric": "RS(12+4) 4MiB-shard reconstruct(2 missing) GiB/s/chip",
+                "value": round(repair_gibs, 3),
+                "unit": "GiB/s",
+                "vs_baseline": round(repair_gibs / target_gibs, 3),
+                "extras": {
+                    "encode_gibs": round(encode_gibs, 3),
+                    "crc32_gbs": round(crc_gbs, 3),
+                    "platform": platform,
+                    "shard_bytes": S,
+                    "stripes_per_step": B,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,6 @@ Bit-identical to zlib/Go hash/crc32 by construction (exact GF(2) math).
 from __future__ import annotations
 
 import functools
-import zlib
 
 import jax
 import jax.numpy as jnp
@@ -98,41 +97,56 @@ def chunk_matrix(chunk_len: int) -> np.ndarray:
     return w
 
 
+def linear_crc_bits(segments: jax.Array, chunk_len: int) -> jax.Array:
+    """Pure-linear CRC part of equal-length byte segments, as bit vectors.
+
+    segments: (..., seg_len) uint8 -> (..., 32) int32 in {0,1}: L(m) such
+    that crc32(m) == L(m) XOR crc32(0^seg_len). Traceable inside jit /
+    shard_map — this is the device-local piece of the distributed CRC
+    (cross-device combining applies zeros_matrix shifts and XORs).
+    """
+    *lead, seg_len = segments.shape
+    if seg_len % chunk_len:
+        raise ValueError(f"seg_len {seg_len} % chunk_len {chunk_len} != 0")
+    n_chunks = seg_len // chunk_len
+    w = jnp.asarray(chunk_matrix(chunk_len).astype(np.int8))  # (32, 8L)
+    # combine matrix for chunk k: append (n_chunks-1-k)*chunk_len zeros
+    shifts = jnp.asarray(
+        np.stack(
+            [zeros_matrix((n_chunks - 1 - k) * chunk_len) for k in range(n_chunks)]
+        ).astype(np.int8)
+    )  # (C, 32, 32)
+    flat = segments.reshape(-1, n_chunks, chunk_len)
+    bits = rs_kernel.unpack_bits(flat.reshape(-1, chunk_len, 1))
+    bits = bits.reshape(flat.shape[0], n_chunks, 8 * chunk_len)
+    part = jax.lax.dot_general(
+        bits, w, (((2,), (1,)), ((), ())), preferred_element_type=jnp.int32
+    ) & 1  # (B, C, 32) per-chunk raw CRC
+    folded = jnp.einsum(
+        "cij,bcj->bi", shifts, part, preferred_element_type=jnp.int32
+    ) & 1
+    return folded.reshape(*lead, 32)
+
+
+def pack_crc_bits(bits: jax.Array) -> jax.Array:
+    """(..., 32) {0,1} -> (...,) uint32."""
+    pow2 = jnp.asarray(
+        (np.uint64(1) << np.arange(32, dtype=np.uint64)).astype(np.uint32)
+    )
+    return (bits.astype(jnp.uint32) * pow2).sum(-1, dtype=jnp.uint32)
+
+
 @functools.cache
 def _crc_block_fn(block_len: int, chunk_len: int):
     if block_len % chunk_len:
         raise ValueError(f"block_len {block_len} % chunk_len {chunk_len} != 0")
-    n_chunks = block_len // chunk_len
-    w = chunk_matrix(chunk_len).astype(np.int8)  # (32, 8L)
-    # combine matrix for chunk k (0-based from block start): append
-    # (n_chunks-1-k)*chunk_len zero bytes.
-    shifts = np.stack(
-        [zeros_matrix((n_chunks - 1 - k) * chunk_len) for k in range(n_chunks)]
-    ).astype(np.int8)  # (C, 32, 32)
-    # affine constant: crc32 of an all-zero block (init/xorout conditioning)
-    const = zlib.crc32(b"\x00" * block_len)
-    const_bits = jnp.asarray(_state_bits(const), dtype=jnp.int32)
-    pow2 = jnp.asarray((np.uint64(1) << np.arange(32, dtype=np.uint64)).astype(np.uint32))
+    const_bits = jnp.asarray(_state_bits(crc32_zeros(block_len)), dtype=jnp.int32)
 
     @jax.jit
     def crc(blocks: jax.Array) -> jax.Array:
         """blocks: (B, block_len) uint8 -> (B,) uint32 crc32 (zlib)."""
-        b = blocks.shape[0]
-        chunks = blocks.reshape(b, n_chunks, chunk_len)
-        bits = rs_kernel.unpack_bits(chunks.reshape(b * n_chunks, chunk_len, 1))
-        bits = bits.reshape(b, n_chunks, 8 * chunk_len)
-        # per-chunk raw CRC: (B, C, 32)
-        part = jax.lax.dot_general(
-            bits, jnp.asarray(w), (((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        ) & 1
-        # fold: out[b, i] = XOR_c sum_j shifts[c, i, j] * part[b, c, j]
-        folded = jnp.einsum(
-            "cij,bcj->bi", jnp.asarray(shifts), part,
-            preferred_element_type=jnp.int32,
-        ) & 1
-        final = folded ^ const_bits[None, :]
-        return (final.astype(jnp.uint32) * pow2[None, :]).sum(-1, dtype=jnp.uint32)
+        linear = linear_crc_bits(blocks, chunk_len)
+        return pack_crc_bits(linear ^ const_bits[None, :])
 
     return crc
 

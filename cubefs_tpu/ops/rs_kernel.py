@@ -43,12 +43,19 @@ def pack_bits(bits: jax.Array) -> jax.Array:
     return (planes << jnp.arange(8)[None, :, None]).sum(-2).astype(jnp.uint8)
 
 
-def gf_apply_bits(w_bits: jax.Array, shards: jax.Array) -> jax.Array:
+def gf_apply_bits(
+    w_bits: jax.Array, shards: jax.Array, psum_axis: str | None = None
+) -> jax.Array:
     """Apply a GF(2)-expanded coefficient matrix to shard bytes.
 
     w_bits: (8M, 8N) int8 0/1; shards: (..., N, S) uint8 -> (..., M, S).
     The contraction K = 8N <= 288 keeps the accumulator far below int32
     limits; XLA lowers the int8 x int8 -> int32 dot onto the MXU.
+
+    psum_axis: inside shard_map with the shard axis N split across mesh
+    axis `psum_axis`, pass its name — partial int32 products are summed
+    across devices BEFORE the mod-2, which is exact (parity of a sum ==
+    XOR of parities).
     """
     x = unpack_bits(shards)
     y = jax.lax.dot_general(
@@ -59,6 +66,8 @@ def gf_apply_bits(w_bits: jax.Array, shards: jax.Array) -> jax.Array:
     )  # (8M, ..., S)
     if x.ndim > 2:
         y = jnp.moveaxis(y, 0, -2)
+    if psum_axis is not None:
+        y = jax.lax.psum(y, psum_axis)
     return pack_bits(y & 1)
 
 

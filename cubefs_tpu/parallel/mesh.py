@@ -1,0 +1,54 @@
+"""Device-mesh construction for the codec fleet.
+
+The reference scales by scattering shards across nodes/AZs over TCP
+(SURVEY.md §2.4); the TPU-native analog shards the codec math over a
+`jax.sharding.Mesh` and lets XLA place collectives on ICI:
+
+  * ``dp`` — stripe batch (independent stripes; embarrassingly parallel,
+    the analog of per-volume task fan-out)
+  * ``tp`` — shard axis N (each device holds a subset of a stripe's
+    shards; partial GF(2)-matmul products are XOR-combined via psum —
+    the analog of shards living on different blobnodes)
+  * ``sp`` — byte axis within a shard (long-object/sequence parallelism;
+    CRC folds across devices with zero-extension matrices — the analog
+    of blob splitting at access/stream/stream_put.go:114)
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "tp", "sp")
+
+
+def factor_mesh(n_devices: int) -> dict[str, int]:
+    """Split n devices over (dp, tp, sp), preferring dp > tp > sp but
+    exercising every axis when the device count allows."""
+    dims = {"dp": 1, "tp": 1, "sp": 1}
+    remaining = n_devices
+    for axis in ("tp", "sp"):
+        if remaining % 2 == 0 and remaining > 1:
+            dims[axis] = 2
+            remaining //= 2
+    dims["dp"] = remaining
+    return dims
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    dims = factor_mesh(len(devices))
+    dev_array = np.asarray(devices).reshape(dims["dp"], dims["tp"], dims["sp"])
+    return Mesh(dev_array, AXES)
+
+
+def stripe_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (batch, shards, bytes) stripe stacks."""
+    return NamedSharding(mesh, P("dp", "tp", "sp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
