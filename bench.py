@@ -68,7 +68,7 @@ def main() -> None:
     import numpy as np
 
     from cubefs_tpu.models import repair
-    from cubefs_tpu.ops import crc32_kernel, gf256, rs_kernel
+    from cubefs_tpu.ops import crc32_kernel, rs_kernel
 
     dev = jax.devices()[0]
     platform = dev.platform

@@ -1,0 +1,279 @@
+"""Access: the stateless put/get/delete gateway of the EC plane.
+
+Role parity: blobstore/access/stream (Put: codemode select → volume
+alloc → split → EC encode → quorum write, stream_put.go:44-169; Get:
+n-of-N+M read with degraded-path reconstruction, stream_get.go:115,461).
+
+TPU-first redesign of the hot path: a PUT's blobs are encoded as ONE
+batched stripe stack (B, total, S) on the device — the reference
+pipelines blob-by-blob through an AVX2 encoder (bounded concurrency 4,
+stream_put.go:106); here batching IS the throughput story, and the
+device sees large contiguous arrays.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..codec import codemode as cm
+from ..codec.encoder import CodecConfig, new_encoder
+from ..utils import rpc
+from .types import Location, Slice, VolumeInfo
+
+
+class PutQuorumError(Exception):
+    pass
+
+
+class GetError(Exception):
+    pass
+
+
+DEFAULT_POLICIES = [
+    cm.Policy("EC3P3", min_size=0, max_size=256 << 10),
+    cm.Policy("EC6P6", min_size=(256 << 10) + 1, max_size=4 << 20),
+    cm.Policy("EC12P4", min_size=(4 << 20) + 1, max_size=1 << 62),
+]
+
+
+@dataclass
+class AccessConfig:
+    blob_size: int = 8 << 20  # max payload bytes per blob
+    engine: str | None = None
+    policies: list = field(default_factory=lambda: list(DEFAULT_POLICIES))
+    max_workers: int = 16
+    put_quorum_override: int | None = None  # tests
+
+
+class AccessHandler:
+    """One handler per process; thread-safe."""
+
+    def __init__(self, cm_client: rpc.Client, node_clients: "NodePool",
+                 cfg: AccessConfig | None = None, repair_queue=None,
+                 delete_queue=None):
+        self.cm = cm_client
+        self.nodes = node_clients
+        self.cfg = cfg or AccessConfig()
+        self.repair_queue = repair_queue
+        self.delete_queue = delete_queue
+        self._pool = ThreadPoolExecutor(max_workers=self.cfg.max_workers)
+        self._encoders: dict[int, object] = {}
+        self._lock = threading.Lock()
+
+    def _encoder(self, mode: int):
+        with self._lock:
+            if mode not in self._encoders:
+                self._encoders[mode] = new_encoder(
+                    CodecConfig(mode=cm.CodeMode(mode), engine=self.cfg.engine)
+                )
+            return self._encoders[mode]
+
+    # ------------------------------ PUT ------------------------------
+    def put(self, data: bytes, codemode: int | None = None) -> Location:
+        if not data:
+            raise ValueError("empty payload")
+        mode = int(codemode if codemode is not None
+                   else cm.select_codemode(self.cfg.policies, len(data)))
+        enc = self._encoder(mode)
+        t = enc.t
+
+        blob_size = self.cfg.blob_size
+        blobs = [data[i : i + blob_size] for i in range(0, len(data), blob_size)]
+        meta, _ = self.cm.call("alloc_volume", {"codemode": mode})
+        vol = VolumeInfo.from_dict(meta["volume"])
+        meta, _ = self.cm.call("alloc_bids", {"count": len(blobs)})
+        min_bid = meta["start"]
+
+        # ---- batched device encode: group equal shard sizes ----
+        shard_size = enc.shard_size(len(blobs[0]))
+        stripes = np.zeros((len(blobs), t.total, shard_size), dtype=np.uint8)
+        for i, blob in enumerate(blobs):
+            buf = np.frombuffer(blob, dtype=np.uint8)
+            stripes[i].reshape(-1)[: buf.size] = buf
+        enc.encode(stripes)  # ONE batched kernel call for all blobs
+
+        # ---- quorum writes ----
+        quorum = self.cfg.put_quorum_override or t.put_quorum
+        futures = []
+        for i in range(len(blobs)):
+            bid = min_bid + i
+            for u in vol.units:
+                futures.append(
+                    self._pool.submit(self._write_shard, vol, u, bid, stripes[i, u.index])
+                )
+        fails: list[tuple[int, int]] = []  # (bid, unit index)
+        ok_per_bid = {min_bid + i: 0 for i in range(len(blobs))}
+        for f in futures:
+            bid, idx, err = f.result()
+            if err is None:
+                ok_per_bid[bid] += 1
+            else:
+                fails.append((bid, idx))
+        for bid, n_ok in ok_per_bid.items():
+            if n_ok < quorum:
+                raise PutQuorumError(
+                    f"bid {bid}: {n_ok}/{len(vol.units)} shards < quorum {quorum}"
+                )
+        for bid, idx in fails:
+            if self.repair_queue is not None:
+                self.repair_queue.put(
+                    {"type": "shard_repair", "vid": vol.vid, "bid": bid, "bad_index": idx}
+                )
+
+        return Location(
+            cluster_id=1,
+            codemode=mode,
+            size=len(data),
+            slices=[Slice(min_bid=min_bid, vid=vol.vid, count=len(blobs),
+                          blob_size=blob_size)],
+            crc=zlib.crc32(data),
+        )
+
+    def _write_shard(self, vol: VolumeInfo, unit, bid: int, shard: np.ndarray):
+        try:
+            self.nodes.get(unit.node_addr).call(
+                "put_shard",
+                {"disk_id": unit.disk_id, "chunk_id": unit.chunk_id, "bid": bid},
+                shard.tobytes(),
+                timeout=10.0,
+            )
+            return bid, unit.index, None
+        except Exception as e:
+            return bid, unit.index, e
+
+    # ------------------------------ GET ------------------------------
+    def get(self, loc: Location) -> bytes:
+        enc = self._encoder(loc.codemode)
+        t = enc.t
+        out = bytearray()
+        remaining = loc.size
+        for sl in loc.slices:
+            vol = VolumeInfo.from_dict(
+                self.cm.call("get_volume", {"vid": sl.vid})[0]["volume"]
+            )
+            for k in range(sl.count):
+                payload_len = min(sl.blob_size, remaining)
+                out += self._get_blob(enc, vol, sl.min_bid + k, payload_len)
+                remaining -= payload_len
+        data = bytes(out)
+        if loc.crc and zlib.crc32(data) != loc.crc:
+            raise GetError("payload crc mismatch after reassembly")
+        return data
+
+    def _read_shard(self, vol: VolumeInfo, idx: int, bid: int):
+        u = vol.units[idx]
+        try:
+            _, payload = self.nodes.get(u.node_addr).call(
+                "get_shard",
+                {"disk_id": u.disk_id, "chunk_id": u.chunk_id, "bid": bid},
+                timeout=10.0,
+            )
+            return idx, payload, None
+        except Exception as e:
+            return idx, None, e
+
+    def _get_blob(self, enc, vol: VolumeInfo, bid: int, payload_len: int) -> bytes:
+        t = enc.t
+        shard_size = enc.shard_size(
+            payload_len if payload_len > 0 else 1
+        )
+        # fast path: read the N data shards
+        reads = list(self._pool.map(
+            lambda i: self._read_shard(vol, i, bid), range(t.n)
+        ))
+        got = {i: p for i, p, err in reads if err is None}
+        if len(got) == t.n:
+            data = b"".join(got[i] for i in range(t.n))
+            return data[:payload_len]
+
+        # degraded read: pull parity/local shards until n_global available
+        missing = [i for i in range(t.n) if i not in got]
+        extra_idx = [i for i in range(t.n, t.n + t.m) if i not in got]
+        for i, p, err in self._pool.map(
+            lambda i: self._read_shard(vol, i, bid), extra_idx
+        ):
+            if err is None:
+                got[i] = p
+        present = sorted(got)
+        if len(present) < t.n:
+            raise GetError(
+                f"bid {bid}: only {len(present)} of {t.n} shards readable"
+            )
+        if self.repair_queue is not None:
+            for i in missing:
+                self.repair_queue.put(
+                    {"type": "shard_repair", "vid": vol.vid, "bid": bid, "bad_index": i}
+                )
+        shard_size = len(next(iter(got.values())))
+        stripe = np.zeros((t.n + t.m, shard_size), dtype=np.uint8)
+        for i in present:
+            if i < t.n + t.m:
+                stripe[i] = np.frombuffer(got[i], dtype=np.uint8)
+        enc.reconstruct_data(stripe, missing)
+        data = np.ascontiguousarray(stripe[: t.n]).reshape(-1)[:payload_len]
+        return data.tobytes()
+
+    # ----------------------------- DELETE -----------------------------
+    def delete(self, loc: Location) -> None:
+        """Mark-delete: enqueue async deletion (proxy/mq analog); the
+        consumer (scheduler blob_deleter) performs the actual unlink."""
+        if self.delete_queue is None:
+            self._delete_now(loc)
+            return
+        for sl in loc.slices:
+            self.delete_queue.put(
+                {"type": "blob_delete", "vid": sl.vid,
+                 "min_bid": sl.min_bid, "count": sl.count}
+            )
+
+    def _delete_now(self, loc: Location) -> None:
+        for sl in loc.slices:
+            vol = VolumeInfo.from_dict(
+                self.cm.call("get_volume", {"vid": sl.vid})[0]["volume"]
+            )
+            for k in range(sl.count):
+                bid = sl.min_bid + k
+                for u in vol.units:
+                    try:
+                        self.nodes.get(u.node_addr).call(
+                            "delete_shard",
+                            {"disk_id": u.disk_id, "chunk_id": u.chunk_id, "bid": bid},
+                        )
+                    except rpc.RpcError:
+                        pass  # already gone / node down -> scrubber's job
+
+    # ---------------- RPC surface ----------------
+    def rpc_put(self, args, body):
+        loc = self.put(body, args.get("codemode"))
+        return {"location": loc.to_dict()}
+
+    def rpc_get(self, args, body):
+        return {}, self.get(Location.from_dict(args["location"]))
+
+    def rpc_delete(self, args, body):
+        self.delete(Location.from_dict(args["location"]))
+        return {}
+
+
+class NodePool:
+    """Address -> client map, supporting in-process targets (tests) and
+    HTTP addresses transparently."""
+
+    def __init__(self):
+        self._clients: dict[str, rpc.Client] = {}
+        self._lock = threading.Lock()
+
+    def bind(self, addr: str, target) -> None:
+        with self._lock:
+            self._clients[addr] = rpc.Client(target)
+
+    def get(self, addr: str) -> rpc.Client:
+        with self._lock:
+            if addr not in self._clients:
+                self._clients[addr] = rpc.Client(addr)  # HTTP
+            return self._clients[addr]

@@ -1,0 +1,110 @@
+"""Pythonic facade over the native chunk-store engine (ctypes).
+
+The blobnode disk engine (reference: blobstore/blobnode/core chunk files
++ shard meta KV) as a C++ runtime component; this wrapper adds typed
+errors and numpy-friendly buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..runtime import build as rt
+
+
+class ChunkStoreError(Exception):
+    pass
+
+
+class CrcMismatchError(ChunkStoreError):
+    pass
+
+
+class ShardNotFoundError(ChunkStoreError):
+    pass
+
+
+class ChunkStore:
+    def __init__(self, directory: str):
+        self._lib = rt.load()
+        self._h = self._lib.cs_open(directory.encode())
+        if not self._h:
+            raise ChunkStoreError(f"cannot open store at {directory}")
+        self.directory = directory
+
+    def _err(self) -> str:
+        return (self._lib.cs_last_error(self._h) or b"").decode()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.cs_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def create_chunk(self, chunk_id: int) -> None:
+        if self._lib.cs_create_chunk(self._h, chunk_id) != 0:
+            raise ChunkStoreError(self._err())
+
+    def put_shard(self, chunk_id: int, bid: int, data: bytes | np.ndarray) -> int:
+        buf = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
+        crc = ctypes.c_uint32()
+        rc = self._lib.cs_put_shard(
+            self._h, chunk_id, bid, buf, len(buf), ctypes.byref(crc)
+        )
+        if rc != 0:
+            raise ChunkStoreError(self._err())
+        return crc.value
+
+    def get_shard(self, chunk_id: int, bid: int, max_size: int = 16 << 20) -> tuple[bytes, int]:
+        buf = ctypes.create_string_buffer(max_size)
+        crc = ctypes.c_uint32()
+        rc = self._lib.cs_get_shard(
+            self._h, chunk_id, bid, buf, max_size, ctypes.byref(crc)
+        )
+        if rc == -2:
+            raise CrcMismatchError(self._err())
+        if rc == -3:
+            raise ChunkStoreError(self._err())
+        if rc < 0:
+            raise ShardNotFoundError(self._err())
+        return buf.raw[: rc], crc.value
+
+    def delete_shard(self, chunk_id: int, bid: int) -> None:
+        if self._lib.cs_delete_shard(self._h, chunk_id, bid) != 0:
+            raise ShardNotFoundError(self._err())
+
+    def list_shards(self, chunk_id: int, cap: int = 1 << 20) -> list[tuple[int, int, int]]:
+        n = self._lib.cs_shard_count(self._h, chunk_id)
+        if n < 0:
+            raise ChunkStoreError(self._err())
+        n = min(n, cap)
+        bids = (ctypes.c_uint64 * n)()
+        sizes = (ctypes.c_uint32 * n)()
+        crcs = (ctypes.c_uint32 * n)()
+        got = self._lib.cs_list_shards(self._h, chunk_id, bids, sizes, crcs, n)
+        if got < 0:
+            raise ChunkStoreError(self._err())
+        return [(bids[i], sizes[i], crcs[i]) for i in range(got)]
+
+    def shard_count(self, chunk_id: int) -> int:
+        n = self._lib.cs_shard_count(self._h, chunk_id)
+        if n < 0:
+            raise ChunkStoreError(self._err())
+        return n
+
+    def sync(self, chunk_id: int) -> None:
+        if self._lib.cs_sync(self._h, chunk_id) != 0:
+            raise ChunkStoreError(self._err())
+
+
+def cpu_crc32(data: bytes) -> int:
+    """Native slicing-by-8 CRC32 — the CPU baseline for the TPU kernel."""
+    return rt.load().cs_crc32(data, len(data))

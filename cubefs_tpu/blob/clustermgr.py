@@ -1,0 +1,325 @@
+"""ClusterMgr: the EC-plane metadata center.
+
+Role parity: blobstore/clustermgr (volume mgr / disk mgr / scope (BID)
+mgr / config kv / service registry; svr.go:146,203). State mutations go
+through a single apply() door with an append-only JSON WAL + snapshot —
+the same FSM discipline the reference gets from raft+RocksDB, kept
+pluggable so a consensus layer can replicate the apply stream.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ..codec import codemode as cm
+from ..utils import rpc
+from .types import DiskInfo, DiskStatus, VolumeInfo, VolumeStatus, VolumeUnit
+
+
+class NoAvailableDisks(Exception):
+    pass
+
+
+class ClusterMgr:
+    HEARTBEAT_TIMEOUT = 12.0  # seconds without heartbeat -> suspect
+
+    def __init__(self, cluster_id: int = 1, data_dir: str | None = None,
+                 allow_colocated_units: bool = False):
+        self.cluster_id = cluster_id
+        self.data_dir = data_dir
+        self.allow_colocated_units = allow_colocated_units
+        self._lock = threading.RLock()
+        self.disks: dict[int, DiskInfo] = {}
+        self.volumes: dict[int, VolumeInfo] = {}
+        self.services: dict[str, list[str]] = {}
+        self.kv: dict[str, str] = {}
+        self._next_disk = 1
+        self._next_vid = 1
+        self._next_bid = 1
+        self._next_chunk = 1
+        self._wal = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load()
+            self._wal = open(os.path.join(data_dir, "wal.jsonl"), "a")
+
+    # ---------------- persistence (FSM apply stream) ----------------
+    def _log(self, op: str, **kw) -> None:
+        if self._wal is not None:
+            self._wal.write(json.dumps({"op": op, **kw}) + "\n")
+            self._wal.flush()
+
+    def snapshot(self) -> None:
+        if not self.data_dir:
+            return
+        with self._lock:
+            state = {
+                "cluster_id": self.cluster_id,
+                "disks": {k: v.to_dict() for k, v in self.disks.items()},
+                "volumes": {k: v.to_dict() for k, v in self.volumes.items()},
+                "services": self.services,
+                "kv": self.kv,
+                "next": [self._next_disk, self._next_vid, self._next_bid, self._next_chunk],
+            }
+            tmp = os.path.join(self.data_dir, "snapshot.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, os.path.join(self.data_dir, "snapshot.json"))
+            if self._wal is not None:
+                self._wal.close()
+            open(os.path.join(self.data_dir, "wal.jsonl"), "w").close()
+            self._wal = open(os.path.join(self.data_dir, "wal.jsonl"), "a")
+
+    def _load(self) -> None:
+        snap = os.path.join(self.data_dir, "snapshot.json")
+        if os.path.exists(snap):
+            state = json.load(open(snap))
+            self.cluster_id = state["cluster_id"]
+            self.disks = {int(k): DiskInfo.from_dict(v) for k, v in state["disks"].items()}
+            self.volumes = {int(k): VolumeInfo.from_dict(v) for k, v in state["volumes"].items()}
+            self.services = state["services"]
+            self.kv = state["kv"]
+            (self._next_disk, self._next_vid, self._next_bid, self._next_chunk) = state["next"]
+        wal = os.path.join(self.data_dir, "wal.jsonl")
+        if os.path.exists(wal):
+            for line in open(wal):
+                line = line.strip()
+                if line:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail
+                    self._apply(rec)
+
+    def _apply(self, rec: dict) -> None:
+        op = rec.pop("op")
+        getattr(self, f"_apply_{op}")(**rec)
+
+    # ---------------- disks & nodes ----------------
+    def register_disk(self, node_addr: str, path: str) -> int:
+        with self._lock:
+            disk_id = self._next_disk
+            self._apply_register_disk(disk_id, node_addr, path)
+            self._log("register_disk", disk_id=disk_id, node_addr=node_addr, path=path)
+            return disk_id
+
+    def _apply_register_disk(self, disk_id: int, node_addr: str, path: str) -> None:
+        self.disks[disk_id] = DiskInfo(disk_id, node_addr, path,
+                                       last_heartbeat=time.time())
+        self._next_disk = max(self._next_disk, disk_id + 1)
+
+    def heartbeat(self, disk_ids: list[int], chunk_counts: dict | None = None) -> None:
+        now = time.time()
+        with self._lock:
+            for d in disk_ids:
+                if d in self.disks:
+                    self.disks[d].last_heartbeat = now
+                    if chunk_counts and str(d) in chunk_counts:
+                        self.disks[d].chunk_count = chunk_counts[str(d)]
+
+    def set_disk_status(self, disk_id: int, status: int) -> None:
+        with self._lock:
+            self._apply_set_disk_status(disk_id, status)
+            self._log("set_disk_status", disk_id=disk_id, status=status)
+
+    def _apply_set_disk_status(self, disk_id: int, status: int) -> None:
+        self.disks[disk_id].status = int(status)
+
+    def suspect_dead_disks(self) -> list[int]:
+        """Disks past the heartbeat timeout (the failure detector's input;
+        reference master/cluster.go:851-902 heartbeat checks analog)."""
+        now = time.time()
+        with self._lock:
+            return [
+                d.disk_id
+                for d in self.disks.values()
+                if d.status == DiskStatus.NORMAL
+                and now - d.last_heartbeat > self.HEARTBEAT_TIMEOUT
+            ]
+
+    # ---------------- volumes ----------------
+    def alloc_volume(self, codemode: int) -> VolumeInfo:
+        """Create a volume: place its N+M+L chunks on distinct normal
+        disks (distinctness waived only for single-node dev clusters)."""
+        t = cm.tactic(codemode)
+        with self._lock:
+            normal = [d for d in self.disks.values() if d.status == DiskStatus.NORMAL]
+            if not normal:
+                raise NoAvailableDisks("no registered disks")
+            if len(normal) < t.total and not self.allow_colocated_units:
+                raise NoAvailableDisks(
+                    f"{len(normal)} disks < {t.total} units for {cm.CodeMode(codemode).name}"
+                )
+            # least-loaded placement
+            normal.sort(key=lambda d: d.chunk_count)
+            picks = [normal[i % len(normal)] for i in range(t.total)]
+            vid = self._next_vid
+            chunk_base = self._next_chunk
+            rec = {
+                "vid": vid,
+                "codemode": int(codemode),
+                "units": [
+                    {"index": i, "disk_id": p.disk_id,
+                     "chunk_id": chunk_base + i, "node_addr": p.node_addr}
+                    for i, p in enumerate(picks)
+                ],
+            }
+            self._apply_create_volume(**rec)
+            self._log("create_volume", **rec)
+            return self.volumes[vid]
+
+    def _apply_create_volume(self, vid: int, codemode: int, units: list[dict]) -> None:
+        vol = VolumeInfo(vid=vid, codemode=codemode,
+                         units=[VolumeUnit.from_dict(u) for u in units],
+                         status=VolumeStatus.ACTIVE)
+        self.volumes[vid] = vol
+        for u in vol.units:
+            if u.disk_id in self.disks:
+                self.disks[u.disk_id].chunk_count += 1
+        self._next_vid = max(self._next_vid, vid + 1)
+        self._next_chunk = max(self._next_chunk, max(u.chunk_id for u in vol.units) + 1)
+
+    def get_volume(self, vid: int) -> VolumeInfo:
+        with self._lock:
+            # defensive copy: callers (incl. in-process clients) must not
+            # alias the FSM's internal state
+            return VolumeInfo.from_dict(self.volumes[vid].to_dict())
+
+    def update_volume_unit(self, vid: int, index: int, disk_id: int,
+                           chunk_id: int, node_addr: str) -> None:
+        """Repair writeback: point a shard slot at its new home."""
+        with self._lock:
+            self._apply_update_unit(vid, index, disk_id, chunk_id, node_addr)
+            self._log("update_unit", vid=vid, index=index, disk_id=disk_id,
+                      chunk_id=chunk_id, node_addr=node_addr)
+
+    def _apply_update_unit(self, vid: int, index: int, disk_id: int,
+                           chunk_id: int, node_addr: str) -> None:
+        vol = self.volumes[vid]
+        vol.units[index] = VolumeUnit(index, disk_id, chunk_id, node_addr)
+        vol.epoch += 1
+
+    def volumes_on_disk(self, disk_id: int) -> list[tuple[int, int]]:
+        """(vid, unit_index) pairs whose shard lives on the disk — the
+        scheduler's repair work-list for a broken disk."""
+        with self._lock:
+            out = []
+            for vol in self.volumes.values():
+                for u in vol.units:
+                    if u.disk_id == disk_id:
+                        out.append((vol.vid, u.index))
+            return out
+
+    def pick_destination(self, exclude_disks: set[int]) -> DiskInfo:
+        with self._lock:
+            cands = [
+                d for d in self.disks.values()
+                if d.status == DiskStatus.NORMAL and d.disk_id not in exclude_disks
+            ]
+            if not cands:
+                if not self.allow_colocated_units:
+                    raise NoAvailableDisks("no destination disk outside exclusion set")
+                cands = [d for d in self.disks.values() if d.status == DiskStatus.NORMAL]
+                if not cands:
+                    raise NoAvailableDisks("no normal disks at all")
+            return min(cands, key=lambda d: d.chunk_count)
+
+    def alloc_chunk_id(self) -> int:
+        with self._lock:
+            cid = self._next_chunk
+            self._next_chunk += 1
+            self._log("alloc_chunk", chunk_id=cid)
+            return cid
+
+    def _apply_alloc_chunk(self, chunk_id: int) -> None:
+        self._next_chunk = max(self._next_chunk, chunk_id + 1)
+
+    # ---------------- scope (BID) allocation ----------------
+    def alloc_bids(self, count: int) -> int:
+        with self._lock:
+            start = self._next_bid
+            self._next_bid += count
+            self._log("alloc_bids", start=start, count=count)
+            return start
+
+    def _apply_alloc_bids(self, start: int, count: int) -> None:
+        self._next_bid = max(self._next_bid, start + count)
+
+    # ---------------- service registry & config ----------------
+    def register_service(self, name: str, addr: str) -> None:
+        with self._lock:
+            self.services.setdefault(name, [])
+            if addr not in self.services[name]:
+                self.services[name].append(addr)
+            self._log("register_service", name=name, addr=addr)
+
+    def _apply_register_service(self, name: str, addr: str) -> None:
+        self.services.setdefault(name, [])
+        if addr not in self.services[name]:
+            self.services[name].append(addr)
+
+    def get_service(self, name: str) -> list[str]:
+        with self._lock:
+            return list(self.services.get(name, []))
+
+    def set_config(self, key: str, value: str) -> None:
+        with self._lock:
+            self.kv[key] = value
+            self._log("set_config", key=key, value=value)
+
+    def _apply_set_config(self, key: str, value: str) -> None:
+        self.kv[key] = value
+
+    def get_config(self, key: str, default: str | None = None) -> str | None:
+        with self._lock:
+            return self.kv.get(key, default)
+
+    def stat(self) -> dict:
+        with self._lock:
+            return {
+                "cluster_id": self.cluster_id,
+                "disks": len(self.disks),
+                "volumes": len(self.volumes),
+                "broken_disks": sum(
+                    1 for d in self.disks.values() if d.status == DiskStatus.BROKEN
+                ),
+            }
+
+    # ---------------- RPC surface ----------------
+    def rpc_register_disk(self, args, body):
+        return {"disk_id": self.register_disk(args["node_addr"], args["path"])}
+
+    def rpc_heartbeat(self, args, body):
+        self.heartbeat(args["disk_ids"], args.get("chunk_counts"))
+        return {}
+
+    def rpc_alloc_volume(self, args, body):
+        return {"volume": self.alloc_volume(args["codemode"]).to_dict()}
+
+    def rpc_get_volume(self, args, body):
+        return {"volume": self.get_volume(args["vid"]).to_dict()}
+
+    def rpc_alloc_bids(self, args, body):
+        return {"start": self.alloc_bids(args["count"])}
+
+    def rpc_set_disk_status(self, args, body):
+        self.set_disk_status(args["disk_id"], args["status"])
+        return {}
+
+    def rpc_update_volume_unit(self, args, body):
+        self.update_volume_unit(args["vid"], args["index"], args["disk_id"],
+                                args["chunk_id"], args["node_addr"])
+        return {}
+
+    def rpc_register_service(self, args, body):
+        self.register_service(args["name"], args["addr"])
+        return {}
+
+    def rpc_get_service(self, args, body):
+        return {"addrs": self.get_service(args["name"])}
+
+    def rpc_stat(self, args, body):
+        return self.stat()

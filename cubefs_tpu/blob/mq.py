@@ -1,0 +1,66 @@
+"""Persistent message queue: the async repair/delete bus.
+
+Role parity: the reference pushes shard-repair and blob-delete events
+through Kafka (blobstore/proxy/mq, scheduler/blob_deleter.go:315). A
+broker dependency is out of scope for a storage framework's core, so
+this is a durable append-log queue (jsonl + consumer offset file) with
+the same at-least-once + ack semantics the consumers rely on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class MessageQueue:
+    def __init__(self, path: str | None = None, topic: str = "q"):
+        self._lock = threading.Lock()
+        self._mem: list[dict] = []
+        self._offset = 0
+        self._log = None
+        self._offset_path = None
+        if path:
+            os.makedirs(path, exist_ok=True)
+            log_path = os.path.join(path, f"{topic}.jsonl")
+            self._offset_path = os.path.join(path, f"{topic}.offset")
+            if os.path.exists(log_path):
+                for line in open(log_path):
+                    line = line.strip()
+                    if line:
+                        try:
+                            self._mem.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            break
+            if os.path.exists(self._offset_path):
+                try:
+                    self._offset = int(open(self._offset_path).read().strip() or 0)
+                except ValueError:
+                    self._offset = 0
+            self._log = open(log_path, "a")
+
+    def put(self, msg: dict) -> None:
+        with self._lock:
+            self._mem.append(msg)
+            if self._log is not None:
+                self._log.write(json.dumps(msg) + "\n")
+                self._log.flush()
+
+    def poll(self, max_n: int = 64) -> list[tuple[int, dict]]:
+        """Peek up to max_n unacked messages as (offset, msg); consumers
+        ack() the highest offset they fully processed (at-least-once)."""
+        with self._lock:
+            end = min(self._offset + max_n, len(self._mem))
+            return [(i, self._mem[i]) for i in range(self._offset, end)]
+
+    def ack(self, offset: int) -> None:
+        with self._lock:
+            self._offset = max(self._offset, offset + 1)
+            if self._offset_path:
+                with open(self._offset_path, "w") as f:
+                    f.write(str(self._offset))
+
+    def backlog(self) -> int:
+        with self._lock:
+            return len(self._mem) - self._offset

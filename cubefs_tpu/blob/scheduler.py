@@ -1,0 +1,261 @@
+"""Scheduler: the background-task brain of the EC plane.
+
+Role parity: blobstore/scheduler — disk repair (disk_repairer.go:38,
+collectTask:197, AcquireTask:761), shard-repair and blob-delete queue
+consumers (shard_repairer.go, blob_deleter.go), task leasing with renew
+and idempotent re-queue (migrate.go:941), and per-type runtime
+kill-switches (common/taskswitch). Workers (cubefs_tpu/blob/worker.py)
+pull leased tasks and do the codec math on the TPU engine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+from ..utils import rpc
+from .types import DiskStatus, VolumeInfo
+
+
+class TaskSwitch:
+    """Runtime on/off switches per background task type."""
+
+    def __init__(self):
+        self._off: set[str] = set()
+        self._lock = threading.Lock()
+
+    def enable(self, kind: str) -> None:
+        with self._lock:
+            self._off.discard(kind)
+
+    def disable(self, kind: str) -> None:
+        with self._lock:
+            self._off.add(kind)
+
+    def enabled(self, kind: str) -> bool:
+        with self._lock:
+            return kind not in self._off
+
+
+class Scheduler:
+    LEASE_SECONDS = 30.0
+
+    def __init__(self, cm_obj, repair_queue=None, delete_queue=None,
+                 node_pool=None):
+        # cm_obj is the ClusterMgr object (leader-colocated, like the
+        # reference scheduler's direct clustermgr client)
+        self.cm = cm_obj
+        self.repair_queue = repair_queue
+        self.delete_queue = delete_queue
+        self.nodes = node_pool
+        self.switch = TaskSwitch()
+        self._lock = threading.RLock()
+        self.tasks: dict[str, dict] = {}  # task_id -> record
+        self._done_units: dict[int, set[int]] = {}  # disk -> unit indexes done
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---------------- task generation ----------------
+    def collect_broken_disks(self) -> list[int]:
+        """Failure detector → repair work: mark heartbeat-dead disks
+        BROKEN and emit one migrate task per volume-unit on them."""
+        if not self.switch.enabled("disk_repair"):
+            return []
+        newly = []
+        for disk_id in self.cm.suspect_dead_disks():
+            self.mark_disk_broken(disk_id)
+            newly.append(disk_id)
+        return newly
+
+    def mark_disk_broken(self, disk_id: int) -> int:
+        """Explicit breakage report (blobnode disk report analog);
+        idempotent. Returns number of tasks queued."""
+        with self._lock:
+            disk = self.cm.disks[disk_id]
+            if disk.status not in (DiskStatus.NORMAL, DiskStatus.BROKEN):
+                return 0
+            self.cm.set_disk_status(disk_id, DiskStatus.REPAIRING)
+            n = 0
+            for vid, unit_index in self.cm.volumes_on_disk(disk_id):
+                self._queue_unit_repair(vid, unit_index, reason=f"disk {disk_id} broken",
+                                        src_disk=disk_id)
+                n += 1
+            if n == 0:
+                self.cm.set_disk_status(disk_id, DiskStatus.REPAIRED)
+            return n
+
+    def _queue_unit_repair(self, vid: int, unit_index: int, reason: str,
+                           src_disk: int | None = None) -> str:
+        with self._lock:
+            for t in self.tasks.values():
+                if (t["vid"] == vid and t["unit_index"] == unit_index
+                        and t["state"] in ("pending", "leased")):
+                    return t["task_id"]  # idempotent re-queue
+            vol = self.cm.get_volume(vid)
+            exclude = {u.disk_id for u in vol.units}
+            dest = self.cm.pick_destination(exclude)
+            task = {
+                "task_id": uuid.uuid4().hex[:16],
+                "type": "unit_repair",
+                "vid": vid,
+                "unit_index": unit_index,
+                "codemode": vol.codemode,
+                "src_disk": src_disk,
+                "dest_disk": dest.disk_id,
+                "dest_chunk": self.cm.alloc_chunk_id(),
+                "dest_addr": dest.node_addr,
+                "state": "pending",
+                "lease_until": 0.0,
+                "worker": None,
+                "attempts": 0,
+                "reason": reason,
+            }
+            self.tasks[task["task_id"]] = task
+            return task["task_id"]
+
+    def drop_disk(self, disk_id: int) -> int:
+        """Planned decommission: same migrate machinery, healthy source."""
+        with self._lock:
+            self.cm.set_disk_status(disk_id, DiskStatus.REPAIRING)
+            n = 0
+            for vid, unit_index in self.cm.volumes_on_disk(disk_id):
+                self._queue_unit_repair(vid, unit_index,
+                                        reason=f"disk {disk_id} drop", src_disk=disk_id)
+                n += 1
+            return n
+
+    # ---------------- queue consumers ----------------
+    def consume_repair_msgs(self, max_n: int = 64) -> int:
+        """Shard-repair events from access (failed PUT shards, degraded
+        GETs) → unit repair tasks."""
+        if self.repair_queue is None or not self.switch.enabled("shard_repair"):
+            return 0
+        msgs = self.repair_queue.poll(max_n)
+        n = 0
+        for off, msg in msgs:
+            if msg.get("type") == "shard_repair":
+                self._queue_unit_repair(msg["vid"], msg["bad_index"],
+                                        reason="shard repair msg")
+                n += 1
+            self.repair_queue.ack(off)
+        return n
+
+    def consume_delete_msgs(self, max_n: int = 64) -> int:
+        if self.delete_queue is None or not self.switch.enabled("blob_delete"):
+            return 0
+        msgs = self.delete_queue.poll(max_n)
+        n = 0
+        for off, msg in msgs:
+            if msg.get("type") == "blob_delete":
+                self._delete_blobs(msg["vid"], msg["min_bid"], msg["count"])
+                n += 1
+            self.delete_queue.ack(off)
+        return n
+
+    def _delete_blobs(self, vid: int, min_bid: int, count: int) -> None:
+        vol = self.cm.get_volume(vid)
+        for k in range(count):
+            bid = min_bid + k
+            for u in vol.units:
+                try:
+                    self.nodes.get(u.node_addr).call(
+                        "delete_shard",
+                        {"disk_id": u.disk_id, "chunk_id": u.chunk_id, "bid": bid},
+                    )
+                except rpc.RpcError:
+                    pass
+
+    # ---------------- task leasing (worker API) ----------------
+    def acquire_task(self, worker_id: str) -> dict | None:
+        now = time.time()
+        with self._lock:
+            for t in self.tasks.values():
+                if t["state"] == "leased" and t["lease_until"] < now:
+                    t["state"] = "pending"  # lease expired -> requeue
+                if t["state"] == "pending":
+                    t["state"] = "leased"
+                    t["worker"] = worker_id
+                    t["attempts"] += 1
+                    t["lease_until"] = now + self.LEASE_SECONDS
+                    return dict(t)
+            return None
+
+    def renew_task(self, task_id: str, worker_id: str) -> bool:
+        with self._lock:
+            t = self.tasks.get(task_id)
+            if t and t["state"] == "leased" and t["worker"] == worker_id:
+                t["lease_until"] = time.time() + self.LEASE_SECONDS
+                return True
+            return False
+
+    def complete_task(self, task_id: str, worker_id: str) -> None:
+        with self._lock:
+            t = self.tasks.get(task_id)
+            if not t or t["worker"] != worker_id or t["state"] != "leased":
+                return  # stale completion; writeback already idempotent
+            t["state"] = "done"
+            self.cm.update_volume_unit(
+                t["vid"], t["unit_index"], t["dest_disk"], t["dest_chunk"],
+                t["dest_addr"],
+            )
+            src = t.get("src_disk")
+            if src is not None:
+                pending = any(
+                    x.get("src_disk") == src and x["state"] != "done"
+                    for x in self.tasks.values()
+                )
+                if not pending:
+                    self.cm.set_disk_status(src, DiskStatus.REPAIRED)
+
+    def fail_task(self, task_id: str, worker_id: str, error: str) -> None:
+        with self._lock:
+            t = self.tasks.get(task_id)
+            if t and t["worker"] == worker_id:
+                t["state"] = "pending"
+                t["last_error"] = error
+
+    def stats(self) -> dict:
+        with self._lock:
+            by_state: dict[str, int] = {}
+            for t in self.tasks.values():
+                by_state[t["state"]] = by_state.get(t["state"], 0) + 1
+            return {"tasks": by_state,
+                    "repair_backlog": self.repair_queue.backlog() if self.repair_queue else 0,
+                    "delete_backlog": self.delete_queue.backlog() if self.delete_queue else 0}
+
+    # ---------------- background loop ----------------
+    def start(self, interval: float = 1.0) -> None:
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.collect_broken_disks()
+                    self.consume_repair_msgs()
+                    self.consume_delete_msgs()
+                except Exception:
+                    pass  # leader loop must survive transient errors
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ---------------- RPC surface ----------------
+    def rpc_acquire_task(self, args, body):
+        t = self.acquire_task(args["worker_id"])
+        return {"task": t}
+
+    def rpc_renew_task(self, args, body):
+        return {"ok": self.renew_task(args["task_id"], args["worker_id"])}
+
+    def rpc_complete_task(self, args, body):
+        self.complete_task(args["task_id"], args["worker_id"])
+        return {}
+
+    def rpc_fail_task(self, args, body):
+        self.fail_task(args["task_id"], args["worker_id"], args.get("error", ""))
+        return {}
+
+    def rpc_stats(self, args, body):
+        return self.stats()

@@ -1,0 +1,171 @@
+"""Repair worker: pulls unit-repair tasks and reconstructs on the TPU.
+
+Role parity: blobstore/blobnode worker (loopAcquireTask at
+worker_service.go:206; ShardRecover download-and-reconstruct at
+worker_slice_recover.go:458,865; CRC cross-check at :45).
+
+TPU-first redesign: instead of reconstructing blob-by-blob, a task's
+blobs are grouped by shard size and recovered as BATCHED stripe stacks
+(B, n, S) in one device call — the migrate fleet's throughput rides the
+batch dimension.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import defaultdict
+
+import numpy as np
+
+from ..ops import rs_kernel
+from ..codec import codemode as cm
+from ..codec.engine import get_engine
+from ..utils import rpc
+from .types import VolumeInfo
+
+
+class RepairWorker:
+    def __init__(self, scheduler_client: rpc.Client, cm_client: rpc.Client,
+                 node_pool, engine: str | None = None,
+                 worker_id: str | None = None, batch_stripes: int = 64):
+        self.sched = scheduler_client
+        self.cm = cm_client
+        self.nodes = node_pool
+        self.engine = get_engine(engine)
+        self.worker_id = worker_id or uuid.uuid4().hex[:12]
+        self.batch_stripes = batch_stripes
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.completed = 0
+        self.failed = 0
+
+    # ---------------- loop ----------------
+    def start(self, idle_wait: float = 0.5) -> None:
+        def loop():
+            while not self._stop.wait(0 if self.run_once() else idle_wait):
+                pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run_once(self) -> bool:
+        """Acquire and execute one task; returns True if one was run."""
+        meta, _ = self.sched.call("acquire_task", {"worker_id": self.worker_id})
+        task = meta.get("task")
+        if not task:
+            return False
+        try:
+            self.execute(task)
+            self.sched.call("complete_task",
+                            {"task_id": task["task_id"], "worker_id": self.worker_id})
+            self.completed += 1
+        except Exception as e:
+            self.sched.call(
+                "fail_task",
+                {"task_id": task["task_id"], "worker_id": self.worker_id,
+                 "error": f"{type(e).__name__}: {e}"},
+            )
+            self.failed += 1
+        return True
+
+    # ---------------- execution ----------------
+    def execute(self, task: dict) -> None:
+        vol = VolumeInfo.from_dict(
+            self.cm.call("get_volume", {"vid": task["vid"]})[0]["volume"]
+        )
+        t = cm.tactic(vol.codemode)
+        bad = int(task["unit_index"])
+
+        # discover the blob population from surviving units' chunk listings
+        bids = self._list_bids(vol, exclude=bad)
+        dest = self.nodes.get(task["dest_addr"])
+        if not bids:
+            return  # empty chunk: nothing to rebuild
+
+        # choose the read set: prefer the bad unit's local stripe peers
+        # when an LRC local repair is possible (intra-AZ bandwidth), else
+        # the global stripe. code_pos maps unit index -> index within the
+        # solving code's shard space.
+        local_idx, ln, lm = t.local_stripe(bad) if t.l else ([], 0, 0)
+        if local_idx and bad in local_idx:
+            read_set = [i for i in local_idx if i != bad]
+            n_solve, total_code = ln, ln + lm
+            code_pos = {u: s for s, u in enumerate(local_idx)}
+            bad_sub = code_pos[bad]
+        else:
+            read_set = [i for i in range(t.n + t.m) if i != bad]
+            n_solve, total_code = t.n, t.n + t.m
+            code_pos = {u: u for u in read_set}
+            bad_sub = bad
+
+        # per-bid survivor reads; the ACTUALLY-read survivor set selects
+        # the decode matrix, so per-shard read failures mid-task are fine
+        by_key: dict[tuple, list] = defaultdict(list)
+        for bid in bids:
+            subs, shards = self._read_survivors(vol, read_set, code_pos, bid, n_solve)
+            by_key[(len(shards[0]), tuple(subs))].append((bid, shards))
+
+        for (size, subs), group in by_key.items():
+            rows = rs_kernel.reconstruct_rows(
+                n_solve, total_code, list(subs), [bad_sub]
+            )
+            for start in range(0, len(group), self.batch_stripes):
+                chunk = group[start : start + self.batch_stripes]
+                batch = np.stack([
+                    np.stack([np.frombuffer(s, dtype=np.uint8) for s in shards])
+                    for _, shards in chunk
+                ])  # (B, n_solve, size)
+                recovered = self.engine.matrix_apply(rows, batch)  # (B, 1, size)
+                for (bid, _), rec in zip(chunk, recovered):
+                    dest.call(
+                        "put_shard",
+                        {"disk_id": task["dest_disk"],
+                         "chunk_id": task["dest_chunk"], "bid": bid},
+                        rec[0].tobytes(),
+                    )
+                self.sched.call("renew_task", {"task_id": task["task_id"],
+                                               "worker_id": self.worker_id})
+
+    def _list_bids(self, vol: VolumeInfo, exclude: int) -> list[int]:
+        for u in vol.units:
+            if u.index == exclude:
+                continue
+            try:
+                meta, _ = self.nodes.get(u.node_addr).call(
+                    "list_chunk", {"disk_id": u.disk_id, "chunk_id": u.chunk_id}
+                )
+                return [b for b, _, _ in meta["shards"]]
+            except rpc.RpcError:
+                continue
+        raise RuntimeError(f"vid {vol.vid}: no unit listable")
+
+    def _read_survivors(
+        self, vol: VolumeInfo, read_set: list[int], code_pos: dict[int, int],
+        bid: int, n_solve: int,
+    ) -> tuple[list[int], list[bytes]]:
+        """Read n_solve survivors for bid; returns (code-space indices of
+        the shards actually read, shard payloads), ascending."""
+        subs: list[int] = []
+        shards: list[bytes] = []
+        for idx in read_set:
+            if len(shards) == n_solve:
+                break
+            u = vol.units[idx]
+            try:
+                _, payload = self.nodes.get(u.node_addr).call(
+                    "get_shard",
+                    {"disk_id": u.disk_id, "chunk_id": u.chunk_id, "bid": bid},
+                )
+            except rpc.RpcError:
+                continue
+            subs.append(code_pos[idx])
+            shards.append(payload)
+        if len(shards) < n_solve:
+            raise RuntimeError(f"bid {bid}: only {len(shards)}/{n_solve} survivors")
+        order = np.argsort(subs)
+        return [subs[i] for i in order], [shards[i] for i in order]

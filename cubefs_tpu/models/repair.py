@@ -23,10 +23,9 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh
 
-from ..ops import bitlin, crc32_kernel, gf256, rs_kernel
+from ..ops import bitlin, crc32_kernel, rs_kernel
 from ..parallel import sharded_codec
 
 

@@ -151,18 +151,34 @@ def _crc_block_fn(block_len: int, chunk_len: int):
     return crc
 
 
+def fit_chunk_len(chunk_len: int, total_len: int) -> int:
+    """Largest divisor of total_len that is <= chunk_len (>=1), so any
+    block length is chunkable without caller-side divisibility math."""
+    if total_len <= chunk_len:
+        return total_len
+    best = 1
+    d = 1
+    while d * d <= total_len:
+        if total_len % d == 0:
+            if d <= chunk_len:
+                best = max(best, d)
+            if total_len // d <= chunk_len:
+                best = max(best, total_len // d)
+        d += 1
+    return best
+
+
 def crc32_blocks(
     blocks: jax.Array, chunk_len: int = 1024
 ) -> jax.Array:
     """Batched zlib-compatible CRC32 of equal-length blocks.
 
-    blocks: (B, block_len) uint8; block_len must be a multiple of
-    chunk_len. Returns (B,) uint32, bit-identical to zlib.crc32/Go
-    hash/crc32.ChecksumIEEE per block.
+    blocks: (B, block_len) uint8 -> (B,) uint32, bit-identical to
+    zlib.crc32 / Go hash/crc32.ChecksumIEEE per block. chunk_len is a
+    target: the largest divisor of block_len <= chunk_len is used.
     """
     block_len = int(blocks.shape[-1])
-    chunk_len = min(chunk_len, block_len)
-    return _crc_block_fn(block_len, chunk_len)(blocks)
+    return _crc_block_fn(block_len, fit_chunk_len(chunk_len, block_len))(blocks)
 
 
 @functools.cache

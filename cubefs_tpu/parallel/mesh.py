@@ -39,6 +39,11 @@ def factor_mesh(n_devices: int) -> dict[str, int]:
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"asked for a {n_devices}-device mesh but only "
+                f"{len(devices)} devices exist"
+            )
         devices = devices[:n_devices]
     dims = factor_mesh(len(devices))
     dev_array = np.asarray(devices).reshape(dims["dp"], dims["tp"], dims["sp"])

@@ -23,13 +23,15 @@ not ICI-bound.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+if hasattr(jax, "shard_map"):  # jax >= 0.8 canonical API
+    shard_map = jax.shard_map
+else:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 
 from ..ops import bitlin, crc32_kernel, gf256, rs_kernel
 
@@ -58,7 +60,6 @@ def gf_matrix_apply_sharded(
         mesh=mesh,
         in_specs=(P("dp", "tp", "sp"),),
         out_specs=P("dp", None, "sp"),
-        check_rep=False,
     )
 
 
@@ -76,7 +77,7 @@ def crc32_sharded(mesh: Mesh, seg_len_total: int, chunk_len: int = 512) -> calla
     if seg_len_total % sp:
         raise ValueError(f"segment {seg_len_total} not divisible by sp={sp}")
     local_len = seg_len_total // sp
-    chunk_len = min(chunk_len, local_len)
+    chunk_len = crc32_kernel.fit_chunk_len(chunk_len, local_len)
     # device d's local linear part must be zero-extended by the bytes that
     # come AFTER it: (sp-1-d) * local_len.
     shifts = np.stack(
@@ -100,5 +101,4 @@ def crc32_sharded(mesh: Mesh, seg_len_total: int, chunk_len: int = 512) -> calla
         mesh=mesh,
         in_specs=(P("dp", "sp"),),
         out_specs=P("dp"),
-        check_rep=False,
     )

@@ -1,0 +1,76 @@
+"""Build + load the native runtime (ctypes, no pybind11).
+
+g++ compiles cubefs_tpu/runtime/src/*.cc into libcubefs_rt.so next to
+this file; rebuilt automatically when sources are newer than the .so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_SO = os.path.join(_DIR, "libcubefs_rt.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    return any(
+        os.path.getmtime(os.path.join(_SRC, f)) > so_mtime
+        for f in os.listdir(_SRC)
+        if f.endswith((".cc", ".h"))
+    )
+
+
+def build() -> str:
+    srcs = [
+        os.path.join(_SRC, f) for f in sorted(os.listdir(_SRC)) if f.endswith(".cc")
+    ]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, *srcs]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return _SO
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            if _needs_build():
+                build()
+            lib = ctypes.CDLL(_SO)
+            c = ctypes
+            lib.cs_open.restype = c.c_void_p
+            lib.cs_open.argtypes = [c.c_char_p]
+            lib.cs_close.argtypes = [c.c_void_p]
+            lib.cs_last_error.restype = c.c_char_p
+            lib.cs_last_error.argtypes = [c.c_void_p]
+            lib.cs_create_chunk.argtypes = [c.c_void_p, c.c_uint64]
+            lib.cs_put_shard.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_uint64,
+                c.c_char_p, c.c_uint32, c.POINTER(c.c_uint32),
+            ]
+            lib.cs_get_shard.restype = c.c_int64
+            lib.cs_get_shard.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_uint64,
+                c.c_void_p, c.c_uint32, c.POINTER(c.c_uint32),
+            ]
+            lib.cs_delete_shard.argtypes = [c.c_void_p, c.c_uint64, c.c_uint64]
+            lib.cs_list_shards.restype = c.c_int64
+            lib.cs_list_shards.argtypes = [
+                c.c_void_p, c.c_uint64,
+                c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64,
+            ]
+            lib.cs_shard_count.restype = c.c_int64
+            lib.cs_shard_count.argtypes = [c.c_void_p, c.c_uint64]
+            lib.cs_sync.argtypes = [c.c_void_p, c.c_uint64]
+            lib.cs_crc32.restype = c.c_uint32
+            lib.cs_crc32.argtypes = [c.c_char_p, c.c_uint64]
+            _lib = lib
+    return _lib

@@ -1,0 +1,314 @@
+// Native chunk-store runtime: the blobnode disk engine.
+//
+// Role parity: reference blobstore/blobnode/core (chunk data files with
+// crc32block framing at core/storage/datafile.go:304-379 + RocksDB shard
+// meta). This implementation is TPU-framework-native: a C++ engine with a
+// C ABI consumed via ctypes (no cgo), storing
+//   <dir>/chunk_<id>.data   — append-only shard payloads
+//   <dir>/chunk_<id>.idx    — append-only fixed-width index records
+// Shard lookup state is rebuilt from the index log at open (last record
+// wins, delete records tombstone). CRC32 (IEEE, slicing-by-8) is computed
+// on write and verified on read — this is also the CPU baseline the TPU
+// CRC kernel is compared against.
+//
+// Build: g++ -O3 -shared -fPIC -o libcubefs_rt.so chunkstore.cc
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cerrno>
+#include <string>
+#include <unordered_map>
+#include <map>
+#include <mutex>
+#include <vector>
+#include <fcntl.h>
+#include <unistd.h>
+#include <sys/stat.h>
+
+namespace {
+
+// ---------------- CRC32 (IEEE reflected), slicing-by-8 ----------------
+struct CrcTables {
+  uint32_t t[8][256];
+  CrcTables() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c >> 1) ^ ((c & 1) ? 0xEDB88320u : 0);
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+      for (int j = 1; j < 8; j++)
+        t[j][i] = (t[j - 1][i] >> 8) ^ t[0][t[j - 1][i] & 0xFF];
+  }
+};
+const CrcTables kCrc;
+
+uint32_t crc32_ieee(uint32_t crc, const uint8_t* p, size_t n) {
+  crc = ~crc;
+  while (n >= 8) {
+    crc ^= (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+           ((uint32_t)p[3] << 24);
+    uint32_t hi = (uint32_t)p[4] | ((uint32_t)p[5] << 8) |
+                  ((uint32_t)p[6] << 16) | ((uint32_t)p[7] << 24);
+    crc = kCrc.t[7][crc & 0xFF] ^ kCrc.t[6][(crc >> 8) & 0xFF] ^
+          kCrc.t[5][(crc >> 16) & 0xFF] ^ kCrc.t[4][crc >> 24] ^
+          kCrc.t[3][hi & 0xFF] ^ kCrc.t[2][(hi >> 8) & 0xFF] ^
+          kCrc.t[1][(hi >> 16) & 0xFF] ^ kCrc.t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = (crc >> 8) ^ kCrc.t[0][(crc ^ *p++) & 0xFF];
+  return ~crc;
+}
+
+// ---------------- index records ----------------
+struct __attribute__((packed)) IdxRec {
+  uint64_t bid;      // blob id
+  uint64_t offset;   // offset in .data file
+  uint32_t size;     // payload bytes
+  uint32_t crc;      // payload crc32
+  uint32_t flags;    // 1 = delete tombstone
+  uint32_t rec_crc;  // crc of the preceding fields
+};
+
+struct ShardLoc {
+  uint64_t offset;
+  uint32_t size;
+  uint32_t crc;
+};
+
+struct Chunk {
+  int data_fd = -1;
+  int idx_fd = -1;
+  uint64_t data_end = 0;
+  std::map<uint64_t, ShardLoc> shards;  // ordered for list-scans
+  std::mutex mu;
+};
+
+struct Store {
+  std::string dir;
+  std::unordered_map<uint64_t, Chunk*> chunks;
+  std::mutex mu;
+  char err[256] = {0};
+};
+
+thread_local char g_err[256];
+
+void set_err(Store* s, const char* msg) {
+  snprintf(s ? s->err : g_err, 256, "%s (errno=%d %s)", msg, errno,
+           errno ? strerror(errno) : "");
+}
+
+std::string chunk_path(Store* s, uint64_t id, const char* ext) {
+  char buf[64];
+  snprintf(buf, sizeof buf, "/chunk_%016llx.%s", (unsigned long long)id, ext);
+  return s->dir + buf;
+}
+
+bool load_chunk(Store* s, uint64_t id, Chunk* c) {
+  std::string dp = chunk_path(s, id, "data"), ip = chunk_path(s, id, "idx");
+  c->data_fd = ::open(dp.c_str(), O_RDWR | O_CREAT, 0644);
+  c->idx_fd = ::open(ip.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
+  if (c->data_fd < 0 || c->idx_fd < 0) {
+    set_err(s, "open chunk files");
+    return false;
+  }
+  struct stat st;
+  fstat(c->data_fd, &st);
+  c->data_end = (uint64_t)st.st_size;
+  // replay index log; torn/corrupt tail records are ignored (crash safety)
+  IdxRec r;
+  off_t pos = 0;
+  while (pread(c->idx_fd, &r, sizeof r, pos) == (ssize_t)sizeof r) {
+    uint32_t expect = crc32_ieee(0, (const uint8_t*)&r, sizeof r - 4);
+    if (r.rec_crc != expect) break;
+    if (r.flags & 1)
+      c->shards.erase(r.bid);
+    else
+      c->shards[r.bid] = ShardLoc{r.offset, r.size, r.crc};
+    pos += sizeof r;
+  }
+  return true;
+}
+
+bool append_idx(Store* s, Chunk* c, const IdxRec& rec) {
+  IdxRec r = rec;
+  r.rec_crc = crc32_ieee(0, (const uint8_t*)&r, sizeof r - 4);
+  if (write(c->idx_fd, &r, sizeof r) != (ssize_t)sizeof r) {
+    set_err(s, "append idx");
+    return false;
+  }
+  return true;
+}
+
+Chunk* get_chunk(Store* s, uint64_t id, bool create) {
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->chunks.find(id);
+  if (it != s->chunks.end()) return it->second;
+  if (!create) {
+    // lazily open if files exist on disk
+    std::string dp = chunk_path(s, id, "data");
+    if (access(dp.c_str(), F_OK) != 0) {
+      set_err(s, "no such chunk");
+      return nullptr;
+    }
+  }
+  Chunk* c = new Chunk();
+  if (!load_chunk(s, id, c)) {
+    delete c;
+    return nullptr;
+  }
+  s->chunks[id] = c;
+  return c;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* cs_open(const char* dir) {
+  Store* s = new Store();
+  s->dir = dir;
+  ::mkdir(dir, 0755);
+  struct stat st;
+  if (stat(dir, &st) != 0 || !S_ISDIR(st.st_mode)) {
+    set_err(nullptr, "store dir unusable");
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void cs_close(void* h) {
+  Store* s = (Store*)h;
+  if (!s) return;
+  for (auto& kv : s->chunks) {
+    if (kv.second->data_fd >= 0) ::close(kv.second->data_fd);
+    if (kv.second->idx_fd >= 0) ::close(kv.second->idx_fd);
+    delete kv.second;
+  }
+  delete s;
+}
+
+const char* cs_last_error(void* h) { return h ? ((Store*)h)->err : g_err; }
+
+int cs_create_chunk(void* h, uint64_t chunk_id) {
+  Store* s = (Store*)h;
+  return get_chunk(s, chunk_id, true) ? 0 : -1;
+}
+
+// Write a shard; returns 0 and fills out_crc. Overwrite of an existing
+// bid appends new data and repoints the index (last-wins), matching the
+// append-only chunk file + meta-update model.
+int cs_put_shard(void* h, uint64_t chunk_id, uint64_t bid, const uint8_t* buf,
+                 uint32_t len, uint32_t* out_crc) {
+  Store* s = (Store*)h;
+  Chunk* c = get_chunk(s, chunk_id, true);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  uint32_t crc = crc32_ieee(0, buf, len);
+  uint64_t off = c->data_end;
+  ssize_t wr = pwrite(c->data_fd, buf, len, (off_t)off);
+  if (wr != (ssize_t)len) {
+    set_err(s, "pwrite shard");
+    return -1;
+  }
+  c->data_end += len;
+  IdxRec rec{bid, off, len, crc, 0, 0};
+  if (!append_idx(s, c, rec)) return -1;
+  c->shards[bid] = ShardLoc{off, len, crc};
+  if (out_crc) *out_crc = crc;
+  return 0;
+}
+
+// Returns shard size, or -1 (missing) / -2 (crc mismatch) / -3 (short buf).
+int64_t cs_get_shard(void* h, uint64_t chunk_id, uint64_t bid, uint8_t* buf,
+                     uint32_t buf_len, uint32_t* out_crc) {
+  Store* s = (Store*)h;
+  Chunk* c = get_chunk(s, chunk_id, false);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->shards.find(bid);
+  if (it == c->shards.end()) {
+    set_err(s, "shard not found");
+    return -1;
+  }
+  const ShardLoc& loc = it->second;
+  if (buf_len < loc.size) {
+    set_err(s, "buffer too small");
+    return -3;
+  }
+  if (pread(c->data_fd, buf, loc.size, (off_t)loc.offset) != (ssize_t)loc.size) {
+    set_err(s, "pread shard");
+    return -1;
+  }
+  uint32_t crc = crc32_ieee(0, buf, loc.size);
+  if (out_crc) *out_crc = crc;
+  if (crc != loc.crc) {
+    set_err(s, "crc mismatch");
+    return -2;
+  }
+  return (int64_t)loc.size;
+}
+
+int cs_delete_shard(void* h, uint64_t chunk_id, uint64_t bid) {
+  Store* s = (Store*)h;
+  Chunk* c = get_chunk(s, chunk_id, false);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->shards.find(bid);
+  if (it == c->shards.end()) {
+    set_err(s, "shard not found");
+    return -1;
+  }
+  IdxRec rec{bid, 0, 0, 0, 1, 0};
+  if (!append_idx(s, c, rec)) return -1;
+  c->shards.erase(it);
+  return 0;
+}
+
+// Fill up to cap entries with (bid, size, crc) triples; returns count.
+int64_t cs_list_shards(void* h, uint64_t chunk_id, uint64_t* bids,
+                       uint32_t* sizes, uint32_t* crcs, int64_t cap) {
+  Store* s = (Store*)h;
+  Chunk* c = get_chunk(s, chunk_id, false);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  int64_t i = 0;
+  for (auto& kv : c->shards) {
+    if (i >= cap) break;
+    bids[i] = kv.first;
+    sizes[i] = kv.second.size;
+    crcs[i] = kv.second.crc;
+    i++;
+  }
+  return i;
+}
+
+int64_t cs_shard_count(void* h, uint64_t chunk_id) {
+  Store* s = (Store*)h;
+  Chunk* c = get_chunk(s, chunk_id, false);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  return (int64_t)c->shards.size();
+}
+
+int cs_sync(void* h, uint64_t chunk_id) {
+  Store* s = (Store*)h;
+  Chunk* c = get_chunk(s, chunk_id, false);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  if (fsync(c->data_fd) != 0 || fsync(c->idx_fd) != 0) {
+    set_err(s, "fsync");
+    return -1;
+  }
+  return 0;
+}
+
+// CPU CRC baseline entry point (benchmarked against the TPU kernel).
+uint32_t cs_crc32(const uint8_t* buf, uint64_t len) {
+  return crc32_ieee(0, buf, len);
+}
+
+}  // extern "C"

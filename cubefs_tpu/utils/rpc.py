@@ -1,0 +1,156 @@
+"""Minimal HTTP RPC: JSON args + binary payloads (stdlib only).
+
+The blob plane's control/data transport (role parity with the
+reference's blobstore/common/rpc HTTP/JSON framework). Handlers are
+plain methods on service objects; the same objects can be called
+in-process (the mocktest pattern) or served over HTTP.
+
+Wire shape: POST /method with JSON args in the `X-Rpc-Args` header and
+an optional raw binary body; response mirrors it (`X-Rpc-Resp` header +
+binary body). Errors return HTTP 4xx/5xx with a JSON error message.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class RpcError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"rpc {code}: {message}")
+        self.code = code
+        self.message = message
+
+
+class ServiceUnavailable(RpcError):
+    pass
+
+
+def expose(obj) -> dict:
+    """Collect rpc_* methods from a service object into a route table."""
+    return {
+        name[len("rpc_") :]: getattr(obj, name)
+        for name in dir(obj)
+        if name.startswith("rpc_") and callable(getattr(obj, name))
+    }
+
+
+class RpcServer:
+    """Threaded HTTP server over a route table of callables
+    fn(args: dict, body: bytes) -> (dict, bytes) | dict | bytes | None."""
+
+    def __init__(self, routes: dict, host: str = "127.0.0.1", port: int = 0):
+        self.routes = dict(routes)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                name = self.path.lstrip("/")
+                fn = outer.routes.get(name)
+                if fn is None:
+                    self._reply(404, {"error": f"no such method {name!r}"}, b"")
+                    return
+                try:
+                    args = json.loads(self.headers.get("X-Rpc-Args") or "{}")
+                    n = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(n) if n else b""
+                    out = fn(args, body)
+                    meta, payload = _normalize(out)
+                    self._reply(200, meta, payload)
+                except RpcError as e:
+                    self._reply(e.code, {"error": e.message}, b"")
+                except Exception as e:  # surface as 500 with the message
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"}, b"")
+
+            def _reply(self, code: int, meta: dict, payload: bytes):
+                self.send_response(code)
+                self.send_header("X-Rpc-Resp", json.dumps(meta))
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.addr = f"{host}:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    def start(self) -> "RpcServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _normalize(out) -> tuple[dict, bytes]:
+    if out is None:
+        return {}, b""
+    if isinstance(out, tuple):
+        meta, payload = out
+        return meta or {}, payload or b""
+    if isinstance(out, (bytes, bytearray, memoryview)):
+        return {}, bytes(out)
+    return out, b""
+
+
+def call(
+    addr: str, method: str, args: dict | None = None, body: bytes = b"",
+    timeout: float = 30.0,
+) -> tuple[dict, bytes]:
+    """Invoke method on a remote RpcServer; returns (meta, payload)."""
+    req = urllib.request.Request(
+        f"http://{addr}/{method}",
+        data=body or b"",
+        headers={"X-Rpc-Args": json.dumps(args or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            meta = json.loads(resp.headers.get("X-Rpc-Resp") or "{}")
+            return meta, resp.read()
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.headers.get("X-Rpc-Resp") or "{}").get("error", str(e))
+        except Exception:
+            msg = str(e)
+        raise RpcError(e.code, msg) from None
+    except (urllib.error.URLError, socket.timeout, ConnectionError) as e:
+        raise ServiceUnavailable(503, f"{addr}/{method}: {e}") from None
+
+
+class Client:
+    """Bound client: in-process (direct route table) or HTTP by address.
+
+    Keeps access/scheduler logic transport-agnostic — the in-process mode
+    is the test fixture analog of the reference's mocktest servers.
+    """
+
+    def __init__(self, target):
+        self._routes = None
+        self._addr = None
+        if isinstance(target, str):
+            self._addr = target
+        elif isinstance(target, RpcServer):
+            self._addr = target.addr
+        else:
+            self._routes = expose(target)
+
+    def call(self, method: str, args: dict | None = None, body: bytes = b"",
+             timeout: float = 30.0) -> tuple[dict, bytes]:
+        if self._routes is not None:
+            fn = self._routes.get(method)
+            if fn is None:
+                raise RpcError(404, f"no such method {method!r}")
+            return _normalize(fn(args or {}, body))
+        return call(self._addr, method, args, body, timeout)

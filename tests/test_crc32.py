@@ -58,3 +58,23 @@ def test_crc_combine_chain_matches_extent_semantics(rng):
     for c in block_crcs[1:]:
         acc = crc32_kernel.crc32_combine(acc, c, 2048)
     assert acc == zlib.crc32(blocks.tobytes())
+
+
+def test_fit_chunk_len():
+    from cubefs_tpu.ops.crc32_kernel import fit_chunk_len
+    assert fit_chunk_len(1024, 1536) == 768
+    assert fit_chunk_len(512, 768) == 384
+    assert fit_chunk_len(1024, 512) == 512
+    assert fit_chunk_len(1024, 1021) == 1021  # fits whole: one chunk
+    assert fit_chunk_len(1024, 2053) == 1  # large prime: degenerate but valid
+    assert fit_chunk_len(4096, 4096) == 4096
+
+
+def test_crc_blocks_awkward_lengths(rng):
+    import zlib
+    from cubefs_tpu.ops import crc32_kernel
+    for n in (1536, 1021, 6000):
+        blocks = rng.integers(0, 256, (3, n)).astype(np.uint8)
+        got = np.asarray(crc32_kernel.crc32_blocks(blocks))
+        expect = np.array([zlib.crc32(b.tobytes()) for b in blocks], dtype=np.uint32)
+        assert np.array_equal(got, expect), n
