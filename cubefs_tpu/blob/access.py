@@ -260,20 +260,4 @@ class AccessHandler:
         return {}
 
 
-class NodePool:
-    """Address -> client map, supporting in-process targets (tests) and
-    HTTP addresses transparently."""
-
-    def __init__(self):
-        self._clients: dict[str, rpc.Client] = {}
-        self._lock = threading.Lock()
-
-    def bind(self, addr: str, target) -> None:
-        with self._lock:
-            self._clients[addr] = rpc.Client(target)
-
-    def get(self, addr: str) -> rpc.Client:
-        with self._lock:
-            if addr not in self._clients:
-                self._clients[addr] = rpc.Client(addr)  # HTTP
-            return self._clients[addr]
+NodePool = rpc.NodePool  # canonical home: cubefs_tpu/utils/rpc.py

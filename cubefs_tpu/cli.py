@@ -1,0 +1,121 @@
+"""cfs-cli analog: cluster admin + file + blob operations.
+
+Role parity: cli/ (cobra `cfs-cli` command groups: vol, datanode,
+datapartition, user...) and blobstore/cli. Usage:
+
+  python -m cubefs_tpu.cli cluster stat --master HOST:PORT
+  python -m cubefs_tpu.cli vol create NAME --master ...
+  python -m cubefs_tpu.cli fs put LOCAL /remote --master ... --vol NAME
+  python -m cubefs_tpu.cli fs get /remote LOCAL --master ... --vol NAME
+  python -m cubefs_tpu.cli fs ls /dir  | rm | stat | mkdir
+  python -m cubefs_tpu.cli blob put LOCAL --access HOST:PORT
+  python -m cubefs_tpu.cli blob get LOCATION.json LOCAL --access ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fs(args):
+    from .fs.client import FileSystem
+    from .utils.rpc import NodePool
+    from .utils import rpc
+
+    master = rpc.Client(args.master)
+    view = master.call("client_view", {"name": args.vol})[0]["volume"]
+    return FileSystem(view, NodePool())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="cubefs-tpu-cli")
+    sub = ap.add_subparsers(dest="group", required=True)
+
+    p_cluster = sub.add_parser("cluster")
+    p_cluster.add_argument("action", choices=["stat"])
+    p_cluster.add_argument("--master")
+    p_cluster.add_argument("--clustermgr")
+
+    p_vol = sub.add_parser("vol")
+    p_vol.add_argument("action", choices=["create", "view"])
+    p_vol.add_argument("name")
+    p_vol.add_argument("--master", required=True)
+    p_vol.add_argument("--mp-count", type=int, default=3)
+    p_vol.add_argument("--dp-count", type=int, default=4)
+
+    p_fs = sub.add_parser("fs")
+    p_fs.add_argument("action",
+                      choices=["put", "get", "ls", "rm", "stat", "mkdir", "mv"])
+    p_fs.add_argument("args", nargs="*")
+    p_fs.add_argument("--master", required=True)
+    p_fs.add_argument("--vol", required=True)
+
+    p_blob = sub.add_parser("blob")
+    p_blob.add_argument("action", choices=["put", "get", "delete", "stat"])
+    p_blob.add_argument("args", nargs="*")
+    p_blob.add_argument("--access", required=True)
+
+    args = ap.parse_args(argv)
+    from .utils import rpc
+
+    if args.group == "cluster":
+        addr = args.master or args.clustermgr
+        if not addr:
+            sys.exit("need --master or --clustermgr")
+        print(json.dumps(rpc.call(addr, "stat")[0], indent=2))
+
+    elif args.group == "vol":
+        master = rpc.Client(args.master)
+        if args.action == "create":
+            out = master.call("create_volume", {
+                "name": args.name, "mp_count": args.mp_count,
+                "dp_count": args.dp_count})[0]
+        else:
+            out = master.call("client_view", {"name": args.name})[0]
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "fs":
+        fs = _fs(args)
+        a = args.args
+        if args.action == "put":
+            fs.write_file(a[1], open(a[0], "rb").read())
+            print(f"put {a[0]} -> {a[1]}")
+        elif args.action == "get":
+            data = fs.read_file(a[0])
+            open(a[1], "wb").write(data)
+            print(f"get {a[0]} -> {a[1]} ({len(data)} bytes)")
+        elif args.action == "ls":
+            for name, ino in sorted(fs.readdir(a[0] if a else "/").items()):
+                st = fs.meta.inode_get(ino)
+                print(f"{st['type']:<8} {st['size']:>12} {name}")
+        elif args.action == "rm":
+            fs.unlink(a[0])
+        elif args.action == "stat":
+            print(json.dumps(fs.stat(a[0]), indent=2, default=str))
+        elif args.action == "mkdir":
+            fs.mkdir(a[0])
+        elif args.action == "mv":
+            fs.rename(a[0], a[1])
+
+    elif args.group == "blob":
+        a = args.args
+        if args.action == "put":
+            data = open(a[0], "rb").read()
+            meta, _ = rpc.call(args.access, "put", {}, data)
+            print(json.dumps(meta["location"]))
+        elif args.action == "get":
+            loc = json.load(open(a[0]))
+            _, data = rpc.call(args.access, "get", {"location": loc})
+            open(a[1], "wb").write(data)
+            print(f"{len(data)} bytes")
+        elif args.action == "delete":
+            loc = json.load(open(a[0]))
+            rpc.call(args.access, "delete", {"location": loc})
+        elif args.action == "stat":
+            print(json.dumps(rpc.call(args.access, "stat")[0], indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""Single-binary role launcher.
+
+Role parity: cmd/cmd.go — one `cfs-server` binary dispatching on the
+"role" key of a JSON config (cmd.go:184-231), here
+`python -m cubefs_tpu.cmd -c config.json`. Each role builds its service
+object(s), serves them with the RPC layer, registers with its control
+plane, and blocks. Heartbeat loops run in daemon threads.
+
+Config keys (JSON):
+  role:        master | metanode | datanode | objectnode |
+               clustermgr | blobnode | access | scheduler
+  listen_host / listen_port: bind address (port 0 = ephemeral)
+  master_addr / clustermgr_addr / scheduler_addr: upstreams
+  data_dirs / data_dir: storage paths
+  vols: {bucket: vol_name} (objectnode)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def _serve(routes, cfg):
+    from .utils import rpc
+
+    srv = rpc.RpcServer(
+        routes, host=cfg.get("listen_host", "127.0.0.1"),
+        port=int(cfg.get("listen_port", 0)),
+    ).start()
+    print(f"[{cfg['role']}] listening on {srv.addr}", flush=True)
+    return srv
+
+
+def _heartbeat_loop(fn, interval=3.0):
+    def loop():
+        while True:
+            try:
+                fn()
+            except Exception as e:
+                print(f"heartbeat error: {e}", file=sys.stderr, flush=True)
+            time.sleep(interval)
+
+    threading.Thread(target=loop, daemon=True).start()
+
+
+def run_role(cfg: dict):
+    # NOTE: heavy imports (jax via the codec) stay inside the role
+    # branches that need them — datanode/metanode/master boot fast.
+    from .utils import rpc
+    from .utils.rpc import NodePool
+
+    role = cfg["role"]
+    pool = NodePool()
+
+    if role == "master":
+        from .fs.master import Master
+
+        svc = Master(pool, replicas=int(cfg.get("replicas", 3)),
+                     allow_single_node=bool(cfg.get("allow_single_node", False)))
+        return _serve(rpc.expose(svc), cfg), svc
+
+    if role == "metanode":
+        from .fs.metanode import MetaNode
+
+        svc = MetaNode(int(cfg.get("node_id", 0)), data_dir=cfg.get("data_dir"))
+        srv = _serve(rpc.expose(svc), cfg)
+        master = rpc.Client(cfg["master_addr"])
+        master.call("register", {"kind": "meta", "addr": srv.addr})
+        _heartbeat_loop(lambda: master.call(
+            "heartbeat", {"kind": "meta", "addr": srv.addr}))
+        return srv, svc
+
+    if role == "datanode":
+        from .fs.datanode import DataNode
+
+        # the node learns its own address only after the server binds
+        svc = DataNode(int(cfg.get("node_id", 0)), cfg["data_dir"], "pending", pool)
+        srv = _serve(rpc.expose(svc), cfg)
+        svc.addr = srv.addr
+        master = rpc.Client(cfg["master_addr"])
+        master.call("register", {"kind": "data", "addr": srv.addr})
+        _heartbeat_loop(lambda: master.call(
+            "heartbeat", {"kind": "data", "addr": srv.addr}))
+        return srv, svc
+
+    if role == "objectnode":
+        from .fs.client import FileSystem
+        from .fs.objectnode import ObjectNode
+
+        master = rpc.Client(cfg["master_addr"])
+        vols = {}
+        for bucket, vol_name in cfg.get("vols", {}).items():
+            view = master.call("client_view", {"name": vol_name})[0]["volume"]
+            vols[bucket] = FileSystem(view, pool)
+        node = ObjectNode(vols, host=cfg.get("listen_host", "127.0.0.1"),
+                          port=int(cfg.get("listen_port", 0))).start()
+        print(f"[objectnode] S3 on {node.addr}", flush=True)
+        return node, node
+
+    if role == "clustermgr":
+        from .blob.clustermgr import ClusterMgr
+
+        svc = ClusterMgr(data_dir=cfg.get("data_dir"),
+                         allow_colocated_units=bool(cfg.get("allow_colocated_units", False)))
+        return _serve(rpc.expose(svc), cfg), svc
+
+    if role == "blobnode":
+        from .blob.blobnode import BlobNode
+
+        svc = BlobNode(int(cfg.get("node_id", 0)), cfg["data_dirs"],
+                       rpc.Client(cfg["clustermgr_addr"]), addr="")
+        srv = _serve(rpc.expose(svc), cfg)
+        svc.addr = srv.addr
+        svc.register()
+        svc.start_heartbeat()
+        return srv, svc
+
+    if role == "access":
+        from .blob.access import AccessConfig, AccessHandler
+        from .blob.mq import MessageQueue
+
+        q_dir = cfg.get("queue_dir")
+        svc = AccessHandler(
+            rpc.Client(cfg["clustermgr_addr"]), pool,
+            AccessConfig(blob_size=int(cfg.get("blob_size", 8 << 20)),
+                         engine=cfg.get("ec_engine")),
+            repair_queue=MessageQueue(q_dir, "repair") if q_dir else None,
+            delete_queue=MessageQueue(q_dir, "delete") if q_dir else None,
+        )
+        return _serve(rpc.expose(svc), cfg), svc
+
+    if role == "scheduler":
+        # The scheduler colocates with clustermgr state; in multi-process
+        # deployments it owns its own ClusterMgr data dir (leader mode).
+        from .blob.clustermgr import ClusterMgr
+        from .blob.mq import MessageQueue
+        from .blob.scheduler import Scheduler
+
+        cm = ClusterMgr(data_dir=cfg.get("data_dir"))
+        q_dir = cfg.get("queue_dir")
+        svc = Scheduler(
+            cm,
+            repair_queue=MessageQueue(q_dir, "repair") if q_dir else None,
+            delete_queue=MessageQueue(q_dir, "delete") if q_dir else None,
+            node_pool=pool,
+        )
+        svc.start()
+        routes = {**rpc.expose(svc), **{f"cm_{k}": v for k, v in rpc.expose(cm).items()}}
+        return _serve(dict(routes, role=lambda a, b: {"role": "scheduler"}), cfg), svc
+
+    raise SystemExit(f"unknown role {role!r}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="cubefs-tpu-server")
+    ap.add_argument("-c", "--config", required=True, help="JSON config file")
+    args = ap.parse_args(argv)
+    cfg = json.load(open(args.config))
+    srv, _ = run_role(cfg)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

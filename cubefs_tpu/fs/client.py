@@ -1,0 +1,343 @@
+"""Client SDK: meta routing + streaming extent IO + a filesystem facade.
+
+Role parity: sdk/meta (MetaWrapper partition-range routing, meta/api.go),
+sdk/data (ExtentClient/Streamer extent pipeline, stream/extent_client.go
+:712 Write), and the FUSE client's VFS semantics (client/fs) as a
+Python file API (open/read/write/mkdir/readdir/unlink/rename/stat) —
+the gateway layers (FUSE wire protocol, S3) sit on top of this facade.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import rpc
+from . import metanode as mn
+
+
+class FsError(Exception):
+    def __init__(self, errno_: int, msg: str):
+        super().__init__(msg)
+        self.errno = errno_
+
+
+def _unwrap(fn):
+    """Map metanode RPC errors (400+errno) back to FsError."""
+    try:
+        return fn()
+    except rpc.RpcError as e:
+        if 400 <= e.code < 500:
+            raise FsError(e.code - 400, e.message) from None
+        raise
+
+
+class MetaWrapper:
+    """Routes inode/dentry ops to the owning meta partition by range."""
+
+    def __init__(self, vol_view: dict, node_pool):
+        self.mps = vol_view["mps"]
+        self.nodes = node_pool
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def _mp_for(self, ino: int) -> dict:
+        for mp in self.mps:
+            if mp["start"] <= ino < mp["end"]:
+                return mp
+        raise FsError(mn.ENOENT, f"no meta partition owns inode {ino}")
+
+    def _call(self, mp: dict, method: str, args: dict):
+        return _unwrap(lambda: self.nodes.get(mp["addr"]).call(
+            method, {"pid": mp["pid"], **args}
+        ))
+
+    def pick_create_mp(self) -> dict:
+        with self._lock:
+            mp = self.mps[self._rr % len(self.mps)]
+            self._rr += 1
+            return mp
+
+    # ---- inode/dentry API (reference sdk/meta/api.go shapes) ----
+    def inode_create(self, typ: str, mode: int = 0o644, target=None) -> dict:
+        mp = self.pick_create_mp()
+        ino = self._call(mp, "alloc_ino", {})[0]["ino"]
+        rec = {"op": "mk_inode", "ino": ino, "type": typ, "mode": mode,
+               "ts": time.time()}
+        if target is not None:
+            rec["target"] = target
+        self._call(mp, "submit", {"record": rec})
+        return self.inode_get(ino)
+
+    def inode_get(self, ino: int) -> dict:
+        mp = self._mp_for(ino)
+        return self._call(mp, "inode_get", {"ino": ino})[0]["inode"]
+
+    def inode_delete(self, ino: int) -> list:
+        mp = self._mp_for(ino)
+        res = self._call(mp, "submit", {"record": {"op": "rm_inode", "ino": ino}})
+        return res[0]["result"].get("extents", [])
+
+    def dentry_create(self, parent: int, name: str, ino: int) -> None:
+        mp = self._mp_for(parent)
+        self._call(mp, "submit", {"record": {
+            "op": "mk_dentry", "parent": parent, "name": name, "ino": ino}})
+
+    def dentry_delete(self, parent: int, name: str) -> int:
+        mp = self._mp_for(parent)
+        res = self._call(mp, "submit", {"record": {
+            "op": "rm_dentry", "parent": parent, "name": name}})
+        return res[0]["result"]["ino"]
+
+    def lookup(self, parent: int, name: str) -> int:
+        mp = self._mp_for(parent)
+        return self._call(mp, "lookup", {"parent": parent, "name": name})[0]["ino"]
+
+    def readdir(self, parent: int) -> dict[str, int]:
+        mp = self._mp_for(parent)
+        return self._call(mp, "readdir", {"parent": parent})[0]["entries"]
+
+    def dentry_count(self, parent: int) -> int:
+        mp = self._mp_for(parent)
+        return self._call(mp, "dentry_count", {"parent": parent})[0]["count"]
+
+    def append_extents(self, ino: int, extents: list[dict], size: int) -> None:
+        mp = self._mp_for(ino)
+        self._call(mp, "submit", {"record": {
+            "op": "append_extents", "ino": ino, "extents": extents,
+            "size": size, "ts": time.time()}})
+
+    def set_attr(self, ino: int, **attrs) -> None:
+        mp = self._mp_for(ino)
+        self._call(mp, "submit", {"record": {
+            "op": "set_attr", "ino": ino, **attrs, "ts": time.time()}})
+
+    def set_xattr(self, ino: int, key: str, value) -> None:
+        mp = self._mp_for(ino)
+        self._call(mp, "submit", {"record": {
+            "op": "set_xattr", "ino": ino, "key": key, "value": value}})
+
+    def truncate(self, ino: int, size: int = 0) -> list:
+        mp = self._mp_for(ino)
+        res = self._call(mp, "submit", {"record": {
+            "op": "truncate", "ino": ino, "size": size}})
+        return res[0]["result"].get("extents", [])
+
+
+class ExtentClient:
+    """Streaming extent IO against data partitions.
+
+    Write: route to a dp leader, allocate/reuse an extent, chain-write,
+    then commit the extent key to the metanode (write-then-commit order,
+    like the Streamer's flush)."""
+
+    PACKET = 128 << 10  # write packet granularity
+    EXTENT_CAP = 128 << 20  # roll to a fresh extent past this (max extent)
+
+    def __init__(self, vol_view: dict, node_pool):
+        self.dps = vol_view["dps"]
+        self.nodes = node_pool
+        self._rr = 0
+        self._lock = threading.Lock()
+        # per-inode open extent: ino -> (dp, extent_id, next_offset)
+        self._streams: dict[int, tuple[dict, int, int]] = {}
+
+    def _pick_dp(self) -> dict:
+        with self._lock:
+            dp = self.dps[self._rr % len(self.dps)]
+            self._rr += 1
+            return dp
+
+    def write(self, meta: MetaWrapper, ino: int, file_offset: int,
+              data: bytes) -> None:
+        with self._lock:
+            stream = self._streams.get(ino)
+        if stream is not None and stream[2] + len(data) > self.EXTENT_CAP:
+            stream = None  # extent full: roll to a new one
+        if stream is None:
+            dp = self._pick_dp()
+            leader = self.nodes.get(dp["leader"])
+            eid = leader.call("alloc_extent", {"dp_id": dp["dp_id"]})[0]["extent_id"]
+            ext_off = 0
+        else:
+            dp, eid, ext_off = stream
+            leader = self.nodes.get(dp["leader"])
+        written = 0
+        while written < len(data):
+            pkt = data[written : written + self.PACKET]
+            leader.call(
+                "write",
+                {"dp_id": dp["dp_id"], "extent_id": eid,
+                 "offset": ext_off + written},
+                pkt,
+            )
+            written += len(pkt)
+        meta.append_extents(
+            ino,
+            [{"dp_id": dp["dp_id"], "extent_id": eid, "ext_offset": ext_off,
+              "file_offset": file_offset, "size": len(data)}],
+            size=file_offset + len(data),
+        )
+        with self._lock:
+            self._streams[ino] = (dp, eid, ext_off + written)
+
+    def close_stream(self, ino: int) -> None:
+        with self._lock:
+            self._streams.pop(ino, None)
+
+    def _dp_by_id(self, dp_id: int) -> dict:
+        for dp in self.dps:
+            if dp["dp_id"] == dp_id:
+                return dp
+        raise FsError(5, f"unknown dp {dp_id}")
+
+    def read(self, inode: dict, offset: int, length: int) -> bytes:
+        """Assemble file bytes from the extent list (later keys win)."""
+        size = inode["size"]
+        if offset >= size:
+            return b""
+        length = min(length, size - offset)
+        out = bytearray(length)
+        for ek in inode["extents"]:
+            lo = max(offset, ek["file_offset"])
+            hi = min(offset + length, ek["file_offset"] + ek["size"])
+            if lo >= hi:
+                continue
+            dp = self._dp_by_id(ek["dp_id"])
+            data = self._read_replicated(
+                dp, ek["extent_id"], ek["ext_offset"] + (lo - ek["file_offset"]),
+                hi - lo,
+            )
+            out[lo - offset : hi - offset] = data
+        return bytes(out)
+
+    def release_extents(self, extent_keys: list[dict]) -> None:
+        """Best-effort GC of data extents freed by unlink/truncate: delete
+        each unique extent on every replica of its dp (extents are owned
+        by a single inode's stream, so key removal implies reclaim)."""
+        seen: set[tuple[int, int]] = set()
+        for ek in extent_keys:
+            key = (ek["dp_id"], ek["extent_id"])
+            if key in seen:
+                continue
+            seen.add(key)
+            try:
+                dp = self._dp_by_id(ek["dp_id"])
+            except FsError:
+                continue
+            for addr in dp["replicas"]:
+                try:
+                    self.nodes.get(addr).call(
+                        "delete_extent",
+                        {"dp_id": dp["dp_id"], "extent_id": ek["extent_id"]},
+                    )
+                except rpc.RpcError:
+                    pass  # node down: scrubber reclaims later
+
+    def _read_replicated(self, dp: dict, eid: int, off: int, ln: int) -> bytes:
+        last_err = None
+        for addr in [dp["leader"]] + [a for a in dp["replicas"] if a != dp["leader"]]:
+            try:
+                _, data = self.nodes.get(addr).call(
+                    "read", {"dp_id": dp["dp_id"], "extent_id": eid,
+                             "offset": off, "length": ln},
+                )
+                return data
+            except rpc.RpcError as e:
+                last_err = e
+        raise FsError(5, f"all replicas failed for dp {dp['dp_id']}: {last_err}")
+
+
+class FileSystem:
+    """Path-level facade over meta + data clients (the VFS layer)."""
+
+    def __init__(self, vol_view: dict, node_pool):
+        self.meta = MetaWrapper(vol_view, node_pool)
+        self.data = ExtentClient(vol_view, node_pool)
+
+    # ---- path helpers ----
+    def resolve(self, path: str) -> int:
+        ino = mn.ROOT_INO
+        for part in [p for p in path.split("/") if p]:
+            ino = self.meta.lookup(ino, part)
+        return ino
+
+    def _parent_of(self, path: str) -> tuple[int, str]:
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise FsError(22, "root has no parent")
+        parent = mn.ROOT_INO
+        for part in parts[:-1]:
+            parent = self.meta.lookup(parent, part)
+        return parent, parts[-1]
+
+    # ---- files & dirs ----
+    def mkdir(self, path: str, mode: int = 0o755) -> int:
+        parent, name = self._parent_of(path)
+        inode = self.meta.inode_create(mn.DIR, mode)
+        try:
+            self.meta.dentry_create(parent, name, inode["ino"])
+        except FsError:
+            self.meta.inode_delete(inode["ino"])
+            raise
+        return inode["ino"]
+
+    def create(self, path: str, mode: int = 0o644) -> int:
+        parent, name = self._parent_of(path)
+        inode = self.meta.inode_create(mn.FILE, mode)
+        try:
+            self.meta.dentry_create(parent, name, inode["ino"])
+        except FsError:
+            self.meta.inode_delete(inode["ino"])
+            raise
+        return inode["ino"]
+
+    def write_file(self, path: str, data: bytes, append: bool = False) -> int:
+        try:
+            ino = self.resolve(path)
+        except FsError:
+            ino = self.create(path)
+        inode = self.meta.inode_get(ino)
+        off = inode["size"] if append else 0
+        if not append and inode["size"]:
+            freed = self.meta.truncate(ino, 0)
+            self.data.close_stream(ino)
+            self.data.release_extents(freed)
+        self.data.write(self.meta, ino, off, data)
+        return ino
+
+    def read_file(self, path: str, offset: int = 0, length: int | None = None) -> bytes:
+        inode = self.meta.inode_get(self.resolve(path))
+        if length is None:
+            length = inode["size"] - offset
+        return self.data.read(inode, offset, length)
+
+    def readdir(self, path: str) -> dict[str, int]:
+        return self.meta.readdir(self.resolve(path))
+
+    def stat(self, path: str) -> dict:
+        return self.meta.inode_get(self.resolve(path))
+
+    def unlink(self, path: str) -> None:
+        parent, name = self._parent_of(path)
+        ino = self.meta.lookup(parent, name)
+        inode = self.meta.inode_get(ino)
+        if inode["type"] == mn.DIR and self.meta.dentry_count(ino) > 0:
+            raise FsError(mn.ENOTEMPTY, f"{path} not empty")
+        self.meta.dentry_delete(parent, name)
+        freed = self.meta.inode_delete(ino)
+        self.data.close_stream(ino)
+        self.data.release_extents(freed)
+
+    def rename(self, old: str, new: str) -> None:
+        old_parent, old_name = self._parent_of(old)
+        new_parent, new_name = self._parent_of(new)
+        ino = self.meta.lookup(old_parent, old_name)
+        self.meta.dentry_create(new_parent, new_name, ino)
+        self.meta.dentry_delete(old_parent, old_name)
+
+    def setxattr(self, path: str, key: str, value: str) -> None:
+        self.meta.set_xattr(self.resolve(path), key, value)
+
+    def getxattr(self, path: str, key: str):
+        return self.meta.inode_get(self.resolve(path))["xattr"].get(key)

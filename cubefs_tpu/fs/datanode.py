@@ -1,0 +1,200 @@
+"""DataNode: replicated extent storage with chain replication.
+
+Role parity: datanode/ — per-partition extent storage on the native
+engine (datanode/storage), leader→followers chain replication with ack
+aggregation (repl/repl_protocol.go:311 sendRequestToAllFollowers), CRC
+fingerprint diffing for replica repair (data_partition_repair.go:102).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from ..utils import rpc
+from .extent_store import BlockCrcError, ExtentError, ExtentStore
+
+
+class DataPartition:
+    def __init__(self, dp_id: int, path: str, peers: list[str], leader: str):
+        self.dp_id = dp_id
+        self.store = ExtentStore(path)
+        self.peers = list(peers)  # all replica addrs incl. leader
+        self.leader = leader
+        self._meta_path = os.path.join(path, "dp_meta.json")
+        self._lock = threading.Lock()
+        self.next_extent = 1
+        if os.path.exists(self._meta_path):
+            meta = json.load(open(self._meta_path))
+            self.next_extent = meta.get("next_extent", 1)
+            self.peers = meta.get("peers", self.peers)
+            self.leader = meta.get("leader", self.leader)
+        self._persist()
+
+    def _persist(self) -> None:
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"dp_id": self.dp_id, "next_extent": self.next_extent,
+                       "peers": self.peers, "leader": self.leader}, f)
+        os.replace(tmp, self._meta_path)
+
+    def alloc_extent(self) -> int:
+        with self._lock:
+            eid = self.next_extent
+            self.next_extent += 1
+            self._persist()
+            self.store.create(eid)
+            return eid
+
+
+class DataNode:
+    def __init__(self, node_id: int, root_dir: str, addr: str, node_pool):
+        self.node_id = node_id
+        self.root = root_dir
+        self.addr = addr
+        self.nodes = node_pool  # addr -> rpc client (for chain forward)
+        self.partitions: dict[int, DataPartition] = {}
+        self._lock = threading.RLock()
+        self.broken = False
+        os.makedirs(root_dir, exist_ok=True)
+        # reopen partitions found on disk
+        for name in os.listdir(root_dir):
+            if name.startswith("dp_") and os.path.isdir(os.path.join(root_dir, name)):
+                dp_id = int(name[3:])
+                self.partitions[dp_id] = DataPartition(
+                    dp_id, os.path.join(root_dir, name), [], ""
+                )
+
+    def create_partition(self, dp_id: int, peers: list[str], leader: str) -> None:
+        with self._lock:
+            if dp_id not in self.partitions:
+                self.partitions[dp_id] = DataPartition(
+                    dp_id, os.path.join(self.root, f"dp_{dp_id}"), peers, leader
+                )
+            else:
+                dp = self.partitions[dp_id]
+                dp.peers, dp.leader = list(peers), leader
+                dp._persist()
+
+    def _dp(self, dp_id: int) -> DataPartition:
+        if self.broken:
+            raise rpc.RpcError(503, f"datanode {self.addr} is down")
+        dp = self.partitions.get(dp_id)
+        if dp is None:
+            raise rpc.RpcError(404, f"dp {dp_id} not on {self.addr}")
+        return dp
+
+    # ---------------- write path (chain replication) ----------------
+    def write(self, dp_id: int, extent_id: int, offset: int, data: bytes,
+              chain: bool = True) -> None:
+        """Leader entry point: local write then parallel forward to the
+        followers; the write acks only when EVERY replica applied it
+        (3-replica strong consistency, like the repl chain)."""
+        dp = self._dp(dp_id)
+        dp.store.write(extent_id, offset, data)
+        if not chain:
+            return
+        errs = []
+        followers = [p for p in dp.peers if p != self.addr]
+        threads = []
+
+        def fwd(peer):
+            try:
+                self.nodes.get(peer).call(
+                    "write_replica",
+                    {"dp_id": dp_id, "extent_id": extent_id, "offset": offset},
+                    data, timeout=15.0,
+                )
+            except Exception as e:
+                errs.append((peer, e))
+
+        for p in followers:
+            t = threading.Thread(target=fwd, args=(p,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errs:
+            peers = ", ".join(p for p, _ in errs)
+            raise rpc.RpcError(500, f"chain write failed on {peers}: {errs[0][1]}")
+
+    def read(self, dp_id: int, extent_id: int, offset: int, length: int) -> bytes:
+        dp = self._dp(dp_id)
+        return dp.store.read(extent_id, offset, length)
+
+    # ---------------- repair (CRC fingerprint diff) ----------------
+    def extent_fingerprint(self, dp_id: int, extent_id: int) -> tuple[int, int]:
+        dp = self._dp(dp_id)
+        size = dp.store.size(extent_id)
+        if size == 0:  # absent or empty extent: nothing to fingerprint
+            return 0, 0
+        return size, dp.store.extent_crc(extent_id)
+
+    def sync_extent_from(self, dp_id: int, extent_id: int, src_addr: str) -> None:
+        """Pull a full extent from a healthy replica (streamed in 1MiB
+        spans) — the repair executor for CRC/size-diverged replicas."""
+        dp = self._dp(dp_id)
+        meta, _ = self.nodes.get(src_addr).call(
+            "extent_fingerprint", {"dp_id": dp_id, "extent_id": extent_id}
+        )
+        size = meta["size"]
+        dp.store.create(extent_id)
+        span = 1 << 20
+        for off in range(0, size, span):
+            _, chunk = self.nodes.get(src_addr).call(
+                "read", {"dp_id": dp_id, "extent_id": extent_id,
+                         "offset": off, "length": min(span, size - off)},
+            )
+            dp.store.write(extent_id, off, chunk)
+
+    # ---------------- RPC surface ----------------
+    def rpc_create_partition(self, args, body):
+        self.create_partition(args["dp_id"], args["peers"], args["leader"])
+        return {}
+
+    def rpc_alloc_extent(self, args, body):
+        return {"extent_id": self._dp(args["dp_id"]).alloc_extent()}
+
+    def rpc_write(self, args, body):
+        self.write(args["dp_id"], args["extent_id"], args["offset"], body)
+        return {}
+
+    def rpc_write_replica(self, args, body):
+        # follower leg: apply locally, never re-forward
+        self.write(args["dp_id"], args["extent_id"], args["offset"], body,
+                   chain=False)
+        return {}
+
+    def rpc_read(self, args, body):
+        try:
+            data = self.read(args["dp_id"], args["extent_id"], args["offset"],
+                             args["length"])
+        except BlockCrcError as e:
+            raise rpc.RpcError(409, str(e)) from None
+        except ExtentError as e:
+            raise rpc.RpcError(500, str(e)) from None
+        return {}, data
+
+    def rpc_extent_fingerprint(self, args, body):
+        size, crc = self.extent_fingerprint(args["dp_id"], args["extent_id"])
+        return {"size": size, "crc": crc}
+
+    def rpc_list_extents(self, args, body):
+        return {"extents": self._dp(args["dp_id"]).store.list_extents()}
+
+    def rpc_delete_extent(self, args, body):
+        self._dp(args["dp_id"]).store.delete(args["extent_id"])
+        return {}
+
+    def rpc_sync_extent_from(self, args, body):
+        self.sync_extent_from(args["dp_id"], args["extent_id"], args["src_addr"])
+        return {}
+
+    def rpc_stat(self, args, body):
+        return {"node_id": self.node_id, "partitions": sorted(self.partitions)}
+
+    def stop(self) -> None:
+        for dp in self.partitions.values():
+            dp.store.close()
+        self.partitions.clear()

@@ -1,0 +1,210 @@
+"""Master: the FS-plane resource manager.
+
+Role parity: master/ — volume lifecycle (meta-partition inode ranges +
+data-partition replica sets, cluster.go:3992 vol create / :1901 dp
+create), node registries with heartbeat health checks (cluster.go:
+851-902), and replica-repair orchestration on node death (decommission
+machinery, cluster.go:2525). Placement is least-loaded over live nodes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils import rpc
+
+INO_RANGE = 1 << 24  # inodes per meta partition
+
+
+class MasterError(Exception):
+    pass
+
+
+class Master:
+    HEARTBEAT_TIMEOUT = 10.0
+
+    def __init__(self, node_pool, replicas: int = 3, allow_single_node: bool = False):
+        self.nodes = node_pool
+        self.replicas = replicas
+        self.allow_single_node = allow_single_node
+        self._lock = threading.RLock()
+        self.datanodes: dict[str, dict] = {}  # addr -> info
+        self.metanodes: dict[str, dict] = {}
+        self.volumes: dict[str, dict] = {}
+        self._next_pid = 1
+        self._next_dp = 1
+
+    # ---------------- registries ----------------
+    def register_datanode(self, addr: str) -> None:
+        with self._lock:
+            self.datanodes.setdefault(addr, {"addr": addr})["hb"] = time.time()
+
+    def register_metanode(self, addr: str) -> None:
+        with self._lock:
+            self.metanodes.setdefault(addr, {"addr": addr})["hb"] = time.time()
+
+    def heartbeat(self, addr: str, kind: str) -> None:
+        with self._lock:
+            reg = self.datanodes if kind == "data" else self.metanodes
+            # unknown addr re-registers: a restarted master recovers its
+            # registries from the heartbeat stream
+            reg.setdefault(addr, {"addr": addr})["hb"] = time.time()
+
+    def _live(self, reg: dict) -> list[str]:
+        now = time.time()
+        return [a for a, i in reg.items() if now - i["hb"] <= self.HEARTBEAT_TIMEOUT]
+
+    # ---------------- volume lifecycle ----------------
+    def create_volume(self, name: str, mp_count: int = 3, dp_count: int = 4) -> dict:
+        with self._lock:
+            if name in self.volumes:
+                raise MasterError(f"volume {name!r} exists")
+            live_meta = self._live(self.metanodes)
+            live_data = self._live(self.datanodes)
+            if not live_meta or not live_data:
+                raise MasterError("need live metanodes and datanodes")
+            if len(live_data) < self.replicas and not self.allow_single_node:
+                raise MasterError(
+                    f"{len(live_data)} datanodes < {self.replicas} replicas"
+                )
+
+            mps = []
+            for i in range(mp_count):
+                pid = self._next_pid
+                self._next_pid += 1
+                start = 1 if i == 0 else i * INO_RANGE
+                end = (i + 1) * INO_RANGE
+                addr = live_meta[i % len(live_meta)]
+                self.nodes.get(addr).call(
+                    "create_partition", {"pid": pid, "start": start, "end": end}
+                )
+                mps.append({"pid": pid, "start": start, "end": end, "addr": addr})
+
+            dps = []
+            for i in range(dp_count):
+                dps.append(self._create_dp(live_data))
+            vol = {"name": name, "mps": mps, "dps": dps, "status": "active"}
+            self.volumes[name] = vol
+            return self.client_view(name)
+
+    def _create_dp(self, live_data: list[str]) -> dict:
+        dp_id = self._next_dp
+        self._next_dp += 1
+        k = min(self.replicas, len(live_data))
+        # least-loaded spread: count dps per node
+        load = {a: 0 for a in live_data}
+        for v in self.volumes.values():
+            for dp in v["dps"]:
+                for r in dp["replicas"]:
+                    if r in load:
+                        load[r] += 1
+        picks = sorted(live_data, key=lambda a: load[a])[:k]
+        leader = picks[0]
+        for addr in picks:
+            self.nodes.get(addr).call(
+                "create_partition",
+                {"dp_id": dp_id, "peers": picks, "leader": leader},
+            )
+        return {"dp_id": dp_id, "replicas": picks, "leader": leader}
+
+    def client_view(self, name: str) -> dict:
+        with self._lock:
+            vol = self.volumes.get(name)
+            if vol is None:
+                raise MasterError(f"no volume {name!r}")
+            return {"name": name, "mps": [dict(m) for m in vol["mps"]],
+                    "dps": [dict(d) for d in vol["dps"]]}
+
+    # ---------------- failure handling ----------------
+    def check_replicas(self) -> list[tuple[int, str, str]]:
+        """Decommission dead datanodes: for every dp with a dead replica,
+        pick a live substitute, resync its extents from a healthy peer,
+        and repoint the replica set. Returns (dp_id, dead, new) actions.
+
+        The (slow) extent copy runs OUTSIDE the master lock — heartbeats
+        must keep landing while a rebuild streams data, or healthy nodes
+        would go stale and cascade."""
+        with self._lock:
+            live = set(self._live(self.datanodes))
+            plans = []
+            for vol in self.volumes.values():
+                for dp in vol["dps"]:
+                    dead = [a for a in dp["replicas"] if a not in live]
+                    for dead_addr in dead:
+                        healthy = [a for a in dp["replicas"] if a in live]
+                        cands = [a for a in live
+                                 if a not in dp["replicas"]] or (
+                                     list(live) if self.allow_single_node else []
+                                 )
+                        if not healthy or not cands:
+                            continue
+                        plans.append((dp, dead_addr, cands[0], healthy[0]))
+        actions = []
+        for dp, dead_addr, new_addr, src in plans:
+            try:
+                self._rebuild_replica(dp, dead_addr, new_addr, src)
+                actions.append((dp["dp_id"], dead_addr, new_addr))
+            except rpc.RpcError:
+                continue  # retried on the next sweep
+        return actions
+
+    def _rebuild_replica(self, dp: dict, dead: str, new: str, src: str) -> None:
+        peers = [new if a == dead else a for a in dp["replicas"]]
+        leader = new if dp["leader"] == dead else dp["leader"]
+        self.nodes.get(new).call(
+            "create_partition", {"dp_id": dp["dp_id"], "peers": peers,
+                                 "leader": leader}
+        )
+        # copy every extent the healthy source actually has
+        src_client = self.nodes.get(src)
+        extents = src_client.call("list_extents", {"dp_id": dp["dp_id"]})[0]["extents"]
+        for eid in extents:
+            self.nodes.get(new).call(
+                "sync_extent_from",
+                {"dp_id": dp["dp_id"], "extent_id": eid, "src_addr": src},
+            )
+        # repoint every live replica's peer set, then install under lock
+        for addr in peers:
+            try:
+                self.nodes.get(addr).call(
+                    "create_partition",
+                    {"dp_id": dp["dp_id"], "peers": peers, "leader": leader},
+                )
+            except rpc.RpcError:
+                pass
+        with self._lock:
+            dp["replicas"] = peers
+            dp["leader"] = leader
+
+    # ---------------- RPC surface ----------------
+    def rpc_register(self, args, body):
+        if args["kind"] == "data":
+            self.register_datanode(args["addr"])
+        else:
+            self.register_metanode(args["addr"])
+        return {}
+
+    def rpc_heartbeat(self, args, body):
+        self.heartbeat(args["addr"], args["kind"])
+        return {}
+
+    def rpc_create_volume(self, args, body):
+        return {"volume": self.create_volume(
+            args["name"], args.get("mp_count", 3), args.get("dp_count", 4)
+        )}
+
+    def rpc_client_view(self, args, body):
+        try:
+            return {"volume": self.client_view(args["name"])}
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+
+    def rpc_check_replicas(self, args, body):
+        return {"actions": self.check_replicas()}
+
+    def rpc_stat(self, args, body):
+        with self._lock:
+            return {"datanodes": len(self.datanodes),
+                    "metanodes": len(self.metanodes),
+                    "volumes": sorted(self.volumes)}

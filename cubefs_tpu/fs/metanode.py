@@ -1,0 +1,305 @@
+"""MetaNode: partitioned in-RAM filesystem metadata.
+
+Role parity: metanode/ — a MetaPartition owns an inode-id range and
+keeps inode/dentry trees in memory (partition.go:484-524, btree.go),
+mutations flow through a single submit→apply door (partition_op_inode.go
+:205 submit, partition_fsm.go:38 Apply) and persist as an op-log +
+CRC'd snapshot with an apply-id watermark (partition_store.go). The
+apply stream is the replication interface: peers (and later raft) replay
+the same records.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+
+from ..utils import rpc
+
+ROOT_INO = 1
+
+# inode types
+DIR = "dir"
+FILE = "file"
+SYMLINK = "symlink"
+
+
+class MetaError(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = code
+
+
+ENOENT = 2
+EEXIST = 17
+ENOTDIR = 20
+ENOTEMPTY = 39
+
+
+class MetaPartition:
+    """One inode-range shard: [start, end)."""
+
+    def __init__(self, pid: int, start: int, end: int, data_dir: str | None = None):
+        self.pid = pid
+        self.start = start
+        self.end = end
+        self._lock = threading.RLock()
+        self.inodes: dict[int, dict] = {}
+        self.dentries: dict[int, dict[str, int]] = {}  # parent -> name -> ino
+        self.apply_id = 0
+        self._next_ino = start
+        self.data_dir = data_dir
+        self._oplog = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load()
+            self._oplog = open(os.path.join(data_dir, "oplog.jsonl"), "a")
+        if self.start <= ROOT_INO < self.end and ROOT_INO not in self.inodes:
+            self.apply({"op": "mk_inode", "ino": ROOT_INO, "type": DIR, "mode": 0o755})
+
+    # ---------------- apply door (replication interface) ----------------
+    def submit(self, record: dict) -> dict:
+        """Validate + apply + log one mutation; returns the result."""
+        with self._lock:
+            result = self.apply(record)
+            if self._oplog is not None:
+                self._oplog.write(json.dumps(record) + "\n")
+                self._oplog.flush()
+            return result
+
+    def apply(self, record: dict) -> dict:
+        with self._lock:
+            self.apply_id += 1
+            op = record["op"]
+            return getattr(self, f"_apply_{op}")(record)
+
+    # ---------------- snapshot / recovery ----------------
+    def snapshot(self) -> None:
+        if not self.data_dir:
+            return
+        with self._lock:
+            state = json.dumps({
+                "pid": self.pid, "start": self.start, "end": self.end,
+                "apply_id": self.apply_id, "next_ino": self._next_ino,
+                "inodes": {str(k): v for k, v in self.inodes.items()},
+                "dentries": {str(k): v for k, v in self.dentries.items()},
+            }).encode()
+            crc = zlib.crc32(state)
+            tmp = os.path.join(self.data_dir, "snap.tmp")
+            with open(tmp, "wb") as f:
+                f.write(crc.to_bytes(4, "little") + state)
+            os.replace(tmp, os.path.join(self.data_dir, "snap.bin"))
+            open(os.path.join(self.data_dir, "oplog.jsonl"), "w").close()
+            if self._oplog is not None:
+                self._oplog.close()
+            self._oplog = open(os.path.join(self.data_dir, "oplog.jsonl"), "a")
+
+    def _load(self) -> None:
+        snap = os.path.join(self.data_dir, "snap.bin")
+        if os.path.exists(snap):
+            raw = open(snap, "rb").read()
+            crc, state = int.from_bytes(raw[:4], "little"), raw[4:]
+            if zlib.crc32(state) != crc:
+                raise MetaError(5, f"snapshot crc mismatch for mp {self.pid}")
+            st = json.loads(state)
+            self.apply_id = st["apply_id"]
+            self._next_ino = st["next_ino"]
+            self.inodes = {int(k): v for k, v in st["inodes"].items()}
+            self.dentries = {int(k): v for k, v in st["dentries"].items()}
+        oplog = os.path.join(self.data_dir, "oplog.jsonl")
+        if os.path.exists(oplog):
+            for line in open(oplog):
+                line = line.strip()
+                if line:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break
+                    try:
+                        self.apply(rec)
+                    except MetaError:
+                        pass  # op failed identically at original apply time
+
+    # ---------------- inode ops ----------------
+    def alloc_ino(self) -> int:
+        with self._lock:
+            while self._next_ino in self.inodes or self._next_ino == ROOT_INO:
+                self._next_ino += 1
+            if self._next_ino >= self.end:
+                raise MetaError(28, f"mp {self.pid} inode range exhausted")
+            ino = self._next_ino
+            self._next_ino += 1  # reserve: concurrent creates stay unique
+            return ino
+
+    def _apply_mk_inode(self, r: dict) -> dict:
+        ino = r["ino"]
+        if ino in self.inodes:
+            raise MetaError(EEXIST, f"inode {ino} exists")
+        now = r.get("ts", time.time())
+        self.inodes[ino] = {
+            "ino": ino, "type": r["type"], "mode": r.get("mode", 0o644),
+            "size": 0, "nlink": 2 if r["type"] == DIR else 1,
+            "uid": r.get("uid", 0), "gid": r.get("gid", 0),
+            "mtime": now, "ctime": now, "atime": now,
+            "extents": [], "xattr": {}, "target": r.get("target"),
+        }
+        if r["type"] == DIR:
+            self.dentries.setdefault(ino, {})
+        self._next_ino = max(self._next_ino, ino + 1)
+        return {"ino": ino}
+
+    def _apply_rm_inode(self, r: dict) -> dict:
+        ino = r["ino"]
+        inode = self.inodes.pop(ino, None)
+        self.dentries.pop(ino, None)
+        return {"extents": inode["extents"] if inode else []}
+
+    def _apply_mk_dentry(self, r: dict) -> dict:
+        parent, name = r["parent"], r["name"]
+        d = self.dentries.get(parent)
+        if d is None:
+            raise MetaError(ENOENT, f"parent dir {parent} not here")
+        if name in d:
+            raise MetaError(EEXIST, f"{name!r} exists in {parent}")
+        d[name] = r["ino"]
+        return {}
+
+    def _apply_rm_dentry(self, r: dict) -> dict:
+        parent, name = r["parent"], r["name"]
+        d = self.dentries.get(parent)
+        if d is None or name not in d:
+            raise MetaError(ENOENT, f"{name!r} not in {parent}")
+        ino = d.pop(name)
+        return {"ino": ino}
+
+    def _apply_append_extents(self, r: dict) -> dict:
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            raise MetaError(ENOENT, f"inode {r['ino']}")
+        inode["extents"].extend(r["extents"])
+        inode["size"] = max(inode["size"], r.get("size", inode["size"]))
+        inode["mtime"] = r.get("ts", time.time())
+        return {}
+
+    def _apply_set_attr(self, r: dict) -> dict:
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            raise MetaError(ENOENT, f"inode {r['ino']}")
+        for k in ("mode", "uid", "gid", "size", "mtime", "atime", "nlink"):
+            if k in r:
+                inode[k] = r[k]
+        inode["ctime"] = r.get("ts", time.time())
+        return {}
+
+    def _apply_set_xattr(self, r: dict) -> dict:
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            raise MetaError(ENOENT, f"inode {r['ino']}")
+        if r.get("value") is None:
+            inode["xattr"].pop(r["key"], None)
+        else:
+            inode["xattr"][r["key"]] = r["value"]
+        return {}
+
+    def _apply_truncate(self, r: dict) -> dict:
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            raise MetaError(ENOENT, f"inode {r['ino']}")
+        inode["size"] = r["size"]
+        if r["size"] == 0:
+            old = inode["extents"]
+            inode["extents"] = []
+            return {"extents": old}
+        return {"extents": []}
+
+    # ---------------- reads (no apply) ----------------
+    def inode_get(self, ino: int) -> dict:
+        with self._lock:
+            inode = self.inodes.get(ino)
+            if inode is None:
+                raise MetaError(ENOENT, f"inode {ino}")
+            return dict(inode)
+
+    def lookup(self, parent: int, name: str) -> int:
+        with self._lock:
+            d = self.dentries.get(parent)
+            if d is None or name not in d:
+                raise MetaError(ENOENT, f"{name!r} not in {parent}")
+            return d[name]
+
+    def readdir(self, parent: int) -> dict[str, int]:
+        with self._lock:
+            d = self.dentries.get(parent)
+            if d is None:
+                raise MetaError(ENOTDIR, f"{parent} is not a dir here")
+            return dict(d)
+
+    def dentry_count(self, parent: int) -> int:
+        with self._lock:
+            return len(self.dentries.get(parent, {}))
+
+
+class MetaNode:
+    """Hosts many MetaPartitions; RPC surface for the meta SDK."""
+
+    def __init__(self, node_id: int, data_dir: str | None = None):
+        self.node_id = node_id
+        self.data_dir = data_dir
+        self.partitions: dict[int, MetaPartition] = {}
+        self._lock = threading.RLock()
+
+    def create_partition(self, pid: int, start: int, end: int) -> MetaPartition:
+        with self._lock:
+            if pid not in self.partitions:
+                pdir = os.path.join(self.data_dir, f"mp_{pid}") if self.data_dir else None
+                self.partitions[pid] = MetaPartition(pid, start, end, pdir)
+            return self.partitions[pid]
+
+    def _mp(self, pid: int) -> MetaPartition:
+        mp = self.partitions.get(pid)
+        if mp is None:
+            raise rpc.RpcError(404, f"meta partition {pid} not on node {self.node_id}")
+        return mp
+
+    # ---------------- RPC surface ----------------
+    def rpc_create_partition(self, args, body):
+        self.create_partition(args["pid"], args["start"], args["end"])
+        return {}
+
+    def rpc_submit(self, args, body):
+        try:
+            res = self._mp(args["pid"]).submit(args["record"])
+        except MetaError as e:
+            raise rpc.RpcError(400 + e.code, str(e)) from None
+        return {"result": res}
+
+    def rpc_alloc_ino(self, args, body):
+        return {"ino": self._mp(args["pid"]).alloc_ino()}
+
+    def rpc_inode_get(self, args, body):
+        try:
+            return {"inode": self._mp(args["pid"]).inode_get(args["ino"])}
+        except MetaError as e:
+            raise rpc.RpcError(400 + e.code, str(e)) from None
+
+    def rpc_lookup(self, args, body):
+        try:
+            return {"ino": self._mp(args["pid"]).lookup(args["parent"], args["name"])}
+        except MetaError as e:
+            raise rpc.RpcError(400 + e.code, str(e)) from None
+
+    def rpc_readdir(self, args, body):
+        try:
+            return {"entries": self._mp(args["pid"]).readdir(args["parent"])}
+        except MetaError as e:
+            raise rpc.RpcError(400 + e.code, str(e)) from None
+
+    def rpc_dentry_count(self, args, body):
+        return {"count": self._mp(args["pid"]).dentry_count(args["parent"])}
+
+    def rpc_snapshot(self, args, body):
+        self._mp(args["pid"]).snapshot()
+        return {}

@@ -1,0 +1,202 @@
+"""ObjectNode: S3-compatible HTTP gateway over the FS client.
+
+Role parity: objectnode/ — S3 REST semantics (PutObject/GetObject/
+DeleteObject/HeadObject/ListObjectsV2/CreateBucket, fs adapter
+fs_volume.go:617 PutObject / :1684 ReadFile). Buckets map to volumes;
+object keys map to nested paths (directories are created on demand and
+pruned on delete, the same key<->path adaptation the reference's volume
+adapter performs). Signature validation (V4) is pluggable via an
+authenticator callable; the default accepts all (auth service lands with
+the authnode component).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import urllib.parse
+import xml.sax.saxutils as xs
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import metanode as mn
+from .client import FileSystem, FsError
+
+
+class ObjectNode:
+    def __init__(self, volumes: dict[str, FileSystem], host="127.0.0.1", port=0,
+                 authenticator=None):
+        self.volumes = dict(volumes)
+        self.auth = authenticator
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            # ---- helpers ----
+            def _split(self):
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.lstrip("/").split("/", 1)
+                bucket = parts[0]
+                key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+                query = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+                return bucket, key, query
+
+            def _fs(self, bucket) -> FileSystem | None:
+                return outer.volumes.get(bucket)
+
+            def _reply(self, code, body=b"", ctype="application/xml",
+                       headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _error(self, code, s3code, msg):
+                body = (
+                    f"<?xml version='1.0'?><Error><Code>{s3code}</Code>"
+                    f"<Message>{xs.escape(msg)}</Message></Error>"
+                ).encode()
+                self._reply(code, body)
+
+            def _authorized(self) -> bool:
+                if outer.auth is None:
+                    return True
+                return outer.auth(self)
+
+            # ---- verbs ----
+            def do_PUT(self):
+                if not self._authorized():
+                    return self._error(403, "AccessDenied", "bad signature")
+                bucket, key, _ = self._split()
+                if not key:  # CreateBucket
+                    if bucket not in outer.volumes:
+                        return self._error(404, "NoSuchBucket",
+                                           f"no volume backs {bucket}")
+                    return self._reply(200)
+                fs = self._fs(bucket)
+                if fs is None:
+                    return self._error(404, "NoSuchBucket", bucket)
+                n = int(self.headers.get("Content-Length") or 0)
+                data = self.rfile.read(n)
+                try:
+                    outer._put_object(fs, key, data)
+                except FsError as e:
+                    return self._error(500, "InternalError", str(e))
+                etag = hashlib.md5(data).hexdigest()
+                self._reply(200, headers={"ETag": f'"{etag}"'})
+
+            def do_GET(self):
+                if not self._authorized():
+                    return self._error(403, "AccessDenied", "bad signature")
+                bucket, key, query = self._split()
+                fs = self._fs(bucket)
+                if fs is None:
+                    return self._error(404, "NoSuchBucket", bucket)
+                if not key:  # ListObjectsV2
+                    prefix = query.get("prefix", [""])[0]
+                    keys = outer._list_objects(fs, prefix)
+                    items = "".join(
+                        f"<Contents><Key>{xs.escape(k)}</Key>"
+                        f"<Size>{sz}</Size></Contents>"
+                        for k, sz in keys
+                    )
+                    body = (
+                        f"<?xml version='1.0'?><ListBucketResult>"
+                        f"<Name>{bucket}</Name><Prefix>{xs.escape(prefix)}</Prefix>"
+                        f"<KeyCount>{len(keys)}</KeyCount>{items}"
+                        f"</ListBucketResult>"
+                    ).encode()
+                    return self._reply(200, body)
+                try:
+                    data = fs.read_file("/" + key)
+                except FsError:
+                    return self._error(404, "NoSuchKey", key)
+                self._reply(200, data, ctype="application/octet-stream")
+
+            def do_HEAD(self):
+                if not self._authorized():
+                    return self._error(403, "AccessDenied", "bad signature")
+                bucket, key, _ = self._split()
+                fs = self._fs(bucket)
+                if fs is None:
+                    return self._error(404, "NoSuchBucket", bucket)
+                try:
+                    st = fs.stat("/" + key)
+                except FsError:
+                    return self._error(404, "NoSuchKey", key)
+                self._reply(200, headers={"Content-Length-Hint": str(st["size"])})
+
+            def do_DELETE(self):
+                if not self._authorized():
+                    return self._error(403, "AccessDenied", "bad signature")
+                bucket, key, _ = self._split()
+                fs = self._fs(bucket)
+                if fs is None:
+                    return self._error(404, "NoSuchBucket", bucket)
+                try:
+                    fs.unlink("/" + key)
+                    outer._prune_empty_dirs(fs, key)
+                except FsError:
+                    return self._error(404, "NoSuchKey", key)
+                self._reply(204)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.addr = f"{host}:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    # ---- key <-> path adaptation ----
+    def _put_object(self, fs: FileSystem, key: str, data: bytes) -> None:
+        parts = [p for p in key.split("/") if p]
+        path = ""
+        for d in parts[:-1]:
+            path += "/" + d
+            try:
+                fs.mkdir(path)
+            except FsError as e:
+                if e.errno != mn.EEXIST:
+                    raise
+        fs.write_file("/" + key, data)
+
+    def _list_objects(self, fs: FileSystem, prefix: str) -> list[tuple[str, int]]:
+        out: list[tuple[str, int]] = []
+
+        def walk(path: str, keybase: str):
+            for name, ino in sorted(fs.readdir(path or "/").items()):
+                inode = fs.meta.inode_get(ino)
+                k = f"{keybase}{name}"
+                if inode["type"] == mn.DIR:
+                    walk(f"{path}/{name}", f"{k}/")
+                elif k.startswith(prefix):
+                    out.append((k, inode["size"]))
+
+        walk("", "")
+        return out
+
+    def _prune_empty_dirs(self, fs: FileSystem, key: str) -> None:
+        parts = [p for p in key.split("/") if p][:-1]
+        while parts:
+            path = "/" + "/".join(parts)
+            try:
+                if fs.meta.dentry_count(fs.resolve(path)) == 0:
+                    fs.unlink(path)
+                else:
+                    break
+            except FsError:
+                break
+            parts.pop()
+
+    def start(self) -> "ObjectNode":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

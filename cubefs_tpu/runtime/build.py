@@ -72,5 +72,25 @@ def load() -> ctypes.CDLL:
             lib.cs_sync.argtypes = [c.c_void_p, c.c_uint64]
             lib.cs_crc32.restype = c.c_uint32
             lib.cs_crc32.argtypes = [c.c_char_p, c.c_uint64]
+            # extent store (datanode engine)
+            lib.es_open.restype = c.c_void_p
+            lib.es_open.argtypes = [c.c_char_p]
+            lib.es_close.argtypes = [c.c_void_p]
+            lib.es_last_error.restype = c.c_char_p
+            lib.es_last_error.argtypes = [c.c_void_p]
+            lib.es_create.argtypes = [c.c_void_p, c.c_uint64]
+            lib.es_write.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_uint64, c.c_char_p, c.c_uint64,
+            ]
+            lib.es_read.restype = c.c_int64
+            lib.es_read.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_uint64, c.c_void_p, c.c_uint64,
+            ]
+            lib.es_size.restype = c.c_uint64
+            lib.es_size.argtypes = [c.c_void_p, c.c_uint64]
+            lib.es_block_crcs.restype = c.c_int64
+            lib.es_block_crcs.argtypes = [c.c_void_p, c.c_uint64, c.c_void_p, c.c_int64]
+            lib.es_delete.argtypes = [c.c_void_p, c.c_uint64]
+            lib.es_sync.argtypes = [c.c_void_p, c.c_uint64]
             _lib = lib
     return _lib

@@ -129,6 +129,25 @@ def call(
         raise ServiceUnavailable(503, f"{addr}/{method}: {e}") from None
 
 
+class NodePool:
+    """Address -> client map, supporting in-process targets (tests) and
+    HTTP addresses transparently."""
+
+    def __init__(self):
+        self._clients: dict[str, "Client"] = {}
+        self._lock = threading.Lock()
+
+    def bind(self, addr: str, target) -> None:
+        with self._lock:
+            self._clients[addr] = Client(target)
+
+    def get(self, addr: str) -> "Client":
+        with self._lock:
+            if addr not in self._clients:
+                self._clients[addr] = Client(addr)  # HTTP
+            return self._clients[addr]
+
+
 class Client:
     """Bound client: in-process (direct route table) or HTTP by address.
 

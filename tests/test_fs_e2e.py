@@ -1,0 +1,214 @@
+"""FS plane end-to-end: in-process cluster of master + metanodes +
+datanodes + client SDK — create/write/read/rename/unlink, chain
+replication to all replicas, replica failover with extent resync, and
+metadata persistence via oplog/snapshot."""
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob.access import NodePool
+from cubefs_tpu.fs import metanode as mn
+from cubefs_tpu.fs.client import FileSystem, FsError
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+from cubefs_tpu.utils import rpc
+
+
+class FsCluster:
+    def __init__(self, tmp_path, n_data=4, n_meta=2):
+        self.pool = NodePool()
+        self.master = Master(self.pool)
+        self.pool.bind("master", self.master)
+        self.metas, self.datas = [], []
+        for i in range(n_meta):
+            node = MetaNode(i, data_dir=str(tmp_path / f"meta{i}"))
+            addr = f"meta{i}"
+            self.pool.bind(addr, node)
+            self.master.register_metanode(addr)
+            self.metas.append(node)
+        for i in range(n_data):
+            addr = f"data{i}"
+            node = DataNode(i, str(tmp_path / f"data{i}"), addr, self.pool)
+            self.pool.bind(addr, node)
+            self.master.register_datanode(addr)
+            self.datas.append(node)
+        self.view = self.master.create_volume("vol1", mp_count=2, dp_count=3)
+        self.fs = FileSystem(self.view, self.pool)
+
+    def data_node(self, addr: str) -> DataNode:
+        return self.datas[int(addr.removeprefix("data"))]
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    return FsCluster(tmp_path)
+
+
+def test_mkdir_create_write_read(cluster, rng):
+    fs = cluster.fs
+    fs.mkdir("/docs")
+    payload = rng.integers(0, 256, 500_000, dtype=np.uint8).tobytes()
+    fs.write_file("/docs/a.bin", payload)
+    assert fs.read_file("/docs/a.bin") == payload
+    assert fs.read_file("/docs/a.bin", offset=1000, length=5000) == payload[1000:6000]
+    st = fs.stat("/docs/a.bin")
+    assert st["size"] == len(payload) and st["type"] == mn.FILE
+
+
+def test_append_and_overwrite(cluster, rng):
+    fs = cluster.fs
+    fs.write_file("/f", b"hello ")
+    fs.write_file("/f", b"world", append=True)
+    assert fs.read_file("/f") == b"hello world"
+    fs.write_file("/f", b"reset")
+    assert fs.read_file("/f") == b"reset"
+
+
+def test_readdir_rename_unlink(cluster):
+    fs = cluster.fs
+    fs.mkdir("/d")
+    fs.write_file("/d/x", b"1")
+    fs.write_file("/d/y", b"2")
+    assert set(fs.readdir("/d")) == {"x", "y"}
+    fs.rename("/d/x", "/d/z")
+    assert set(fs.readdir("/d")) == {"z", "y"}
+    fs.unlink("/d/y")
+    assert set(fs.readdir("/d")) == {"z"}
+    with pytest.raises(FsError):
+        fs.unlink("/d")  # not empty
+    fs.unlink("/d/z")
+    fs.unlink("/d")
+    with pytest.raises(FsError):
+        fs.resolve("/d")
+
+
+def test_xattr(cluster):
+    fs = cluster.fs
+    fs.write_file("/tagged", b"x")
+    fs.setxattr("/tagged", "user.k", "v")
+    assert fs.getxattr("/tagged", "user.k") == "v"
+
+
+def test_chain_replication_to_all_replicas(cluster, rng):
+    fs = cluster.fs
+    payload = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    fs.write_file("/rep.bin", payload)
+    inode = fs.meta.inode_get(fs.resolve("/rep.bin"))
+    ek = inode["extents"][0]
+    dp = next(d for d in cluster.view["dps"] if d["dp_id"] == ek["dp_id"])
+    fps = set()
+    for addr in dp["replicas"]:
+        node = cluster.data_node(addr)
+        fps.add(node.extent_fingerprint(dp["dp_id"], ek["extent_id"]))
+    assert len(fps) == 1  # every replica bit-identical
+
+
+def test_read_falls_over_to_replica(cluster, rng):
+    fs = cluster.fs
+    payload = rng.integers(0, 256, 100_000, dtype=np.uint8).tobytes()
+    fs.write_file("/ha.bin", payload)
+    inode = fs.meta.inode_get(fs.resolve("/ha.bin"))
+    dp = next(d for d in cluster.view["dps"] if d["dp_id"] == inode["extents"][0]["dp_id"])
+    cluster.data_node(dp["leader"]).broken = True
+    assert fs.read_file("/ha.bin") == payload
+
+
+def test_replica_failover_resync(cluster, rng):
+    fs = cluster.fs
+    payload = rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    fs.write_file("/failover.bin", payload)
+    inode = fs.meta.inode_get(fs.resolve("/failover.bin"))
+    ek = inode["extents"][0]
+    dp = next(d for d in cluster.view["dps"] if d["dp_id"] == ek["dp_id"])
+    victim = dp["replicas"][1]
+    cluster.data_node(victim).broken = True
+    cluster.master.datanodes[victim]["hb"] = 0  # simulate heartbeat loss
+    actions = cluster.master.check_replicas()
+    assert any(a[1] == victim for a in actions)
+    # the new replica holds a bit-identical extent
+    new_dp = next(d for d in cluster.master.volumes["vol1"]["dps"]
+                  if d["dp_id"] == ek["dp_id"])
+    new_addr = [a for a in new_dp["replicas"] if a != victim]
+    fps = {
+        cluster.data_node(a).extent_fingerprint(ek["dp_id"], ek["extent_id"])
+        for a in new_addr
+    }
+    assert len(fps) == 1
+    assert fs.read_file("/failover.bin") == payload
+
+
+def test_metadata_survives_restart(tmp_path, rng):
+    c = FsCluster(tmp_path)
+    payload = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+    c.fs.mkdir("/persist")
+    c.fs.write_file("/persist/f.bin", payload)
+    c.metas[0].partitions[list(c.metas[0].partitions)[0]].snapshot()
+    # "restart" metanodes: new objects over the same data dirs
+    pool2 = NodePool()
+    for i, old in enumerate(c.metas):
+        node = MetaNode(i, data_dir=str(tmp_path / f"meta{i}"))
+        for pid, mp in old.partitions.items():
+            node.create_partition(pid, mp.start, mp.end)
+        pool2.bind(f"meta{i}", node)
+    for i in range(len(c.datas)):
+        pool2.bind(f"data{i}", c.datas[i])
+    fs2 = FileSystem(c.view, pool2)
+    assert fs2.read_file("/persist/f.bin") == payload
+    st = fs2.stat("/persist")
+    assert st["type"] == mn.DIR
+
+
+def test_extent_rotation_past_cap(cluster, rng, monkeypatch):
+    from cubefs_tpu.fs import client as cl
+    monkeypatch.setattr(cl.ExtentClient, "EXTENT_CAP", 64 << 10)
+    fs = cluster.fs
+    payload = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    fs.write_file("/big", payload[:100_000])
+    fs.write_file("/big", payload[100_000:], append=True)
+    assert fs.read_file("/big") == payload
+    inode = fs.meta.inode_get(fs.resolve("/big"))
+    # the second write must have rolled to a fresh extent (not grown the
+    # first past the cap)
+    assert len({(e["dp_id"], e["extent_id"]) for e in inode["extents"]}) == 2
+
+
+def test_unlink_reclaims_extents(cluster, rng):
+    fs = cluster.fs
+    payload = rng.integers(0, 256, 150_000, dtype=np.uint8).tobytes()
+    fs.write_file("/gc.bin", payload)
+    inode = fs.meta.inode_get(fs.resolve("/gc.bin"))
+    ek = inode["extents"][0]
+    dp = next(d for d in cluster.view["dps"] if d["dp_id"] == ek["dp_id"])
+    node = cluster.data_node(dp["replicas"][0])
+    assert node.partitions[dp["dp_id"]].store.size(ek["extent_id"]) > 0
+    fs.unlink("/gc.bin")
+    for addr in dp["replicas"]:
+        n = cluster.data_node(addr)
+        assert ek["extent_id"] not in n.partitions[dp["dp_id"]].store.list_extents()
+
+
+def test_concurrent_creates_unique_inodes(cluster):
+    import concurrent.futures as cf
+    fs = cluster.fs
+    fs.mkdir("/par")
+    with cf.ThreadPoolExecutor(8) as ex:
+        inos = list(ex.map(lambda i: fs.create(f"/par/f{i}"), range(24)))
+    assert len(set(inos)) == 24
+
+
+def test_master_restart_recovers_liveness_from_heartbeats(cluster):
+    m2 = Master(cluster.pool)  # fresh registries (restart)
+    for i in range(len(cluster.datas)):
+        m2.heartbeat(f"data{i}", "data")  # nodes keep heartbeating
+    m2.heartbeat("meta0", "meta")
+    assert len(m2._live(m2.datanodes)) == len(cluster.datas)
+    m2.create_volume("after-restart", mp_count=1, dp_count=1)
+
+
+def test_zero_length_read(cluster, rng):
+    fs = cluster.fs
+    fs.write_file("/zr", b"abc")
+    assert fs.read_file("/zr", offset=0, length=0) == b""
+    inode = fs.meta.inode_get(fs.resolve("/zr"))
+    assert fs.data.read(inode, 1, 0) == b""
