@@ -66,8 +66,10 @@ def run_role(cfg: dict):
     if role == "metanode":
         from .fs.metanode import MetaNode
 
-        svc = MetaNode(int(cfg.get("node_id", 0)), data_dir=cfg.get("data_dir"))
-        srv = _serve(rpc.expose(svc), cfg)
+        svc = MetaNode(int(cfg.get("node_id", 0)), data_dir=cfg.get("data_dir"),
+                       node_pool=pool)
+        srv = _serve(svc, cfg)  # live routing: per-partition raft handlers
+        svc.addr = srv.addr
         master = rpc.Client(cfg["master_addr"])
         master.call("register", {"kind": "meta", "addr": srv.addr})
         _heartbeat_loop(lambda: master.call(

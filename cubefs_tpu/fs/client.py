@@ -12,6 +12,8 @@ from __future__ import annotations
 import threading
 import time
 
+import uuid
+
 from ..utils import rpc
 from . import metanode as mn
 
@@ -22,14 +24,6 @@ class FsError(Exception):
         self.errno = errno_
 
 
-def _unwrap(fn):
-    """Map metanode RPC errors (400+errno) back to FsError."""
-    try:
-        return fn()
-    except rpc.RpcError as e:
-        if 400 <= e.code < 500:
-            raise FsError(e.code - 400, e.message) from None
-        raise
 
 
 class MetaWrapper:
@@ -47,10 +41,47 @@ class MetaWrapper:
                 return mp
         raise FsError(mn.ENOENT, f"no meta partition owns inode {ino}")
 
+    REDIRECT = 421  # metanode "not leader" status
+
     def _call(self, mp: dict, method: str, args: dict):
-        return _unwrap(lambda: self.nodes.get(mp["addr"]).call(
-            method, {"pid": mp["pid"], **args}
-        ))
+        """Call the partition, following leader redirects and failing
+        over across its replica set. Mutations ("submit") carry a unique
+        op_id so a retry after a lost response is exactly-once."""
+        addrs = list(mp.get("addrs") or [mp["addr"]])
+        payload = {"pid": mp["pid"], **args}
+        if method == "submit":
+            payload["record"] = dict(payload["record"])
+            payload["record"].setdefault("op_id", uuid.uuid4().hex)
+        last: Exception | None = None
+        tried: set[str] = set()
+        queue = list(addrs)
+        deadline = time.time() + 10.0
+        while queue and time.time() < deadline:
+            addr = queue.pop(0)
+            if addr in tried:
+                continue
+            try:
+                return self.nodes.get(addr).call(method, payload)
+            except rpc.RpcError as e:
+                if e.code == self.REDIRECT:
+                    leader = e.message.removeprefix("leader=").strip()
+                    if leader and leader not in tried:
+                        queue.insert(0, leader)
+                    elif not leader:  # election in progress: retry shortly
+                        time.sleep(0.05)
+                        queue.append(addr)
+                    last = e
+                    continue
+                if isinstance(e, rpc.ServiceUnavailable) or e.code >= 500 or e.code == 404:
+                    # 404 = method/partition not on that node (dead or
+                    # stale view): fail over like a down node
+                    tried.add(addr)
+                    last = e
+                    continue
+                if 400 <= e.code < 500:  # metanode errno mapping
+                    raise FsError(e.code - 400, e.message) from None
+                raise
+        raise last if last else FsError(5, f"mp {mp['pid']}: no replica reachable")
 
     def pick_create_mp(self) -> dict:
         with self._lock:
@@ -150,36 +181,43 @@ class ExtentClient:
 
     def write(self, meta: MetaWrapper, ino: int, file_offset: int,
               data: bytes) -> None:
-        with self._lock:
-            stream = self._streams.get(ino)
-        if stream is not None and stream[2] + len(data) > self.EXTENT_CAP:
-            stream = None  # extent full: roll to a new one
-        if stream is None:
-            dp = self._pick_dp()
-            leader = self.nodes.get(dp["leader"])
-            eid = leader.call("alloc_extent", {"dp_id": dp["dp_id"]})[0]["extent_id"]
-            ext_off = 0
-        else:
-            dp, eid, ext_off = stream
-            leader = self.nodes.get(dp["leader"])
-        written = 0
-        while written < len(data):
-            pkt = data[written : written + self.PACKET]
-            leader.call(
-                "write",
-                {"dp_id": dp["dp_id"], "extent_id": eid,
-                 "offset": ext_off + written},
-                pkt,
-            )
-            written += len(pkt)
-        meta.append_extents(
-            ino,
-            [{"dp_id": dp["dp_id"], "extent_id": eid, "ext_offset": ext_off,
-              "file_offset": file_offset, "size": len(data)}],
-            size=file_offset + len(data),
-        )
-        with self._lock:
-            self._streams[ino] = (dp, eid, ext_off + written)
+        """Write through the inode's open extent, rolling to fresh
+        extents at the cap — a single huge write spans several extent
+        keys, like the streamer's packet pipeline."""
+        extent_keys: list[dict] = []
+        done = 0
+        while done < len(data):
+            with self._lock:
+                stream = self._streams.get(ino)
+            if stream is not None and stream[2] >= self.EXTENT_CAP:
+                stream = None  # extent full: roll to a new one
+            if stream is None:
+                dp = self._pick_dp()
+                leader = self.nodes.get(dp["leader"])
+                eid = leader.call("alloc_extent", {"dp_id": dp["dp_id"]})[0]["extent_id"]
+                ext_off = 0
+            else:
+                dp, eid, ext_off = stream
+                leader = self.nodes.get(dp["leader"])
+            seg = min(len(data) - done, self.EXTENT_CAP - ext_off)
+            written = 0
+            while written < seg:
+                pkt = data[done + written : done + min(written + self.PACKET, seg)]
+                leader.call(
+                    "write",
+                    {"dp_id": dp["dp_id"], "extent_id": eid,
+                     "offset": ext_off + written},
+                    pkt,
+                )
+                written += len(pkt)
+            extent_keys.append({
+                "dp_id": dp["dp_id"], "extent_id": eid, "ext_offset": ext_off,
+                "file_offset": file_offset + done, "size": seg,
+            })
+            with self._lock:
+                self._streams[ino] = (dp, eid, ext_off + seg)
+            done += seg
+        meta.append_extents(ino, extent_keys, size=file_offset + len(data))
 
     def close_stream(self, ino: int) -> None:
         with self._lock:

@@ -70,37 +70,52 @@ class Master:
                 )
 
             mps = []
+            meta_replicas = min(self.replicas, len(live_meta))
             for i in range(mp_count):
                 pid = self._next_pid
                 self._next_pid += 1
                 start = 1 if i == 0 else i * INO_RANGE
                 end = (i + 1) * INO_RANGE
-                addr = live_meta[i % len(live_meta)]
-                self.nodes.get(addr).call(
-                    "create_partition", {"pid": pid, "start": start, "end": end}
-                )
-                mps.append({"pid": pid, "start": start, "end": end, "addr": addr})
+                addrs = [live_meta[(i + k) % len(live_meta)]
+                         for k in range(meta_replicas)]
+                for a in addrs:
+                    self.nodes.get(a).call(
+                        "create_partition",
+                        {"pid": pid, "start": start, "end": end, "peers": addrs},
+                    )
+                mps.append({"pid": pid, "start": start, "end": end,
+                            "addr": addrs[0], "addrs": addrs})
 
             dps = []
+            intra_load: dict[str, int] = {}
             for i in range(dp_count):
-                dps.append(self._create_dp(live_data))
+                dps.append(self._create_dp(live_data, intra_load))
             vol = {"name": name, "mps": mps, "dps": dps, "status": "active"}
             self.volumes[name] = vol
             return self.client_view(name)
 
-    def _create_dp(self, live_data: list[str]) -> dict:
+    def _create_dp(self, live_data: list[str], intra_load: dict | None = None) -> dict:
         dp_id = self._next_dp
         self._next_dp += 1
         k = min(self.replicas, len(live_data))
-        # least-loaded spread: count dps per node
+        # least-loaded spread: count dps per node, INCLUDING ones placed
+        # earlier in this same create_volume call (intra_load), and rotate
+        # leadership so one node is not the write leader of every dp
         load = {a: 0 for a in live_data}
         for v in self.volumes.values():
             for dp in v["dps"]:
                 for r in dp["replicas"]:
                     if r in load:
                         load[r] += 1
-        picks = sorted(live_data, key=lambda a: load[a])[:k]
-        leader = picks[0]
+        for a, n in (intra_load or {}).items():
+            if a in load:
+                load[a] += n
+        picks = sorted(live_data, key=lambda a: (load[a], a))[:k]
+        leader = min(picks, key=lambda a: (intra_load or {}).get(a, 0))
+        if intra_load is not None:
+            for a in picks:
+                intra_load[a] = intra_load.get(a, 0) + 1
+            intra_load[leader] = intra_load.get(leader, 0) + 1
         for addr in picks:
             self.nodes.get(addr).call(
                 "create_partition",

@@ -51,6 +51,7 @@ class MetaPartition:
         self.dentries: dict[int, dict[str, int]] = {}  # parent -> name -> ino
         self.apply_id = 0
         self._next_ino = start
+        self._op_cache: dict[str, tuple] = {}  # op_id -> (result, err)
         self.data_dir = data_dir
         self._oplog = None
         if data_dir:
@@ -70,11 +71,58 @@ class MetaPartition:
                 self._oplog.flush()
             return result
 
+    OP_CACHE_SIZE = 4096
+
     def apply(self, record: dict) -> dict:
+        """Apply one mutation. Records carrying an op_id are idempotent:
+        a client retry of an already-applied op (lost response, replica
+        failover) returns the cached outcome instead of re-applying —
+        the cache is part of the FSM, so replicas stay identical."""
         with self._lock:
+            op_id = record.get("op_id")
+            if op_id is not None and op_id in self._op_cache:
+                result, err = self._op_cache[op_id]
+                if err is not None:
+                    raise MetaError(err[0], err[1])
+                return result
             self.apply_id += 1
             op = record["op"]
-            return getattr(self, f"_apply_{op}")(record)
+            try:
+                result = getattr(self, f"_apply_{op}")(record)
+                outcome = (result, None)
+            except MetaError as e:
+                outcome = (None, (e.code, str(e)))
+                self._remember(op_id, outcome)
+                raise
+            self._remember(op_id, outcome)
+            return result
+
+    def _remember(self, op_id, outcome) -> None:
+        if op_id is None:
+            return
+        self._op_cache[op_id] = outcome
+        if len(self._op_cache) > self.OP_CACHE_SIZE:
+            # drop oldest half (insertion-ordered dict)
+            for k in list(self._op_cache)[: self.OP_CACHE_SIZE // 2]:
+                del self._op_cache[k]
+
+    # ---------------- raft FSM snapshot interface ----------------
+    def state_bytes(self) -> bytes:
+        """Serialize the whole partition state (raft snapshot payload)."""
+        with self._lock:
+            return json.dumps({
+                "apply_id": self.apply_id, "next_ino": self._next_ino,
+                "inodes": {str(k): v for k, v in self.inodes.items()},
+                "dentries": {str(k): v for k, v in self.dentries.items()},
+            }).encode()
+
+    def restore_state(self, data: bytes) -> None:
+        with self._lock:
+            st = json.loads(data)
+            self.apply_id = st["apply_id"]
+            self._next_ino = st["next_ino"]
+            self.inodes = {int(k): v for k, v in st["inodes"].items()}
+            self.dentries = {int(k): v for k, v in st["dentries"].items()}
 
     # ---------------- snapshot / recovery ----------------
     def snapshot(self) -> None:
@@ -243,19 +291,57 @@ class MetaPartition:
 
 
 class MetaNode:
-    """Hosts many MetaPartitions; RPC surface for the meta SDK."""
+    """Hosts many MetaPartitions; RPC surface for the meta SDK.
 
-    def __init__(self, node_id: int, data_dir: str | None = None):
+    With peers configured, each partition is a raft group member
+    (multi-raft: one RaftNode per partition, handlers mounted on this
+    node's live route table) — mutations commit through raft before
+    applying, so any majority of metanode replicas preserves the trees.
+    """
+
+    REDIRECT = 421  # "not leader; retry at meta['leader']"
+
+    def __init__(self, node_id: int, data_dir: str | None = None,
+                 addr: str | None = None, node_pool=None):
         self.node_id = node_id
         self.data_dir = data_dir
+        self.addr = addr
+        self.pool = node_pool
         self.partitions: dict[int, MetaPartition] = {}
+        self.rafts: dict[int, object] = {}  # pid -> RaftNode
+        self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = threading.RLock()
 
-    def create_partition(self, pid: int, start: int, end: int) -> MetaPartition:
+    def create_partition(self, pid: int, start: int, end: int,
+                         peers: list[str] | None = None) -> MetaPartition:
         with self._lock:
             if pid not in self.partitions:
-                pdir = os.path.join(self.data_dir, f"mp_{pid}") if self.data_dir else None
-                self.partitions[pid] = MetaPartition(pid, start, end, pdir)
+                replicated = bool(peers and len(peers) > 1)
+                # replicated partitions persist via the raft wal (replayed
+                # into apply on restart) — a second mp-level oplog would
+                # double-apply; standalone partitions keep their own oplog
+                pdir = (os.path.join(self.data_dir, f"mp_{pid}")
+                        if self.data_dir and not replicated else None)
+                mp = MetaPartition(pid, start, end, pdir)
+                self.partitions[pid] = mp
+                if replicated:
+                    if not self.addr or self.pool is None:
+                        raise rpc.RpcError(
+                            500,
+                            f"metanode {self.node_id} got replicated partition "
+                            f"{pid} but has no addr/node_pool configured",
+                        )
+                    from ..parallel import raft as raftlib
+
+                    node = raftlib.RaftNode(
+                        f"mp{pid}", self.addr, peers, mp.apply, self.pool,
+                        data_dir=os.path.join(self.data_dir, f"mp_{pid}_raft")
+                        if self.data_dir else None,
+                        snapshot_fn=mp.state_bytes,
+                        restore_fn=mp.restore_state,
+                    )
+                    raftlib.register_routes(self.extra_routes, node)
+                    self.rafts[pid] = node.start()
             return self.partitions[pid]
 
     def _mp(self, pid: int) -> MetaPartition:
@@ -264,41 +350,70 @@ class MetaNode:
             raise rpc.RpcError(404, f"meta partition {pid} not on node {self.node_id}")
         return mp
 
+    def _mp_leader(self, pid: int) -> MetaPartition:
+        """Leader-routed access: replicated partitions serve reads and
+        ino allocation from the raft leader only (followers apply
+        asynchronously; serving them would allow stale reads right after
+        a committed write)."""
+        mp = self._mp(pid)
+        node = self.rafts.get(pid)
+        if node is not None:
+            st = node.status()
+            if st["role"] != "leader":
+                raise rpc.RpcError(self.REDIRECT, f"leader={st['leader'] or ''}")
+        return mp
+
+    def stop(self) -> None:
+        for r in self.rafts.values():
+            r.stop()
+
     # ---------------- RPC surface ----------------
     def rpc_create_partition(self, args, body):
-        self.create_partition(args["pid"], args["start"], args["end"])
+        self.create_partition(args["pid"], args["start"], args["end"],
+                              args.get("peers"))
         return {}
 
     def rpc_submit(self, args, body):
+        pid = args["pid"]
+        raft_node = self.rafts.get(pid)
         try:
-            res = self._mp(args["pid"]).submit(args["record"])
+            if raft_node is None:
+                res = self._mp(pid).submit(args["record"])
+            else:
+                from ..parallel.raft import NotLeaderError
+
+                try:
+                    res = raft_node.propose(args["record"])
+                except NotLeaderError as e:
+                    raise rpc.RpcError(self.REDIRECT,
+                                       f"leader={e.leader or ''}") from None
         except MetaError as e:
             raise rpc.RpcError(400 + e.code, str(e)) from None
         return {"result": res}
 
     def rpc_alloc_ino(self, args, body):
-        return {"ino": self._mp(args["pid"]).alloc_ino()}
+        return {"ino": self._mp_leader(args["pid"]).alloc_ino()}
 
     def rpc_inode_get(self, args, body):
         try:
-            return {"inode": self._mp(args["pid"]).inode_get(args["ino"])}
+            return {"inode": self._mp_leader(args["pid"]).inode_get(args["ino"])}
         except MetaError as e:
             raise rpc.RpcError(400 + e.code, str(e)) from None
 
     def rpc_lookup(self, args, body):
         try:
-            return {"ino": self._mp(args["pid"]).lookup(args["parent"], args["name"])}
+            return {"ino": self._mp_leader(args["pid"]).lookup(args["parent"], args["name"])}
         except MetaError as e:
             raise rpc.RpcError(400 + e.code, str(e)) from None
 
     def rpc_readdir(self, args, body):
         try:
-            return {"entries": self._mp(args["pid"]).readdir(args["parent"])}
+            return {"entries": self._mp_leader(args["pid"]).readdir(args["parent"])}
         except MetaError as e:
             raise rpc.RpcError(400 + e.code, str(e)) from None
 
     def rpc_dentry_count(self, args, body):
-        return {"count": self._mp(args["pid"]).dentry_count(args["parent"])}
+        return {"count": self._mp_leader(args["pid"]).dentry_count(args["parent"])}
 
     def rpc_snapshot(self, args, body):
         self._mp(args["pid"]).snapshot()

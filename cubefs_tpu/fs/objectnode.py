@@ -131,7 +131,12 @@ class ObjectNode:
                     st = fs.stat("/" + key)
                 except FsError:
                     return self._error(404, "NoSuchKey", key)
-                self._reply(200, headers={"Content-Length-Hint": str(st["size"])})
+                # HEAD: standard Content-Length describes what GET would
+                # return; no body follows (RFC 9110)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(st["size"]))
+                self.end_headers()
 
             def do_DELETE(self):
                 if not self._authorized():

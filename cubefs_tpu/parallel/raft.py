@@ -1,0 +1,530 @@
+"""Raft consensus: the replication substrate for metadata services.
+
+Role parity: depends/tiglabs/raft (multi-raft lib: leader/follower/
+candidate FSMs, log replication, snapshot transfer, vote/heartbeat RPC
+planes) and blobstore/common/raftserver — re-implemented compactly over
+this framework's RPC layer rather than ported. One `RaftNode` is one
+group member; a process hosts many nodes (multi-raft = one RaftNode per
+metadata partition, sharing a transport).
+
+Design notes:
+  * The applied state machine is a callable `apply_fn(entry: dict)`;
+    metadata services plug their submit→apply door straight in.
+  * Election + replication follow the Raft paper: randomized election
+    timeout; term-checked RequestVote with the up-to-date-log rule;
+    AppendEntries with (prev_index, prev_term) consistency check and
+    conflict truncation; commit at the majority match of the current
+    term; a term-noop committed on election (§5.4.2) so prior-term
+    entries become committable.
+  * Log compaction: the log is offset-based (`log_base` = absolute index
+    of the last compacted entry). With `snapshot_fn`/`restore_fn`
+    configured, the node auto-compacts past COMPACT_THRESHOLD entries
+    and leaders stream the FSM snapshot to followers whose next index
+    was compacted away (InstallSnapshot).
+  * propose() waiters are keyed by (index, term): if leadership changes
+    and the slot is overwritten by another leader's entry, the waiter
+    gets NotLeaderError instead of a false success.
+  * Persistence: (term, voted_for, log_base/term) in meta.json; log
+    entries as jsonl; FSM snapshot bytes beside them.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import random
+import threading
+import time
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader: str | None, reason: str = "not leader"):
+        super().__init__(f"{reason}; try {leader!r}")
+        self.leader = leader
+
+
+class RaftNode:
+    ELECTION_MIN = 0.15
+    ELECTION_MAX = 0.30
+    HEARTBEAT = 0.05
+    COMPACT_THRESHOLD = 1024  # log entries kept before auto-snapshot
+
+    NOOP = {"__raft_noop__": True}
+
+    def __init__(self, group_id: str, me: str, peers: list[str], apply_fn,
+                 pool, data_dir: str | None = None,
+                 snapshot_fn=None, restore_fn=None):
+        self.group_id = group_id
+        self.me = me
+        self.peers = [p for p in peers if p != me]
+        self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn  # () -> bytes of FSM state
+        self.restore_fn = restore_fn  # (bytes) -> None
+        self.pool = pool
+        self.data_dir = data_dir
+
+        self._lock = threading.RLock()
+        self.term = 0
+        self.voted_for: str | None = None
+        self.log: list[dict] = []  # entries AFTER log_base
+        self.log_base = 0  # absolute index of last compacted entry
+        self.log_base_term = 0
+        self.commit_index = 0  # absolute, 1-based; 0 = nothing
+        self.last_applied = 0
+        self.role = "follower"
+        self.leader: str | None = None
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self._last_heard = time.monotonic()
+        self._election_due = self._rand_timeout()
+        self._stop = threading.Event()
+        self._apply_cv = threading.Condition(self._lock)
+        self._waiting: dict[int, int] = {}  # absolute index -> proposed term
+        self._results: dict[int, tuple[object, BaseException | None]] = {}
+        self._wal = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load()
+            self._wal = open(self._wal_path(), "a")
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+
+    # ---------------- index helpers (absolute <-> list) ----------------
+    def _last_index(self) -> int:
+        return self.log_base + len(self.log)
+
+    def _term_at(self, abs_index: int) -> int:
+        if abs_index == self.log_base:
+            return self.log_base_term
+        return self.log[abs_index - 1 - self.log_base]["term"]
+
+    def _entry_at(self, abs_index: int) -> dict:
+        return self.log[abs_index - 1 - self.log_base]
+
+    # ---------------- persistence ----------------
+    def _wal_path(self) -> str:
+        return os.path.join(self.data_dir, "raft.jsonl")
+
+    def _snap_path(self) -> str:
+        return os.path.join(self.data_dir, "snapshot.json")
+
+    def _persist_meta(self) -> None:
+        if not self.data_dir:
+            return
+        tmp = os.path.join(self.data_dir, "meta.tmp")
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for,
+                       "log_base": self.log_base,
+                       "log_base_term": self.log_base_term}, f)
+        os.replace(tmp, os.path.join(self.data_dir, "meta.json"))
+
+    def _persist_entries(self, appended: list[dict], rewrote: bool) -> None:
+        """appended = strict suffix newly appended to self.log; rewrote =
+        a conflict truncated/overwrote earlier entries (or compaction):
+        rewrite the whole wal so it never holds duplicates."""
+        if self._wal is None:
+            return
+        if rewrote:
+            self._wal.close()
+            with open(self._wal_path(), "w") as f:
+                for rec in self.log:
+                    f.write(json.dumps(rec) + "\n")
+            self._wal = open(self._wal_path(), "a")
+        else:
+            for rec in appended:
+                self._wal.write(json.dumps(rec) + "\n")
+            self._wal.flush()
+
+    def _persist_snapshot(self, data: bytes) -> None:
+        if not self.data_dir:
+            return
+        tmp = self._snap_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"index": self.log_base, "term": self.log_base_term,
+                       "data": base64.b64encode(data).decode()}, f)
+        os.replace(tmp, self._snap_path())
+
+    def _load(self) -> None:
+        meta = os.path.join(self.data_dir, "meta.json")
+        if os.path.exists(meta):
+            m = json.load(open(meta))
+            self.term, self.voted_for = m["term"], m["voted_for"]
+            self.log_base = m.get("log_base", 0)
+            self.log_base_term = m.get("log_base_term", 0)
+        if os.path.exists(self._snap_path()) and self.restore_fn:
+            s = json.load(open(self._snap_path()))
+            self.restore_fn(base64.b64decode(s["data"]))
+            self.log_base = s["index"]
+            self.log_base_term = s["term"]
+        self.commit_index = self.last_applied = self.log_base
+        if os.path.exists(self._wal_path()):
+            for line in open(self._wal_path()):
+                line = line.strip()
+                if line:
+                    try:
+                        self.log.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        break
+
+    # ---------------- lifecycle ----------------
+    def start(self) -> "RaftNode":
+        self._ticker.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._apply_cv:
+            self._apply_cv.notify_all()
+
+    def _rand_timeout(self) -> float:
+        return random.uniform(self.ELECTION_MIN, self.ELECTION_MAX)
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(0.01):
+            with self._lock:
+                role = self.role
+                overdue = (
+                    time.monotonic() - self._last_heard > self._election_due
+                )
+                want_compact = (
+                    self.snapshot_fn is not None
+                    and len(self.log) > self.COMPACT_THRESHOLD
+                    and self.last_applied > self.log_base
+                )
+            if want_compact:
+                self.take_snapshot()
+            if role == "leader":
+                self._broadcast_append()
+                time.sleep(self.HEARTBEAT)
+            elif overdue:
+                self._run_election()
+
+    # ---------------- snapshot / compaction ----------------
+    def take_snapshot(self) -> None:
+        """Compact the log up to last_applied using the FSM's snapshot."""
+        if self.snapshot_fn is None:
+            return
+        with self._lock:
+            upto = self.last_applied
+            if upto <= self.log_base:
+                return
+            data = self.snapshot_fn()
+            self.log_base_term = self._term_at(upto)
+            del self.log[: upto - self.log_base]
+            self.log_base = upto
+            self._persist_snapshot(data)
+            self._persist_meta()
+            self._persist_entries([], rewrote=True)
+
+    def handle_install_snapshot(self, args: dict, body: bytes) -> dict:
+        with self._lock:
+            if args["term"] < self.term:
+                return {"ok": False, "term": self.term}
+            if args["term"] > self.term or self.role != "follower":
+                self._step_down(args["term"])
+            self.leader = args["leader"]
+            self._last_heard = time.monotonic()
+            if args["index"] <= self.log_base:
+                return {"ok": True, "term": self.term}
+            if self.restore_fn is not None:
+                self.restore_fn(base64.b64decode(args["data"]))
+            self.log = []
+            self.log_base = args["index"]
+            self.log_base_term = args["snap_term"]
+            self.commit_index = self.last_applied = self.log_base
+            self._persist_snapshot(base64.b64decode(args["data"]))
+            self._persist_meta()
+            self._persist_entries([], rewrote=True)
+            return {"ok": True, "term": self.term}
+
+    # ---------------- election ----------------
+    def _run_election(self) -> None:
+        with self._lock:
+            self.term += 1
+            self.role = "candidate"
+            self.voted_for = self.me
+            self.leader = None
+            self._persist_meta()
+            term = self.term
+            last_index = self._last_index()
+            last_term = self._term_at(last_index) if last_index else 0
+            self._last_heard = time.monotonic()
+            self._election_due = self._rand_timeout()
+        votes = 1
+        vlock = threading.Lock()
+        done = threading.Event()
+        majority = (len(self.peers) + 1) // 2 + 1
+        if votes >= majority:  # single-node group
+            self._become_leader(term)
+            return
+
+        def ask(peer):
+            nonlocal votes
+            try:
+                meta, _ = self.pool.get(peer).call(
+                    f"raft_{self.group_id}_vote",
+                    {"term": term, "candidate": self.me,
+                     "last_index": last_index, "last_term": last_term},
+                    timeout=1.0,
+                )
+            except Exception:
+                return
+            with self._lock:
+                if meta.get("term", 0) > self.term:
+                    self._step_down(meta["term"])
+                    done.set()
+                    return
+            if meta.get("granted"):
+                with vlock:
+                    votes += 1
+                    if votes >= majority:
+                        done.set()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in self.peers]
+        for t in threads:
+            t.start()
+        done.wait(timeout=self.ELECTION_MIN)
+        with vlock:
+            won = votes >= majority
+        if won:
+            self._become_leader(term)
+
+    def _become_leader(self, term: int) -> None:
+        with self._lock:
+            if self.role != "candidate" or self.term != term:
+                return
+            self.role = "leader"
+            self.leader = self.me
+            n = self._last_index() + 1
+            self.next_index = {p: n for p in self.peers}
+            self.match_index = {p: 0 for p in self.peers}
+            # commit a current-term no-op immediately: prior-term entries
+            # can only commit transitively through it (Raft §5.4.2)
+            rec = {"term": self.term, "entry": dict(self.NOOP)}
+            self.log.append(rec)
+            self._persist_entries([rec], rewrote=False)
+        self._broadcast_append()
+
+    def _step_down(self, term: int) -> None:
+        # caller holds the lock
+        self.term = max(self.term, term)
+        self.role = "follower"
+        self.voted_for = None
+        self._persist_meta()
+        self._last_heard = time.monotonic()
+        self._election_due = self._rand_timeout()
+
+    # ---------------- replication ----------------
+    def propose(self, entry: dict, timeout: float = 5.0):
+        """Leader-only: append + replicate + wait for commit+apply.
+        Returns the state machine's apply result (re-raising the apply
+        exception if the op failed deterministically). A leadership
+        change that drops the entry raises NotLeaderError — never a
+        false success."""
+        with self._lock:
+            if self.role != "leader":
+                raise NotLeaderError(self.leader)
+            rec = {"term": self.term, "entry": entry}
+            self.log.append(rec)
+            index = self._last_index()
+            self._waiting[index] = self.term
+            self._persist_entries([rec], rewrote=False)
+        self._broadcast_append()
+        deadline = time.monotonic() + timeout
+        with self._apply_cv:
+            while index not in self._results:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop.is_set():
+                    self._waiting.pop(index, None)
+                    raise TimeoutError(f"entry {index} not committed in time")
+                self._apply_cv.wait(remaining)
+            result, exc = self._results.pop(index)
+            self._waiting.pop(index, None)
+        if exc is not None:
+            raise exc
+        return result
+
+    def _broadcast_append(self) -> None:
+        with self._lock:
+            if self.role != "leader":
+                return
+            peers = list(self.peers)
+        if not peers:  # single node: commit = log end
+            with self._lock:
+                self._advance_commit()
+            return
+        for p in peers:
+            threading.Thread(target=self._append_to, args=(p,), daemon=True).start()
+
+    def _append_to(self, peer: str) -> None:
+        snapshot_args = None
+        with self._lock:
+            if self.role != "leader":
+                return
+            ni = self.next_index.get(peer, self._last_index() + 1)
+            if ni <= self.log_base:
+                # peer needs entries we compacted: stream the snapshot
+                if self.snapshot_fn is None:
+                    return
+                snapshot_args = {
+                    "term": self.term, "leader": self.me,
+                    "index": self.log_base, "snap_term": self.log_base_term,
+                    "data": base64.b64encode(self.snapshot_fn()).decode(),
+                }
+            else:
+                prev_index = ni - 1
+                prev_term = self._term_at(prev_index) if prev_index else 0
+                entries = self.log[ni - 1 - self.log_base :]
+                args = {
+                    "term": self.term, "leader": self.me,
+                    "prev_index": prev_index, "prev_term": prev_term,
+                    "entries": entries, "commit": self.commit_index,
+                }
+        try:
+            if snapshot_args is not None:
+                meta, _ = self.pool.get(peer).call(
+                    f"raft_{self.group_id}_snapshot", snapshot_args, timeout=5.0
+                )
+                with self._lock:
+                    if meta.get("term", 0) > self.term:
+                        self._step_down(meta["term"])
+                    elif meta.get("ok"):
+                        self.match_index[peer] = snapshot_args["index"]
+                        self.next_index[peer] = snapshot_args["index"] + 1
+                return
+            meta, _ = self.pool.get(peer).call(
+                f"raft_{self.group_id}_append", args, timeout=1.0
+            )
+        except Exception:
+            return
+        with self._lock:
+            if meta.get("term", 0) > self.term:
+                self._step_down(meta["term"])
+                return
+            if self.role != "leader":
+                return
+            if meta.get("ok"):
+                self.match_index[peer] = args["prev_index"] + len(args["entries"])
+                self.next_index[peer] = self.match_index[peer] + 1
+                self._advance_commit()
+            else:
+                hint = meta.get("conflict_index")
+                self.next_index[peer] = max(
+                    1, hint if hint else self.next_index.get(peer, 2) - 1
+                )
+
+    def _advance_commit(self) -> None:
+        # caller holds lock; commit = highest index replicated on majority
+        # with an entry of the current term
+        n_members = len(self.peers) + 1
+        for idx in range(self._last_index(), self.commit_index, -1):
+            if self._term_at(idx) != self.term:
+                break
+            count = 1 + sum(1 for p in self.peers if self.match_index.get(p, 0) >= idx)
+            if count > n_members // 2:
+                self.commit_index = idx
+                break
+        self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        # caller holds lock
+        while self.last_applied < self.commit_index:
+            abs_idx = self.last_applied + 1
+            rec = self._entry_at(abs_idx)
+            self.last_applied = abs_idx
+            waited_term = self._waiting.get(abs_idx)
+            result, exc = None, None
+            if rec["entry"].get("__raft_noop__"):
+                pass
+            else:
+                try:
+                    result = self.apply_fn(rec["entry"])
+                except Exception as e:
+                    # deterministic app-level failures are part of the FSM;
+                    # surface to a local waiter, ignore on replicas
+                    exc = e
+            if waited_term is not None:
+                if rec["term"] != waited_term:
+                    # slot was overwritten by another leader's entry: the
+                    # proposed entry is LOST, not committed
+                    exc = NotLeaderError(self.leader, "entry lost to new leader")
+                    result = None
+                self._results[abs_idx] = (result, exc)
+        self._apply_cv.notify_all()
+
+    # ---------------- RPC handlers ----------------
+    def handle_vote(self, args: dict, body: bytes) -> dict:
+        with self._lock:
+            if args["term"] < self.term:
+                return {"granted": False, "term": self.term}
+            if args["term"] > self.term:
+                self._step_down(args["term"])
+            last_index = self._last_index()
+            last_term = self._term_at(last_index) if last_index else 0
+            up_to_date = (args["last_term"], args["last_index"]) >= (last_term, last_index)
+            if up_to_date and self.voted_for in (None, args["candidate"]):
+                self.voted_for = args["candidate"]
+                self._persist_meta()
+                self._last_heard = time.monotonic()
+                return {"granted": True, "term": self.term}
+            return {"granted": False, "term": self.term}
+
+    def handle_append(self, args: dict, body: bytes) -> dict:
+        with self._lock:
+            if args["term"] < self.term:
+                return {"ok": False, "term": self.term}
+            if args["term"] > self.term or self.role != "follower":
+                self._step_down(args["term"])
+            self.leader = args["leader"]
+            self._last_heard = time.monotonic()
+            prev_index = args["prev_index"]
+            entries = args["entries"]
+            if prev_index > self._last_index():
+                return {"ok": False, "term": self.term,
+                        "conflict_index": self._last_index() + 1}
+            if prev_index < self.log_base:
+                # we compacted past prev: drop entries we already hold
+                skip = self.log_base - prev_index
+                entries = entries[skip:]
+                prev_index = self.log_base
+            if prev_index > self.log_base and self._term_at(prev_index) != args["prev_term"]:
+                t = self._term_at(prev_index)
+                ci = prev_index
+                while ci - 1 > self.log_base and self._term_at(ci - 1) == t:
+                    ci -= 1
+                return {"ok": False, "term": self.term, "conflict_index": ci}
+            # append, overwriting conflicts; track the wal delta precisely
+            appended: list[dict] = []
+            rewrote = False
+            for i, rec in enumerate(entries):
+                idx = prev_index + i + 1
+                if idx <= self._last_index():
+                    if self._term_at(idx) != rec["term"]:
+                        del self.log[idx - 1 - self.log_base :]
+                        self.log.append(rec)
+                        rewrote = True
+                    # same term at same index: identical entry, skip
+                else:
+                    self.log.append(rec)
+                    appended.append(rec)
+            if appended or rewrote:
+                self._persist_entries(appended, rewrote)
+            if args["commit"] > self.commit_index:
+                self.commit_index = min(args["commit"], self._last_index())
+                self._apply_committed()
+            return {"ok": True, "term": self.term}
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"role": self.role, "term": self.term, "leader": self.leader,
+                    "log_len": len(self.log), "log_base": self.log_base,
+                    "commit": self.commit_index, "applied": self.last_applied}
+
+
+def register_routes(routes: dict, node: RaftNode) -> None:
+    """Mount a raft node's handlers on a service's route table
+    (multi-raft: many nodes share one server)."""
+    routes[f"raft_{node.group_id}_vote"] = node.handle_vote
+    routes[f"raft_{node.group_id}_append"] = node.handle_append
+    routes[f"raft_{node.group_id}_snapshot"] = node.handle_install_snapshot

@@ -40,12 +40,31 @@ def expose(obj) -> dict:
     }
 
 
+def resolve_route(target, name: str):
+    """Find a handler on a service object: its live `extra_routes` dict
+    (dynamically mounted handlers, e.g. per-partition raft) first, then
+    rpc_* methods. Returns None if absent."""
+    extra = getattr(target, "extra_routes", None)
+    if extra and name in extra:
+        return extra[name]
+    fn = getattr(target, f"rpc_{name}", None)
+    return fn if callable(fn) else None
+
+
 class RpcServer:
     """Threaded HTTP server over a route table of callables
-    fn(args: dict, body: bytes) -> (dict, bytes) | dict | bytes | None."""
+    fn(args: dict, body: bytes) -> (dict, bytes) | dict | bytes | None.
+    Pass a service OBJECT instead of a dict to get live resolution
+    (rpc_* methods + its extra_routes), so handlers mounted after server
+    start (per-partition raft) are reachable."""
 
-    def __init__(self, routes: dict, host: str = "127.0.0.1", port: int = 0):
-        self.routes = dict(routes)
+    def __init__(self, routes, host: str = "127.0.0.1", port: int = 0):
+        self._target = None
+        if isinstance(routes, dict):
+            self.routes = dict(routes)
+        else:
+            self._target = routes
+            self.routes = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -57,6 +76,8 @@ class RpcServer:
             def do_POST(self):
                 name = self.path.lstrip("/")
                 fn = outer.routes.get(name)
+                if fn is None and outer._target is not None:
+                    fn = resolve_route(outer._target, name)
                 if fn is None:
                     self._reply(404, {"error": f"no such method {name!r}"}, b"")
                     return
@@ -156,19 +177,19 @@ class Client:
     """
 
     def __init__(self, target):
-        self._routes = None
+        self._target = None
         self._addr = None
         if isinstance(target, str):
             self._addr = target
         elif isinstance(target, RpcServer):
             self._addr = target.addr
         else:
-            self._routes = expose(target)
+            self._target = target  # live resolution (see resolve_route)
 
     def call(self, method: str, args: dict | None = None, body: bytes = b"",
              timeout: float = 30.0) -> tuple[dict, bytes]:
-        if self._routes is not None:
-            fn = self._routes.get(method)
+        if self._target is not None:
+            fn = resolve_route(self._target, method)
             if fn is None:
                 raise RpcError(404, f"no such method {method!r}")
             return _normalize(fn(args or {}, body))

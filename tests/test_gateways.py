@@ -22,7 +22,7 @@ def fscluster(tmp_path):
     master = Master(pool)
     pool.bind("master", master)
     for i in range(2):
-        node = MetaNode(i)
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
         pool.bind(f"meta{i}", node)
         master.register_metanode(f"meta{i}")
     for i in range(3):
