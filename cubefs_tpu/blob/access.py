@@ -13,6 +13,7 @@ device sees large contiguous arrays.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import zlib
 from concurrent.futures import ThreadPoolExecutor
@@ -55,15 +56,25 @@ class AccessHandler:
 
     def __init__(self, cm_client: rpc.Client, node_clients: "NodePool",
                  cfg: AccessConfig | None = None, repair_queue=None,
-                 delete_queue=None):
+                 delete_queue=None, proxy_client: rpc.Client | None = None):
         self.cm = cm_client
         self.nodes = node_clients
         self.cfg = cfg or AccessConfig()
+        self.proxy = proxy_client  # allocation cache (blob/proxy.py)
         self.repair_queue = repair_queue
         self.delete_queue = delete_queue
         self._pool = ThreadPoolExecutor(max_workers=self.cfg.max_workers)
         self._encoders: dict[int, object] = {}
         self._lock = threading.Lock()
+
+    def _submit(self, fn, *args):
+        # carry the request's trace context into pool workers, else the
+        # shard RPCs lose their X-Trace linkage
+        ctx = contextvars.copy_context()
+        return self._pool.submit(ctx.run, fn, *args)
+
+    def _map(self, fn, items):
+        return [f.result() for f in [self._submit(fn, i) for i in items]]
 
     def _encoder(self, mode: int):
         with self._lock:
@@ -84,10 +95,16 @@ class AccessHandler:
 
         blob_size = self.cfg.blob_size
         blobs = [data[i : i + blob_size] for i in range(0, len(data), blob_size)]
-        meta, _ = self.cm.call("alloc_volume", {"codemode": mode})
-        vol = VolumeInfo.from_dict(meta["volume"])
-        meta, _ = self.cm.call("alloc_bids", {"count": len(blobs)})
-        min_bid = meta["start"]
+        if self.proxy is not None:  # allocation cache: no per-put cm trip
+            meta, _ = self.proxy.call("alloc", {"codemode": mode,
+                                                "count": len(blobs)})
+            vol = VolumeInfo.from_dict(meta["volume"])
+            min_bid = meta["min_bid"]
+        else:
+            meta, _ = self.cm.call("alloc_volume", {"codemode": mode})
+            vol = VolumeInfo.from_dict(meta["volume"])
+            meta, _ = self.cm.call("alloc_bids", {"count": len(blobs)})
+            min_bid = meta["start"]
 
         # ---- batched device encode: group equal shard sizes ----
         shard_size = enc.shard_size(len(blobs[0]))
@@ -104,7 +121,7 @@ class AccessHandler:
             bid = min_bid + i
             for u in vol.units:
                 futures.append(
-                    self._pool.submit(self._write_shard, vol, u, bid, stripes[i, u.index])
+                    self._submit(self._write_shard, vol, u, bid, stripes[i, u.index])
                 )
         fails: list[tuple[int, int]] = []  # (bid, unit index)
         ok_per_bid = {min_bid + i: 0 for i in range(len(blobs))}
@@ -116,6 +133,12 @@ class AccessHandler:
                 fails.append((bid, idx))
         for bid, n_ok in ok_per_bid.items():
             if n_ok < quorum:
+                if self.proxy is not None:
+                    # don't re-lease a volume that just failed quorum
+                    try:
+                        self.proxy.call("invalidate", {"codemode": mode})
+                    except rpc.RpcError:
+                        pass
                 raise PutQuorumError(
                     f"bid {bid}: {n_ok}/{len(vol.units)} shards < quorum {quorum}"
                 )
@@ -183,9 +206,7 @@ class AccessHandler:
             payload_len if payload_len > 0 else 1
         )
         # fast path: read the N data shards
-        reads = list(self._pool.map(
-            lambda i: self._read_shard(vol, i, bid), range(t.n)
-        ))
+        reads = self._map(lambda i: self._read_shard(vol, i, bid), range(t.n))
         got = {i: p for i, p, err in reads if err is None}
         if len(got) == t.n:
             data = b"".join(got[i] for i in range(t.n))
@@ -194,7 +215,7 @@ class AccessHandler:
         # degraded read: pull parity/local shards until n_global available
         missing = [i for i in range(t.n) if i not in got]
         extra_idx = [i for i in range(t.n, t.n + t.m) if i not in got]
-        for i, p, err in self._pool.map(
+        for i, p, err in self._map(
             lambda i: self._read_shard(vol, i, bid), extra_idx
         ):
             if err is None:

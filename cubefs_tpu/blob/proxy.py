@@ -1,0 +1,71 @@
+"""Proxy: per-IDC allocation cache in front of clustermgr.
+
+Role parity: blobstore/proxy (volume+BID allocator cache,
+proxy/allocator/; async-message producer, proxy/mq — here the queues
+are handed in directly). Access asks the proxy for (volume, bid-range)
+leases; the proxy prefetches from clustermgr in batches so the hot PUT
+path doesn't pay a control-plane round trip per blob.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..codec import codemode as cm
+from ..utils import rpc
+from .types import VolumeInfo
+
+
+class ProxyAllocator:
+    BID_BATCH = 1024
+    VOLUME_REUSE = 64  # blobs per cached volume before rotating
+
+    def __init__(self, cm_client: rpc.Client):
+        self.cm = cm_client
+        self._lock = threading.Lock()
+        self._bid_next = 0
+        self._bid_end = 0
+        self._vols: dict[int, tuple[VolumeInfo, int]] = {}  # mode -> (vol, uses)
+
+    def alloc(self, codemode: int, blob_count: int) -> tuple[VolumeInfo, int]:
+        """Returns (volume, first_bid) for blob_count consecutive bids."""
+        with self._lock:
+            vol = self._vol_locked(int(codemode))
+            first = self._bids_locked(blob_count)
+            return vol, first
+
+    def _vol_locked(self, mode: int) -> VolumeInfo:
+        cached = self._vols.get(mode)
+        if cached is not None:
+            vol, uses = cached
+            if uses < self.VOLUME_REUSE:
+                self._vols[mode] = (vol, uses + 1)
+                return vol
+        meta, _ = self.cm.call("alloc_volume", {"codemode": mode})
+        vol = VolumeInfo.from_dict(meta["volume"])
+        self._vols[mode] = (vol, 1)
+        return vol
+
+    def _bids_locked(self, count: int) -> int:
+        if self._bid_next + count > self._bid_end:
+            batch = max(self.BID_BATCH, count)
+            meta, _ = self.cm.call("alloc_bids", {"count": batch})
+            self._bid_next = meta["start"]
+            self._bid_end = meta["start"] + batch
+        first = self._bid_next
+        self._bid_next += count
+        return first
+
+    def invalidate_volume(self, codemode: int) -> None:
+        """Drop the cached volume (e.g. after write failures against it)."""
+        with self._lock:
+            self._vols.pop(int(codemode), None)
+
+    # ---------------- RPC surface ----------------
+    def rpc_alloc(self, args, body):
+        vol, first = self.alloc(args["codemode"], args["count"])
+        return {"volume": vol.to_dict(), "min_bid": first}
+
+    def rpc_invalidate(self, args, body):
+        self.invalidate_volume(args["codemode"])
+        return {}

@@ -8,7 +8,7 @@ plane, and blocks. Heartbeat loops run in daemon threads.
 
 Config keys (JSON):
   role:        master | metanode | datanode | objectnode |
-               clustermgr | blobnode | access | scheduler
+               clustermgr | blobnode | access | proxy | scheduler
   listen_host / listen_port: bind address (port 0 = ephemeral)
   master_addr / clustermgr_addr / scheduler_addr: upstreams
   data_dirs / data_dir: storage paths
@@ -26,10 +26,15 @@ import time
 
 def _serve(routes, cfg):
     from .utils import rpc
+    from .utils.auditlog import AuditLogger
 
+    audit = None
+    if cfg.get("audit_dir"):
+        audit = AuditLogger(f"{cfg['audit_dir']}/{cfg['role']}.audit.log")
     srv = rpc.RpcServer(
         routes, host=cfg.get("listen_host", "127.0.0.1"),
         port=int(cfg.get("listen_port", 0)),
+        service=cfg["role"], audit=audit,
     ).start()
     print(f"[{cfg['role']}] listening on {srv.addr}", flush=True)
     return srv
@@ -121,6 +126,12 @@ def run_role(cfg: dict):
         svc.start_heartbeat()
         return srv, svc
 
+    if role == "proxy":
+        from .blob.proxy import ProxyAllocator
+
+        svc = ProxyAllocator(rpc.Client(cfg["clustermgr_addr"]))
+        return _serve(rpc.expose(svc), cfg), svc
+
     if role == "access":
         from .blob.access import AccessConfig, AccessHandler
         from .blob.mq import MessageQueue
@@ -132,6 +143,7 @@ def run_role(cfg: dict):
                          engine=cfg.get("ec_engine")),
             repair_queue=MessageQueue(q_dir, "repair") if q_dir else None,
             delete_queue=MessageQueue(q_dir, "delete") if q_dir else None,
+            proxy_client=rpc.Client(cfg["proxy_addr"]) if cfg.get("proxy_addr") else None,
         )
         return _serve(rpc.expose(svc), cfg), svc
 

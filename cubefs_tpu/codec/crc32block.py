@@ -1,0 +1,83 @@
+"""crc32block: per-64KiB-block CRC framing for blob payloads.
+
+Role parity: blobstore/common/crc32block (streaming CRC framing of every
+blob payload on disk and on the wire, encode.go/decode.go) — each
+payload block is followed by its CRC32, so corruption is localized to a
+block and detected at every hop.
+
+Frame layout (block_len B = 64KiB payload per block):
+    [payload b0][crc32(b0) LE u32][payload b1][crc32(b1)] ... ;
+the final block may be short. Encoded size = n + 4*ceil(n/B).
+
+TPU tie-in: `verify_batch` re-CRCs many equal-sized frames as one
+batched device call (decode-side scrub).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+BLOCK = 64 << 10
+
+
+class CrcFrameError(Exception):
+    pass
+
+
+def encoded_size(n: int, block: int = BLOCK) -> int:
+    return n + 4 * ((n + block - 1) // block) if n else 0
+
+
+def decoded_size(n: int, block: int = BLOCK) -> int:
+    full = block + 4
+    blocks, rem = divmod(n, full)
+    if rem == 0:
+        return blocks * block
+    if rem <= 4:
+        raise CrcFrameError(f"frame tail of {rem} bytes is not a block")
+    return blocks * block + rem - 4
+
+
+def encode(data: bytes, block: int = BLOCK) -> bytes:
+    out = bytearray()
+    for off in range(0, len(data), block):
+        chunk = data[off : off + block]
+        out += chunk
+        out += zlib.crc32(chunk).to_bytes(4, "little")
+    return bytes(out)
+
+
+def decode(frame: bytes, block: int = BLOCK) -> bytes:
+    out = bytearray()
+    full = block + 4
+    if len(frame) % full and len(frame) % full <= 4:
+        raise CrcFrameError("truncated frame")
+    for off in range(0, len(frame), full):
+        rec = frame[off : off + full]
+        chunk, crc_raw = rec[:-4], rec[-4:]
+        if len(rec) < 5:
+            raise CrcFrameError("truncated frame")
+        if zlib.crc32(chunk) != int.from_bytes(crc_raw, "little"):
+            raise CrcFrameError(f"crc mismatch in block at offset {off}")
+        out += chunk
+    return bytes(out)
+
+
+def verify_batch(frames: np.ndarray, block: int = BLOCK) -> np.ndarray:
+    """frames: (B, frame_len) uint8 equal-length frames of FULL blocks
+    -> (B,) bool per-frame validity, CRCs computed on-device as one
+    batched kernel call."""
+    from ..ops import crc32_kernel
+
+    b, frame_len = frames.shape
+    full = block + 4
+    if frame_len % full:
+        raise CrcFrameError(f"frame length {frame_len} not whole blocks")
+    nblk = frame_len // full
+    recs = frames.reshape(b, nblk, full)
+    payloads = np.ascontiguousarray(recs[:, :, :block]).reshape(b * nblk, block)
+    crcs = np.asarray(crc32_kernel.crc32_blocks(payloads)).reshape(b, nblk)
+    stored = recs[:, :, block:].copy().view("<u4")[:, :, 0]
+    return (crcs == stored).all(axis=1)

@@ -1,0 +1,154 @@
+"""Metrics: prometheus-style registry + text exposition.
+
+Role parity: util/exporter (Prometheus registry + /metrics endpoint,
+exporter.go:76,115) and the per-module metric files. Counters, gauges
+and histograms register globally; any RPC server can mount
+render_text() at /metrics. Pushgateway/Consul registration is a
+deployment concern left to the operator (the reference gates it on
+config too).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, labels: tuple[str, ...]):
+        self.name = name
+        self.help = help_
+        self.label_names = labels
+        self._series: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict) -> tuple:
+        return tuple(str(labels.get(k, "")) for k in self.label_names)
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+    def samples(self):
+        with self._lock:
+            return [(k, v) for k, v in self._series.items()]
+
+
+class Gauge(Counter):
+    TYPE = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[self._key(labels)] = float(value)
+
+
+class Histogram(_Metric):
+    TYPE = "histogram"
+    BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+    def observe(self, value: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            s = self._series.get(k)
+            if s is None:
+                s = {"count": 0, "sum": 0.0, "buckets": [0] * len(self.BUCKETS)}
+                self._series[k] = s
+            s["count"] += 1
+            s["sum"] += value
+            i = bisect.bisect_left(self.BUCKETS, value)
+            for j in range(i, len(self.BUCKETS)):
+                s["buckets"][j] += 1
+
+    def time(self, **labels):
+        metric = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                metric.observe(time.perf_counter() - self.t0, **labels)
+
+        return _Timer()
+
+    def samples(self):
+        with self._lock:
+            return [(k, dict(v, buckets=list(v["buckets"])))
+                    for k, v in self._series.items()]
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name, help_, labels):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, tuple(labels))
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name, help_="", labels=()) -> Counter:
+        return self._get(Counter, name, help_, labels)
+
+    def gauge(self, name, help_="", labels=()) -> Gauge:
+        return self._get(Gauge, name, help_, labels)
+
+    def histogram(self, name, help_="", labels=()) -> Histogram:
+        return self._get(Histogram, name, help_, labels)
+
+    def render_text(self) -> str:
+        """Prometheus exposition format."""
+        out = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.TYPE}")
+            if isinstance(m, Histogram):
+                for k, s in m.samples():
+                    lbl = _labels(m.label_names, k)
+                    for bound, cum in zip(m.BUCKETS, s["buckets"]):
+                        le = _labels(m.label_names + ("le",), k + (str(bound),))
+                        out.append(f"{m.name}_bucket{le} {cum}")
+                    inf = _labels(m.label_names + ("le",), k + ("+Inf",))
+                    out.append(f"{m.name}_bucket{inf} {s['count']}")
+                    out.append(f"{m.name}_sum{lbl} {s['sum']}")
+                    out.append(f"{m.name}_count{lbl} {s['count']}")
+            else:
+                for k, v in m.samples():
+                    out.append(f"{m.name}{_labels(m.label_names, k)} {v}")
+        return "\n".join(out) + "\n"
+
+
+def _labels(names, values) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+DEFAULT = Registry()
+
+# framework-wide series
+rpc_requests = DEFAULT.counter("cubefs_rpc_requests_total",
+                               "RPC requests served", ("method", "code"))
+rpc_latency = DEFAULT.histogram("cubefs_rpc_latency_seconds",
+                                "RPC handler latency", ("method",))
+codec_bytes = DEFAULT.counter("cubefs_codec_bytes_total",
+                              "bytes through the EC codec", ("op", "engine"))
+repair_tasks = DEFAULT.counter("cubefs_repair_tasks_total",
+                               "repair tasks", ("state",))

@@ -58,13 +58,16 @@ class RpcServer:
     (rpc_* methods + its extra_routes), so handlers mounted after server
     start (per-partition raft) are reachable."""
 
-    def __init__(self, routes, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, routes, host: str = "127.0.0.1", port: int = 0,
+                 service: str = "svc", audit=None):
         self._target = None
         if isinstance(routes, dict):
             self.routes = dict(routes)
         else:
             self._target = routes
             self.routes = {}
+        self.service = service
+        self.audit = audit  # AuditLogger or None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -73,7 +76,36 @@ class RpcServer:
             def log_message(self, *a):  # quiet
                 pass
 
+            def do_GET(self):
+                # observability endpoints (util/exporter + pprof analog)
+                from . import metrics, trace as tracelib
+
+                from urllib.parse import parse_qs, urlsplit
+
+                parts = urlsplit(self.path)
+                if parts.path == "/metrics":
+                    body = metrics.DEFAULT.render_text().encode()
+                    self._reply_raw(200, body, "text/plain; version=0.0.4")
+                elif parts.path == "/spans":
+                    q = parse_qs(parts.query)
+                    tid = (q.get("trace_id") or [None])[0]
+                    body = json.dumps(tracelib.finished_spans(tid)).encode()
+                    self._reply_raw(200, body, "application/json")
+                else:
+                    self._reply_raw(404, b"not found", "text/plain")
+
+            def _reply_raw(self, code, body, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_POST(self):
+                import time as _time
+
+                from . import metrics, trace as tracelib
+
                 name = self.path.lstrip("/")
                 fn = outer.routes.get(name)
                 if fn is None and outer._target is not None:
@@ -81,17 +113,32 @@ class RpcServer:
                 if fn is None:
                     self._reply(404, {"error": f"no such method {name!r}"}, b"")
                     return
+                span = tracelib.from_header(
+                    f"{outer.service}.{name}", self.headers.get("X-Trace")
+                )
+                t0 = _time.perf_counter()
+                code = 200
                 try:
-                    args = json.loads(self.headers.get("X-Rpc-Args") or "{}")
-                    n = int(self.headers.get("Content-Length") or 0)
-                    body = self.rfile.read(n) if n else b""
-                    out = fn(args, body)
-                    meta, payload = _normalize(out)
+                    with span:
+                        args = json.loads(self.headers.get("X-Rpc-Args") or "{}")
+                        n = int(self.headers.get("Content-Length") or 0)
+                        body = self.rfile.read(n) if n else b""
+                        out = fn(args, body)
+                        meta, payload = _normalize(out)
                     self._reply(200, meta, payload)
                 except RpcError as e:
+                    code = e.code
                     self._reply(e.code, {"error": e.message}, b"")
                 except Exception as e:  # surface as 500 with the message
+                    code = 500
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"}, b"")
+                finally:
+                    dt = _time.perf_counter() - t0
+                    metrics.rpc_requests.inc(method=name, code=code)
+                    metrics.rpc_latency.observe(dt, method=name)
+                    if outer.audit is not None:
+                        outer.audit.record(outer.service, name, code, dt,
+                                           trace_id=span.trace_id)
 
             def _reply(self, code: int, meta: dict, payload: bytes):
                 self.send_response(code)
@@ -130,10 +177,16 @@ def call(
     timeout: float = 30.0,
 ) -> tuple[dict, bytes]:
     """Invoke method on a remote RpcServer; returns (meta, payload)."""
+    from . import trace as tracelib
+
+    headers = {"X-Rpc-Args": json.dumps(args or {})}
+    span = tracelib.current()
+    if span is not None:
+        headers["X-Trace"] = span.header()
     req = urllib.request.Request(
         f"http://{addr}/{method}",
         data=body or b"",
-        headers={"X-Rpc-Args": json.dumps(args or {})},
+        headers=headers,
         method="POST",
     )
     try:

@@ -1,0 +1,118 @@
+"""Tracing: span tree with RPC-header propagation.
+
+Role parity: blobstore/common/trace (OpenTracing-compatible spans,
+span.go:36-44; HTTP header propagation, propagation.go; per-request
+track-logs appended to responses, access/stream/stream_put.go:101).
+contextvars carry the active span; the RPC layer injects/extracts the
+`X-Trace` header automatically so a request's spans stitch across
+services.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import threading
+import time
+
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "cubefs_span", default=None
+)
+
+_collector_lock = threading.Lock()
+_finished: list[dict] = []
+MAX_KEPT = 2048
+
+
+def _rand_id() -> str:
+    return f"{random.getrandbits(64):016x}"
+
+
+class Span:
+    def __init__(self, operation: str, trace_id: str | None = None,
+                 parent_id: str | None = None):
+        self.operation = operation
+        self.trace_id = trace_id or _rand_id()
+        self.span_id = _rand_id()
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.finish_ts: float | None = None
+        self.tags: dict = {}
+        self.logs: list[tuple[float, str]] = []
+        self._token = None
+
+    # ---- lifecycle ----
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None:
+            self.set_tag("error", f"{type(exc).__name__}: {exc}")
+        self.finish()
+        if self._token is not None:
+            _current.reset(self._token)
+
+    def finish(self) -> None:
+        if self.finish_ts is None:
+            self.finish_ts = time.time()
+            with _collector_lock:
+                _finished.append(self.to_dict())
+                if len(_finished) > MAX_KEPT:
+                    del _finished[: MAX_KEPT // 2]
+
+    # ---- data ----
+    def set_tag(self, key: str, value) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def log(self, message: str) -> None:
+        self.logs.append((time.time(), message))
+
+    def track_log(self) -> str:
+        """Compact per-hop record (the reference appends these to
+        responses for request forensics)."""
+        dur = (self.finish_ts or time.time()) - self.start
+        return f"{self.operation}:{dur * 1000:.1f}ms"
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "op": self.operation,
+            "start": self.start, "duration": (self.finish_ts or time.time()) - self.start,
+            "tags": dict(self.tags), "logs": list(self.logs),
+        }
+
+    # ---- propagation ----
+    def header(self) -> str:
+        return f"{self.trace_id}:{self.span_id}"
+
+
+def start_span(operation: str) -> Span:
+    """Child of the context's active span (or a fresh root)."""
+    parent = _current.get()
+    if parent is not None:
+        return Span(operation, parent.trace_id, parent.span_id)
+    return Span(operation)
+
+
+def from_header(operation: str, header: str | None) -> Span:
+    if header:
+        try:
+            trace_id, parent_id = header.split(":", 1)
+            return Span(operation, trace_id, parent_id)
+        except ValueError:
+            pass
+    return Span(operation)
+
+
+def current() -> Span | None:
+    return _current.get()
+
+
+def finished_spans(trace_id: str | None = None) -> list[dict]:
+    with _collector_lock:
+        spans = list(_finished)
+    if trace_id:
+        spans = [s for s in spans if s["trace_id"] == trace_id]
+    return spans
