@@ -13,7 +13,6 @@ import threading
 import time
 
 from ..utils import metrics, rpc
-from .types import Location
 
 dial_ops = metrics.DEFAULT.counter(
     "cubefs_dial_ops_total", "dial prober operations", ("op", "ok")

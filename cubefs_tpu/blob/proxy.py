@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import threading
 
-from ..codec import codemode as cm
 from ..utils import rpc
 from .types import VolumeInfo
 
@@ -25,36 +24,45 @@ class ProxyAllocator:
         self._lock = threading.Lock()
         self._bid_next = 0
         self._bid_end = 0
-        self._vols: dict[int, tuple[VolumeInfo, int]] = {}  # mode -> (vol, uses)
+        self._vols: dict[int, tuple[VolumeInfo, int]] = {}  # mode -> (vol, blobs)
 
     def alloc(self, codemode: int, blob_count: int) -> tuple[VolumeInfo, int]:
-        """Returns (volume, first_bid) for blob_count consecutive bids."""
-        with self._lock:
-            vol = self._vol_locked(int(codemode))
-            first = self._bids_locked(blob_count)
-            return vol, first
+        """Returns (volume, first_bid) for blob_count consecutive bids.
 
-    def _vol_locked(self, mode: int) -> VolumeInfo:
-        cached = self._vols.get(mode)
-        if cached is not None:
-            vol, uses = cached
-            if uses < self.VOLUME_REUSE:
-                self._vols[mode] = (vol, uses + 1)
-                return vol
+        Control-plane RPCs happen OUTSIDE the mutex (double-checked
+        install) — a slow clustermgr must not serialize the hot path."""
+        return (self._vol(int(codemode), blob_count),
+                self._bids(blob_count))
+
+    def _vol(self, mode: int, blob_count: int) -> VolumeInfo:
+        with self._lock:
+            cached = self._vols.get(mode)
+            if cached is not None:
+                vol, used = cached
+                if used + blob_count <= self.VOLUME_REUSE:
+                    self._vols[mode] = (vol, used + blob_count)
+                    return vol
         meta, _ = self.cm.call("alloc_volume", {"codemode": mode})
         vol = VolumeInfo.from_dict(meta["volume"])
-        self._vols[mode] = (vol, 1)
+        with self._lock:
+            # another thread may have installed a fresher volume; ours
+            # still works (extra volume, no correctness issue)
+            self._vols[mode] = (vol, blob_count)
         return vol
 
-    def _bids_locked(self, count: int) -> int:
-        if self._bid_next + count > self._bid_end:
-            batch = max(self.BID_BATCH, count)
-            meta, _ = self.cm.call("alloc_bids", {"count": batch})
-            self._bid_next = meta["start"]
+    def _bids(self, count: int) -> int:
+        with self._lock:
+            if self._bid_next + count <= self._bid_end:
+                first = self._bid_next
+                self._bid_next += count
+                return first
+        batch = max(self.BID_BATCH, count)
+        meta, _ = self.cm.call("alloc_bids", {"count": batch})
+        with self._lock:
+            # install the fresh lease; serve this request from its head
+            self._bid_next = meta["start"] + count
             self._bid_end = meta["start"] + batch
-        first = self._bid_next
-        self._bid_next += count
-        return first
+            return meta["start"]
 
     def invalidate_volume(self, codemode: int) -> None:
         """Drop the cached volume (e.g. after write failures against it)."""

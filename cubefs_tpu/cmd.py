@@ -103,8 +103,22 @@ def run_role(cfg: dict):
         for bucket, vol_name in cfg.get("vols", {}).items():
             view = master.call("client_view", {"name": vol_name})[0]["volume"]
             vols[bucket] = FileSystem(view, pool)
+        auth = None
+        if cfg.get("users"):  # [{access_key, secret_key, grants:{vol:perm}}]
+            from .fs.authnode import UserStore
+            from .fs.s3auth import S3V4Authenticator
+
+            store = UserStore()
+            for u in cfg["users"]:
+                store.users[u["access_key"]] = {
+                    "user_id": u.get("user_id", u["access_key"]),
+                    "sk": u["secret_key"],
+                    "volumes": dict(u.get("grants", {})),
+                }
+            auth = S3V4Authenticator(store, dict(cfg.get("vols", {})))
         node = ObjectNode(vols, host=cfg.get("listen_host", "127.0.0.1"),
-                          port=int(cfg.get("listen_port", 0))).start()
+                          port=int(cfg.get("listen_port", 0)),
+                          authenticator=auth).start()
         print(f"[objectnode] S3 on {node.addr}", flush=True)
         return node, node
 

@@ -57,8 +57,6 @@ def decode(frame: bytes, block: int = BLOCK) -> bytes:
     for off in range(0, len(frame), full):
         rec = frame[off : off + full]
         chunk, crc_raw = rec[:-4], rec[-4:]
-        if len(rec) < 5:
-            raise CrcFrameError("truncated frame")
         if zlib.crc32(chunk) != int.from_bytes(crc_raw, "little"):
             raise CrcFrameError(f"crc mismatch in block at offset {off}")
         out += chunk

@@ -1,0 +1,129 @@
+"""Block cache: client-local read cache (bcache daemon role).
+
+Role parity: client/blockcache — a local SSD LRU keyed by extent block
+that accelerates repeated reads (bcache/manage.go LRU management). Here
+a process-local tier in front of ExtentClient reads, optionally spilling
+to a local directory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+
+class BlockCache:
+    def __init__(self, capacity_bytes: int = 128 << 20,
+                 spill_dir: str | None = None):
+        self.capacity = capacity_bytes
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+        self._lru: OrderedDict[str, bytes | None] = OrderedDict()
+        self._used = 0
+        self.hits = 0
+        self.misses = 0
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        h = hashlib.sha1(key.encode()).hexdigest()
+        return os.path.join(self.spill_dir, h)
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            if key in self._lru:
+                data = self._lru[key]
+                self._lru.move_to_end(key)
+                if data is None and self.spill_dir:  # spilled entry
+                    try:
+                        data = open(self._path(key), "rb").read()
+                    except OSError:
+                        del self._lru[key]
+                        self.misses += 1
+                        return None
+                self.hits += 1
+                return data
+            self.misses += 1
+            return None
+
+    def put(self, key: str, data: bytes) -> None:
+        with self._lock:
+            old = self._lru.pop(key, None)
+            if old:
+                self._used -= len(old)
+            if self.spill_dir:
+                with open(self._path(key), "wb") as f:
+                    f.write(data)
+                self._lru[key] = None  # present on disk
+            else:
+                self._lru[key] = data
+                self._used += len(data)
+            while self._used > self.capacity and self._lru:
+                k, evicted = self._lru.popitem(last=False)
+                if evicted:
+                    self._used -= len(evicted)
+                elif self.spill_dir:
+                    try:
+                        os.unlink(self._path(k))
+                    except OSError:
+                        pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"items": len(self._lru), "bytes": self._used,
+                    "hits": self.hits, "misses": self.misses}
+
+
+class CachingExtentClient:
+    """ExtentClient wrapper adding the local block cache on the read
+    path (write path invalidates touched extents)."""
+
+    BLOCK = 128 << 10
+
+    def __init__(self, inner, cache: BlockCache | None = None):
+        self.inner = inner
+        self.cache = cache or BlockCache()
+
+    def write(self, meta, ino: int, file_offset: int, data: bytes) -> None:
+        self.inner.write(meta, ino, file_offset, data)
+        # conservative invalidation: drop all cached blocks of this inode
+        with self.cache._lock:
+            stale = [k for k in self.cache._lru if k.startswith(f"{ino}/")]
+            for k in stale:
+                v = self.cache._lru.pop(k)
+                if v:
+                    self.cache._used -= len(v)
+
+    def close_stream(self, ino: int) -> None:
+        self.inner.close_stream(ino)
+
+    def release_extents(self, eks) -> None:
+        self.inner.release_extents(eks)
+
+    def _dp_by_id(self, dp_id):
+        return self.inner._dp_by_id(dp_id)
+
+    def read(self, inode: dict, offset: int, length: int) -> bytes:
+        size = inode["size"]
+        if offset >= size:
+            return b""
+        length = min(length, size - offset)
+        out = bytearray(length)
+        pos = offset
+        while pos < offset + length:
+            block = pos // self.BLOCK
+            in_block = pos % self.BLOCK
+            take = min(offset + length - pos, self.BLOCK - in_block)
+            key = f"{inode['ino']}/{block}"
+            blk = self.cache.get(key)
+            if blk is None:
+                blk = self.inner.read(
+                    inode, block * self.BLOCK,
+                    min(self.BLOCK, size - block * self.BLOCK),
+                )
+                self.cache.put(key, blk)
+            out[pos - offset : pos - offset + take] = blk[in_block : in_block + take]
+            pos += take
+        return bytes(out)

@@ -1,0 +1,126 @@
+"""LcNode: S3 lifecycle rule executor.
+
+Role parity: lcnode/ — scans volume metadata against lifecycle rules
+(lc_scanner.go) and applies expiration actions; the reference also
+transitions storage classes (lc_transition.go), which here maps to
+re-writing a file's payload into the EC blob plane (cold tier) and
+recording the blob location in an xattr.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from dataclasses import dataclass, field
+
+from . import metanode as mn
+from .client import FileSystem, FsError
+
+
+@dataclass
+class LifecycleRule:
+    rule_id: str
+    prefix: str = ""  # path prefix, e.g. "/logs/"
+    expire_after_s: float | None = None  # delete when mtime older
+    transition_after_s: float | None = None  # move payload to blob plane
+    enabled: bool = True
+
+
+@dataclass
+class ScanReport:
+    scanned: int = 0
+    expired: int = 0
+    transitioned: int = 0
+    errors: list = field(default_factory=list)
+
+
+class LcNode:
+    def __init__(self, fs: FileSystem, blob_access=None):
+        self.fs = fs
+        self.blob = blob_access  # AccessHandler-compatible (cold tier)
+        self.rules: list[LifecycleRule] = []
+        self._stop = threading.Event()
+
+    def set_rules(self, rules: list[LifecycleRule]) -> None:
+        self.rules = list(rules)
+
+    def scan_once(self) -> ScanReport:
+        report = ScanReport()
+        now = time.time()
+        self._walk("/", mn.ROOT_INO, now, report)
+        return report
+
+    def _walk(self, path: str, ino: int, now: float, report: ScanReport) -> None:
+        try:
+            entries = self.fs.meta.readdir(ino)
+        except FsError:
+            return
+        for name, child in sorted(entries.items()):
+            cpath = f"{path.rstrip('/')}/{name}"
+            try:
+                inode = self.fs.meta.inode_get(child)
+            except FsError:
+                continue
+            if inode["type"] == mn.DIR:
+                self._walk(cpath, child, now, report)
+                continue
+            report.scanned += 1
+            for rule in self.rules:
+                if not rule.enabled or not cpath.startswith(rule.prefix):
+                    continue
+                age = now - inode["mtime"]
+                try:
+                    if rule.expire_after_s is not None and age > rule.expire_after_s:
+                        self.fs.unlink(cpath)
+                        report.expired += 1
+                        break
+                    if (rule.transition_after_s is not None
+                            and age > rule.transition_after_s
+                            and self.blob is not None
+                            and not inode["xattr"].get("cold.location")):
+                        self._transition(cpath, inode, report)
+                        break
+                except FsError as e:
+                    report.errors.append((cpath, str(e)))
+        return
+
+    def _transition(self, path: str, inode: dict, report: ScanReport) -> None:
+        """Cold-tier transition: payload moves to the EC blob plane; the
+        hot-tier extents are released and the location pinned in xattr
+        (the FS<->blob bridge, sdk/data/blobstore writer role)."""
+        data = self.fs.read_file(path)
+        loc = self.blob.put(data) if data else None
+        if loc is not None:
+            self.fs.meta.set_xattr(inode["ino"], "cold.location",
+                                   __import__("json").dumps(loc.to_dict()))
+            freed = self.fs.meta.truncate(inode["ino"], 0)
+            self.fs.meta.set_attr(inode["ino"], size=len(data))
+            self.fs.data.release_extents(freed)
+            report.transitioned += 1
+
+    def read_through(self, path: str) -> bytes:
+        """Read a possibly-cold file: hot extents if present, else fetch
+        from the blob plane via the pinned location."""
+        inode = self.fs.meta.inode_get(self.fs.resolve(path))
+        if inode["extents"]:
+            return self.fs.data.read(inode, 0, inode["size"])
+        cold = inode["xattr"].get("cold.location")
+        if cold:
+            from ..blob.types import Location
+
+            return self.blob.get(Location.from_dict(__import__("json").loads(cold)))
+        return b""
+
+    def start(self, interval: float = 60.0) -> None:
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.scan_once()
+                except Exception:
+                    pass
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -72,8 +72,16 @@ class ObjectNode:
 
             # ---- verbs ----
             def do_PUT(self):
-                if not self._authorized():
-                    return self._error(403, "AccessDenied", "bad signature")
+                # drain the body BEFORE any reply: leftover body bytes
+                # desync HTTP/1.1 keep-alive clients. The authenticator
+                # drains (and stashes) it as part of signature hashing.
+                if outer.auth is None:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    data = self.rfile.read(n)
+                else:
+                    if not self._authorized():
+                        return self._error(403, "AccessDenied", "bad signature")
+                    data = getattr(self, "_stashed_body", b"")
                 bucket, key, _ = self._split()
                 if not key:  # CreateBucket
                     if bucket not in outer.volumes:
@@ -83,8 +91,6 @@ class ObjectNode:
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
-                n = int(self.headers.get("Content-Length") or 0)
-                data = self.rfile.read(n)
                 try:
                     outer._put_object(fs, key, data)
                 except FsError as e:

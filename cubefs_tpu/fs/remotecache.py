@@ -1,0 +1,178 @@
+"""RemoteCache: distributed read-acceleration cache.
+
+Role parity: remotecache/ — flashnode (cache engine serving hot extent
+blocks, cachengine/engine.go:42) + flashgroupmanager (slot-routed flash
+groups, flashgroupmanager/cluster.go) + the client read hook
+(sdk/data/stream/stream_remote_cache.go) with consistent-hash slot
+routing (proto/distributed_cache.go).
+
+FlashNode: LRU of (dp, extent, block) -> bytes with a capacity budget.
+FlashGroupManager: slot ring mapping cache keys to flash groups.
+CachedReader: ExtentClient wrapper that consults the ring before the
+datanode and populates on miss.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+from ..utils import metrics, rpc
+
+CACHE_BLOCK = 128 << 10
+
+cache_ops = metrics.DEFAULT.counter(
+    "cubefs_flashcache_ops_total", "flash cache results", ("result",)
+)
+
+
+class FlashNode:
+    """In-RAM LRU cache engine (tmpfs-class tier of the reference)."""
+
+    def __init__(self, capacity_bytes: int = 256 << 20):
+        self.capacity = capacity_bytes
+        self._lock = threading.Lock()
+        self._lru: OrderedDict[str, bytes] = OrderedDict()
+        self._used = 0
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            data = self._lru.get(key)
+            if data is not None:
+                self._lru.move_to_end(key)
+            return data
+
+    def put(self, key: str, data: bytes) -> None:
+        with self._lock:
+            old = self._lru.pop(key, None)
+            if old is not None:
+                self._used -= len(old)
+            self._lru[key] = data
+            self._used += len(data)
+            while self._used > self.capacity and self._lru:
+                _, evicted = self._lru.popitem(last=False)
+                self._used -= len(evicted)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"items": len(self._lru), "bytes": self._used,
+                    "capacity": self.capacity}
+
+    # ---------------- RPC surface ----------------
+    def rpc_cache_get(self, args, body):
+        data = self.get(args["key"])
+        if data is None:
+            raise rpc.RpcError(404, "cache miss")
+        return {}, data
+
+    def rpc_cache_put(self, args, body):
+        self.put(args["key"], body)
+        return {}
+
+    def rpc_stats(self, args, body):
+        return self.stats()
+
+
+class FlashGroupManager:
+    """Slot ring: SLOTS hash slots spread over flash groups (each group =
+    a set of flashnode addrs; reads hit the first healthy member)."""
+
+    SLOTS = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.groups: dict[int, list[str]] = {}
+
+    def register_group(self, group_id: int, addrs: list[str]) -> None:
+        with self._lock:
+            self.groups[group_id] = list(addrs)
+
+    def ring(self) -> dict[int, list[str]]:
+        with self._lock:
+            return {g: list(a) for g, a in self.groups.items()}
+
+    @classmethod
+    def slot_of(cls, key: str) -> int:
+        return int.from_bytes(hashlib.md5(key.encode()).digest()[:4], "big") % cls.SLOTS
+
+    def group_for(self, key: str) -> list[str]:
+        with self._lock:
+            if not self.groups:
+                return []
+            ids = sorted(self.groups)
+            gid = ids[self.slot_of(key) % len(ids)]
+            return list(self.groups[gid])
+
+    # ---------------- RPC surface ----------------
+    def rpc_register_group(self, args, body):
+        self.register_group(args["group_id"], args["addrs"])
+        return {}
+
+    def rpc_ring(self, args, body):
+        return {"groups": {str(k): v for k, v in self.ring().items()}}
+
+
+class CachedReader:
+    """Read-through wrapper for ExtentClient: flash ring first, datanode
+    on miss, then populate (the client hook in stream_remote_cache.go)."""
+
+    def __init__(self, extent_client, fgm: FlashGroupManager, node_pool):
+        self.inner = extent_client
+        self.fgm = fgm
+        self.nodes = node_pool
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _key(dp_id: int, extent_id: int, block: int) -> str:
+        return f"{dp_id}/{extent_id}/{block}"
+
+    def read_block(self, dp: dict, extent_id: int, block: int,
+                   length: int) -> bytes:
+        key = self._key(dp["dp_id"], extent_id, block)
+        for addr in self.fgm.group_for(key):
+            try:
+                _, data = self.nodes.get(addr).call("cache_get", {"key": key})
+                self.hits += 1
+                cache_ops.inc(result="hit")
+                return data[:length]
+            except rpc.RpcError:
+                continue
+        self.misses += 1
+        cache_ops.inc(result="miss")
+        data = self.inner._read_replicated(
+            dp, extent_id, block * CACHE_BLOCK, CACHE_BLOCK
+        )
+        for addr in self.fgm.group_for(key):
+            try:
+                self.nodes.get(addr).call("cache_put", {"key": key}, data)
+                break
+            except rpc.RpcError:
+                continue
+        return data[:length]
+
+    def read(self, inode: dict, offset: int, length: int) -> bytes:
+        """Cache-block-aligned read of one inode's bytes."""
+        size = inode["size"]
+        if offset >= size:
+            return b""
+        length = min(length, size - offset)
+        out = bytearray(length)
+        for ek in inode["extents"]:
+            lo = max(offset, ek["file_offset"])
+            hi = min(offset + length, ek["file_offset"] + ek["size"])
+            if lo >= hi:
+                continue
+            dp = self.inner._dp_by_id(ek["dp_id"])
+            pos = lo
+            while pos < hi:
+                ext_pos = ek["ext_offset"] + (pos - ek["file_offset"])
+                block = ext_pos // CACHE_BLOCK
+                in_block = ext_pos % CACHE_BLOCK
+                take = min(hi - pos, CACHE_BLOCK - in_block)
+                blk = self.read_block(dp, ek["extent_id"], block,
+                                      in_block + take)
+                out[pos - offset : pos - offset + take] = blk[in_block : in_block + take]
+                pos += take
+        return bytes(out)

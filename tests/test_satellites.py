@@ -1,0 +1,137 @@
+"""Satellite services: remotecache (flashnode/ring/cached reads), lcnode
+lifecycle (expire + cold transition to the blob plane + read-through),
+client block cache."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob.access import AccessConfig, AccessHandler
+from cubefs_tpu.blob.blobnode import BlobNode
+from cubefs_tpu.blob.clustermgr import ClusterMgr
+from cubefs_tpu.fs import metanode as mn
+from cubefs_tpu.fs.blockcache import BlockCache, CachingExtentClient
+from cubefs_tpu.fs.client import FileSystem
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.lcnode import LcNode, LifecycleRule
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+from cubefs_tpu.fs.remotecache import CachedReader, FlashGroupManager, FlashNode
+from cubefs_tpu.utils import rpc
+from cubefs_tpu.utils.rpc import NodePool
+
+
+@pytest.fixture
+def fscluster(tmp_path):
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+    for i in range(3):
+        node = DataNode(i, str(tmp_path / f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", node)
+        master.register_datanode(f"data{i}")
+    view = master.create_volume("satvol", mp_count=1, dp_count=2)
+    return FileSystem(view, pool), pool, tmp_path
+
+
+def test_flashnode_lru_eviction():
+    fn = FlashNode(capacity_bytes=3000)
+    for i in range(5):
+        fn.put(f"k{i}", b"x" * 1000)
+    st = fn.stats()
+    assert st["bytes"] <= 3000 and st["items"] == 3
+    assert fn.get("k0") is None and fn.get("k4") is not None
+
+
+def test_flash_ring_routing():
+    fgm = FlashGroupManager()
+    fgm.register_group(1, ["fn-a"])
+    fgm.register_group(2, ["fn-b"])
+    seen = {tuple(fgm.group_for(f"key{i}")) for i in range(64)}
+    assert seen == {("fn-a",), ("fn-b",)}  # both groups used
+    # stable routing
+    assert fgm.group_for("keyX") == fgm.group_for("keyX")
+
+
+def test_cached_reader_hits_after_first_read(fscluster, rng):
+    fs, pool, _ = fscluster
+    payload = rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    fs.write_file("/hot.bin", payload)
+    fgm = FlashGroupManager()
+    flash = FlashNode()
+    pool.bind("flash0", flash)
+    fgm.register_group(1, ["flash0"])
+    reader = CachedReader(fs.data, fgm, pool)
+    inode = fs.meta.inode_get(fs.resolve("/hot.bin"))
+    assert reader.read(inode, 0, len(payload)) == payload
+    first_misses = reader.misses
+    assert reader.read(inode, 1000, 100_000) == payload[1000:101_000]
+    assert reader.misses == first_misses  # warm: all from cache
+    assert reader.hits > 0
+
+
+def test_lcnode_expiration(fscluster, rng):
+    fs, _, _ = fscluster
+    fs.mkdir("/logs")
+    fs.write_file("/logs/old.log", b"ancient")
+    fs.write_file("/logs/new.log", b"fresh")
+    fs.write_file("/keep.dat", b"other")
+    fs.meta.set_attr(fs.resolve("/logs/old.log"), mtime=time.time() - 3600)
+    lc = LcNode(fs)
+    lc.set_rules([LifecycleRule("expire-logs", prefix="/logs/",
+                                expire_after_s=600)])
+    report = lc.scan_once()
+    assert report.expired == 1
+    assert set(fs.readdir("/logs")) == {"new.log"}
+    assert fs.read_file("/keep.dat") == b"other"
+
+
+def test_lcnode_cold_transition_and_read_through(fscluster, tmp_path, rng):
+    fs, pool, _ = fscluster
+    # cold tier: a mini blob plane
+    cm = ClusterMgr(allow_colocated_units=True)
+    bn = BlobNode(0, [str(tmp_path / f"bd{i}") for i in range(9)],
+                  rpc.Client(cm), addr="bn0")
+    bn.register()
+    bn.send_heartbeat()
+    pool.bind("bn0", bn)
+    blob = AccessHandler(rpc.Client(cm), pool, AccessConfig(blob_size=64 << 10))
+
+    payload = rng.integers(0, 256, 120_000, dtype=np.uint8).tobytes()
+    fs.write_file("/cold/data.bin" if False else "/data.bin", payload)
+    fs.meta.set_attr(fs.resolve("/data.bin"), mtime=time.time() - 7200)
+    lc = LcNode(fs, blob_access=blob)
+    lc.set_rules([LifecycleRule("tier", prefix="/", transition_after_s=3600)])
+    report = lc.scan_once()
+    assert report.transitioned == 1
+    inode = fs.meta.inode_get(fs.resolve("/data.bin"))
+    assert inode["extents"] == [] and inode["xattr"].get("cold.location")
+    assert lc.read_through("/data.bin") == payload  # served from blob plane
+
+
+def test_block_cache_spill_and_stats(tmp_path, rng):
+    bc = BlockCache(capacity_bytes=1, spill_dir=str(tmp_path / "bc"))
+    data = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    bc.put("a/0", data)
+    assert bc.get("a/0") == data  # served from spill file
+    assert bc.stats()["hits"] == 1
+
+
+def test_caching_extent_client(fscluster, rng):
+    fs, _, _ = fscluster
+    payload = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    fs.write_file("/c.bin", payload)
+    cached = CachingExtentClient(fs.data, BlockCache())
+    fs.data = cached
+    assert fs.read_file("/c.bin") == payload
+    m0 = cached.cache.misses
+    assert fs.read_file("/c.bin", offset=5_000, length=50_000) == payload[5_000:55_000]
+    assert cached.cache.misses == m0  # warm
+    # write invalidates
+    fs.write_file("/c.bin", b"new-bytes")
+    assert fs.read_file("/c.bin") == b"new-bytes"
