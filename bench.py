@@ -94,6 +94,22 @@ def main() -> None:
     dt = _time_fn(lambda a: rs_kernel.gf_matrix_apply(rows, a), surv)
     repair_gibs = B * n * S / dt / (1 << 30)
 
+    # fused pallas path (TPU): avoids the 8x bit tensor in HBM
+    pallas_gibs = None
+    if on_tpu:
+        try:
+            from cubefs_tpu.ops import pallas_gf
+
+            dt = _time_fn(
+                lambda a: pallas_gf.gf_matrix_apply_pallas(rows, a), surv
+            )
+            pallas_gibs = B * n * S / dt / (1 << 30)
+            repair_gibs = max(repair_gibs, pallas_gibs)
+        except Exception as e:
+            import sys
+
+            print(f"bench: pallas path failed: {e}", file=sys.stderr)
+
     # --- CRC32, 128KiB blocks -------------------------------------------
     nblk = 256 if on_tpu else 32
     blocks = jax.device_put(
@@ -113,6 +129,7 @@ def main() -> None:
                 "extras": {
                     "encode_gibs": round(encode_gibs, 3),
                     "crc32_gbs": round(crc_gbs, 3),
+                    "pallas_repair_gibs": round(pallas_gibs, 3) if pallas_gibs else None,
                     "platform": platform,
                     "shard_bytes": S,
                     "stripes_per_step": B,

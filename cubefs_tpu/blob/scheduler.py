@@ -86,7 +86,10 @@ class Scheduler:
             return n
 
     def _queue_unit_repair(self, vid: int, unit_index: int, reason: str,
-                           src_disk: int | None = None) -> str:
+                           src_disk: int | None = None,
+                           created_flag: list | None = None) -> str:
+        """Queue (or dedup to) a unit-repair task. created_flag, if
+        given, receives True only when a NEW task was created."""
         with self._lock:
             for t in self.tasks.values():
                 if (t["vid"] == vid and t["unit_index"] == unit_index
@@ -112,6 +115,8 @@ class Scheduler:
                 "reason": reason,
             }
             self.tasks[task["task_id"]] = task
+            if created_flag is not None:
+                created_flag.append(True)
             return task["task_id"]
 
     def drop_disk(self, disk_id: int) -> int:
@@ -165,6 +170,115 @@ class Scheduler:
                     )
                 except rpc.RpcError:
                     pass
+
+    # ---------------- balance / manual migrate / inspect ----------------
+    def balance(self, max_moves: int = 4, threshold: int = 2) -> int:
+        """Move units off the most-loaded disks onto the least-loaded
+        (balancer.go role). Only counts NORMAL disks; a move is the same
+        unit_repair machinery with a healthy source."""
+        if not self.switch.enabled("balance"):
+            return 0
+        with self._lock:
+            normal = [d for d in self.cm.disks.values()
+                      if d.status == DiskStatus.NORMAL]
+            if len(normal) < 2:
+                return 0
+            normal.sort(key=lambda d: d.chunk_count)
+            # account planned moves locally — never mutate clustermgr's
+            # records outside its apply door, and never count deduped
+            # re-queues as movement
+            planned: dict[int, int] = {}
+            moves = 0
+            for hot in reversed(normal):
+                cold = normal[0]
+                eff_hot = hot.chunk_count - planned.get(hot.disk_id, 0)
+                if eff_hot - cold.chunk_count < threshold or moves >= max_moves:
+                    break
+                units = self.cm.volumes_on_disk(hot.disk_id)
+                if not units:
+                    continue
+                vid, unit_index = units[0]
+                created: list = []
+                self._queue_unit_repair(vid, unit_index,
+                                        reason=f"balance off disk {hot.disk_id}",
+                                        created_flag=created)
+                if created:
+                    planned[hot.disk_id] = planned.get(hot.disk_id, 0) + 1
+                    moves += 1
+            return moves
+
+    def manual_migrate(self, vid: int, unit_index: int) -> str:
+        """Operator-requested unit migration (manual_migrater.go role)."""
+        return self._queue_unit_repair(vid, unit_index, reason="manual migrate")
+
+    def inspect_volumes(self, max_volumes: int = 8, max_bids: int = 64) -> dict:
+        """Scrubber (volume_inspector.go role): re-reads stripes and
+        verifies parity with a BATCHED device call per (volume, size)
+        group; inconsistent or unreadable units become repair tasks."""
+        if not self.switch.enabled("volume_inspect"):
+            return {"checked": 0, "bad": 0}
+        import numpy as np
+
+        from ..codec import codemode as cmode
+        from ..codec.encoder import CodecConfig, new_encoder
+
+        checked = bad = 0
+        with self._lock:
+            vids = sorted(self.cm.volumes)[:max_volumes]
+        for vid in vids:
+            vol = self.cm.get_volume(vid)
+            enc = new_encoder(CodecConfig(mode=cmode.CodeMode(vol.codemode)))
+            t = enc.t
+            listings: dict[int, dict[int, tuple[int, int]]] = {}
+            for u in vol.units:
+                try:
+                    meta, _ = self.nodes.get(u.node_addr).call(
+                        "list_chunk", {"disk_id": u.disk_id, "chunk_id": u.chunk_id}
+                    )
+                    listings[u.index] = {b: (s, c) for b, s, c in meta["shards"]}
+                except rpc.RpcError:
+                    listings[u.index] = {}
+            bids = sorted(set().union(*[set(l) for l in listings.values()]))[:max_bids]
+            by_size: dict[int, list[int]] = {}
+            for bid in bids:
+                sizes = {listings[i][bid][0] for i in listings if bid in listings[i]}
+                if len(sizes) == 1:
+                    by_size.setdefault(sizes.pop(), []).append(bid)
+            for size, group in by_size.items():
+                stripes = np.zeros((len(group), t.total, size), dtype=np.uint8)
+                missing: dict[int, set[int]] = {}  # group idx -> unit idxs
+                for gi, bid in enumerate(group):
+                    for u in vol.units:
+                        try:
+                            _, payload = self.nodes.get(u.node_addr).call(
+                                "get_shard",
+                                {"disk_id": u.disk_id, "chunk_id": u.chunk_id,
+                                 "bid": bid},
+                            )
+                            stripes[gi, u.index] = np.frombuffer(payload, np.uint8)
+                        except rpc.RpcError:
+                            missing.setdefault(gi, set()).add(u.index)
+                checked += len(group)
+                # one batched device parity recompute, per-stripe verdicts
+                parity = enc.engine.encode_parity(stripes[:, : t.n], t.m)
+                mismatch = (parity != stripes[:, t.n : t.n + t.m]).any(axis=-1)
+                for gi, bid in enumerate(group):
+                    miss = missing.get(gi, set())
+                    for idx in miss:
+                        self._queue_unit_repair(vol.vid, idx,
+                                                reason=f"inspect: bid {bid} missing")
+                    # parity rows that disagree (and aren't merely missing)
+                    # are corrupt-but-present: queue their repair too
+                    bad_parity = {
+                        t.n + pi for pi in np.nonzero(mismatch[gi])[0]
+                    } - miss
+                    if bad_parity and not miss:
+                        bad += 1
+                        for idx in sorted(bad_parity):
+                            self._queue_unit_repair(
+                                vol.vid, idx,
+                                reason=f"inspect: bid {bid} parity mismatch")
+        return {"checked": checked, "bad": bad}
 
     # ---------------- task leasing (worker API) ----------------
     def acquire_task(self, worker_id: str) -> dict | None:

@@ -8,7 +8,7 @@ plane, and blocks. Heartbeat loops run in daemon threads.
 
 Config keys (JSON):
   role:        master | metanode | datanode | objectnode |
-               clustermgr | blobnode | access | proxy | scheduler
+               clustermgr | blobnode | access | proxy | scheduler | codec
   listen_host / listen_port: bind address (port 0 = ephemeral)
   master_addr / clustermgr_addr / scheduler_addr: upstreams
   data_dirs / data_dir: storage paths
@@ -159,6 +159,12 @@ def run_role(cfg: dict):
             delete_queue=MessageQueue(q_dir, "delete") if q_dir else None,
             proxy_client=rpc.Client(cfg["proxy_addr"]) if cfg.get("proxy_addr") else None,
         )
+        return _serve(rpc.expose(svc), cfg), svc
+
+    if role == "codec":
+        from .codec.service import CodecService
+
+        svc = CodecService(engine=cfg.get("ec_engine"))
         return _serve(rpc.expose(svc), cfg), svc
 
     if role == "scheduler":

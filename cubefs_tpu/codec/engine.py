@@ -80,6 +80,10 @@ def get_engine(name: str | None = None) -> Engine:
     """Resolve an engine by name; default from CUBEFS_TPU_EC_ENGINE
     (the --ec-engine flag analog), falling back to the TPU path."""
     name = name or os.environ.get("CUBEFS_TPU_EC_ENGINE", "tpu")
+    if name == "tpu-pallas" and name not in _REGISTRY:
+        from ..ops import pallas_gf
+
+        pallas_gf.register()  # idempotent; import alone is a no-op if cached
     if name not in _REGISTRY:
         raise KeyError(f"unknown ec engine {name!r}; have {sorted(_REGISTRY)}")
     if name not in _instances:

@@ -1,0 +1,86 @@
+"""Codec sidecar service: the cross-language `codec.Engine` boundary.
+
+BASELINE.json's north star puts the TPU codec behind a service boundary
+("streams shard batches to a co-located Python/JAX sidecar over
+cgo/gRPC"): non-Python storage nodes offload EC math here. Binary-in/
+binary-out RPC endpoints over the framework transport; shapes ride the
+JSON args, shard bytes ride the body (zero JSON overhead on the data).
+
+Endpoints:
+  encode      {n, m, shard_size, batch} + body data shards -> parity
+  reconstruct {n, total, present, wanted, shard_size, batch} + survivors
+  crc32      {block_len} + blocks -> u32le array
+  verify     {n, m, shard_size, batch} + full stripes -> {ok: [...]}
+
+Consumed by the native client library (runtime/src/native_client.cc,
+the libcfs-analog C ABI).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import crc32_kernel, gf256, rs_kernel
+from ..utils import metrics, rpc
+from .engine import get_engine
+
+codec_bytes = metrics.codec_bytes
+
+
+class CodecService:
+    def __init__(self, engine: str | None = None):
+        self.engine = get_engine(engine)
+
+    # ---------------- RPC surface ----------------
+    def rpc_engine(self, args, body):
+        return {"engine": self.engine.name}
+
+    def rpc_encode(self, args, body):
+        n, m = int(args["n"]), int(args["m"])
+        s, b = int(args["shard_size"]), int(args.get("batch", 1))
+        expect = b * n * s
+        if len(body) != expect:
+            raise rpc.RpcError(400, f"body {len(body)}B != batch*n*shard {expect}B")
+        data = np.frombuffer(body, dtype=np.uint8).reshape(b, n, s)
+        parity = self.engine.encode_parity(data, m)
+        codec_bytes.inc(len(body), op="encode", engine=self.engine.name)
+        return {"shape": [b, m, s]}, np.ascontiguousarray(parity).tobytes()
+
+    def rpc_reconstruct(self, args, body):
+        n, total = int(args["n"]), int(args["total"])
+        present = [int(i) for i in args["present"]]
+        wanted = [int(i) for i in args["wanted"]]
+        if present != sorted(present):
+            # decode rows are built for ascending shard order; silently
+            # accepting a different body order would corrupt the output
+            raise rpc.RpcError(400, "present must be sorted ascending and "
+                                    "body rows must follow that order")
+        s, b = int(args["shard_size"]), int(args.get("batch", 1))
+        k = len(present[:n])
+        if len(body) != b * k * s:
+            raise rpc.RpcError(400, "body size mismatch")
+        surv = np.frombuffer(body, dtype=np.uint8).reshape(b, k, s)[:, :n]
+        rows = rs_kernel.reconstruct_rows(n, total, present, wanted)
+        rec = self.engine.matrix_apply(rows, surv)
+        codec_bytes.inc(len(body), op="reconstruct", engine=self.engine.name)
+        return {"shape": [b, len(wanted), s]}, np.ascontiguousarray(rec).tobytes()
+
+    def rpc_crc32(self, args, body):
+        block = int(args["block_len"])
+        if block <= 0 or len(body) % block:
+            raise rpc.RpcError(400, f"body not a multiple of block {block}")
+        blocks = np.frombuffer(body, dtype=np.uint8).reshape(-1, block)
+        crcs = np.asarray(crc32_kernel.crc32_blocks(blocks), dtype="<u4")
+        codec_bytes.inc(len(body), op="crc32", engine="tpu")
+        return {"count": len(crcs)}, crcs.tobytes()
+
+    def rpc_verify(self, args, body):
+        n, m = int(args["n"]), int(args["m"])
+        s, b = int(args["shard_size"]), int(args.get("batch", 1))
+        if len(body) != b * (n + m) * s:
+            raise rpc.RpcError(400, "body size mismatch")
+        stripes = np.frombuffer(body, dtype=np.uint8).reshape(b, n + m, s)
+        parity = self.engine.encode_parity(stripes[:, :n], m)
+        ok = (parity == stripes[:, n:]).all(axis=(1, 2))
+        codec_bytes.inc(len(body), op="verify", engine=self.engine.name)
+        return {"ok": [bool(x) for x in ok]}

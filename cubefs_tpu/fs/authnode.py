@@ -117,9 +117,9 @@ class AuthNode:
             "session": base64.b64encode(session_key).decode(),
         }
         payload = json.dumps(claims, sort_keys=True).encode()
-        ticket = base64.b64encode(
-            payload + b"." + _mac(skey, payload)
-        ).decode()
+        # MAC appended as a FIXED 32-byte suffix: raw digest bytes may
+        # contain any separator byte, so delimiter-splitting is unsafe
+        ticket = base64.b64encode(payload + _mac(skey, payload)).decode()
         return {"ticket": ticket,
                 "session_key": base64.b64encode(session_key).decode()}
 
@@ -129,7 +129,9 @@ class AuthNode:
         """Service-side check: MAC + expiry + audience."""
         try:
             raw = base64.b64decode(ticket)
-            payload, mac = raw.rsplit(b".", 1)
+            if len(raw) <= 32:
+                raise ValueError("too short")
+            payload, mac = raw[:-32], raw[-32:]
         except Exception:
             raise AuthError("malformed ticket") from None
         if not hmac.compare_digest(mac, _mac(service_key, payload)):

@@ -92,5 +92,19 @@ def load() -> ctypes.CDLL:
             lib.es_block_crcs.argtypes = [c.c_void_p, c.c_uint64, c.c_void_p, c.c_int64]
             lib.es_delete.argtypes = [c.c_void_p, c.c_uint64]
             lib.es_sync.argtypes = [c.c_void_p, c.c_uint64]
+            # native client (libcfs-analog C ABI over the RPC wire)
+            lib.cfs_last_error.restype = c.c_char_p
+            lib.cfs_last_meta.restype = c.c_char_p
+            lib.cfs_blob_put.argtypes = [
+                c.c_char_p, c.c_int, c.c_char_p, c.c_uint64, c.c_char_p, c.c_uint64]
+            lib.cfs_blob_get.restype = c.c_int64
+            lib.cfs_blob_get.argtypes = [
+                c.c_char_p, c.c_int, c.c_char_p, c.c_void_p, c.c_uint64]
+            lib.cfs_blob_delete.argtypes = [c.c_char_p, c.c_int, c.c_char_p]
+            lib.cfs_codec_encode.argtypes = [
+                c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_uint64, c.c_int,
+                c.c_char_p, c.c_void_p]
+            lib.cfs_codec_crc32.argtypes = [
+                c.c_char_p, c.c_int, c.c_uint64, c.c_char_p, c.c_uint64, c.c_void_p]
             _lib = lib
     return _lib

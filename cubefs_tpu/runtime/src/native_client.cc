@@ -1,0 +1,186 @@
+// Native client library: the libcfs-analog C ABI.
+//
+// Role parity: client/libsdk (cgo libcfs.so with //export cfs_* symbols
+// consumed by the Java SDK) and the cgo/gRPC sidecar boundary named in
+// BASELINE.json. This is a dependency-free C++ HTTP/1.1 client for the
+// framework's RPC wire shape (POST /method, JSON args in X-Rpc-Args,
+// binary body), exposing:
+//   cfs_blob_put / cfs_blob_get / cfs_blob_delete  — access gateway
+//   cfs_codec_encode / cfs_codec_crc32             — codec sidecar
+// so Go/Java/C++ storage nodes can drive the TPU codec and the blob
+// plane without a Python runtime.
+//
+// Build: part of libcubefs_rt.so (see runtime/build.py).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_nc_err;
+thread_local std::string g_nc_meta;  // last response's X-Rpc-Resp JSON
+
+void nc_set_err(const std::string& e) { g_nc_err = e; }
+
+int dial(const char* host, int port) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || !res) {
+    nc_set_err("getaddrinfo failed");
+    return -1;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, 0);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    nc_set_err(std::string("connect failed: ") + strerror(errno));
+    if (fd >= 0) close(fd);
+    freeaddrinfo(res);
+    return -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) return false;
+    p += w;
+    n -= w;
+  }
+  return true;
+}
+
+// Minimal HTTP/1.1 exchange. Returns status code, fills resp body+meta.
+int http_post(const char* host, int port, const std::string& path,
+              const std::string& args_json, const uint8_t* body,
+              size_t body_len, std::vector<uint8_t>* resp) {
+  int fd = dial(host, port);
+  if (fd < 0) return -1;
+  // heap-built header: args_json (e.g. a multi-slice location) can be
+  // arbitrarily long; a fixed buffer would truncate and over-send
+  std::string head = "POST /" + path + " HTTP/1.1\r\nHost: " + host +
+                     "\r\nX-Rpc-Args: " + args_json +
+                     "\r\nContent-Length: " + std::to_string(body_len) +
+                     "\r\nConnection: close\r\n\r\n";
+  if (!send_all(fd, head.data(), head.size()) ||
+      (body_len && !send_all(fd, body, body_len))) {
+    nc_set_err("send failed");
+    close(fd);
+    return -1;
+  }
+  std::string raw;
+  char buf[65536];
+  ssize_t r;
+  while ((r = recv(fd, buf, sizeof buf, 0)) > 0) raw.append(buf, r);
+  close(fd);
+  size_t hdr_end = raw.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) {
+    nc_set_err("malformed http response");
+    return -1;
+  }
+  int status = 0;
+  sscanf(raw.c_str(), "HTTP/1.1 %d", &status);
+  // stash X-Rpc-Resp
+  g_nc_meta.clear();
+  size_t mp = raw.find("X-Rpc-Resp: ");
+  if (mp != std::string::npos && mp < hdr_end) {
+    size_t e = raw.find("\r\n", mp);
+    g_nc_meta = raw.substr(mp + 12, e - mp - 12);
+  }
+  if (resp) {
+    resp->assign(raw.begin() + hdr_end + 4, raw.end());
+  }
+  if (status != 200) nc_set_err("http status " + std::to_string(status) +
+                                ": " + g_nc_meta);
+  return status;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* cfs_last_error() { return g_nc_err.c_str(); }
+const char* cfs_last_meta() { return g_nc_meta.c_str(); }
+
+// PUT via access; returns 0 and writes the location JSON into loc_out.
+int cfs_blob_put(const char* host, int port, const uint8_t* data,
+                 uint64_t len, char* loc_out, uint64_t loc_cap) {
+  std::vector<uint8_t> resp;
+  int st = http_post(host, port, "put", "{}", data, len, &resp);
+  if (st != 200) return -1;
+  // location JSON is inside the meta header
+  if (g_nc_meta.size() + 1 > loc_cap) {
+    nc_set_err("location buffer too small");
+    return -1;
+  }
+  memcpy(loc_out, g_nc_meta.c_str(), g_nc_meta.size() + 1);
+  return 0;
+}
+
+// GET via access; loc_json = {"location": {...}} args payload.
+int64_t cfs_blob_get(const char* host, int port, const char* args_json,
+                     uint8_t* out, uint64_t cap) {
+  std::vector<uint8_t> resp;
+  int st = http_post(host, port, "get", args_json, nullptr, 0, &resp);
+  if (st != 200) return -1;
+  if (resp.size() > cap) {
+    nc_set_err("output buffer too small");
+    return -2;
+  }
+  memcpy(out, resp.data(), resp.size());
+  return (int64_t)resp.size();
+}
+
+int cfs_blob_delete(const char* host, int port, const char* args_json) {
+  int st = http_post(host, port, "delete", args_json, nullptr, 0, nullptr);
+  return st == 200 ? 0 : -1;
+}
+
+// EC encode offload: data = batch*n shards of shard_size bytes; parity
+// (batch*m*shard_size) written to out.
+int cfs_codec_encode(const char* host, int port, int n, int m,
+                     uint64_t shard_size, int batch, const uint8_t* data,
+                     uint8_t* parity_out) {
+  char args[256];
+  snprintf(args, sizeof args,
+           "{\"n\": %d, \"m\": %d, \"shard_size\": %llu, \"batch\": %d}",
+           n, m, (unsigned long long)shard_size, batch);
+  std::vector<uint8_t> resp;
+  int st = http_post(host, port, "encode", args, data,
+                     (size_t)batch * n * shard_size, &resp);
+  if (st != 200) return -1;
+  if (resp.size() != (size_t)batch * m * shard_size) {
+    nc_set_err("unexpected parity size");
+    return -1;
+  }
+  memcpy(parity_out, resp.data(), resp.size());
+  return 0;
+}
+
+// Batched CRC32 offload: blocks of block_len; out = count u32le CRCs.
+int cfs_codec_crc32(const char* host, int port, uint64_t block_len,
+                    const uint8_t* data, uint64_t data_len, uint32_t* out) {
+  char args[128];
+  snprintf(args, sizeof args, "{\"block_len\": %llu}",
+           (unsigned long long)block_len);
+  std::vector<uint8_t> resp;
+  int st = http_post(host, port, "crc32", args, data, data_len, &resp);
+  if (st != 200) return -1;
+  memcpy(out, resp.data(), resp.size());
+  return (int)(resp.size() / 4);
+}
+
+}  // extern "C"

@@ -206,3 +206,56 @@ def test_http_transport_smoke(cluster, rng):
     finally:
         for s in servers:
             s.stop()
+
+
+def test_manual_migrate(cluster, rng):
+    data = payload(rng, 40_000)
+    loc = cluster.access.put(data, codemode=cmode.CodeMode.EC6P3)
+    vid = loc.slices[0].vid
+    before = cluster.cm.get_volume(vid)
+    cluster.sched.manual_migrate(vid, 4)
+    cluster.drain_worker()
+    after = cluster.cm.get_volume(vid)
+    assert (after.units[4].disk_id, after.units[4].chunk_id) != (
+        before.units[4].disk_id, before.units[4].chunk_id)
+    assert cluster.access.get(loc) == data
+
+
+def test_volume_inspector_clean_and_missing(cluster, rng):
+    data = payload(rng, 60_000)
+    loc = cluster.access.put(data, codemode=cmode.CodeMode.EC6P3)
+    rep = cluster.sched.inspect_volumes()
+    assert rep["checked"] >= 1 and rep["bad"] == 0
+    # delete one unit's shard behind the system's back -> inspector queues repair
+    vol = cluster.cm.get_volume(loc.slices[0].vid)
+    u = vol.units[3]
+    node = cluster.node_of(u.node_addr)
+    bid = loc.slices[0].min_bid
+    node.delete_shard(u.disk_id, u.chunk_id, bid)
+    cluster.sched.inspect_volumes()
+    assert any(t["reason"].startswith("inspect:") for t in cluster.sched.tasks.values())
+    cluster.drain_worker()
+    assert cluster.access.get(loc) == data
+
+
+def test_balancer_moves_from_hot_disk(cluster, rng):
+    # load several volumes so placement skews, then force skew manually
+    for _ in range(3):
+        cluster.access.put(payload(rng, 20_000), codemode=cmode.CodeMode.EC6P3)
+    hot = max(cluster.cm.disks.values(), key=lambda d: d.chunk_count)
+    hot.chunk_count += 5  # simulate imbalance
+    moved = cluster.sched.balance(max_moves=2)
+    assert moved >= 1
+    cluster.drain_worker()
+
+
+def test_balance_dedups_and_preserves_cm_counts(cluster, rng):
+    for _ in range(2):
+        cluster.access.put(payload(rng, 15_000), codemode=cmode.CodeMode.EC6P3)
+    hot = max(cluster.cm.disks.values(), key=lambda d: d.chunk_count)
+    hot.chunk_count += 5
+    before = hot.chunk_count
+    m1 = cluster.sched.balance(max_moves=1)
+    m2 = cluster.sched.balance(max_moves=1)  # same task dedups -> no move
+    assert m1 == 1 and m2 == 0
+    assert hot.chunk_count == before  # scheduler never mutates cm records

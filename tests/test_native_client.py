@@ -1,0 +1,89 @@
+"""Cross-language boundary: the C client library (native_client.cc)
+driving the codec sidecar and blob access over real sockets — the
+libcfs/Java-SDK consumption path."""
+
+import ctypes
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob.access import AccessConfig, AccessHandler
+from cubefs_tpu.blob.blobnode import BlobNode
+from cubefs_tpu.blob.clustermgr import ClusterMgr
+from cubefs_tpu.codec.service import CodecService
+from cubefs_tpu.ops import gf256
+from cubefs_tpu.runtime import build as rt
+from cubefs_tpu.utils import rpc
+from cubefs_tpu.utils.rpc import NodePool
+
+
+@pytest.fixture(scope="module")
+def lib():
+    return rt.load()
+
+
+def _host_port(addr):
+    h, p = addr.split(":")
+    return h.encode(), int(p)
+
+
+def test_c_client_codec_encode(lib, rng):
+    srv = rpc.RpcServer(rpc.expose(CodecService()), service="codec").start()
+    try:
+        host, port = _host_port(srv.addr)
+        n, m, s, b = 6, 3, 2048, 2
+        data = rng.integers(0, 256, (b, n, s), dtype=np.uint8)
+        parity = np.zeros((b, m, s), dtype=np.uint8)
+        rc = lib.cfs_codec_encode(host, port, n, m, s, b, data.tobytes(),
+                                  parity.ctypes.data_as(ctypes.c_void_p))
+        assert rc == 0, lib.cfs_last_error()
+        for i in range(b):
+            expect = gf256.gf_matmul(gf256.parity_matrix(n, m), data[i])
+            assert np.array_equal(parity[i], expect)
+    finally:
+        srv.stop()
+
+
+def test_c_client_codec_crc32(lib, rng):
+    srv = rpc.RpcServer(rpc.expose(CodecService()), service="codec").start()
+    try:
+        host, port = _host_port(srv.addr)
+        blocks = rng.integers(0, 256, (5, 4096), dtype=np.uint8)
+        out = np.zeros(5, dtype=np.uint32)
+        cnt = lib.cfs_codec_crc32(host, port, 4096, blocks.tobytes(),
+                                  blocks.size, out.ctypes.data_as(ctypes.c_void_p))
+        assert cnt == 5, lib.cfs_last_error()
+        expect = [zlib.crc32(b.tobytes()) for b in blocks]
+        assert out.tolist() == expect
+    finally:
+        srv.stop()
+
+
+def test_c_client_blob_roundtrip(lib, tmp_path, rng):
+    cm = ClusterMgr(allow_colocated_units=True)
+    pool = NodePool()
+    node = BlobNode(0, [str(tmp_path / f"d{i}") for i in range(9)],
+                    rpc.Client(cm), addr="n0")
+    node.register()
+    node.send_heartbeat()
+    pool.bind("n0", node)
+    access = AccessHandler(rpc.Client(cm), pool, AccessConfig(blob_size=32 << 10))
+    srv = rpc.RpcServer(rpc.expose(access), service="access").start()
+    try:
+        host, port = _host_port(srv.addr)
+        payload = rng.integers(0, 256, 90_000, dtype=np.uint8).tobytes()
+        loc_buf = ctypes.create_string_buffer(8192)
+        rc = lib.cfs_blob_put(host, port, payload, len(payload), loc_buf, 8192)
+        assert rc == 0, lib.cfs_last_error()
+        loc_meta = json.loads(loc_buf.value)
+        args = json.dumps({"location": loc_meta["location"]}).encode()
+        out = ctypes.create_string_buffer(len(payload) + 16)
+        got = lib.cfs_blob_get(host, port, args, out, len(payload) + 16)
+        assert got == len(payload), lib.cfs_last_error()
+        assert out.raw[:got] == payload
+        assert lib.cfs_blob_delete(host, port, args) == 0
+        assert lib.cfs_blob_get(host, port, args, out, len(payload) + 16) < 0
+    finally:
+        srv.stop()
