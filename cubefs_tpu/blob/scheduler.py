@@ -224,7 +224,14 @@ class Scheduler:
 
         checked = bad = 0
         with self._lock:
-            vids = sorted(self.cm.volumes)[:max_volumes]
+            all_vids = sorted(self.cm.volumes)
+            if not all_vids:
+                return {"checked": 0, "bad": 0}
+            # rotating cursor: max_volumes is a batch size, not a
+            # coverage cap — every volume gets scrubbed eventually
+            start = getattr(self, "_inspect_cursor", 0) % len(all_vids)
+            vids = (all_vids[start:] + all_vids[:start])[:max_volumes]
+            self._inspect_cursor = (start + len(vids)) % len(all_vids)
         for vid in vids:
             vol = self.cm.get_volume(vid)
             enc = new_encoder(CodecConfig(mode=cmode.CodeMode(vol.codemode)))
@@ -267,18 +274,42 @@ class Scheduler:
                     for idx in miss:
                         self._queue_unit_repair(vol.vid, idx,
                                                 reason=f"inspect: bid {bid} missing")
-                    # parity rows that disagree (and aren't merely missing)
-                    # are corrupt-but-present: queue their repair too
-                    bad_parity = {
-                        t.n + pi for pi in np.nonzero(mismatch[gi])[0]
-                    } - miss
-                    if bad_parity and not miss:
+                    if mismatch[gi].any() and not miss:
                         bad += 1
-                        for idx in sorted(bad_parity):
+                        culprit = self._isolate_corrupt_unit(enc, stripes[gi])
+                        if culprit is not None:
+                            # never "repair" parity from possibly-corrupt
+                            # data: repair exactly the unit whose exclusion
+                            # makes the stripe a consistent codeword
                             self._queue_unit_repair(
-                                vol.vid, idx,
-                                reason=f"inspect: bid {bid} parity mismatch")
+                                vol.vid, culprit,
+                                reason=f"inspect: bid {bid} corrupt unit")
+                        # multi-corruption: leave for operators; repairing
+                        # any single unit could cement wrong data
         return {"checked": checked, "bad": bad}
+
+    @staticmethod
+    def _isolate_corrupt_unit(enc, stripe) -> int | None:
+        """Find the single unit whose exclusion leaves a consistent
+        codeword (reconstruct it from the rest and compare everything
+        else). Returns None when no unique culprit exists."""
+        import numpy as np
+
+        from ..ops import rs_kernel
+
+        t = enc.t
+        n, total = t.n, t.n + t.m
+        culprits = []
+        for c in range(total):
+            present = [i for i in range(total) if i != c]
+            rows = rs_kernel.reconstruct_rows(n, total, present, [c])
+            rebuilt = enc.engine.matrix_apply(rows, stripe[present[:n]])[0]
+            candidate = stripe.copy()
+            candidate[c] = rebuilt
+            par = enc.engine.encode_parity(candidate[None, :n], t.m)[0]
+            if np.array_equal(par, candidate[n:total]):
+                culprits.append(c)
+        return culprits[0] if len(culprits) == 1 else None
 
     # ---------------- task leasing (worker API) ----------------
     def acquire_task(self, worker_id: str) -> dict | None:

@@ -1,0 +1,176 @@
+"""ShardNode: range-sharded KV/blob serving layer with per-shard raft.
+
+Role parity: blobstore/shardnode — catalog spaces carved into range
+shards (shardnode/catalog/catalog.go), each shard a raft group over its
+replicas (storage/shard.go, raft_impl.go FSM), serving item put/get/
+delete/list plus small-blob ops. Built on this framework's raft
+(parallel/raft.py) with a dict store per shard; the same multi-raft
+transport-sharing pattern as the metanode.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..parallel import raft as raftlib
+from ..utils import rpc
+
+
+class Shard:
+    """One key range [start, end) with a replicated ordered KV store."""
+
+    def __init__(self, shard_id: int, start: str, end: str):
+        self.shard_id = shard_id
+        self.start = start
+        self.end = end
+        self._lock = threading.RLock()
+        self.kv: dict[str, bytes] = {}
+
+    def owns(self, key: str) -> bool:
+        return self.start <= key and (not self.end or key < self.end)
+
+    # FSM apply door
+    def apply(self, rec: dict):
+        with self._lock:
+            op = rec["op"]
+            if op == "put":
+                self.kv[rec["key"]] = bytes.fromhex(rec["value_hex"])
+                return {}
+            if op == "delete":
+                if rec["key"] not in self.kv:
+                    raise KeyError(rec["key"])
+                del self.kv[rec["key"]]
+                return {}
+            raise ValueError(f"unknown shard op {op!r}")
+
+    def state_bytes(self) -> bytes:
+        import json
+
+        with self._lock:
+            return json.dumps({k: v.hex() for k, v in self.kv.items()}).encode()
+
+    def restore_state(self, data: bytes) -> None:
+        import json
+
+        with self._lock:
+            self.kv = {k: bytes.fromhex(v) for k, v in json.loads(data).items()}
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            if key not in self.kv:
+                raise KeyError(key)
+            return self.kv[key]
+
+    def list(self, prefix: str, limit: int) -> list[str]:
+        with self._lock:
+            return sorted(k for k in self.kv if k.startswith(prefix))[:limit]
+
+
+class ShardNode:
+    """Hosts shards; replicated when peers are configured (multi-raft)."""
+
+    REDIRECT = 421
+
+    def __init__(self, node_id: int, addr: str | None = None, node_pool=None,
+                 data_dir: str | None = None):
+        self.node_id = node_id
+        self.addr = addr
+        self.pool = node_pool
+        self.data_dir = data_dir
+        self.shards: dict[int, Shard] = {}
+        self.rafts: dict[int, raftlib.RaftNode] = {}
+        self.extra_routes: dict = {}
+        self._lock = threading.RLock()
+
+    def create_shard(self, shard_id: int, start: str, end: str,
+                     peers: list[str] | None = None) -> Shard:
+        import os
+
+        with self._lock:
+            if shard_id not in self.shards:
+                sh = Shard(shard_id, start, end)
+                self.shards[shard_id] = sh
+                if peers and len(peers) > 1:
+                    node = raftlib.RaftNode(
+                        f"sn{shard_id}", self.addr, peers, sh.apply, self.pool,
+                        data_dir=os.path.join(self.data_dir, f"sn_{shard_id}")
+                        if self.data_dir else None,
+                        snapshot_fn=sh.state_bytes,
+                        restore_fn=sh.restore_state,
+                    )
+                    raftlib.register_routes(self.extra_routes, node)
+                    self.rafts[shard_id] = node.start()
+            return self.shards[shard_id]
+
+    def _shard(self, shard_id: int, need_leader: bool = False) -> Shard:
+        sh = self.shards.get(shard_id)
+        if sh is None:
+            raise rpc.RpcError(404, f"shard {shard_id} not on node {self.node_id}")
+        node = self.rafts.get(shard_id)
+        if need_leader and node is not None:
+            st = node.status()
+            if st["role"] != "leader":
+                raise rpc.RpcError(self.REDIRECT, f"leader={st['leader'] or ''}")
+        return sh
+
+    def _mutate(self, shard_id: int, rec: dict):
+        sh = self._shard(shard_id, need_leader=True)
+        node = self.rafts.get(shard_id)
+        try:
+            if node is None:
+                return sh.apply(rec)
+            try:
+                return node.propose(rec)
+            except raftlib.NotLeaderError as e:
+                raise rpc.RpcError(self.REDIRECT, f"leader={e.leader or ''}") from None
+        except KeyError as e:
+            raise rpc.RpcError(404, f"no such key {e}") from None
+
+    def stop(self) -> None:
+        for r in self.rafts.values():
+            r.stop()
+
+    # ---------------- RPC surface ----------------
+    def rpc_create_shard(self, args, body):
+        self.create_shard(args["shard_id"], args.get("start", ""),
+                          args.get("end", ""), args.get("peers"))
+        return {}
+
+    def rpc_kv_put(self, args, body):
+        self._mutate(args["shard_id"],
+                     {"op": "put", "key": args["key"], "value_hex": body.hex()})
+        return {}
+
+    def rpc_kv_get(self, args, body):
+        try:
+            return {}, self._shard(args["shard_id"], need_leader=True).get(args["key"])
+        except KeyError:
+            raise rpc.RpcError(404, f"no such key {args['key']!r}") from None
+
+    def rpc_kv_delete(self, args, body):
+        self._mutate(args["shard_id"], {"op": "delete", "key": args["key"]})
+        return {}
+
+    def rpc_kv_list(self, args, body):
+        sh = self._shard(args["shard_id"], need_leader=True)
+        return {"keys": sh.list(args.get("prefix", ""), int(args.get("limit", 100)))}
+
+
+class Catalog:
+    """Space -> range-shard map (shardnode/catalog role, normally fed by
+    clustermgr's catalog manager). Routes keys to shard replica sets."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.spaces: dict[str, list[dict]] = {}  # name -> [{shard_id, start, end, addrs}]
+
+    def create_space(self, name: str, shards: list[dict]) -> None:
+        with self._lock:
+            self.spaces[name] = sorted(shards, key=lambda s: s["start"])
+
+    def route(self, name: str, key: str) -> dict:
+        with self._lock:
+            for sh in reversed(self.spaces[name]):
+                if sh["start"] <= key and (not sh["end"] or key < sh["end"]):
+                    return dict(sh)
+            raise KeyError(f"no shard owns key {key!r} in space {name!r}")

@@ -1,0 +1,156 @@
+"""Cluster launcher: the docker-compose analog.
+
+Role parity: docker/docker-compose.yml (3 masters, N metanodes/datanodes,
+objectnodes, monitoring) and blobstore/run_docker.sh — one topology JSON
+spawns every role as a local process, waits for liveness, creates the
+initial volume, and writes a state file with all addresses.
+
+  python -m cubefs_tpu.deploy.cluster --topo topo.json --workdir /tmp/c1
+
+Topology JSON (all counts optional):
+  {"metanodes": 3, "datanodes": 4, "blobnodes": 1, "disks_per_blobnode": 9,
+   "objectnode": true, "access": true, "scheduler": false, "codec": false,
+   "volume": {"name": "vol1", "mp_count": 3, "dp_count": 4}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+
+class Proc:
+    def __init__(self, role: str, cfg: dict, workdir: str):
+        self.role = role
+        path = os.path.join(workdir, f"{cfg.get('name', role)}.json")
+        with open(path, "w") as f:
+            json.dump(cfg, f)
+        self.log_path = os.path.join(workdir, f"{cfg.get('name', role)}.log")
+        self.log = open(self.log_path, "w")
+        self.p = subprocess.Popen(
+            [sys.executable, "-m", "cubefs_tpu.cmd", "-c", path],
+            stdout=self.log, stderr=subprocess.STDOUT,
+        )
+        self.addr: str | None = None
+
+    def wait_addr(self, timeout: float = 60.0) -> str:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for line in open(self.log_path):
+                if "listening on" in line or "S3 on" in line:
+                    self.addr = line.strip().rsplit(" ", 1)[-1]
+                    return self.addr
+            if self.p.poll() is not None:
+                raise RuntimeError(
+                    f"{self.role} exited: {open(self.log_path).read()[-800:]}"
+                )
+            time.sleep(0.3)
+        raise TimeoutError(f"{self.role} did not come up; log: {self.log_path}")
+
+
+class Cluster:
+    def __init__(self, topo: dict, workdir: str):
+        self.topo = topo
+        self.workdir = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self.procs: list[Proc] = []
+        self.state: dict = {"roles": {}}
+
+    def _spawn(self, role: str, cfg: dict) -> str:
+        cfg["role"] = role
+        p = Proc(role, cfg, self.workdir)
+        self.procs.append(p)
+        addr = p.wait_addr()
+        self.state["roles"].setdefault(role, []).append(addr)
+        return addr
+
+    def up(self) -> dict:
+        t = self.topo
+        master = self._spawn("master", {
+            "replicas": t.get("replicas", 3),
+            "allow_single_node": t.get("datanodes", 4) < t.get("replicas", 3),
+        })
+        for i in range(t.get("metanodes", 3)):
+            self._spawn("metanode", {
+                "name": f"metanode{i}", "node_id": i, "master_addr": master,
+                "data_dir": os.path.join(self.workdir, f"meta{i}")})
+        for i in range(t.get("datanodes", 4)):
+            self._spawn("datanode", {
+                "name": f"datanode{i}", "node_id": i, "master_addr": master,
+                "data_dir": os.path.join(self.workdir, f"data{i}")})
+        from ..utils import rpc
+
+        # nodes print "listening" before their register RPC lands; wait
+        # until the master actually sees the full topology
+        deadline = time.time() + 60
+        want_meta, want_data = t.get("metanodes", 3), t.get("datanodes", 4)
+        while time.time() < deadline:
+            st = rpc.call(master, "stat")[0]
+            if st["metanodes"] >= want_meta and st["datanodes"] >= want_data:
+                break
+            time.sleep(0.3)
+        else:
+            raise TimeoutError(f"nodes never registered: {st}")
+
+        vol = t.get("volume", {"name": "vol1"})
+        rpc.call(master, "create_volume", {
+            "name": vol.get("name", "vol1"),
+            "mp_count": vol.get("mp_count", 3),
+            "dp_count": vol.get("dp_count", 4)})
+        self.state["volume"] = vol.get("name", "vol1")
+
+        if t.get("blobnodes"):
+            cm = self._spawn("clustermgr", {
+                "allow_colocated_units": t.get("blobnodes", 1) == 1,
+                "data_dir": os.path.join(self.workdir, "cm")})
+            for i in range(t["blobnodes"]):
+                dirs = [os.path.join(self.workdir, f"bn{i}d{d}")
+                        for d in range(t.get("disks_per_blobnode", 9))]
+                self._spawn("blobnode", {"name": f"blobnode{i}", "node_id": i,
+                                         "clustermgr_addr": cm, "data_dirs": dirs})
+            if t.get("access", True):
+                self._spawn("access", {"clustermgr_addr": cm,
+                                       "blob_size": t.get("blob_size", 8 << 20)})
+        if t.get("objectnode"):
+            self._spawn("objectnode", {
+                "master_addr": master,
+                "vols": {t.get("bucket", "bkt"): self.state["volume"]},
+                "users": t.get("users", [])})
+        if t.get("codec"):
+            self._spawn("codec", {})
+        with open(os.path.join(self.workdir, "cluster.json"), "w") as f:
+            json.dump(self.state, f, indent=2)
+        return self.state
+
+    def down(self) -> None:
+        for p in self.procs:
+            p.p.terminate()
+        for p in self.procs:
+            try:
+                p.p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.p.kill()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="cubefs-tpu-cluster")
+    ap.add_argument("--topo", help="topology JSON file (defaults built in)")
+    ap.add_argument("--workdir", required=True)
+    args = ap.parse_args(argv)
+    topo = json.load(open(args.topo)) if args.topo else {}
+    c = Cluster(topo, args.workdir)
+    state = c.up()
+    print(json.dumps(state, indent=2), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        c.down()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Console: web dashboard over the admin APIs.
+
+Role parity: console/ (GraphQL proxy dashboard over master APIs) — here
+a dependency-free HTML status page aggregating master/clustermgr stats,
+volume tables and per-service metric links.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils import rpc
+
+
+class Console:
+    def __init__(self, master_addr: str | None = None,
+                 clustermgr_addr: str | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.master = master_addr
+        self.cm = clustermgr_addr
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/api/state":
+                    body = json.dumps(outer.state()).encode()
+                    ctype = "application/json"
+                else:
+                    body = outer.render().encode()
+                    ctype = "text/html; charset=utf-8"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.addr = f"{host}:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    def state(self) -> dict:
+        out: dict = {}
+        for name, addr in (("master", self.master), ("clustermgr", self.cm)):
+            if not addr:
+                continue
+            try:
+                out[name] = {"addr": addr, "stat": rpc.call(addr, "stat", timeout=5)[0]}
+            except Exception as e:
+                out[name] = {"addr": addr, "error": str(e)}
+        return out
+
+    def render(self) -> str:
+        st = self.state()
+        rows = []
+        for name, info in st.items():
+            detail = json.dumps(info.get("stat") or info.get("error"), indent=1)
+            rows.append(
+                f"<h2>{html.escape(name)} @ {html.escape(info['addr'])}"
+                f" <a href='http://{html.escape(info['addr'])}/metrics'>metrics</a></h2>"
+                f"<pre>{html.escape(detail)}</pre>"
+            )
+        return (
+            "<!doctype html><title>cubefs-tpu console</title>"
+            "<h1>cubefs-tpu cluster</h1>" + "".join(rows)
+            + "<p><a href='/api/state'>JSON</a></p>"
+        )
+
+    def start(self) -> "Console":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -179,6 +179,12 @@ int cfs_codec_crc32(const char* host, int port, uint64_t block_len,
   std::vector<uint8_t> resp;
   int st = http_post(host, port, "crc32", args, data, data_len, &resp);
   if (st != 200) return -1;
+  // exact-size check: the caller sized `out` for data_len/block_len CRCs
+  size_t expect = (size_t)(data_len / block_len) * 4;
+  if (resp.size() != expect) {
+    nc_set_err("unexpected crc payload size");
+    return -1;
+  }
   memcpy(out, resp.data(), resp.size());
   return (int)(resp.size() / 4);
 }

@@ -259,3 +259,24 @@ def test_balance_dedups_and_preserves_cm_counts(cluster, rng):
     m2 = cluster.sched.balance(max_moves=1)  # same task dedups -> no move
     assert m1 == 1 and m2 == 0
     assert hot.chunk_count == before  # scheduler never mutates cm records
+
+
+def test_inspector_isolates_corrupt_data_shard(cluster, rng):
+    """A CRC-consistent corrupt DATA shard must be repaired from the
+    surviving code, never 'fixed' by recomputing parity from it."""
+    data = payload(rng, 30_000)
+    loc = cluster.access.put(data, codemode=cmode.CodeMode.EC6P3)
+    vol = cluster.cm.get_volume(loc.slices[0].vid)
+    bid = loc.slices[0].min_bid
+    u = vol.units[2]  # a data unit
+    node = cluster.node_of(u.node_addr)
+    good, _ = node.get_shard(u.disk_id, u.chunk_id, bid)
+    evil = bytes([b ^ 0xA5 for b in good])
+    node.put_shard(u.disk_id, u.chunk_id, bid, evil)  # CRC recomputed: reads clean
+    rep = cluster.sched.inspect_volumes()
+    assert rep["bad"] >= 1
+    tasks = [t for t in cluster.sched.tasks.values()
+             if "corrupt" in t["reason"]]
+    assert tasks and tasks[0]["unit_index"] == 2  # the DATA unit, not parity
+    cluster.drain_worker()
+    assert cluster.access.get(loc) == data  # original bytes restored

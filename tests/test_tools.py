@@ -1,0 +1,192 @@
+"""Tools + remaining inventory: shardnode KV (replicated, leader
+redirect, failover), deploy cluster launcher (compose analog), console
+dashboard, fsck."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob.shardnode import Catalog, Shard, ShardNode
+from cubefs_tpu.fs.console import Console
+from cubefs_tpu.fs.fsck import fsck
+from cubefs_tpu.utils import rpc
+from cubefs_tpu.utils.rpc import NodePool
+
+
+# ---------------- shardnode ----------------
+def make_sn_cluster(tmp_path, n=3):
+    pool = NodePool()
+    nodes = []
+    for i in range(n):
+        sn = ShardNode(i, addr=f"sn{i}", node_pool=pool,
+                       data_dir=str(tmp_path / f"sn{i}"))
+        pool.bind(f"sn{i}", sn)
+        nodes.append(sn)
+    peers = [f"sn{i}" for i in range(n)]
+    for sn in nodes:
+        sn.create_shard(1, "", "m", peers=peers)
+        sn.create_shard(2, "m", "", peers=peers)
+    return pool, nodes
+
+
+def _kv_call(pool, nodes, method, args, body=b"", timeout=8.0):
+    """Client-side leader-following helper."""
+    deadline = time.time() + timeout
+    addrs = [f"sn{i}" for i in range(len(nodes))]
+    i = 0
+    while time.time() < deadline:
+        addr = addrs[i % len(addrs)]
+        i += 1
+        try:
+            return pool.get(addr).call(method, args, body)
+        except rpc.RpcError as e:
+            if e.code == 421:
+                leader = e.message.removeprefix("leader=").strip()
+                if leader:
+                    try:
+                        return pool.get(leader).call(method, args, body)
+                    except rpc.RpcError as e2:
+                        if e2.code in (421, 503):
+                            time.sleep(0.05)
+                            continue
+                        raise
+                time.sleep(0.05)
+                continue
+            if e.code == 503:
+                time.sleep(0.05)
+                continue
+            raise
+    raise TimeoutError(method)
+
+
+def test_shardnode_replicated_kv(tmp_path):
+    pool, nodes = make_sn_cluster(tmp_path)
+    try:
+        _kv_call(pool, nodes, "kv_put", {"shard_id": 1, "key": "alpha"}, b"v1")
+        _kv_call(pool, nodes, "kv_put", {"shard_id": 2, "key": "zeta"}, b"v2")
+        _, v = _kv_call(pool, nodes, "kv_get", {"shard_id": 1, "key": "alpha"})
+        assert v == b"v1"
+        meta, _ = _kv_call(pool, nodes, "kv_list", {"shard_id": 1, "prefix": ""})
+        assert meta["keys"] == ["alpha"]
+        # replicated to all members
+        time.sleep(0.3)
+        assert sum(1 for sn in nodes if sn.shards[1].kv.get("alpha") == b"v1") >= 2
+        _kv_call(pool, nodes, "kv_delete", {"shard_id": 1, "key": "alpha"})
+        with pytest.raises((rpc.RpcError, TimeoutError)):
+            _kv_call(pool, nodes, "kv_get", {"shard_id": 1, "key": "alpha"},
+                     timeout=1.5)
+    finally:
+        for sn in nodes:
+            sn.stop()
+
+
+def test_shardnode_leader_failover(tmp_path):
+    pool, nodes = make_sn_cluster(tmp_path)
+    try:
+        _kv_call(pool, nodes, "kv_put", {"shard_id": 1, "key": "k"}, b"before")
+        leader = next(sn for sn in nodes
+                      if sn.rafts[1].status()["role"] == "leader")
+        leader.stop()
+        pool.bind(leader.addr, object())  # dead target: all calls 404
+        rest = [sn for sn in nodes if sn is not leader]
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            try:
+                _kv_call(pool, rest, "kv_put", {"shard_id": 1, "key": "k2"},
+                         b"after", timeout=1.0)
+                break
+            except (rpc.RpcError, TimeoutError):
+                time.sleep(0.2)
+        _, v = _kv_call(pool, rest, "kv_get", {"shard_id": 1, "key": "k"})
+        assert v == b"before"
+    finally:
+        for sn in nodes:
+            sn.stop()
+
+
+def test_catalog_routing():
+    cat = Catalog()
+    cat.create_space("s", [
+        {"shard_id": 1, "start": "", "end": "m", "addrs": ["a"]},
+        {"shard_id": 2, "start": "m", "end": "", "addrs": ["b"]},
+    ])
+    assert cat.route("s", "apple")["shard_id"] == 1
+    assert cat.route("s", "zebra")["shard_id"] == 2
+
+
+# ---------------- console ----------------
+def test_console_dashboard(tmp_path):
+    from cubefs_tpu.fs.master import Master
+
+    pool = NodePool()
+    master = Master(pool)
+    srv = rpc.RpcServer(rpc.expose(master), service="master").start()
+    con = Console(master_addr=srv.addr).start()
+    try:
+        with urllib.request.urlopen(f"http://{con.addr}/", timeout=5) as r:
+            page = r.read().decode()
+        assert "cubefs-tpu cluster" in page and "master" in page
+        with urllib.request.urlopen(f"http://{con.addr}/api/state", timeout=5) as r:
+            st = json.loads(r.read())
+        assert st["master"]["stat"]["datanodes"] == 0
+    finally:
+        con.stop()
+        srv.stop()
+
+
+# ---------------- fsck ----------------
+def test_fsck_clean_and_findings(tmp_path, rng):
+    from tests.test_fs_e2e import FsCluster
+
+    c = FsCluster(tmp_path)
+    fs = c.fs
+    fs.mkdir("/d")
+    fs.write_file("/d/a.bin", rng.integers(0, 256, 150_000, dtype=np.uint8).tobytes())
+    fs.write_file("/top.bin", b"hello fsck")
+    rep = fsck(fs, c.pool)
+    assert rep.clean, rep.summary()
+    assert rep.files == 2 and rep.bytes_checked > 0
+    # corrupt one replica -> fingerprint mismatch
+    inode = fs.meta.inode_get(fs.resolve("/d/a.bin"))
+    ek = inode["extents"][0]
+    dp = next(d for d in c.view["dps"] if d["dp_id"] == ek["dp_id"])
+    node = c.data_node(dp["replicas"][1])
+    node.partitions[dp["dp_id"]].store.write(ek["extent_id"], 0, b"\x00" * 10)
+    rep2 = fsck(fs, c.pool)
+    assert len(rep2.replica_mismatches) == 1
+    # orphan extent: write directly to a dp without metadata
+    leader = c.data_node(dp["leader"])
+    eid = leader.partitions[dp["dp_id"]].alloc_extent()
+    leader.write(dp["dp_id"], eid, 0, b"orphan", chain=False)
+    rep3 = fsck(fs, c.pool)
+    assert (dp["dp_id"], eid) in rep3.orphan_extents
+    for n in c.metas:
+        n.stop()
+
+
+# ---------------- deploy (compose analog) ----------------
+def test_deploy_cluster_launcher(tmp_path, rng):
+    from cubefs_tpu.deploy.cluster import Cluster as DeployCluster
+
+    topo = {"metanodes": 1, "datanodes": 2, "replicas": 2,
+            "volume": {"name": "dv", "mp_count": 1, "dp_count": 1}}
+    c = DeployCluster(topo, str(tmp_path / "work"))
+    try:
+        state = c.up()
+        assert state["volume"] == "dv"
+        master = state["roles"]["master"][0]
+        # a client can use the launched cluster immediately
+        from cubefs_tpu.fs.client import FileSystem
+        from cubefs_tpu.utils.rpc import NodePool
+
+        view = rpc.call(master, "client_view", {"name": "dv"})[0]["volume"]
+        fs = FileSystem(view, NodePool())
+        payload = rng.integers(0, 256, 60_000, dtype=np.uint8).tobytes()
+        fs.write_file("/compose.bin", payload)
+        assert fs.read_file("/compose.bin") == payload
+        assert (tmp_path / "work" / "cluster.json").exists()
+    finally:
+        c.down()
