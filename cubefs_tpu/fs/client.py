@@ -164,6 +164,8 @@ class ExtentClient:
 
     PACKET = 128 << 10  # write packet granularity
     EXTENT_CAP = 128 << 20  # roll to a fresh extent past this (max extent)
+    TINY_THRESHOLD = 4 << 10  # small writes pack into shared tiny extents
+    TINY_EXTENT_CAP = 8 << 20
 
     def __init__(self, vol_view: dict, node_pool):
         self.dps = vol_view["dps"]
@@ -172,6 +174,14 @@ class ExtentClient:
         self._lock = threading.Lock()
         # per-inode open extent: ino -> (dp, extent_id, next_offset)
         self._streams: dict[int, tuple[dict, int, int]] = {}
+        # shared tiny-extent stream (datanode storage_tinyfile role):
+        # many small files append into ONE extent, so a million 1KB files
+        # don't cost a million extents. _tiny_lock serializes the whole
+        # reserve+write+commit — the stream is shared across inodes, so
+        # lock-free interleaving would commit overlapping offsets.
+        self._tiny: tuple[dict, int, int] | None = None
+        self._tiny_lock = threading.Lock()
+        self._latency: dict[str, float] = {}  # addr -> EWMA seconds
 
     def _pick_dp(self) -> dict:
         with self._lock:
@@ -184,6 +194,9 @@ class ExtentClient:
         """Write through the inode's open extent, rolling to fresh
         extents at the cap — a single huge write spans several extent
         keys, like the streamer's packet pipeline."""
+        if len(data) <= self.TINY_THRESHOLD and file_offset == 0:
+            self._write_tiny(meta, ino, data)
+            return
         extent_keys: list[dict] = []
         done = 0
         while done < len(data):
@@ -218,6 +231,36 @@ class ExtentClient:
                 self._streams[ino] = (dp, eid, ext_off + seg)
             done += seg
         meta.append_extents(ino, extent_keys, size=file_offset + len(data))
+
+    def _write_tiny(self, meta: MetaWrapper, ino: int, data: bytes) -> None:
+        """Append a whole small file into the shared tiny extent; the
+        extent key is flagged tiny so per-file GC skips it (space comes
+        back via scrub-compaction, the punch-hole analog).
+
+        Scope: the tiny stream is per-ExtentClient, so packing pays off
+        for long-lived clients (gateway/FUSE/SDK daemons); one-shot CLI
+        invocations still get one extent per file. Datanode-side shared
+        tiny extents and tiny-extent space compaction (punch-hole) are
+        future work — fsck reports wholly-dead tiny extents meanwhile."""
+        with self._tiny_lock:
+            tiny = self._tiny
+            if tiny is None or tiny[2] + len(data) > self.TINY_EXTENT_CAP:
+                dp = self._pick_dp()
+                eid = self.nodes.get(dp["leader"]).call(
+                    "alloc_extent", {"dp_id": dp["dp_id"]})[0]["extent_id"]
+                tiny = (dp, eid, 0)
+            dp, eid, off = tiny
+            self.nodes.get(dp["leader"]).call(
+                "write", {"dp_id": dp["dp_id"], "extent_id": eid, "offset": off},
+                data,
+            )
+            meta.append_extents(
+                ino,
+                [{"dp_id": dp["dp_id"], "extent_id": eid, "ext_offset": off,
+                  "file_offset": 0, "size": len(data), "tiny": True}],
+                size=len(data),
+            )
+            self._tiny = (dp, eid, off + len(data))
 
     def close_stream(self, ino: int) -> None:
         with self._lock:
@@ -255,6 +298,8 @@ class ExtentClient:
         by a single inode's stream, so key removal implies reclaim)."""
         seen: set[tuple[int, int]] = set()
         for ek in extent_keys:
+            if ek.get("tiny"):
+                continue  # shared extent: other files live there
             key = (ek["dp_id"], ek["extent_id"])
             if key in seen:
                 continue
@@ -273,16 +318,46 @@ class ExtentClient:
                     pass  # node down: scrubber reclaims later
 
     def _read_replicated(self, dp: dict, eid: int, off: int, ln: int) -> bytes:
+        """Read from the historically-fastest replica first (k-faster
+        selector role: an EWMA of per-address latency orders candidates;
+        failures and SHORT reads fall through to the next replica).
+
+        Unmeasured replicas get the median of the measured ones as a
+        neutral prior (never 0 — a fresh, possibly mid-repair replica
+        must not outrank a known-fast one), with the leader as the
+        tiebreak."""
+        known = sorted(self._latency.get(a) for a in dp["replicas"]
+                       if a in self._latency)
+        # unmeasured replicas: just under the median — they never outrank
+        # a known-fast replica by much, but do outrank a known-slow one
+        prior = known[len(known) // 2] * 0.99 if known else 0.0
+        order = sorted(
+            dp["replicas"],
+            key=lambda a: (self._latency.get(a, prior),
+                           0 if a == dp["leader"] else 1),
+        )
         last_err = None
-        for addr in [dp["leader"]] + [a for a in dp["replicas"] if a != dp["leader"]]:
+        for addr in order:
+            t0 = time.monotonic()
             try:
                 _, data = self.nodes.get(addr).call(
                     "read", {"dp_id": dp["dp_id"], "extent_id": eid,
                              "offset": off, "length": ln},
                 )
-                return data
+                if len(data) != ln:
+                    # lagging / mid-repair replica: treat like a failure,
+                    # a short read silently corrupts the assembled file
+                    raise rpc.RpcError(
+                        409, f"short read {len(data)} != {ln} from {addr}"
+                    )
             except rpc.RpcError as e:
                 last_err = e
+                # heavy penalty so failed replicas sort last for a while
+                self._latency[addr] = self._latency.get(addr, 0.0) * 0.7 + 0.3 * 5.0
+                continue
+            dt = time.monotonic() - t0
+            self._latency[addr] = self._latency.get(addr, dt) * 0.7 + 0.3 * dt
+            return data
         raise FsError(5, f"all replicas failed for dp {dp['dp_id']}: {last_err}")
 
 

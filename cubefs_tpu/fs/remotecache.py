@@ -129,20 +129,24 @@ class CachedReader:
         return f"{dp_id}/{extent_id}/{block}"
 
     def read_block(self, dp: dict, extent_id: int, block: int,
-                   length: int) -> bytes:
+                   length: int, fetch_len: int) -> bytes:
+        """length = bytes the caller needs from block start; fetch_len =
+        the block's valid span in the extent (tail blocks are short, and
+        replicas reject short-read requests beyond the span)."""
         key = self._key(dp["dp_id"], extent_id, block)
         for addr in self.fgm.group_for(key):
             try:
                 _, data = self.nodes.get(addr).call("cache_get", {"key": key})
-                self.hits += 1
-                cache_ops.inc(result="hit")
-                return data[:length]
+                if len(data) >= length:  # stale short entry -> refetch
+                    self.hits += 1
+                    cache_ops.inc(result="hit")
+                    return data[:length]
             except rpc.RpcError:
                 continue
         self.misses += 1
         cache_ops.inc(result="miss")
         data = self.inner._read_replicated(
-            dp, extent_id, block * CACHE_BLOCK, CACHE_BLOCK
+            dp, extent_id, block * CACHE_BLOCK, fetch_len
         )
         for addr in self.fgm.group_for(key):
             try:
@@ -165,14 +169,16 @@ class CachedReader:
             if lo >= hi:
                 continue
             dp = self.inner._dp_by_id(ek["dp_id"])
+            ext_end = ek["ext_offset"] + ek["size"]  # extent's valid span
             pos = lo
             while pos < hi:
                 ext_pos = ek["ext_offset"] + (pos - ek["file_offset"])
                 block = ext_pos // CACHE_BLOCK
                 in_block = ext_pos % CACHE_BLOCK
                 take = min(hi - pos, CACHE_BLOCK - in_block)
+                fetch = min(CACHE_BLOCK, ext_end - block * CACHE_BLOCK)
                 blk = self.read_block(dp, ek["extent_id"], block,
-                                      in_block + take)
+                                      in_block + take, fetch)
                 out[pos - offset : pos - offset + take] = blk[in_block : in_block + take]
                 pos += take
         return bytes(out)

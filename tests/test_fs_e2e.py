@@ -275,3 +275,54 @@ class _DeadNode:
         if name.startswith("rpc_") or name == "extra_routes":
             raise AttributeError(name)
         raise AttributeError(name)
+
+
+def test_tiny_files_share_extent(cluster, rng):
+    fs = cluster.fs
+    fs.mkdir("/small")
+    payloads = {}
+    for i in range(6):
+        p = rng.integers(0, 256, 700 + i, dtype=np.uint8).tobytes()
+        payloads[f"/small/f{i}"] = p
+        fs.write_file(f"/small/f{i}", p)
+    # all six share ONE (dp, extent) pair
+    keys = set()
+    for path in payloads:
+        inode = fs.meta.inode_get(fs.resolve(path))
+        (ek,) = inode["extents"]
+        assert ek["tiny"] is True
+        keys.add((ek["dp_id"], ek["extent_id"]))
+    assert len(keys) == 1
+    for path, p in payloads.items():
+        assert fs.read_file(path) == p
+    # deleting one tiny file must NOT delete the shared extent
+    fs.unlink("/small/f0")
+    for path, p in list(payloads.items())[1:]:
+        assert fs.read_file(path) == p
+
+
+def test_read_prefers_faster_replica(cluster, rng):
+    fs = cluster.fs
+    payload_b = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+    fs.write_file("/fast.bin", payload_b)
+    inode = fs.meta.inode_get(fs.resolve("/fast.bin"))
+    dp = next(d for d in cluster.view["dps"]
+              if d["dp_id"] == inode["extents"][0]["dp_id"])
+    # poison one replica's latency record; reads should route around it
+    slow = dp["replicas"][0]
+    fs.data._latency[slow] = 99.0
+    assert fs.read_file("/fast.bin") == payload_b
+    others = [a for a in dp["replicas"] if a != slow]
+    assert any(a in fs.data._latency for a in others)
+
+
+def test_concurrent_tiny_writes_no_overlap(cluster, rng):
+    import concurrent.futures as cf
+    fs = cluster.fs
+    fs.mkdir("/ct")
+    payloads = {f"/ct/f{i}": rng.integers(0, 256, 500 + i, dtype=np.uint8).tobytes()
+                for i in range(16)}
+    with cf.ThreadPoolExecutor(8) as ex:
+        list(ex.map(lambda kv: fs.write_file(kv[0], kv[1]), payloads.items()))
+    for path, p in payloads.items():
+        assert fs.read_file(path) == p, path
