@@ -213,18 +213,22 @@ class ClusterMgr:
                         out.append((vol.vid, u.index))
             return out
 
-    def pick_destination(self, exclude_disks: set[int]) -> DiskInfo:
+    def pick_destination(self, exclude_disks: set[int],
+                         hard_exclude: set[int] | None = None) -> DiskInfo:
+        """Least-loaded NORMAL disk, preferring disks outside
+        exclude_disks (the volume's current homes). When the volume
+        already spans every disk, colocating two units beats leaving the
+        stripe degraded — only hard_exclude (broken/source disks) is
+        absolute."""
+        hard = hard_exclude or set()
         with self._lock:
-            cands = [
-                d for d in self.disks.values()
-                if d.status == DiskStatus.NORMAL and d.disk_id not in exclude_disks
-            ]
+            normal = [d for d in self.disks.values()
+                      if d.status == DiskStatus.NORMAL and d.disk_id not in hard]
+            cands = [d for d in normal if d.disk_id not in exclude_disks]
             if not cands:
-                if not self.allow_colocated_units:
-                    raise NoAvailableDisks("no destination disk outside exclusion set")
-                cands = [d for d in self.disks.values() if d.status == DiskStatus.NORMAL]
-                if not cands:
-                    raise NoAvailableDisks("no normal disks at all")
+                cands = normal
+            if not cands:
+                raise NoAvailableDisks("no normal disks outside the broken set")
             return min(cands, key=lambda d: d.chunk_count)
 
     def alloc_chunk_id(self) -> int:

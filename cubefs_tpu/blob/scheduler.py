@@ -97,7 +97,11 @@ class Scheduler:
                     return t["task_id"]  # idempotent re-queue
             vol = self.cm.get_volume(vid)
             exclude = {u.disk_id for u in vol.units}
-            dest = self.cm.pick_destination(exclude)
+            broken = {d.disk_id for d in self.cm.disks.values()
+                      if d.status != DiskStatus.NORMAL}
+            if src_disk is not None:
+                broken.add(src_disk)
+            dest = self.cm.pick_destination(exclude, hard_exclude=broken)
             task = {
                 "task_id": uuid.uuid4().hex[:16],
                 "type": "unit_repair",

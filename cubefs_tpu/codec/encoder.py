@@ -240,7 +240,10 @@ class LrcEncoder(Encoder):
 
     def reconstruct_data(self, shards: np.ndarray, bad_idx: list[int]) -> np.ndarray:
         t = self.t
-        shards = self._check(shards)
+        # data recovery only needs the global stripe; accept either the
+        # full (N+M+L) layout or just the (N+M) rows (degraded GET path)
+        if np.asarray(shards).shape[-2] != t.n + t.m:
+            shards = self._check(shards)
         global_bad = [i for i in bad_idx if i < t.n + t.m]
         wanted = sorted({i for i in global_bad if i < t.n})
         if wanted:

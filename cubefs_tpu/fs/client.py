@@ -176,9 +176,9 @@ class ExtentClient:
         self._streams: dict[int, tuple[dict, int, int]] = {}
         # shared tiny-extent stream (datanode storage_tinyfile role):
         # many small files append into ONE extent, so a million 1KB files
-        # don't cost a million extents. _tiny_lock serializes the whole
-        # reserve+write+commit — the stream is shared across inodes, so
-        # lock-free interleaving would commit overlapping offsets.
+        # don't cost a million extents. _tiny_lock guards offset
+        # RESERVATION only (the stream is shared across inodes); the
+        # writes themselves run concurrently on disjoint ranges.
         self._tiny: tuple[dict, int, int] | None = None
         self._tiny_lock = threading.Lock()
         self._latency: dict[str, float] = {}  # addr -> EWMA seconds
@@ -242,6 +242,9 @@ class ExtentClient:
         invocations still get one extent per file. Datanode-side shared
         tiny extents and tiny-extent space compaction (punch-hole) are
         future work — fsck reports wholly-dead tiny extents meanwhile."""
+        # reserve the (dp, extent, offset) range under the lock; the
+        # networked write + meta commit run OUTSIDE it so concurrent
+        # small-file writes overlap in flight but never in offsets
         with self._tiny_lock:
             tiny = self._tiny
             if tiny is None or tiny[2] + len(data) > self.TINY_EXTENT_CAP:
@@ -250,17 +253,17 @@ class ExtentClient:
                     "alloc_extent", {"dp_id": dp["dp_id"]})[0]["extent_id"]
                 tiny = (dp, eid, 0)
             dp, eid, off = tiny
-            self.nodes.get(dp["leader"]).call(
-                "write", {"dp_id": dp["dp_id"], "extent_id": eid, "offset": off},
-                data,
-            )
-            meta.append_extents(
-                ino,
-                [{"dp_id": dp["dp_id"], "extent_id": eid, "ext_offset": off,
-                  "file_offset": 0, "size": len(data), "tiny": True}],
-                size=len(data),
-            )
             self._tiny = (dp, eid, off + len(data))
+        self.nodes.get(dp["leader"]).call(
+            "write", {"dp_id": dp["dp_id"], "extent_id": eid, "offset": off},
+            data,
+        )
+        meta.append_extents(
+            ino,
+            [{"dp_id": dp["dp_id"], "extent_id": eid, "ext_offset": off,
+              "file_offset": 0, "size": len(data), "tiny": True}],
+            size=len(data),
+        )
 
     def close_stream(self, ino: int) -> None:
         with self._lock:

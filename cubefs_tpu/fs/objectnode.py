@@ -82,7 +82,7 @@ class ObjectNode:
                     if not self._authorized():
                         return self._error(403, "AccessDenied", "bad signature")
                     data = getattr(self, "_stashed_body", b"")
-                bucket, key, _ = self._split()
+                bucket, key, query = self._split()
                 if not key:  # CreateBucket
                     if bucket not in outer.volumes:
                         return self._error(404, "NoSuchBucket",
@@ -91,12 +91,66 @@ class ObjectNode:
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
+                if "uploadId" in query and "partNumber" in query:  # UploadPart
+                    upload_id = query["uploadId"][0]
+                    try:
+                        part = int(query["partNumber"][0])
+                    except ValueError:
+                        return self._error(400, "InvalidPart",
+                                           "partNumber must be an integer")
+                    if not 1 <= part <= 10000:  # S3's own part limit
+                        return self._error(400, "InvalidPart",
+                                           f"partNumber {part} out of range")
+                    try:
+                        etag = outer._put_part(fs, upload_id, part, data)
+                    except FsError as e:
+                        return self._error(404, "NoSuchUpload", str(e))
+                    return self._reply(200, headers={"ETag": f'"{etag}"'})
                 try:
                     outer._put_object(fs, key, data)
                 except FsError as e:
                     return self._error(500, "InternalError", str(e))
                 etag = hashlib.md5(data).hexdigest()
                 self._reply(200, headers={"ETag": f'"{etag}"'})
+
+            def do_POST(self):
+                # multipart lifecycle: InitiateMultipartUpload (?uploads)
+                # and CompleteMultipartUpload (?uploadId=...)
+                if outer.auth is None:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    self.rfile.read(n)
+                elif not self._authorized():
+                    return self._error(403, "AccessDenied", "bad signature")
+                bucket, key, query = self._split()
+                fs = self._fs(bucket)
+                if fs is None:
+                    return self._error(404, "NoSuchBucket", bucket)
+                if "uploads" in query:
+                    if not key:
+                        return self._error(400, "InvalidRequest",
+                                           "multipart upload needs a key")
+                    upload_id = outer._initiate_multipart(fs, key)
+                    body = (
+                        f"<?xml version='1.0'?><InitiateMultipartUploadResult>"
+                        f"<Bucket>{bucket}</Bucket><Key>{xs.escape(key)}</Key>"
+                        f"<UploadId>{upload_id}</UploadId>"
+                        f"</InitiateMultipartUploadResult>"
+                    ).encode()
+                    return self._reply(200, body)
+                if "uploadId" in query:
+                    try:
+                        etag = outer._complete_multipart(
+                            fs, key, query["uploadId"][0]
+                        )
+                    except FsError as e:
+                        return self._error(404, "NoSuchUpload", str(e))
+                    body = (
+                        f"<?xml version='1.0'?><CompleteMultipartUploadResult>"
+                        f"<Key>{xs.escape(key)}</Key><ETag>\"{etag}\"</ETag>"
+                        f"</CompleteMultipartUploadResult>"
+                    ).encode()
+                    return self._reply(200, body)
+                self._error(400, "InvalidRequest", "unsupported POST")
 
             def do_GET(self):
                 if not self._authorized():
@@ -147,10 +201,13 @@ class ObjectNode:
             def do_DELETE(self):
                 if not self._authorized():
                     return self._error(403, "AccessDenied", "bad signature")
-                bucket, key, _ = self._split()
+                bucket, key, query = self._split()
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
+                if "uploadId" in query:  # AbortMultipartUpload
+                    outer._abort_multipart(fs, query["uploadId"][0])
+                    return self._reply(204)
                 try:
                     fs.unlink("/" + key)
                     outer._prune_empty_dirs(fs, key)
@@ -162,6 +219,52 @@ class ObjectNode:
         self._httpd.daemon_threads = True
         self.addr = f"{host}:{self._httpd.server_address[1]}"
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    # ---- multipart (staged under /.multipart/<uploadId>/) ----
+    def _initiate_multipart(self, fs: FileSystem, key: str) -> str:
+        import secrets
+
+        upload_id = secrets.token_hex(12)
+        for d in ("/.multipart", f"/.multipart/{upload_id}"):
+            try:
+                fs.mkdir(d)
+            except FsError as e:
+                if e.errno != mn.EEXIST:
+                    raise
+        fs.setxattr(f"/.multipart/{upload_id}", "s3.key", key)
+        return upload_id
+
+    def _put_part(self, fs: FileSystem, upload_id: str, part: int,
+                  data: bytes) -> str:
+        import hashlib as _h
+
+        fs.resolve(f"/.multipart/{upload_id}")  # 404 if unknown upload
+        fs.write_file(f"/.multipart/{upload_id}/{part:05d}", data)
+        return _h.md5(data).hexdigest()
+
+    def _complete_multipart(self, fs: FileSystem, key: str,
+                            upload_id: str) -> str:
+        import hashlib as _h
+
+        staging = f"/.multipart/{upload_id}"
+        initiated_for = fs.getxattr(staging, "s3.key")
+        if initiated_for != key:
+            raise FsError(22, f"upload {upload_id} was initiated for "
+                              f"{initiated_for!r}, not {key!r}")
+        parts = sorted(fs.readdir(staging))
+        body = b"".join(fs.read_file(f"{staging}/{p}") for p in parts)
+        self._put_object(fs, key, body)
+        self._abort_multipart(fs, upload_id)  # clear staging
+        return _h.md5(body).hexdigest()
+
+    def _abort_multipart(self, fs: FileSystem, upload_id: str) -> None:
+        staging = f"/.multipart/{upload_id}"
+        try:
+            for p in list(fs.readdir(staging)):
+                fs.unlink(f"{staging}/{p}")
+            fs.unlink(staging)
+        except FsError:
+            pass
 
     # ---- key <-> path adaptation ----
     def _put_object(self, fs: FileSystem, key: str, data: bytes) -> None:
@@ -181,6 +284,8 @@ class ObjectNode:
 
         def walk(path: str, keybase: str):
             for name, ino in sorted(fs.readdir(path or "/").items()):
+                if not path and name == ".multipart":
+                    continue  # staging area is not object namespace
                 inode = fs.meta.inode_get(ino)
                 k = f"{keybase}{name}"
                 if inode["type"] == mn.DIR:

@@ -154,3 +154,5 @@ def test_s3_gateway_with_sigv4(tmp_path, rng):
         assert code == 403
     finally:
         s3.stop()
+        for i in range(2):
+            pool.get(f"meta{i}")._target.stop()

@@ -280,3 +280,28 @@ def test_inspector_isolates_corrupt_data_shard(cluster, rng):
     assert tasks and tasks[0]["unit_index"] == 2  # the DATA unit, not parity
     cluster.drain_worker()
     assert cluster.access.get(loc) == data  # original bytes restored
+
+
+def test_lrc_codemode_through_access(tmp_path, rng):
+    """LRC volumes through the full access path: local parity written,
+    degraded read, and repair prefer the intra-AZ local stripe."""
+    c = Cluster(tmp_path, n_nodes=5, disks_per_node=2)  # 10 disks for EC4P4L2
+    c.cm.allow_colocated_units = True  # repair on a fully-spanned volume
+    data = payload(rng, 80_000)
+    loc = c.access.put(data, codemode=cmode.CodeMode.EC4P4L2)
+    assert c.access.get(loc) == data
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    assert len(vol.units) == 10  # 4 data + 4 global + 2 local parity
+    # local parity shards are populated (non-empty on their nodes)
+    bid = loc.slices[0].min_bid
+    for u in vol.units[8:]:
+        shard, _ = c.node_of(u.node_addr).get_shard(u.disk_id, u.chunk_id, bid)
+        assert len(shard) > 0
+    # degraded read with a broken data disk still works
+    u = vol.units[0]
+    c.node_of(u.node_addr).break_disk(u.disk_id)
+    assert c.access.get(loc) == data
+    # repair of the lost unit uses the local stripe (worker LRC path)
+    c.sched.mark_disk_broken(u.disk_id)
+    c.drain_worker()
+    assert c.access.get(loc) == data

@@ -40,10 +40,16 @@ class FsCluster:
     def data_node(self, addr: str) -> DataNode:
         return self.datas[int(addr.removeprefix("data"))]
 
+    def stop(self):
+        for m in self.metas:
+            m.stop()
+
 
 @pytest.fixture
 def cluster(tmp_path):
-    return FsCluster(tmp_path)
+    c = FsCluster(tmp_path)
+    yield c
+    c.stop()  # raft tickers must die with the test, not pile up
 
 
 def test_mkdir_create_write_read(cluster, rng):

@@ -30,7 +30,11 @@ def fscluster(tmp_path):
         pool.bind(f"data{i}", node)
         master.register_datanode(f"data{i}")
     view = master.create_volume("s3vol", mp_count=1, dp_count=2)
-    return FileSystem(view, pool)
+    fs = FileSystem(view, pool)
+    fs._meta_nodes = [pool.get(f"meta{i}")._target for i in range(2)]
+    yield fs
+    for n in fs._meta_nodes:
+        n.stop()
 
 
 def _req(method, url, data=None):
@@ -141,3 +145,67 @@ def test_launcher_and_cli_end_to_end(tmp_path, rng):
             p.terminate()
         for p in procs:
             p.wait(timeout=10)
+
+
+def test_s3_multipart_upload(fscluster, rng):
+    s3 = ObjectNode({"mp": fscluster}).start()
+    try:
+        base = f"http://{s3.addr}/mp"
+        code, body, _ = _req("POST", f"{base}/video.bin?uploads")
+        assert code == 200
+        upload_id = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+        parts = [rng.integers(0, 256, 40_000 + i, dtype=np.uint8).tobytes()
+                 for i in range(3)]
+        for i, p in enumerate(parts, start=1):
+            code, _, hdrs = _req(
+                "PUT", f"{base}/video.bin?partNumber={i}&uploadId={upload_id}", p)
+            assert code == 200 and "ETag" in hdrs
+        code, body, _ = _req("POST", f"{base}/video.bin?uploadId={upload_id}")
+        assert code == 200 and b"CompleteMultipartUploadResult" in body
+        code, got, _ = _req("GET", f"{base}/video.bin")
+        assert code == 200 and got == b"".join(parts)
+        # staging invisible in listings
+        code, listing, _ = _req("GET", f"http://{s3.addr}/mp")
+        assert b".multipart" not in listing
+        # unknown upload id -> NoSuchUpload
+        code, body, _ = _req("PUT", f"{base}/x?partNumber=1&uploadId=deadbeef", b"x")
+        assert code == 404 and b"NoSuchUpload" in body
+    finally:
+        s3.stop()
+
+
+def test_s3_multipart_abort(fscluster, rng):
+    s3 = ObjectNode({"mp": fscluster}).start()
+    try:
+        base = f"http://{s3.addr}/mp"
+        code, body, _ = _req("POST", f"{base}/tmp.bin?uploads")
+        upload_id = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+        _req("PUT", f"{base}/tmp.bin?partNumber=1&uploadId={upload_id}", b"part")
+        code, _, _ = _req("DELETE", f"{base}/tmp.bin?uploadId={upload_id}")
+        assert code == 204
+        code, _, _ = _req("GET", f"{base}/tmp.bin")
+        assert code == 404  # never completed
+    finally:
+        s3.stop()
+
+
+def test_s3_multipart_guards(fscluster):
+    s3 = ObjectNode({"mp": fscluster}).start()
+    try:
+        base = f"http://{s3.addr}/mp"
+        code, body, _ = _req("POST", f"http://{s3.addr}/mp?uploads")
+        assert code == 400  # no key
+        code, body, _ = _req("POST", f"{base}/k?uploads")
+        uid = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+        code, body, _ = _req("PUT", f"{base}/k?partNumber=abc&uploadId={uid}", b"x")
+        assert code == 400 and b"InvalidPart" in body
+        code, body, _ = _req("PUT", f"{base}/k?partNumber=10001&uploadId={uid}", b"x")
+        assert code == 400
+        _req("PUT", f"{base}/k?partNumber=1&uploadId={uid}", b"x")
+        # completing under a DIFFERENT key than initiated is rejected
+        code, body, _ = _req("POST", f"{base}/other?uploadId={uid}")
+        assert code == 404 or code == 400
+        code, body, _ = _req("POST", f"{base}/k?uploadId={uid}")
+        assert code == 200
+    finally:
+        s3.stop()

@@ -36,7 +36,11 @@ def fscluster(tmp_path):
         pool.bind(f"data{i}", node)
         master.register_datanode(f"data{i}")
     view = master.create_volume("satvol", mp_count=1, dp_count=2)
-    return FileSystem(view, pool), pool, tmp_path
+    fs = FileSystem(view, pool)
+    metas = [pool.get(f"meta{i}")._target for i in range(2)]
+    yield fs, pool, tmp_path
+    for n in metas:
+        n.stop()
 
 
 def test_flashnode_lru_eviction():

@@ -33,9 +33,11 @@ def make_sn_cluster(tmp_path, n=3):
 
 
 def _kv_call(pool, nodes, method, args, body=b"", timeout=8.0):
-    """Client-side leader-following helper."""
+    """Client-side leader-following helper over the LIVE nodes' own
+    addresses; 421 follows the leader, 404/503 (dead or stale member)
+    rotate to the next."""
     deadline = time.time() + timeout
-    addrs = [f"sn{i}" for i in range(len(nodes))]
+    addrs = [n.addr for n in nodes]
     i = 0
     while time.time() < deadline:
         addr = addrs[i % len(addrs)]
@@ -49,10 +51,13 @@ def _kv_call(pool, nodes, method, args, body=b"", timeout=8.0):
                     try:
                         return pool.get(leader).call(method, args, body)
                     except rpc.RpcError as e2:
-                        if e2.code in (421, 503):
+                        if e2.code in (421, 404, 503):
                             time.sleep(0.05)
                             continue
                         raise
+                time.sleep(0.05)
+                continue
+            if e.code in (404, 503) and method != "kv_get":
                 time.sleep(0.05)
                 continue
             if e.code == 503:
