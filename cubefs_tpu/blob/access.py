@@ -235,7 +235,11 @@ class AccessHandler:
         for i in present:
             if i < t.n + t.m:
                 stripe[i] = np.frombuffer(got[i], dtype=np.uint8)
-        enc.reconstruct_data(stripe, missing)
+        # EVERY unread row is bad — including parity we never fetched;
+        # marking only the missing data rows would let zero-filled parity
+        # rows join the solving set and silently corrupt the decode
+        all_bad = [i for i in range(t.n + t.m) if i not in got]
+        enc.reconstruct_data(stripe, all_bad)
         data = np.ascontiguousarray(stripe[: t.n]).reshape(-1)[:payload_len]
         return data.tobytes()
 

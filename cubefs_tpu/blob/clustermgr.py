@@ -225,10 +225,13 @@ class ClusterMgr:
             normal = [d for d in self.disks.values()
                       if d.status == DiskStatus.NORMAL and d.disk_id not in hard]
             cands = [d for d in normal if d.disk_id not in exclude_disks]
-            if not cands:
+            if not cands and self.allow_colocated_units:
+                # operator opted in: colocating beats staying degraded
                 cands = normal
             if not cands:
-                raise NoAvailableDisks("no normal disks outside the broken set")
+                raise NoAvailableDisks(
+                    "no destination disk outside the volume's failure domains"
+                )
             return min(cands, key=lambda d: d.chunk_count)
 
     def alloc_chunk_id(self) -> int:

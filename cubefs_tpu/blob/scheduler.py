@@ -97,11 +97,10 @@ class Scheduler:
                     return t["task_id"]  # idempotent re-queue
             vol = self.cm.get_volume(vid)
             exclude = {u.disk_id for u in vol.units}
-            broken = {d.disk_id for d in self.cm.disks.values()
-                      if d.status != DiskStatus.NORMAL}
-            if src_disk is not None:
-                broken.add(src_disk)
-            dest = self.cm.pick_destination(exclude, hard_exclude=broken)
+            # pick_destination already filters to NORMAL disks; only a
+            # still-NORMAL source (the balance path) needs hard exclusion
+            hard = {src_disk} if src_disk is not None else set()
+            dest = self.cm.pick_destination(exclude, hard_exclude=hard)
             task = {
                 "task_id": uuid.uuid4().hex[:16],
                 "type": "unit_repair",

@@ -7,7 +7,7 @@ object(s), serves them with the RPC layer, registers with its control
 plane, and blocks. Heartbeat loops run in daemon threads.
 
 Config keys (JSON):
-  role:        master | metanode | datanode | objectnode |
+  role:        master | metanode | datanode | objectnode | fuseclient |
                clustermgr | blobnode | access | proxy | scheduler | codec
   listen_host / listen_port: bind address (port 0 = ephemeral)
   master_addr / clustermgr_addr / scheduler_addr: upstreams
@@ -121,6 +121,17 @@ def run_role(cfg: dict):
                           authenticator=auth).start()
         print(f"[objectnode] S3 on {node.addr}", flush=True)
         return node, node
+
+    if role == "fuseclient":
+        from .fs.client import FileSystem
+        from .fs.fuse import mount as fuse_mount
+
+        master = rpc.Client(cfg["master_addr"])
+        view = master.call("client_view", {"name": cfg["vol"]})[0]["volume"]
+        m = fuse_mount(FileSystem(view, pool), cfg["mountpoint"])
+        print(f"[fuseclient] {cfg['vol']} mounted at {cfg['mountpoint']}",
+              flush=True)
+        return m, m
 
     if role == "clustermgr":
         from .blob.clustermgr import ClusterMgr

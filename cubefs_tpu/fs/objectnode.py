@@ -47,6 +47,11 @@ class ObjectNode:
             def _fs(self, bucket) -> FileSystem | None:
                 return outer.volumes.get(bucket)
 
+            def _key_reserved(self, key: str) -> bool:
+                # the multipart staging area is internal: direct key ops
+                # on it would expose/corrupt other clients' uploads
+                return key.split("/", 1)[0] == ".multipart"
+
             def _reply(self, code, body=b"", ctype="application/xml",
                        headers=None):
                 self.send_response(code)
@@ -91,6 +96,9 @@ class ObjectNode:
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
+                if self._key_reserved(key):
+                    return self._error(403, "AccessDenied",
+                                       ".multipart is a reserved namespace")
                 if "uploadId" in query and "partNumber" in query:  # UploadPart
                     upload_id = query["uploadId"][0]
                     try:
@@ -159,6 +167,9 @@ class ObjectNode:
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
+                if key and self._key_reserved(key):
+                    return self._error(403, "AccessDenied",
+                                       ".multipart is a reserved namespace")
                 if not key:  # ListObjectsV2
                     prefix = query.get("prefix", [""])[0]
                     keys = outer._list_objects(fs, prefix)
@@ -187,6 +198,9 @@ class ObjectNode:
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
+                if self._key_reserved(key):
+                    return self._error(403, "AccessDenied",
+                                       ".multipart is a reserved namespace")
                 try:
                     st = fs.stat("/" + key)
                 except FsError:
@@ -208,6 +222,9 @@ class ObjectNode:
                 if "uploadId" in query:  # AbortMultipartUpload
                     outer._abort_multipart(fs, query["uploadId"][0])
                     return self._reply(204)
+                if self._key_reserved(key):
+                    return self._error(403, "AccessDenied",
+                                       ".multipart is a reserved namespace")
                 try:
                     fs.unlink("/" + key)
                     outer._prune_empty_dirs(fs, key)

@@ -209,3 +209,61 @@ def test_s3_multipart_guards(fscluster):
         assert code == 200
     finally:
         s3.stop()
+
+
+def test_s3_reserved_namespace_blocked(fscluster):
+    s3 = ObjectNode({"mp": fscluster}).start()
+    try:
+        base = f"http://{s3.addr}/mp"
+        code, body, _ = _req("POST", f"{base}/x?uploads")
+        uid = body.split(b"<UploadId>")[1].split(b"</UploadId>")[0].decode()
+        _req("PUT", f"{base}/x?partNumber=1&uploadId={uid}", b"secret")
+        for verb, path in [("GET", f".multipart/{uid}/00001"),
+                           ("PUT", ".multipart/evil"),
+                           ("DELETE", f".multipart/{uid}/00001"),
+                           ("HEAD", f".multipart/{uid}/00001")]:
+            code, *_ = _req(verb, f"{base}/{path}",
+                            b"x" if verb == "PUT" else None)
+            assert code == 403, (verb, code)
+        # the upload itself still completes fine
+        code, _, _ = _req("POST", f"{base}/x?uploadId={uid}")
+        assert code == 200
+    finally:
+        s3.stop()
+
+
+def test_fuse_chmod_and_rename_clobber(tmp_path, rng):
+    import os as _os
+    if not _os.path.exists("/dev/fuse") or _os.geteuid() != 0:
+        pytest.skip("needs /dev/fuse and root")
+    from cubefs_tpu.fs import fuse as fusemod
+    from tests.test_fs_e2e import FsCluster
+    import time as _t
+    c = FsCluster(tmp_path)
+    mnt = str(tmp_path / "m")
+    m = fusemod.mount(c.fs, mnt)
+    deadline = _t.time() + 10
+    while _t.time() < deadline:
+        try:
+            _os.listdir(mnt)
+            break
+        except OSError:
+            _t.sleep(0.1)
+    try:
+        open(f"{mnt}/f", "w").write("data")
+        _os.chmod(f"{mnt}/f", 0o640)
+        assert _os.stat(f"{mnt}/f").st_mode & 0o7777 == 0o640
+        # rename onto an existing file reclaims the target's extents
+        open(f"{mnt}/victim", "w").write("V" * 10_000)
+        victim_ino = c.fs.resolve("/victim")
+        _os.rename(f"{mnt}/f", f"{mnt}/victim")
+        assert open(f"{mnt}/victim").read() == "data"
+        # rename onto a non-empty dir fails like rename(2)
+        _os.mkdir(f"{mnt}/d")
+        open(f"{mnt}/d/child", "w").write("x")
+        open(f"{mnt}/g", "w").write("y")
+        with pytest.raises(OSError):
+            _os.rename(f"{mnt}/g", f"{mnt}/d")
+    finally:
+        m.unmount()
+        c.stop()
