@@ -16,7 +16,7 @@ from __future__ import annotations
 import contextvars
 import threading
 import zlib
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -200,36 +200,75 @@ class AccessHandler:
         except Exception as e:
             return idx, None, e
 
+    HEDGE_DELAY = 0.05  # backup-request trigger (stream_get.go hedging)
+
     def _get_blob(self, enc, vol: VolumeInfo, bid: int, payload_len: int) -> bytes:
         t = enc.t
         shard_size = enc.shard_size(
             payload_len if payload_len > 0 else 1
         )
-        # fast path: read the N data shards
-        reads = self._map(lambda i: self._read_shard(vol, i, bid), range(t.n))
-        got = {i: p for i, p, err in reads if err is None}
-        if len(got) == t.n:
+        # fast path: read the N data shards; if any straggle past the
+        # hedge delay, fire backup requests at parity shards and take the
+        # first n results (the reference's n-of-N+x hedged GET)
+        pending_map = {self._submit(self._read_shard, vol, i, bid): i
+                       for i in range(t.n)}
+        _, pending = wait(pending_map, timeout=self.HEDGE_DELAY)
+        # hedge only for reads that STARTED and stalled; queued-not-started
+        # futures mean the pool is saturated — extra reads would amplify
+        # load exactly when overloaded
+        stalled = sum(1 for f in pending if f.running())
+        for i in range(t.n, t.n + min(t.m, stalled)):
+            pending_map[self._submit(self._read_shard, vol, i, bid)] = i
+        # first n distinct shards win (any mix of data/parity decodes);
+        # on the happy path the straggler is abandoned in-flight
+        got: dict[int, bytes] = {}
+        errs: dict[int, object] = {}
+        remaining = set(pending_map)
+        while remaining and len(got) < t.n:
+            done, remaining = wait(remaining, return_when=FIRST_COMPLETED)
+            for f in done:
+                i, p, err = f.result()
+                if err is None:
+                    got[i] = p
+                else:
+                    errs[i] = err
+        if all(i in got for i in range(t.n)):  # got may also hold hedged parity
             data = b"".join(got[i] for i in range(t.n))
             return data[:payload_len]
 
-        # degraded read: pull parity/local shards until n_global available
+        # degraded read. If the hedge already yielded n shards (mixed
+        # data+parity), decode straight away — draining the straggler
+        # would forfeit the hedge's latency win. Only when short of n do
+        # we drain in-flight reads (no duplicate RPCs) and fetch extras.
+        if len(got) < t.n:
+            for f in remaining:
+                i, p, err = f.result()
+                if err is None:
+                    got[i] = p
+                else:
+                    errs[i] = err
+            extra_idx = [i for i in range(t.n, t.n + t.m)
+                         if i not in got and i not in errs]
+            for i, p, err in self._map(
+                lambda i: self._read_shard(vol, i, bid), extra_idx
+            ):
+                if err is None:
+                    got[i] = p
         missing = [i for i in range(t.n) if i not in got]
-        extra_idx = [i for i in range(t.n, t.n + t.m) if i not in got]
-        for i, p, err in self._map(
-            lambda i: self._read_shard(vol, i, bid), extra_idx
-        ):
-            if err is None:
-                got[i] = p
         present = sorted(got)
         if len(present) < t.n:
             raise GetError(
                 f"bid {bid}: only {len(present)} of {t.n} shards readable"
             )
         if self.repair_queue is not None:
+            # repair only shards whose reads actually FAILED — a merely
+            # slow healthy shard must not trigger data movement
             for i in missing:
-                self.repair_queue.put(
-                    {"type": "shard_repair", "vid": vol.vid, "bid": bid, "bad_index": i}
-                )
+                if i in errs:
+                    self.repair_queue.put(
+                        {"type": "shard_repair", "vid": vol.vid, "bid": bid,
+                         "bad_index": i}
+                    )
         shard_size = len(next(iter(got.values())))
         stripe = np.zeros((t.n + t.m, shard_size), dtype=np.uint8)
         for i in present:

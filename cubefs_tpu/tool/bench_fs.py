@@ -1,0 +1,135 @@
+"""FS-plane benchmark harness: the mdtest / fio role.
+
+Role parity: the reference's published evaluation (docs/source/
+evaluation: mdtest dir/file creation + stat ops/s, fio seq/rand MB/s,
+small-file TPS — see BASELINE.md). Measures this framework's FS plane
+with the same shapes: metadata ops/s (create/stat/readdir/remove),
+sequential write/read MB/s, and small-file TPS, against an in-process
+cluster (default) or a live master.
+
+  python -m cubefs_tpu.tool.bench_fs               # in-process cluster
+  python -m cubefs_tpu.tool.bench_fs --master H:P --vol NAME
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+
+def _rate(n: int, dt: float) -> float:
+    return round(n / dt, 1) if dt > 0 else float("inf")
+
+
+def run(fs, files: int = 200, io_mb: int = 16, threads: int = 8,
+        small_size: int = 1024) -> dict:
+    import uuid
+
+    out: dict = {}
+    pool = ThreadPoolExecutor(threads)
+    root = f"/bench_{uuid.uuid4().hex[:8]}"  # rerunnable on a live volume
+
+    # ---- mdtest analog: dirs ----
+    fs.mkdir(root)
+    t0 = time.perf_counter()
+    list(pool.map(lambda i: fs.mkdir(f"{root}/d{i}"), range(files)))
+    out["dir_create_ops"] = _rate(files, time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    list(pool.map(lambda i: fs.stat(f"{root}/d{i}"), range(files)))
+    out["dir_stat_ops"] = _rate(files, time.perf_counter() - t0)
+
+    # ---- mdtest analog: files (+ small-file TPS with payload) ----
+    payload = os.urandom(small_size)
+    t0 = time.perf_counter()
+    list(pool.map(lambda i: fs.write_file(f"{root}/d{i % files}/f{i}", payload),
+                  range(files)))
+    out["small_file_create_tps"] = _rate(files, time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    list(pool.map(lambda i: fs.read_file(f"{root}/d{i % files}/f{i}"),
+                  range(files)))
+    out["small_file_read_tps"] = _rate(files, time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    list(pool.map(lambda i: fs.stat(f"{root}/d{i % files}/f{i}"), range(files)))
+    out["file_stat_ops"] = _rate(files, time.perf_counter() - t0)
+
+    # ---- fio analog: sequential write / read ----
+    blob = os.urandom(1 << 20)
+    t0 = time.perf_counter()
+    for i in range(io_mb):
+        fs.write_file(f"{root}/big.bin", blob, append=i > 0)
+    dt = time.perf_counter() - t0
+    out["seq_write_mbps"] = _rate(io_mb, dt)
+    t0 = time.perf_counter()
+    got = fs.read_file(f"{root}/big.bin")
+    dt = time.perf_counter() - t0
+    assert len(got) == io_mb << 20
+    out["seq_read_mbps"] = _rate(io_mb, dt)
+
+    # ---- cleanup ops/s (mdtest removal) ----
+    t0 = time.perf_counter()
+    list(pool.map(lambda i: fs.unlink(f"{root}/d{i % files}/f{i}"),
+                  range(files)))
+    out["file_remove_ops"] = _rate(files, time.perf_counter() - t0)
+    # leave the volume reusable: remove the whole bench tree
+    fs.unlink(f"{root}/big.bin")
+    list(pool.map(lambda i: fs.unlink(f"{root}/d{i}"), range(files)))
+    fs.unlink(root)
+    pool.shutdown()
+    return out
+
+
+def _inprocess_fs(workdir: str, n_data: int = 3, n_meta: int = 2):
+    from ..fs.client import FileSystem
+    from ..fs.datanode import DataNode
+    from ..fs.master import Master
+    from ..fs.metanode import MetaNode
+    from ..utils.rpc import NodePool
+
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas = []
+    for i in range(n_meta):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    for i in range(n_data):
+        node = DataNode(i, os.path.join(workdir, f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", node)
+        master.register_datanode(f"data{i}")
+    view = master.create_volume("bench", mp_count=2, dp_count=3)
+    return FileSystem(view, pool), metas
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="cubefs-tpu-fs-bench")
+    ap.add_argument("--master")
+    ap.add_argument("--vol")
+    ap.add_argument("--files", type=int, default=200)
+    ap.add_argument("--io-mb", type=int, default=16)
+    ap.add_argument("--threads", type=int, default=8)
+    args = ap.parse_args(argv)
+    metas = []
+    if args.master:
+        from ..fs.client import FileSystem
+        from ..utils import rpc
+        from ..utils.rpc import NodePool
+
+        view = rpc.call(args.master, "client_view",
+                        {"name": args.vol})[0]["volume"]
+        fs = FileSystem(view, NodePool())
+    else:
+        workdir = tempfile.mkdtemp(prefix="cubefs-bench-")
+        fs, metas = _inprocess_fs(workdir)
+    print(json.dumps(run(fs, args.files, args.io_mb, args.threads)))
+    for m in metas:
+        m.stop()
+
+
+if __name__ == "__main__":
+    main()

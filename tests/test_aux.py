@@ -195,3 +195,45 @@ def test_pallas_engine_lazy_registration():
     eng._instances.pop("tpu-pallas", None)
     e = eng.get_engine("tpu-pallas")
     assert e.name == "tpu-pallas"
+
+
+def test_fs_bench_tool(tmp_path):
+    from cubefs_tpu.tool import bench_fs
+
+    fs, metas = bench_fs._inprocess_fs(str(tmp_path))
+    try:
+        out = bench_fs.run(fs, files=20, io_mb=2, threads=4)
+        assert out["dir_create_ops"] > 0 and out["seq_read_mbps"] > 0
+        assert out["small_file_create_tps"] > 0
+    finally:
+        for m in metas:
+            m.stop()
+
+
+def test_hedged_get_with_slow_data_shard(mini_blob, rng):
+    """A stalling data-shard read must not stall the GET past the hedge
+    window: parity backup requests fill in and decode recovers."""
+    import time as _t
+    cm, cm_client, pool, node = mini_blob
+    access = AccessHandler(cm_client, pool, AccessConfig(blob_size=32 << 10))
+    access.HEDGE_DELAY = 0.05
+    payload = rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes()
+    loc = access.put(payload, codemode=13)  # EC6P3
+    # wrap the node client: shard 0 reads stall 2s
+    real = pool.get("n0")
+
+    class SlowShard0:
+        def call(self, method, args=None, body=b"", timeout=30.0):
+            vol = cm.get_volume(loc.slices[0].vid)
+            if (method == "get_shard"
+                    and args.get("chunk_id") == vol.units[0].chunk_id):
+                _t.sleep(2.0)
+            return real.call(method, args, body, timeout)
+
+    pool._clients["n0"] = SlowShard0()
+    try:
+        t0 = _t.time()
+        assert access.get(loc) == payload
+        assert _t.time() - t0 < 1.5  # hedged around the 2s stall
+    finally:
+        pool._clients["n0"] = real
