@@ -25,13 +25,17 @@ class NoAvailableDisks(Exception):
 
 class ClusterMgr:
     HEARTBEAT_TIMEOUT = 12.0  # seconds without heartbeat -> suspect
+    REDIRECT = 421
 
     def __init__(self, cluster_id: int = 1, data_dir: str | None = None,
-                 allow_colocated_units: bool = False):
+                 allow_colocated_units: bool = False,
+                 me: str | None = None, peers: list[str] | None = None,
+                 node_pool=None):
         self.cluster_id = cluster_id
         self.data_dir = data_dir
         self.allow_colocated_units = allow_colocated_units
         self._lock = threading.RLock()
+        self._propose_lock = threading.Lock()  # serializes decide+commit
         self.disks: dict[int, DiskInfo] = {}
         self.volumes: dict[int, VolumeInfo] = {}
         self.services: dict[str, list[str]] = {}
@@ -41,10 +45,86 @@ class ClusterMgr:
         self._next_bid = 1
         self._next_chunk = 1
         self._wal = None
-        if data_dir:
+        self.raft = None
+        self.extra_routes: dict = {}
+        if peers and len(peers) > 1:
+            # replicated mode: the raft wal+snapshot supersede the local
+            # wal; mutations decide on the leader and commit records
+            # through consensus (etcd-raft-backed clustermgr role parity)
+            from ..parallel import raft as raftlib
+
+            if data_dir:
+                os.makedirs(data_dir, exist_ok=True)
+            self.raft = raftlib.RaftNode(
+                "cm", me, peers, self._apply, node_pool,
+                data_dir=os.path.join(data_dir, "raft") if data_dir else None,
+                snapshot_fn=self._state_bytes, restore_fn=self._restore_bytes,
+            )
+            raftlib.register_routes(self.extra_routes, self.raft)
+            self.raft.start()
+        elif data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._load()
             self._wal = open(os.path.join(data_dir, "wal.jsonl"), "a")
+
+    # ---------------- replication door ----------------
+    def is_leader(self) -> bool:
+        return self.raft is None or self.raft.status()["role"] == "leader"
+
+    def leader_addr(self) -> str | None:
+        return None if self.raft is None else self.raft.status()["leader"]
+
+    def _leader_gate(self) -> None:
+        """Replicated mode serves reads and accepts writes on the leader
+        only (followers apply asynchronously; serving them would return
+        stale volume maps right after a commit)."""
+        if self.raft is not None and not self.is_leader():
+            raise rpc.RpcError(self.REDIRECT,
+                               f"leader={self.leader_addr() or ''}")
+
+    def _commit(self, record: dict):
+        if self.raft is None:
+            out = self._apply(dict(record))
+            self._log(**record)
+            return out
+        from ..parallel.raft import NotLeaderError
+
+        try:
+            return self.raft.propose(record)
+        except NotLeaderError as e:
+            raise rpc.RpcError(self.REDIRECT, f"leader={e.leader or ''}") from None
+
+    def _state_dict(self) -> dict:
+        """Single source of truth for the FSM's serialized shape — used
+        by BOTH the standalone snapshot and the raft snapshot/restore."""
+        return {
+            "cluster_id": self.cluster_id,
+            "disks": {k: v.to_dict() for k, v in self.disks.items()},
+            "volumes": {k: v.to_dict() for k, v in self.volumes.items()},
+            "services": self.services,
+            "kv": self.kv,
+            "next": [self._next_disk, self._next_vid, self._next_bid,
+                     self._next_chunk],
+        }
+
+    def _load_state_dict(self, state: dict) -> None:
+        self.cluster_id = state["cluster_id"]
+        self.disks = {int(k): DiskInfo.from_dict(v)
+                      for k, v in state["disks"].items()}
+        self.volumes = {int(k): VolumeInfo.from_dict(v)
+                        for k, v in state["volumes"].items()}
+        self.services = state["services"]
+        self.kv = state["kv"]
+        (self._next_disk, self._next_vid, self._next_bid,
+         self._next_chunk) = state["next"]
+
+    def _state_bytes(self) -> bytes:
+        with self._lock:
+            return json.dumps(self._state_dict()).encode()
+
+    def _restore_bytes(self, data: bytes) -> None:
+        with self._lock:
+            self._load_state_dict(json.loads(data))
 
     # ---------------- persistence (FSM apply stream) ----------------
     def _log(self, op: str, **kw) -> None:
@@ -56,14 +136,7 @@ class ClusterMgr:
         if not self.data_dir:
             return
         with self._lock:
-            state = {
-                "cluster_id": self.cluster_id,
-                "disks": {k: v.to_dict() for k, v in self.disks.items()},
-                "volumes": {k: v.to_dict() for k, v in self.volumes.items()},
-                "services": self.services,
-                "kv": self.kv,
-                "next": [self._next_disk, self._next_vid, self._next_bid, self._next_chunk],
-            }
+            state = self._state_dict()
             tmp = os.path.join(self.data_dir, "snapshot.json.tmp")
             with open(tmp, "w") as f:
                 json.dump(state, f)
@@ -76,13 +149,7 @@ class ClusterMgr:
     def _load(self) -> None:
         snap = os.path.join(self.data_dir, "snapshot.json")
         if os.path.exists(snap):
-            state = json.load(open(snap))
-            self.cluster_id = state["cluster_id"]
-            self.disks = {int(k): DiskInfo.from_dict(v) for k, v in state["disks"].items()}
-            self.volumes = {int(k): VolumeInfo.from_dict(v) for k, v in state["volumes"].items()}
-            self.services = state["services"]
-            self.kv = state["kv"]
-            (self._next_disk, self._next_vid, self._next_bid, self._next_chunk) = state["next"]
+            self._load_state_dict(json.load(open(snap)))
         wal = os.path.join(self.data_dir, "wal.jsonl")
         if os.path.exists(wal):
             for line in open(wal):
@@ -94,22 +161,26 @@ class ClusterMgr:
                         break  # torn tail
                     self._apply(rec)
 
-    def _apply(self, rec: dict) -> None:
+    def _apply(self, rec: dict):
+        rec = dict(rec)
         op = rec.pop("op")
-        getattr(self, f"_apply_{op}")(**rec)
+        with self._lock:
+            return getattr(self, f"_apply_{op}")(**rec)
 
     # ---------------- disks & nodes ----------------
     def register_disk(self, node_addr: str, path: str) -> int:
-        with self._lock:
-            disk_id = self._next_disk
-            self._apply_register_disk(disk_id, node_addr, path)
-            self._log("register_disk", disk_id=disk_id, node_addr=node_addr, path=path)
-            return disk_id
+        # ids allocate INSIDE apply: a new leader whose apply stream lags
+        # must never re-issue an id another leader already committed
+        with self._propose_lock:
+            return self._commit({"op": "register_disk",
+                                 "node_addr": node_addr, "path": path})
 
-    def _apply_register_disk(self, disk_id: int, node_addr: str, path: str) -> None:
+    def _apply_register_disk(self, node_addr: str, path: str) -> int:
+        disk_id = self._next_disk
+        self._next_disk += 1
         self.disks[disk_id] = DiskInfo(disk_id, node_addr, path,
                                        last_heartbeat=time.time())
-        self._next_disk = max(self._next_disk, disk_id + 1)
+        return disk_id
 
     def heartbeat(self, disk_ids: list[int], chunk_counts: dict | None = None) -> None:
         now = time.time()
@@ -121,9 +192,9 @@ class ClusterMgr:
                         self.disks[d].chunk_count = chunk_counts[str(d)]
 
     def set_disk_status(self, disk_id: int, status: int) -> None:
-        with self._lock:
-            self._apply_set_disk_status(disk_id, status)
-            self._log("set_disk_status", disk_id=disk_id, status=status)
+        with self._propose_lock:
+            self._commit({"op": "set_disk_status", "disk_id": disk_id,
+                          "status": int(status)})
 
     def _apply_set_disk_status(self, disk_id: int, status: int) -> None:
         self.disks[disk_id].status = int(status)
@@ -145,42 +216,45 @@ class ClusterMgr:
         """Create a volume: place its N+M+L chunks on distinct normal
         disks (distinctness waived only for single-node dev clusters)."""
         t = cm.tactic(codemode)
-        with self._lock:
-            normal = [d for d in self.disks.values() if d.status == DiskStatus.NORMAL]
-            if not normal:
-                raise NoAvailableDisks("no registered disks")
-            if len(normal) < t.total and not self.allow_colocated_units:
-                raise NoAvailableDisks(
-                    f"{len(normal)} disks < {t.total} units for {cm.CodeMode(codemode).name}"
-                )
-            # least-loaded placement
-            normal.sort(key=lambda d: d.chunk_count)
-            picks = [normal[i % len(normal)] for i in range(t.total)]
-            vid = self._next_vid
-            chunk_base = self._next_chunk
+        with self._propose_lock:
+            with self._lock:
+                normal = [d for d in self.disks.values()
+                          if d.status == DiskStatus.NORMAL]
+                if not normal:
+                    raise NoAvailableDisks("no registered disks")
+                if len(normal) < t.total and not self.allow_colocated_units:
+                    raise NoAvailableDisks(
+                        f"{len(normal)} disks < {t.total} units for "
+                        f"{cm.CodeMode(codemode).name}"
+                    )
+                # least-loaded placement (disk_id tiebreak: deterministic)
+                normal.sort(key=lambda d: (d.chunk_count, d.disk_id))
+                picks = [normal[i % len(normal)] for i in range(t.total)]
+            # placement decided leader-side; vid/chunk ids allocate in apply
             rec = {
-                "vid": vid,
+                "op": "create_volume",
                 "codemode": int(codemode),
-                "units": [
-                    {"index": i, "disk_id": p.disk_id,
-                     "chunk_id": chunk_base + i, "node_addr": p.node_addr}
-                    for i, p in enumerate(picks)
-                ],
+                "picks": [{"disk_id": p.disk_id, "node_addr": p.node_addr}
+                          for p in picks],
             }
-            self._apply_create_volume(**rec)
-            self._log("create_volume", **rec)
-            return self.volumes[vid]
+            vid = self._commit(rec)
+            return self.get_volume(vid)
 
-    def _apply_create_volume(self, vid: int, codemode: int, units: list[dict]) -> None:
-        vol = VolumeInfo(vid=vid, codemode=codemode,
-                         units=[VolumeUnit.from_dict(u) for u in units],
+    def _apply_create_volume(self, codemode: int, picks: list[dict]) -> int:
+        vid = self._next_vid
+        self._next_vid += 1
+        units = []
+        for i, p in enumerate(picks):
+            units.append(VolumeUnit(i, p["disk_id"], self._next_chunk,
+                                    p["node_addr"]))
+            self._next_chunk += 1
+        vol = VolumeInfo(vid=vid, codemode=codemode, units=units,
                          status=VolumeStatus.ACTIVE)
         self.volumes[vid] = vol
         for u in vol.units:
             if u.disk_id in self.disks:
                 self.disks[u.disk_id].chunk_count += 1
-        self._next_vid = max(self._next_vid, vid + 1)
-        self._next_chunk = max(self._next_chunk, max(u.chunk_id for u in vol.units) + 1)
+        return vid
 
     def get_volume(self, vid: int) -> VolumeInfo:
         with self._lock:
@@ -191,10 +265,10 @@ class ClusterMgr:
     def update_volume_unit(self, vid: int, index: int, disk_id: int,
                            chunk_id: int, node_addr: str) -> None:
         """Repair writeback: point a shard slot at its new home."""
-        with self._lock:
-            self._apply_update_unit(vid, index, disk_id, chunk_id, node_addr)
-            self._log("update_unit", vid=vid, index=index, disk_id=disk_id,
-                      chunk_id=chunk_id, node_addr=node_addr)
+        with self._propose_lock:
+            self._commit({"op": "update_unit", "vid": vid, "index": index,
+                          "disk_id": disk_id, "chunk_id": chunk_id,
+                          "node_addr": node_addr})
 
     def _apply_update_unit(self, vid: int, index: int, disk_id: int,
                            chunk_id: int, node_addr: str) -> None:
@@ -235,33 +309,28 @@ class ClusterMgr:
             return min(cands, key=lambda d: d.chunk_count)
 
     def alloc_chunk_id(self) -> int:
-        with self._lock:
-            cid = self._next_chunk
-            self._next_chunk += 1
-            self._log("alloc_chunk", chunk_id=cid)
-            return cid
+        with self._propose_lock:
+            return self._commit({"op": "alloc_chunk"})
 
-    def _apply_alloc_chunk(self, chunk_id: int) -> None:
-        self._next_chunk = max(self._next_chunk, chunk_id + 1)
+    def _apply_alloc_chunk(self) -> int:
+        cid = self._next_chunk
+        self._next_chunk += 1
+        return cid
 
     # ---------------- scope (BID) allocation ----------------
     def alloc_bids(self, count: int) -> int:
-        with self._lock:
-            start = self._next_bid
-            self._next_bid += count
-            self._log("alloc_bids", start=start, count=count)
-            return start
+        with self._propose_lock:
+            return self._commit({"op": "alloc_bids", "count": count})
 
-    def _apply_alloc_bids(self, start: int, count: int) -> None:
-        self._next_bid = max(self._next_bid, start + count)
+    def _apply_alloc_bids(self, count: int) -> int:
+        start = self._next_bid
+        self._next_bid += count
+        return start
 
     # ---------------- service registry & config ----------------
     def register_service(self, name: str, addr: str) -> None:
-        with self._lock:
-            self.services.setdefault(name, [])
-            if addr not in self.services[name]:
-                self.services[name].append(addr)
-            self._log("register_service", name=name, addr=addr)
+        with self._propose_lock:
+            self._commit({"op": "register_service", "name": name, "addr": addr})
 
     def _apply_register_service(self, name: str, addr: str) -> None:
         self.services.setdefault(name, [])
@@ -273,9 +342,8 @@ class ClusterMgr:
             return list(self.services.get(name, []))
 
     def set_config(self, key: str, value: str) -> None:
-        with self._lock:
-            self.kv[key] = value
-            self._log("set_config", key=key, value=value)
+        with self._propose_lock:
+            self._commit({"op": "set_config", "key": key, "value": value})
 
     def _apply_set_config(self, key: str, value: str) -> None:
         self.kv[key] = value
@@ -297,6 +365,7 @@ class ClusterMgr:
 
     # ---------------- RPC surface ----------------
     def rpc_register_disk(self, args, body):
+        self._leader_gate()
         return {"disk_id": self.register_disk(args["node_addr"], args["path"])}
 
     def rpc_heartbeat(self, args, body):
@@ -304,12 +373,15 @@ class ClusterMgr:
         return {}
 
     def rpc_alloc_volume(self, args, body):
+        self._leader_gate()
         return {"volume": self.alloc_volume(args["codemode"]).to_dict()}
 
     def rpc_get_volume(self, args, body):
+        self._leader_gate()
         return {"volume": self.get_volume(args["vid"]).to_dict()}
 
     def rpc_alloc_bids(self, args, body):
+        self._leader_gate()
         return {"start": self.alloc_bids(args["count"])}
 
     def rpc_set_disk_status(self, args, body):
@@ -330,3 +402,6 @@ class ClusterMgr:
 
     def rpc_stat(self, args, body):
         return self.stat()
+
+    def rpc_raft_status(self, args, body):
+        return self.raft.status() if self.raft else {"role": "standalone"}

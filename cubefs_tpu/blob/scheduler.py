@@ -59,9 +59,23 @@ class Scheduler:
     # ---------------- task generation ----------------
     def collect_broken_disks(self) -> list[int]:
         """Failure detector → repair work: mark heartbeat-dead disks
-        BROKEN and emit one migrate task per volume-unit on them."""
+        BROKEN and emit one migrate task per volume-unit on them.
+
+        A freshly elected clustermgr leader has a heartbeat view that is
+        entirely stale (heartbeats are leader-local); without a grace
+        period it would declare every healthy disk dead and storm the
+        cluster with migrations."""
         if not self.switch.enabled("disk_repair"):
             return []
+        if not getattr(self.cm, "is_leader", lambda: True)():
+            self._leader_since = None
+            return []
+        if getattr(self.cm, "raft", None) is not None:
+            now = time.time()
+            if getattr(self, "_leader_since", None) is None:
+                self._leader_since = now
+            if now - self._leader_since < 2 * self.cm.HEARTBEAT_TIMEOUT:
+                return []
         newly = []
         for disk_id in self.cm.suspect_dead_disks():
             self.mark_disk_broken(disk_id)
@@ -377,6 +391,9 @@ class Scheduler:
         def loop():
             while not self._stop.wait(interval):
                 try:
+                    if not getattr(self.cm, "is_leader", lambda: True)():
+                        continue  # replicated cm: only the leader's
+                        # scheduler generates tasks
                     self.collect_broken_disks()
                     self.consume_repair_msgs()
                     self.consume_delete_msgs()

@@ -136,9 +136,13 @@ def run_role(cfg: dict):
     if role == "clustermgr":
         from .blob.clustermgr import ClusterMgr
 
+        # peers (incl. our own addr) enable raft replication; addresses
+        # must be static (listen_port != 0) so the group can dial us
         svc = ClusterMgr(data_dir=cfg.get("data_dir"),
-                         allow_colocated_units=bool(cfg.get("allow_colocated_units", False)))
-        return _serve(rpc.expose(svc), cfg), svc
+                         allow_colocated_units=bool(cfg.get("allow_colocated_units", False)),
+                         me=cfg.get("me"), peers=cfg.get("peers"),
+                         node_pool=pool)
+        return _serve(svc, cfg), svc
 
     if role == "blobnode":
         from .blob.blobnode import BlobNode

@@ -239,6 +239,8 @@ class Client:
         else:
             self._target = target  # live resolution (see resolve_route)
 
+    REDIRECT = 421
+
     def call(self, method: str, args: dict | None = None, body: bytes = b"",
              timeout: float = 30.0) -> tuple[dict, bytes]:
         if self._target is not None:
@@ -246,4 +248,28 @@ class Client:
             if fn is None:
                 raise RpcError(404, f"no such method {method!r}")
             return _normalize(fn(args or {}, body))
-        return call(self._addr, method, args, body, timeout)
+        # leader redirects (421 with "leader=<addr>") are followed
+        # transparently and the learned leader is preferred afterwards,
+        # so a clustermgr failover never strands access/blobnode clients
+        addr = getattr(self, "_leader", None) or self._addr
+        for _ in range(3):
+            try:
+                return call(addr, method, args, body, timeout)
+            except RpcError as e:
+                if e.code == self.REDIRECT:
+                    leader = e.message.removeprefix("leader=").strip()
+                    if leader and leader != addr:
+                        self._leader = leader
+                        addr = leader
+                        continue
+                    import time as _t
+
+                    _t.sleep(0.1)  # election in progress
+                    continue
+                if isinstance(e, ServiceUnavailable) and addr != self._addr:
+                    # learned leader died: fall back to the configured addr
+                    self._leader = None
+                    addr = self._addr
+                    continue
+                raise
+        raise RpcError(503, f"{self._addr}/{method}: leader unresolved")

@@ -305,3 +305,68 @@ def test_lrc_codemode_through_access(tmp_path, rng):
     c.sched.mark_disk_broken(u.disk_id)
     c.drain_worker()
     assert c.access.get(loc) == data
+
+
+def test_clustermgr_raft_replication(tmp_path):
+    """3-replica clustermgr: commits through raft, leader redirect for
+    followers, state converges, and a restart recovers via the raft wal."""
+    import time
+    from cubefs_tpu.utils.rpc import NodePool as _Pool
+
+    pool = _Pool()
+    peers = ["cma", "cmb", "cmc"]
+    cms = {}
+    for name in peers:
+        c = ClusterMgr(data_dir=str(tmp_path / name), me=name, peers=peers,
+                       node_pool=pool, allow_colocated_units=True)
+        pool.bind(name, c)
+        cms[name] = c
+    try:
+        deadline = time.time() + 8
+        leader = None
+        while time.time() < deadline and leader is None:
+            leaders = [c for c in cms.values() if c.is_leader()]
+            if len(leaders) == 1:
+                leader = leaders[0]
+            time.sleep(0.05)
+        assert leader is not None
+        disk_id = leader.register_disk("node0", "/d0")
+        for i in range(8):
+            leader.register_disk("node0", f"/d{i+1}")
+        vol = leader.alloc_volume(13)  # EC6P3
+        start = leader.alloc_bids(16)
+        leader.set_config("k", "v")
+        # replicates to followers
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if all(len(c.disks) == 9 and vol.vid in c.volumes
+                   and c.kv.get("k") == "v" for c in cms.values()):
+                break
+            time.sleep(0.05)
+        for c in cms.values():
+            assert len(c.disks) == 9
+            assert c.volumes[vol.vid].codemode == 13
+            assert c.kv.get("k") == "v"
+        # follower mutations redirect
+        follower = next(c for c in cms.values() if c is not leader)
+        with pytest.raises(rpc.RpcError) as ei:
+            follower.rpc_alloc_bids({"count": 4}, b"")
+        assert ei.value.code == 421
+        # restart one member: raft wal replays the full FSM
+        victim_name = follower.raft.me
+        follower.raft.stop()
+        time.sleep(0.2)
+        c2 = ClusterMgr(data_dir=str(tmp_path / victim_name), me=victim_name,
+                        peers=peers, node_pool=pool, allow_colocated_units=True)
+        pool.bind(victim_name, c2)
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if vol.vid in c2.volumes and c2.kv.get("k") == "v":
+                break
+            time.sleep(0.05)
+        assert c2.volumes[vol.vid].codemode == 13
+        c2.raft.stop()
+    finally:
+        for c in cms.values():
+            if c.raft:
+                c.raft.stop()
