@@ -16,6 +16,7 @@ import time
 
 from ..codec import codemode as cm
 from ..utils import rpc
+from ..utils.fsm import ReplicatedFsm
 from .types import DiskInfo, DiskStatus, VolumeInfo, VolumeStatus, VolumeUnit
 
 
@@ -23,9 +24,8 @@ class NoAvailableDisks(Exception):
     pass
 
 
-class ClusterMgr:
+class ClusterMgr(ReplicatedFsm):
     HEARTBEAT_TIMEOUT = 12.0  # seconds without heartbeat -> suspect
-    REDIRECT = 421
 
     def __init__(self, cluster_id: int = 1, data_dir: str | None = None,
                  allow_colocated_units: bool = False,
@@ -35,7 +35,6 @@ class ClusterMgr:
         self.data_dir = data_dir
         self.allow_colocated_units = allow_colocated_units
         self._lock = threading.RLock()
-        self._propose_lock = threading.Lock()  # serializes decide+commit
         self.disks: dict[int, DiskInfo] = {}
         self.volumes: dict[int, VolumeInfo] = {}
         self.services: dict[str, list[str]] = {}
@@ -44,55 +43,7 @@ class ClusterMgr:
         self._next_vid = 1
         self._next_bid = 1
         self._next_chunk = 1
-        self._wal = None
-        self.raft = None
-        self.extra_routes: dict = {}
-        if peers and len(peers) > 1:
-            # replicated mode: the raft wal+snapshot supersede the local
-            # wal; mutations decide on the leader and commit records
-            # through consensus (etcd-raft-backed clustermgr role parity)
-            from ..parallel import raft as raftlib
-
-            if data_dir:
-                os.makedirs(data_dir, exist_ok=True)
-            self.raft = raftlib.RaftNode(
-                "cm", me, peers, self._apply, node_pool,
-                data_dir=os.path.join(data_dir, "raft") if data_dir else None,
-                snapshot_fn=self._state_bytes, restore_fn=self._restore_bytes,
-            )
-            raftlib.register_routes(self.extra_routes, self.raft)
-            self.raft.start()
-        elif data_dir:
-            os.makedirs(data_dir, exist_ok=True)
-            self._load()
-            self._wal = open(os.path.join(data_dir, "wal.jsonl"), "a")
-
-    # ---------------- replication door ----------------
-    def is_leader(self) -> bool:
-        return self.raft is None or self.raft.status()["role"] == "leader"
-
-    def leader_addr(self) -> str | None:
-        return None if self.raft is None else self.raft.status()["leader"]
-
-    def _leader_gate(self) -> None:
-        """Replicated mode serves reads and accepts writes on the leader
-        only (followers apply asynchronously; serving them would return
-        stale volume maps right after a commit)."""
-        if self.raft is not None and not self.is_leader():
-            raise rpc.RpcError(self.REDIRECT,
-                               f"leader={self.leader_addr() or ''}")
-
-    def _commit(self, record: dict):
-        if self.raft is None:
-            out = self._apply(dict(record))
-            self._log(**record)
-            return out
-        from ..parallel.raft import NotLeaderError
-
-        try:
-            return self.raft.propose(record)
-        except NotLeaderError as e:
-            raise rpc.RpcError(self.REDIRECT, f"leader={e.leader or ''}") from None
+        self._init_fsm("cm", data_dir, me, peers, node_pool)
 
     def _state_dict(self) -> dict:
         """Single source of truth for the FSM's serialized shape — used
@@ -125,41 +76,6 @@ class ClusterMgr:
     def _restore_bytes(self, data: bytes) -> None:
         with self._lock:
             self._load_state_dict(json.loads(data))
-
-    # ---------------- persistence (FSM apply stream) ----------------
-    def _log(self, op: str, **kw) -> None:
-        if self._wal is not None:
-            self._wal.write(json.dumps({"op": op, **kw}) + "\n")
-            self._wal.flush()
-
-    def snapshot(self) -> None:
-        if not self.data_dir:
-            return
-        with self._lock:
-            state = self._state_dict()
-            tmp = os.path.join(self.data_dir, "snapshot.json.tmp")
-            with open(tmp, "w") as f:
-                json.dump(state, f)
-            os.replace(tmp, os.path.join(self.data_dir, "snapshot.json"))
-            if self._wal is not None:
-                self._wal.close()
-            open(os.path.join(self.data_dir, "wal.jsonl"), "w").close()
-            self._wal = open(os.path.join(self.data_dir, "wal.jsonl"), "a")
-
-    def _load(self) -> None:
-        snap = os.path.join(self.data_dir, "snapshot.json")
-        if os.path.exists(snap):
-            self._load_state_dict(json.load(open(snap)))
-        wal = os.path.join(self.data_dir, "wal.jsonl")
-        if os.path.exists(wal):
-            for line in open(wal):
-                line = line.strip()
-                if line:
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        break  # torn tail
-                    self._apply(rec)
 
     def _apply(self, rec: dict):
         rec = dict(rec)

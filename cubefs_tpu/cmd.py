@@ -65,8 +65,10 @@ def run_role(cfg: dict):
         from .fs.master import Master
 
         svc = Master(pool, replicas=int(cfg.get("replicas", 3)),
-                     allow_single_node=bool(cfg.get("allow_single_node", False)))
-        return _serve(rpc.expose(svc), cfg), svc
+                     allow_single_node=bool(cfg.get("allow_single_node", False)),
+                     data_dir=cfg.get("data_dir"),
+                     me=cfg.get("me"), peers=cfg.get("peers"))
+        return _serve(svc, cfg), svc
 
     if role == "metanode":
         from .fs.metanode import MetaNode

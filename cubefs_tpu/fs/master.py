@@ -9,10 +9,13 @@ machinery, cluster.go:2525). Placement is least-loaded over live nodes.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 
 from ..utils import rpc
+from ..utils.fsm import ReplicatedFsm
 
 INO_RANGE = 1 << 24  # inodes per meta partition
 
@@ -21,19 +24,59 @@ class MasterError(Exception):
     pass
 
 
-class Master:
+class Master(ReplicatedFsm):
     HEARTBEAT_TIMEOUT = 10.0
 
-    def __init__(self, node_pool, replicas: int = 3, allow_single_node: bool = False):
+    def __init__(self, node_pool, replicas: int = 3, allow_single_node: bool = False,
+                 data_dir: str | None = None, me: str | None = None,
+                 peers: list[str] | None = None):
         self.nodes = node_pool
         self.replicas = replicas
         self.allow_single_node = allow_single_node
         self._lock = threading.RLock()
-        self.datanodes: dict[str, dict] = {}  # addr -> info
+        self.datanodes: dict[str, dict] = {}  # addr -> info (heartbeat-local)
         self.metanodes: dict[str, dict] = {}
         self.volumes: dict[str, dict] = {}
         self._next_pid = 1
         self._next_dp = 1
+        self.data_dir = data_dir
+        self._init_fsm("master", data_dir, me, peers, node_pool)
+
+    def _state_dict(self) -> dict:
+        return {"volumes": self.volumes,
+                "next": [self._next_pid, self._next_dp]}
+
+    def _load_state_dict(self, state: dict) -> None:
+        self.volumes = state["volumes"]
+        self._next_pid, self._next_dp = state["next"]
+
+    def _state_bytes(self) -> bytes:
+        with self._lock:
+            return json.dumps(self._state_dict()).encode()
+
+    def _restore_bytes(self, data: bytes) -> None:
+        with self._lock:
+            self._load_state_dict(json.loads(data))
+
+    def _apply(self, rec: dict):
+        rec = dict(rec)
+        op = rec.pop("op")
+        with self._lock:
+            return getattr(self, f"_apply_{op}")(**rec)
+
+    def _apply_put_volume(self, name: str, vol: dict) -> None:
+        self.volumes[name] = vol
+        self._next_pid = max([self._next_pid]
+                             + [m["pid"] + 1 for m in vol["mps"]])
+        self._next_dp = max([self._next_dp]
+                            + [d["dp_id"] + 1 for d in vol["dps"]])
+
+    def _apply_update_dp(self, name: str, dp_id: int, replicas: list[str],
+                         leader: str) -> None:
+        for dp in self.volumes[name]["dps"]:
+            if dp["dp_id"] == dp_id:
+                dp["replicas"] = replicas
+                dp["leader"] = leader
 
     # ---------------- registries ----------------
     def register_datanode(self, addr: str) -> None:
@@ -57,6 +100,13 @@ class Master:
 
     # ---------------- volume lifecycle ----------------
     def create_volume(self, name: str, mp_count: int = 3, dp_count: int = 4) -> dict:
+        # _propose_lock makes the duplicate-name check atomic with the
+        # commit: without it two concurrent creates both pass the check
+        # and the second silently clobbers the first's partition tables
+        with self._propose_lock:
+            return self._create_volume_locked(name, mp_count, dp_count)
+
+    def _create_volume_locked(self, name: str, mp_count: int, dp_count: int) -> dict:
         with self._lock:
             if name in self.volumes:
                 raise MasterError(f"volume {name!r} exists")
@@ -91,8 +141,9 @@ class Master:
             for i in range(dp_count):
                 dps.append(self._create_dp(live_data, intra_load))
             vol = {"name": name, "mps": mps, "dps": dps, "status": "active"}
-            self.volumes[name] = vol
-            return self.client_view(name)
+        # commit the volume table through the FSM door (wal or raft)
+        self._commit({"op": "put_volume", "name": name, "vol": vol})
+        return self.client_view(name)
 
     def _create_dp(self, live_data: list[str], intra_load: dict | None = None) -> dict:
         dp_id = self._next_dp
@@ -143,7 +194,7 @@ class Master:
         with self._lock:
             live = set(self._live(self.datanodes))
             plans = []
-            for vol in self.volumes.values():
+            for vname, vol in self.volumes.items():
                 for dp in vol["dps"]:
                     dead = [a for a in dp["replicas"] if a not in live]
                     for dead_addr in dead:
@@ -154,17 +205,19 @@ class Master:
                                  )
                         if not healthy or not cands:
                             continue
-                        plans.append((dp, dead_addr, cands[0], healthy[0]))
+                        plans.append((vname, dict(dp), dead_addr, cands[0],
+                                      healthy[0]))
         actions = []
-        for dp, dead_addr, new_addr, src in plans:
+        for vname, dp, dead_addr, new_addr, src in plans:
             try:
-                self._rebuild_replica(dp, dead_addr, new_addr, src)
+                self._rebuild_replica(vname, dp, dead_addr, new_addr, src)
                 actions.append((dp["dp_id"], dead_addr, new_addr))
             except rpc.RpcError:
                 continue  # retried on the next sweep
         return actions
 
-    def _rebuild_replica(self, dp: dict, dead: str, new: str, src: str) -> None:
+    def _rebuild_replica(self, vname: str, dp: dict, dead: str, new: str,
+                         src: str) -> None:
         peers = [new if a == dead else a for a in dp["replicas"]]
         leader = new if dp["leader"] == dead else dp["leader"]
         self.nodes.get(new).call(
@@ -188,9 +241,8 @@ class Master:
                 )
             except rpc.RpcError:
                 pass
-        with self._lock:
-            dp["replicas"] = peers
-            dp["leader"] = leader
+        self._commit({"op": "update_dp", "name": vname, "dp_id": dp["dp_id"],
+                      "replicas": peers, "leader": leader})
 
     # ---------------- RPC surface ----------------
     def rpc_register(self, args, body):
@@ -205,17 +257,21 @@ class Master:
         return {}
 
     def rpc_create_volume(self, args, body):
+        self._leader_gate()
         return {"volume": self.create_volume(
             args["name"], args.get("mp_count", 3), args.get("dp_count", 4)
         )}
 
     def rpc_client_view(self, args, body):
+        self._leader_gate()
         try:
             return {"volume": self.client_view(args["name"])}
         except MasterError as e:
             raise rpc.RpcError(404, str(e)) from None
 
     def rpc_check_replicas(self, args, body):
+        # a deposed leader must not run datanode-mutating rebuilds
+        self._leader_gate()
         return {"actions": self.check_replicas()}
 
     def rpc_stat(self, args, body):

@@ -1,0 +1,137 @@
+"""ReplicatedFsm: the shared persistence/replication door for metadata
+services.
+
+Both the FS master and the blob clustermgr are state machines with the
+same discipline (role parity: the reference backs both with raft +
+RocksDB): every mutation is a record through ONE commit door, persisted
+to a wal (standalone) or committed through raft (replicated), with
+snapshot/restore built from a single serialized-state shape. This mixin
+is that door, audited once and used by both.
+
+Host class contract:
+  * `_state_dict() -> dict` / `_load_state_dict(dict)` — full FSM state
+  * `_apply(record: dict) -> result` — deterministic, takes its own lock
+Provided:
+  * `_init_fsm(group_id, data_dir, me, peers, node_pool)`
+  * `_commit(record)` — wal-append (atomic with apply) or raft-propose;
+    raises RpcError(421, "leader=...") on a follower
+  * `is_leader` / `leader_addr` / `_leader_gate`
+  * `snapshot()` — standalone wal rotation (raft compacts on its own)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from . import rpc
+
+
+class ReplicatedFsm:
+    REDIRECT = 421
+
+    def _init_fsm(self, group_id: str, data_dir: str | None,
+                  me: str | None, peers: list[str] | None, node_pool) -> None:
+        self._fsm_data_dir = data_dir
+        self._wal = None
+        self._wal_lock = threading.Lock()  # apply+wal-append atomicity
+        self._propose_lock = threading.Lock()  # serializes decide+commit
+        self.raft = None
+        self.extra_routes: dict = {}
+        if peers and len(peers) > 1:
+            from ..parallel import raft as raftlib
+
+            if data_dir:
+                os.makedirs(data_dir, exist_ok=True)
+            self.raft = raftlib.RaftNode(
+                group_id, me, peers, self._apply, node_pool,
+                data_dir=os.path.join(data_dir, "raft") if data_dir else None,
+                snapshot_fn=self._state_bytes, restore_fn=self._restore_bytes,
+            )
+            raftlib.register_routes(self.extra_routes, self.raft)
+            self.raft.start()
+        elif data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._fsm_load()
+            self._wal = open(self._wal_path(), "a")
+
+    # ---------------- roles ----------------
+    def is_leader(self) -> bool:
+        return self.raft is None or self.raft.status()["role"] == "leader"
+
+    def leader_addr(self) -> str | None:
+        return None if self.raft is None else self.raft.status()["leader"]
+
+    def _leader_gate(self) -> None:
+        """Replicated mode serves reads and accepts writes on the leader
+        only (followers apply asynchronously — serving them would return
+        stale maps right after a commit)."""
+        if self.raft is not None and not self.is_leader():
+            raise rpc.RpcError(self.REDIRECT,
+                               f"leader={self.leader_addr() or ''}")
+
+    # ---------------- commit door ----------------
+    def _commit(self, record: dict):
+        if self.raft is None:
+            # apply and wal-append must be one atomic step, else
+            # concurrent commits can log in a different order than they
+            # applied and replay to a different state
+            with self._wal_lock:
+                out = self._apply(dict(record))
+                if self._wal is not None:
+                    self._wal.write(json.dumps(record) + "\n")
+                    self._wal.flush()
+            return out
+        from ..parallel.raft import NotLeaderError
+
+        try:
+            return self.raft.propose(record)
+        except NotLeaderError as e:
+            raise rpc.RpcError(self.REDIRECT,
+                               f"leader={e.leader or ''}") from None
+
+    # ---------------- persistence ----------------
+    def _wal_path(self) -> str:
+        return os.path.join(self._fsm_data_dir, "wal.jsonl")
+
+    def _snap_path(self) -> str:
+        return os.path.join(self._fsm_data_dir, "snapshot.json")
+
+    def _state_bytes(self) -> bytes:
+        return json.dumps(self._state_dict()).encode()
+
+    def _restore_bytes(self, data: bytes) -> None:
+        self._load_state_dict(json.loads(data))
+
+    def _fsm_load(self) -> None:
+        if os.path.exists(self._snap_path()):
+            self._load_state_dict(json.load(open(self._snap_path())))
+        if os.path.exists(self._wal_path()):
+            for line in open(self._wal_path()):
+                line = line.strip()
+                if line:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        break  # torn tail
+                    self._apply(rec)
+
+    def snapshot(self) -> None:
+        """Standalone mode: rotate the wal under a snapshot (raft mode
+        compacts through its own snapshot machinery)."""
+        if not self._fsm_data_dir or self.raft is not None:
+            return
+        with self._wal_lock:
+            tmp = self._snap_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._state_dict(), f)
+            os.replace(tmp, self._snap_path())
+            if self._wal is not None:
+                self._wal.close()
+            open(self._wal_path(), "w").close()
+            self._wal = open(self._wal_path(), "a")
+
+    def fsm_stop(self) -> None:
+        if self.raft is not None:
+            self.raft.stop()

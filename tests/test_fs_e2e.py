@@ -332,3 +332,76 @@ def test_concurrent_tiny_writes_no_overlap(cluster, rng):
         list(ex.map(lambda kv: fs.write_file(kv[0], kv[1]), payloads.items()))
     for path, p in payloads.items():
         assert fs.read_file(path) == p, path
+
+
+def test_master_volume_table_persistence(tmp_path, rng):
+    """A restarted master recovers its volume tables from wal+snapshot —
+    no cluster amnesia."""
+    pool = NodePool()
+    m1 = Master(pool, data_dir=str(tmp_path / "master"))
+    pool.bind("master", m1)
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        m1.register_metanode(f"meta{i}")
+    for i in range(3):
+        node = DataNode(i, str(tmp_path / f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", node)
+        m1.register_datanode(f"data{i}")
+    view = m1.create_volume("pv", mp_count=1, dp_count=2)
+    fs = FileSystem(view, pool)
+    payload = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    fs.write_file("/keep.bin", payload)
+    m1.snapshot()
+    m1.create_volume("pv2", mp_count=1, dp_count=1)  # lands in the wal
+    # restart
+    m2 = Master(pool, data_dir=str(tmp_path / "master"))
+    assert set(m2.volumes) == {"pv", "pv2"}
+    view2 = m2.client_view("pv")
+    fs2 = FileSystem(view2, pool)
+    assert fs2.read_file("/keep.bin") == payload
+    for i in range(2):
+        pool.get(f"meta{i}")._target.stop()
+
+
+def test_master_raft_replication(tmp_path):
+    import time
+    pool = NodePool()
+    peers = ["ma", "mb", "mc"]
+    masters = {}
+    for name in peers:
+        m = Master(pool, data_dir=str(tmp_path / name), me=name, peers=peers,
+                   allow_single_node=True, replicas=1)
+        pool.bind(name, m)
+        masters[name] = m
+    mn_node = MetaNode(0, addr="meta0", node_pool=pool)
+    pool.bind("meta0", mn_node)
+    dn = DataNode(0, str(tmp_path / "dn0"), "data0", pool)
+    pool.bind("data0", dn)
+    try:
+        deadline = time.time() + 8
+        leader = None
+        while time.time() < deadline and leader is None:
+            ls = [m for m in masters.values() if m.is_leader()]
+            leader = ls[0] if len(ls) == 1 else None
+            time.sleep(0.05)
+        assert leader is not None
+        leader.register_metanode("meta0")
+        leader.register_datanode("data0")
+        leader.create_volume("rv", mp_count=1, dp_count=1)
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if all("rv" in m.volumes for m in masters.values()):
+                break
+            time.sleep(0.05)
+        for m in masters.values():
+            assert "rv" in m.volumes  # table replicated
+        follower = next(m for m in masters.values() if m is not leader)
+        with pytest.raises(rpc.RpcError) as ei:
+            follower.rpc_client_view({"name": "rv"}, b"")
+        assert ei.value.code == 421
+    finally:
+        for m in masters.values():
+            if m.raft:
+                m.raft.stop()
+        mn_node.stop()
