@@ -207,16 +207,32 @@ def run_role(cfg: dict):
 
 
 def main(argv=None):
+    import signal
+
     ap = argparse.ArgumentParser(prog="cubefs-tpu-server")
     ap.add_argument("-c", "--config", required=True, help="JSON config file")
     args = ap.parse_args(argv)
     cfg = json.load(open(args.config))
-    srv, _ = run_role(cfg)
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        pass
+    srv, svc = run_role(cfg)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    # graceful shutdown: persist/close stores and raft state before exit
+    print(f"[{cfg['role']}] shutting down", flush=True)
+    for closer in ("stop", "fsm_stop", "unmount"):
+        fn = getattr(svc, closer, None)
+        if callable(fn):
+            try:
+                fn()
+            except Exception:
+                pass
+    if hasattr(srv, "stop"):
+        try:
+            srv.stop()
+        except Exception:
+            pass
 
 
 if __name__ == "__main__":

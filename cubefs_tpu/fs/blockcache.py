@@ -8,6 +8,7 @@ to a local directory.
 
 from __future__ import annotations
 
+import concurrent.futures
 import hashlib
 import os
 import threading
@@ -78,17 +79,34 @@ class BlockCache:
 
 class CachingExtentClient:
     """ExtentClient wrapper adding the local block cache on the read
-    path (write path invalidates touched extents)."""
+    path (write path invalidates touched extents) plus sequential
+    read-ahead (the streamer's stream_aheadread role): a cache miss on
+    block k prefetches block k+1 in the background so streaming reads
+    hide the fetch latency."""
 
     BLOCK = 128 << 10
+    READAHEAD = 1  # blocks prefetched past a miss
 
-    def __init__(self, inner, cache: BlockCache | None = None):
+    def __init__(self, inner, cache: BlockCache | None = None,
+                 readahead: bool = True):
         self.inner = inner
         self.cache = cache or BlockCache()
+        self.readahead = readahead
+        self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(2)
+        # block -> in-flight Future so demand reads JOIN a running fetch
+        # instead of re-issuing it; per-inode generation counters make a
+        # racing fetch's put a no-op after a write invalidation
+        self._inflight: dict[str, concurrent.futures.Future] = {}
+        self._gen: dict[int, int] = {}
+        self._pf_lock = threading.Lock()
 
     def write(self, meta, ino: int, file_offset: int, data: bytes) -> None:
         self.inner.write(meta, ino, file_offset, data)
         # conservative invalidation: drop all cached blocks of this inode
+        # and bump its generation so in-flight fetches can't repopulate
+        # the cache with pre-write bytes
+        with self._pf_lock:
+            self._gen[ino] = self._gen.get(ino, 0) + 1
         with self.cache._lock:
             stale = [k for k in self.cache._lru if k.startswith(f"{ino}/")]
             for k in stale:
@@ -119,11 +137,52 @@ class CachingExtentClient:
             key = f"{inode['ino']}/{block}"
             blk = self.cache.get(key)
             if blk is None:
-                blk = self.inner.read(
-                    inode, block * self.BLOCK,
-                    min(self.BLOCK, size - block * self.BLOCK),
-                )
-                self.cache.put(key, blk)
+                with self._pf_lock:
+                    fut = self._inflight.get(key)
+                if fut is not None:
+                    try:  # join the running prefetch instead of re-reading
+                        blk = fut.result()
+                    except Exception:
+                        blk = None
+                if blk is None:
+                    blk = self._fetch_block(inode, block, size)
+                if self.readahead:
+                    self._prefetch(inode, block + 1, size)
             out[pos - offset : pos - offset + take] = blk[in_block : in_block + take]
             pos += take
         return bytes(out)
+
+    def _fetch_block(self, inode: dict, b: int, size: int) -> bytes:
+        ino = inode["ino"]
+        with self._pf_lock:
+            gen = self._gen.get(ino, 0)
+        data = self.inner.read(
+            inode, b * self.BLOCK, min(self.BLOCK, size - b * self.BLOCK)
+        )
+        with self._pf_lock:
+            fresh = self._gen.get(ino, 0) == gen
+        if fresh:  # a write during the fetch means these bytes are stale
+            self.cache.put(f"{ino}/{b}", data)
+        return data
+
+    def _prefetch(self, inode: dict, block: int, size: int) -> None:
+        for b in range(block, block + self.READAHEAD):
+            if b * self.BLOCK >= size:
+                return
+            key = f"{inode['ino']}/{b}"
+            with self._pf_lock:
+                if key in self._inflight or self.cache.get(key) is not None:
+                    continue
+                fut = concurrent.futures.Future()
+                self._inflight[key] = fut
+
+            def fetch(b=b, key=key, fut=fut):
+                try:
+                    fut.set_result(self._fetch_block(inode, b, size))
+                except Exception as e:  # prefetch is best-effort
+                    fut.set_exception(e)
+                finally:
+                    with self._pf_lock:
+                        self._inflight.pop(key, None)
+
+            self._prefetch_pool.submit(fetch)

@@ -31,6 +31,7 @@ FUSE_KERNEL_VERSION = 7
 FUSE_KERNEL_MINOR = 31
 
 (FUSE_LOOKUP, FUSE_FORGET, FUSE_GETATTR, FUSE_SETATTR) = (1, 2, 3, 4)
+FUSE_READLINK, FUSE_SYMLINK = 5, 6
 FUSE_MKDIR, FUSE_UNLINK, FUSE_RMDIR, FUSE_RENAME = 9, 10, 11, 12
 FUSE_OPEN, FUSE_READ, FUSE_WRITE, FUSE_STATFS, FUSE_RELEASE = 14, 15, 16, 17, 18
 FUSE_FSYNC, FUSE_SETXATTR, FUSE_GETXATTR, FUSE_FLUSH = 20, 21, 22, 25
@@ -275,6 +276,24 @@ class FuseMount:
                 fs.meta.inode_delete(inode["ino"])
                 raise
             self._entry_reply(unique, inode)
+
+        elif opcode == FUSE_SYMLINK:
+            name, target = body.split(b"\x00")[:2]
+            inode = fs.meta.inode_create(mn.SYMLINK, 0o777,
+                                         target=target.decode())
+            try:
+                fs.meta.dentry_create(nodeid, name.decode(), inode["ino"])
+            except FsError:
+                fs.meta.inode_delete(inode["ino"])
+                raise
+            self._entry_reply(unique, inode)
+
+        elif opcode == FUSE_READLINK:
+            inode = fs.meta.inode_get(nodeid)
+            if inode["type"] != mn.SYMLINK or not inode.get("target"):
+                self._reply_err(unique, errno.EINVAL)
+            else:
+                self._reply(unique, inode["target"].encode())
 
         elif opcode in (FUSE_UNLINK, FUSE_RMDIR):
             name = body.split(b"\x00", 1)[0].decode()

@@ -208,7 +208,16 @@ class Master(ReplicatedFsm):
                         plans.append((vname, dict(dp), dead_addr, cands[0],
                                       healthy[0]))
         actions = []
-        for vname, dp, dead_addr, new_addr, src in plans:
+        for vname, dp_snapshot, dead_addr, new_addr, src in plans:
+            # re-read the LIVE dp entry: an earlier rebuild in this same
+            # sweep may have repointed it, and working from the planning
+            # snapshot would commit a stale replica list over it
+            with self._lock:
+                dp = next((d for d in self.volumes[vname]["dps"]
+                           if d["dp_id"] == dp_snapshot["dp_id"]), None)
+                if dp is None or dead_addr not in dp["replicas"]:
+                    continue  # already handled
+                dp = dict(dp)
             try:
                 self._rebuild_replica(vname, dp, dead_addr, new_addr, src)
                 actions.append((dp["dp_id"], dead_addr, new_addr))

@@ -96,3 +96,15 @@ def test_errors_via_kernel(mounted):
         os.rmdir(f"{mnt}/full")  # ENOTEMPTY
     os.unlink(f"{mnt}/full/x")
     os.rmdir(f"{mnt}/full")
+
+
+def test_symlink_via_kernel(mounted):
+    c, mnt = mounted
+    with open(f"{mnt}/real.txt", "w") as f:
+        f.write("pointed-at")
+    os.symlink("real.txt", f"{mnt}/link.txt")
+    assert os.readlink(f"{mnt}/link.txt") == "real.txt"
+    assert os.path.islink(f"{mnt}/link.txt")
+    assert open(f"{mnt}/link.txt").read() == "pointed-at"  # kernel follows
+    os.unlink(f"{mnt}/link.txt")
+    assert open(f"{mnt}/real.txt").read() == "pointed-at"

@@ -139,3 +139,23 @@ def test_caching_extent_client(fscluster, rng):
     # write invalidates
     fs.write_file("/c.bin", b"new-bytes")
     assert fs.read_file("/c.bin") == b"new-bytes"
+
+
+def test_readahead_prefetches_next_block(fscluster, rng):
+    import time as _t
+    fs, _, _ = fscluster
+    payload = rng.integers(0, 256, 400_000, dtype=np.uint8).tobytes()
+    fs.write_file("/ra.bin", payload)
+    cached = CachingExtentClient(fs.data, BlockCache())
+    fs.data = cached
+    # read block 0 only; block 1 should appear in cache via prefetch
+    assert fs.read_file("/ra.bin", offset=0, length=1000) == payload[:1000]
+    ino = fs.resolve("/ra.bin")
+    deadline = _t.time() + 5
+    while _t.time() < deadline and cached.cache.get(f"{ino}/1") is None:
+        _t.sleep(0.05)
+    assert cached.cache.get(f"{ino}/1") is not None
+    m0 = cached.cache.misses
+    assert (fs.read_file("/ra.bin", offset=cached.BLOCK, length=1000)
+            == payload[cached.BLOCK : cached.BLOCK + 1000])
+    assert cached.cache.misses == m0  # served by readahead
