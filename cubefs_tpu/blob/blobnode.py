@@ -117,6 +117,10 @@ class BlobNode:
         shards = self.list_chunk(args["disk_id"], args["chunk_id"])
         return {"shards": [[b, s, c] for b, s, c in shards]}
 
+    def rpc_compact_chunk(self, args, body):
+        reclaimed = self._store(args["disk_id"]).compact(args["chunk_id"])
+        return {"reclaimed": reclaimed}
+
     def rpc_stat(self, args, body):
         return {
             "node_id": self.node_id,

@@ -100,6 +100,14 @@ class ChunkStore:
             raise ChunkStoreError(self._err())
         return n
 
+    def compact(self, chunk_id: int) -> int:
+        """Rewrite live shards into fresh files (reclaims tombstoned and
+        overwritten space); returns bytes reclaimed."""
+        got = self._lib.cs_compact_chunk(self._h, chunk_id)
+        if got < 0:
+            raise ChunkStoreError(self._err())
+        return got
+
     def sync(self, chunk_id: int) -> None:
         if self._lib.cs_sync(self._h, chunk_id) != 0:
             raise ChunkStoreError(self._err())

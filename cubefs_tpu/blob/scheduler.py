@@ -10,6 +10,8 @@ pull leased tasks and do the codec math on the TPU engine.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 import uuid
@@ -42,7 +44,7 @@ class Scheduler:
     LEASE_SECONDS = 30.0
 
     def __init__(self, cm_obj, repair_queue=None, delete_queue=None,
-                 node_pool=None):
+                 node_pool=None, data_dir: str | None = None):
         # cm_obj is the ClusterMgr object (leader-colocated, like the
         # reference scheduler's direct clustermgr client)
         self.cm = cm_obj
@@ -55,6 +57,40 @@ class Scheduler:
         self._done_units: dict[int, set[int]] = {}  # disk -> unit indexes done
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # task-state checkpoint + transition record log (reference:
+        # scheduler checkpoints to clustermgr KV + recordlog audit files)
+        self.data_dir = data_dir
+        self._recordlog = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            tpath = os.path.join(data_dir, "tasks.json")
+            if os.path.exists(tpath):
+                try:
+                    restored = json.load(open(tpath))
+                except json.JSONDecodeError:
+                    restored = {}
+                with self._lock:
+                    for t in restored.values():
+                        if t["state"] == "leased":
+                            t["state"] = "pending"  # lease died with us
+                    self.tasks = restored
+            self._recordlog = open(os.path.join(data_dir, "records.jsonl"), "a")
+
+    def _record(self, task_id: str, event: str, **kw) -> None:
+        if self._recordlog is not None:
+            self._recordlog.write(json.dumps(
+                {"ts": round(time.time(), 3), "task": task_id,
+                 "event": event, **kw}) + "\n")
+            self._recordlog.flush()
+
+    def _checkpoint(self) -> None:
+        if not self.data_dir:
+            return
+        tmp = os.path.join(self.data_dir, "tasks.json.tmp")
+        with self._lock:
+            with open(tmp, "w") as f:
+                json.dump(self.tasks, f)
+        os.replace(tmp, os.path.join(self.data_dir, "tasks.json"))
 
     # ---------------- task generation ----------------
     def collect_broken_disks(self) -> list[int]:
@@ -134,6 +170,9 @@ class Scheduler:
             self.tasks[task["task_id"]] = task
             if created_flag is not None:
                 created_flag.append(True)
+            self._record(task["task_id"], "queued", vid=vid,
+                         unit=unit_index, reason=reason)
+            self._checkpoint()
             return task["task_id"]
 
     def drop_disk(self, disk_id: int) -> int:
@@ -328,6 +367,39 @@ class Scheduler:
                 culprits.append(c)
         return culprits[0] if len(culprits) == 1 else None
 
+    def compact_chunks(self, max_chunks: int = 16) -> dict:
+        """Space-reclaim sweep: compact chunks round-robin with a
+        rotating cursor (core/chunk/compact.go role; own kill switch;
+        called periodically from the background loop and exposed via
+        RPC for operators)."""
+        if not self.switch.enabled("compact"):
+            return {"compacted": 0, "reclaimed": 0}
+        with self._lock:
+            units = []
+            for v in sorted(self.cm.volumes):
+                vol = self.cm.get_volume(v)
+                units.extend(vol.units)
+            if not units:
+                return {"compacted": 0, "reclaimed": 0}
+            start = getattr(self, "_compact_cursor", 0) % len(units)
+            batch = (units[start:] + units[:start])[:max_chunks]
+            self._compact_cursor = (start + len(batch)) % len(units)
+        compacted = reclaimed = 0
+        for u in batch:
+            try:
+                meta, _ = self.nodes.get(u.node_addr).call(
+                    "compact_chunk",
+                    {"disk_id": u.disk_id, "chunk_id": u.chunk_id},
+                )
+                compacted += 1
+                reclaimed += meta["reclaimed"]
+            except rpc.RpcError:
+                continue
+        return {"compacted": compacted, "reclaimed": reclaimed}
+
+    def rpc_compact_chunks(self, args, body):
+        return self.compact_chunks(int(args.get("max_chunks", 16)))
+
     # ---------------- task leasing (worker API) ----------------
     def acquire_task(self, worker_id: str) -> dict | None:
         now = time.time()
@@ -340,6 +412,8 @@ class Scheduler:
                     t["worker"] = worker_id
                     t["attempts"] += 1
                     t["lease_until"] = now + self.LEASE_SECONDS
+                    self._record(t["task_id"], "leased", worker=worker_id,
+                                 attempt=t["attempts"])
                     return dict(t)
             return None
 
@@ -357,6 +431,9 @@ class Scheduler:
             if not t or t["worker"] != worker_id or t["state"] != "leased":
                 return  # stale completion; writeback already idempotent
             t["state"] = "done"
+            self._record(task_id, "done", worker=worker_id)
+            # checkpoint AFTER the cm writeback: a crash in between must
+            # re-run the (idempotent) repair, never lose it
             self.cm.update_volume_unit(
                 t["vid"], t["unit_index"], t["dest_disk"], t["dest_chunk"],
                 t["dest_addr"],
@@ -369,6 +446,7 @@ class Scheduler:
                 )
                 if not pending:
                     self.cm.set_disk_status(src, DiskStatus.REPAIRED)
+            self._checkpoint()
 
     def fail_task(self, task_id: str, worker_id: str, error: str) -> None:
         with self._lock:
@@ -376,6 +454,8 @@ class Scheduler:
             if t and t["worker"] == worker_id:
                 t["state"] = "pending"
                 t["last_error"] = error
+                self._record(task_id, "failed", worker=worker_id, error=error[:120])
+                self._checkpoint()
 
     def stats(self) -> dict:
         with self._lock:
@@ -397,6 +477,9 @@ class Scheduler:
                     self.collect_broken_disks()
                     self.consume_repair_msgs()
                     self.consume_delete_msgs()
+                    self._ticks = getattr(self, "_ticks", 0) + 1
+                    if self._ticks % 60 == 0:  # periodic space reclaim
+                        self.compact_chunks()
                 except Exception:
                     pass  # leader loop must survive transient errors
 

@@ -198,6 +198,7 @@ def run_role(cfg: dict):
             repair_queue=MessageQueue(q_dir, "repair") if q_dir else None,
             delete_queue=MessageQueue(q_dir, "delete") if q_dir else None,
             node_pool=pool,
+            data_dir=cfg.get("task_dir"),
         )
         svc.start()
         routes = {**rpc.expose(svc), **{f"cm_{k}": v for k, v in rpc.expose(cm).items()}}

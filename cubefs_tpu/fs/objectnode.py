@@ -170,18 +170,41 @@ class ObjectNode:
                 if key and self._key_reserved(key):
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")
-                if not key:  # ListObjectsV2
+                if not key:  # ListObjectsV2 (+ delimiter and pagination)
                     prefix = query.get("prefix", [""])[0]
-                    keys = outer._list_objects(fs, prefix)
+                    delimiter = query.get("delimiter", [""])[0]
+                    try:
+                        max_keys = int(query.get("max-keys", ["1000"])[0])
+                    except ValueError:
+                        return self._error(400, "InvalidArgument",
+                                           "max-keys must be an integer")
+                    if max_keys < 1:
+                        return self._error(400, "InvalidArgument",
+                                           "max-keys must be positive")
+                    token = query.get("continuation-token", [""])[0]
+                    keys, prefixes, next_token, truncated = outer._list_v2(
+                        fs, prefix, delimiter, max_keys, token
+                    )
                     items = "".join(
                         f"<Contents><Key>{xs.escape(k)}</Key>"
                         f"<Size>{sz}</Size></Contents>"
                         for k, sz in keys
                     )
+                    cps = "".join(
+                        f"<CommonPrefixes><Prefix>{xs.escape(p)}</Prefix>"
+                        f"</CommonPrefixes>"
+                        for p in prefixes
+                    )
+                    nt = (f"<NextContinuationToken>{xs.escape(next_token)}"
+                          f"</NextContinuationToken>") if next_token else ""
                     body = (
                         f"<?xml version='1.0'?><ListBucketResult>"
                         f"<Name>{bucket}</Name><Prefix>{xs.escape(prefix)}</Prefix>"
-                        f"<KeyCount>{len(keys)}</KeyCount>{items}"
+                        f"<Delimiter>{xs.escape(delimiter)}</Delimiter>"
+                        f"<MaxKeys>{max_keys}</MaxKeys>"
+                        f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+                        f"<KeyCount>{len(keys) + len(prefixes)}</KeyCount>"
+                        f"{items}{cps}{nt}"
                         f"</ListBucketResult>"
                     ).encode()
                     return self._reply(200, body)
@@ -311,7 +334,45 @@ class ObjectNode:
                     out.append((k, inode["size"]))
 
         walk("", "")
-        return out
+        return sorted(out)
+
+    def _list_v2(self, fs: FileSystem, prefix: str, delimiter: str,
+                 max_keys: int, token: str):
+        """ListObjectsV2 semantics: delimiter groups keys into
+        CommonPrefixes (one entry per group, the whole group consumed in
+        the same page); the continuation token is the last RAW key the
+        page consumed, so pagination resumes after a full group and is
+        stable under concurrent writes."""
+        all_keys = sorted(self._list_objects(fs, prefix))  # global order
+        if token:
+            all_keys = [(k, sz) for k, sz in all_keys if k > token]
+        keys: list = []
+        prefixes: list = []
+        last_raw = ""
+        truncated = False
+        i = 0
+        while i < len(all_keys):
+            if len(keys) + len(prefixes) >= max_keys:
+                truncated = True
+                break
+            k, sz = all_keys[i]
+            if delimiter:
+                rest = k[len(prefix):]
+                d = rest.find(delimiter)
+                if d >= 0:
+                    cp = prefix + rest[: d + len(delimiter)]
+                    prefixes.append(cp)
+                    # consume the WHOLE group now so a truncation after
+                    # this entry never re-yields the same CommonPrefix
+                    while i < len(all_keys) and all_keys[i][0].startswith(cp):
+                        last_raw = all_keys[i][0]
+                        i += 1
+                    continue
+            keys.append((k, sz))
+            last_raw = k
+            i += 1
+        next_token = last_raw if truncated else ""
+        return keys, prefixes, next_token, truncated
 
     def _prune_empty_dirs(self, fs: FileSystem, key: str) -> None:
         parts = [p for p in key.split("/") if p][:-1]

@@ -72,6 +72,8 @@ def load() -> ctypes.CDLL:
             lib.cs_sync.argtypes = [c.c_void_p, c.c_uint64]
             lib.cs_crc32.restype = c.c_uint32
             lib.cs_crc32.argtypes = [c.c_char_p, c.c_uint64]
+            lib.cs_compact_chunk.restype = c.c_int64
+            lib.cs_compact_chunk.argtypes = [c.c_void_p, c.c_uint64]
             # extent store (datanode engine)
             lib.es_open.restype = c.c_void_p
             lib.es_open.argtypes = [c.c_char_p]

@@ -62,7 +62,17 @@ uint32_t crc32_ieee(uint32_t crc, const uint8_t* p, size_t n) {
   return ~crc;
 }
 
-// ---------------- index records ----------------
+// ---------------- index format ----------------
+// v2 idx files start with a header carrying the DATA FILE GENERATION:
+// compaction writes a new generation data file and commits it with ONE
+// atomic idx rename — there is never a moment where a live idx points at
+// half-swapped data. Legacy headerless files read as generation 0.
+struct __attribute__((packed)) IdxHdr {
+  uint64_t magic;  // kIdxMagic
+  uint64_t gen;
+};
+constexpr uint64_t kIdxMagic = 0xCFC17A6Eull;
+
 struct __attribute__((packed)) IdxRec {
   uint64_t bid;      // blob id
   uint64_t offset;   // offset in .data file
@@ -82,6 +92,7 @@ struct Chunk {
   int data_fd = -1;
   int idx_fd = -1;
   uint64_t data_end = 0;
+  uint64_t gen = 0;  // data file generation (committed via the idx)
   std::map<uint64_t, ShardLoc> shards;  // ordered for list-scans
   std::mutex mu;
 };
@@ -106,12 +117,34 @@ std::string chunk_path(Store* s, uint64_t id, const char* ext) {
   return s->dir + buf;
 }
 
+std::string data_path(Store* s, uint64_t id, uint64_t gen) {
+  if (gen == 0) return chunk_path(s, id, "data");  // legacy name
+  char buf[80];
+  snprintf(buf, sizeof buf, "/chunk_%016llx.g%llu.data",
+           (unsigned long long)id, (unsigned long long)gen);
+  return s->dir + buf;
+}
+
 bool load_chunk(Store* s, uint64_t id, Chunk* c) {
-  std::string dp = chunk_path(s, id, "data"), ip = chunk_path(s, id, "idx");
-  c->data_fd = ::open(dp.c_str(), O_RDWR | O_CREAT, 0644);
+  std::string ip = chunk_path(s, id, "idx");
   c->idx_fd = ::open(ip.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
-  if (c->data_fd < 0 || c->idx_fd < 0) {
-    set_err(s, "open chunk files");
+  if (c->idx_fd < 0) {
+    set_err(s, "open idx file");
+    return false;
+  }
+  // the idx header names the data generation (single commit point)
+  IdxHdr hdr;
+  off_t pos = 0;
+  c->gen = 0;
+  if (pread(c->idx_fd, &hdr, sizeof hdr, 0) == (ssize_t)sizeof hdr &&
+      hdr.magic == kIdxMagic) {
+    c->gen = hdr.gen;
+    pos = sizeof hdr;
+  }
+  std::string dp = data_path(s, id, c->gen);
+  c->data_fd = ::open(dp.c_str(), O_RDWR | O_CREAT, 0644);
+  if (c->data_fd < 0) {
+    set_err(s, "open data file");
     return false;
   }
   struct stat st;
@@ -119,7 +152,6 @@ bool load_chunk(Store* s, uint64_t id, Chunk* c) {
   c->data_end = (uint64_t)st.st_size;
   // replay index log; torn/corrupt tail records are ignored (crash safety)
   IdxRec r;
-  off_t pos = 0;
   while (pread(c->idx_fd, &r, sizeof r, pos) == (ssize_t)sizeof r) {
     uint32_t expect = crc32_ieee(0, (const uint8_t*)&r, sizeof r - 4);
     if (r.rec_crc != expect) break;
@@ -129,6 +161,9 @@ bool load_chunk(Store* s, uint64_t id, Chunk* c) {
       c->shards[r.bid] = ShardLoc{r.offset, r.size, r.crc};
     pos += sizeof r;
   }
+  // a crash between data write and idx commit can leave a stray
+  // next-generation data file: remove it (its idx never committed)
+  unlink(data_path(s, id, c->gen + 1).c_str());
   return true;
 }
 
@@ -147,9 +182,10 @@ Chunk* get_chunk(Store* s, uint64_t id, bool create) {
   auto it = s->chunks.find(id);
   if (it != s->chunks.end()) return it->second;
   if (!create) {
-    // lazily open if files exist on disk
-    std::string dp = chunk_path(s, id, "data");
-    if (access(dp.c_str(), F_OK) != 0) {
+    // lazily open if the chunk exists on disk; the idx is the one file
+    // every generation keeps (the data filename changes on compaction)
+    std::string ip = chunk_path(s, id, "idx");
+    if (access(ip.c_str(), F_OK) != 0) {
       set_err(s, "no such chunk");
       return nullptr;
     }
@@ -304,6 +340,73 @@ int cs_sync(void* h, uint64_t chunk_id) {
     return -1;
   }
   return 0;
+}
+
+// Compaction: rewrite only the LIVE shards into fresh data+idx files and
+// atomically swap them in (role parity: blobnode chunk compaction,
+// core/chunk/compact.go) — append-only writes + tombstones otherwise
+// grow files forever. Returns bytes reclaimed, or -1.
+int64_t cs_compact_chunk(void* h, uint64_t chunk_id) {
+  Store* s = (Store*)h;
+  Chunk* c = get_chunk(s, chunk_id, false);
+  if (!c) return -1;
+  std::lock_guard<std::mutex> g(c->mu);
+  uint64_t new_gen = c->gen + 1;
+  std::string ip = chunk_path(s, chunk_id, "idx");
+  std::string ndp = data_path(s, chunk_id, new_gen);
+  std::string itmp = ip + ".compact";
+  int dfd = ::open(ndp.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  int ifd = ::open(itmp.c_str(), O_RDWR | O_CREAT | O_TRUNC | O_APPEND, 0644);
+  auto fail = [&](const char* msg, int64_t code) {
+    set_err(s, msg);
+    if (dfd >= 0) close(dfd);
+    if (ifd >= 0) close(ifd);
+    unlink(ndp.c_str());
+    unlink(itmp.c_str());
+    return code;
+  };
+  if (dfd < 0 || ifd < 0) return fail("open compact files", -1);
+  IdxHdr hdr{kIdxMagic, new_gen};
+  if (write(ifd, &hdr, sizeof hdr) != (ssize_t)sizeof hdr)
+    return fail("compact hdr write", -1);
+  uint64_t new_end = 0;
+  std::map<uint64_t, ShardLoc> new_shards;
+  std::vector<uint8_t> buf;
+  for (auto& kv : c->shards) {
+    const ShardLoc& loc = kv.second;
+    buf.resize(loc.size);
+    if (pread(c->data_fd, buf.data(), loc.size, (off_t)loc.offset) !=
+        (ssize_t)loc.size)
+      return fail("compact pread", -1);
+    if (crc32_ieee(0, buf.data(), loc.size) != loc.crc)
+      return fail("compact crc mismatch (refusing to carry corruption)", -2);
+    if (pwrite(dfd, buf.data(), loc.size, (off_t)new_end) != (ssize_t)loc.size)
+      return fail("compact pwrite", -1);
+    IdxRec rec{kv.first, new_end, loc.size, loc.crc, 0, 0};
+    rec.rec_crc = crc32_ieee(0, (const uint8_t*)&rec, sizeof rec - 4);
+    if (write(ifd, &rec, sizeof rec) != (ssize_t)sizeof rec)
+      return fail("compact idx write", -1);
+    new_shards[kv.first] = ShardLoc{new_end, loc.size, loc.crc};
+    new_end += loc.size;
+  }
+  fsync(dfd);
+  fsync(ifd);
+  int64_t reclaimed = (int64_t)c->data_end - (int64_t)new_end;
+  std::string old_dp = data_path(s, chunk_id, c->gen);
+  // SINGLE commit point: the idx rename flips both idx records and (via
+  // the header) the data generation; a crash before it leaves the old
+  // pair fully intact, a crash after it leaves the new pair in effect
+  if (rename(itmp.c_str(), ip.c_str()) != 0)
+    return fail("compact commit rename", -1);
+  close(c->data_fd);
+  close(c->idx_fd);
+  c->data_fd = dfd;
+  c->idx_fd = ifd;
+  c->data_end = new_end;
+  c->gen = new_gen;
+  c->shards = std::move(new_shards);
+  unlink(old_dp.c_str());  // best-effort; stray cleaned at next open too
+  return reclaimed;
 }
 
 // CPU CRC baseline entry point (benchmarked against the TPU kernel).

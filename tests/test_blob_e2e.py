@@ -370,3 +370,41 @@ def test_clustermgr_raft_replication(tmp_path):
         for c in cms.values():
             if c.raft:
                 c.raft.stop()
+
+
+def test_scheduler_task_persistence_and_recordlog(tmp_path, rng):
+    """Scheduler restart resumes pending tasks from its checkpoint; the
+    record log captures the task lifecycle."""
+    import json as _json
+    sdir = str(tmp_path / "sched")
+    c = Cluster(tmp_path)
+    sched1 = Scheduler(c.cm, repair_queue=c.repair_q, delete_queue=c.delete_q,
+                       node_pool=c.pool, data_dir=sdir)
+    data = payload(rng, 30_000)
+    loc = c.access.put(data, codemode=cmode.CodeMode.EC6P3)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    victim = vol.units[1]
+    c.node_of(victim.node_addr).break_disk(victim.disk_id)
+    assert sched1.mark_disk_broken(victim.disk_id) >= 1
+    # "crash" before any worker ran; a new scheduler restores the task
+    sched2 = Scheduler(c.cm, repair_queue=c.repair_q, delete_queue=c.delete_q,
+                       node_pool=c.pool, data_dir=sdir)
+    assert any(t["state"] == "pending" for t in sched2.tasks.values())
+    worker = RepairWorker(rpc.Client(sched2), c.cm_client, c.pool)
+    for _ in range(50):
+        if not worker.run_once():
+            break
+    assert c.access.get(loc) == data
+    events = [_json.loads(l)["event"]
+              for l in open(f"{sdir}/records.jsonl") if l.strip()]
+    assert {"queued", "leased", "done"} <= set(events)
+
+
+def test_compaction_sweep_reclaims(cluster, rng):
+    data = payload(rng, 60_000)
+    loc = cluster.access.put(data, codemode=cmode.CodeMode.EC6P3)
+    cluster.access.put(payload(rng, 30_000), codemode=cmode.CodeMode.EC6P3)
+    # delete the first blob's shards -> dead space in chunks
+    cluster.access._delete_now(loc)
+    rep = cluster.sched.compact_chunks()
+    assert rep["compacted"] > 0 and rep["reclaimed"] > 0

@@ -267,3 +267,61 @@ def test_fuse_chmod_and_rename_clobber(tmp_path, rng):
     finally:
         m.unmount()
         c.stop()
+
+
+def test_s3_list_v2_delimiter_and_pagination(fscluster):
+    s3 = ObjectNode({"lv": fscluster}).start()
+    try:
+        base = f"http://{s3.addr}/lv"
+        for k in ["a/1.txt", "a/2.txt", "b/deep/3.txt", "top1.txt", "top2.txt"]:
+            _req("PUT", f"{base}/{k}", b"x")
+        # delimiter groups 'directories' into CommonPrefixes
+        code, body, _ = _req("GET", f"{base}?delimiter=/")
+        assert code == 200
+        assert b"<Prefix>a/</Prefix>" in body and b"<Prefix>b/</Prefix>" in body
+        assert b"top1.txt" in body and b"a/1.txt" not in body
+        # pagination with max-keys + continuation-token walks everything
+        seen = []
+        token = ""
+        for _ in range(10):
+            q = f"?max-keys=2" + (f"&continuation-token={token}" if token else "")
+            code, body, _ = _req("GET", f"{base}{q}")
+            import re
+            seen += re.findall(rb"<Key>([^<]+)</Key>", body)
+            m = re.search(rb"<NextContinuationToken>([^<]+)<", body)
+            if not m:
+                break
+            token = m.group(1).decode()
+        assert sorted(seen) == [b"a/1.txt", b"a/2.txt", b"b/deep/3.txt",
+                                b"top1.txt", b"top2.txt"]
+    finally:
+        s3.stop()
+
+
+def test_s3_list_v2_prefix_group_pagination(fscluster):
+    """A CommonPrefix group is consumed whole in its page — tokens never
+    loop on a prefix and never skip DFS-misordered keys."""
+    s3 = ObjectNode({"pg": fscluster}).start()
+    try:
+        base = f"http://{s3.addr}/pg"
+        for k in ["a/1.txt", "a/2.txt", "b/x.txt", "c.txt"]:
+            _req("PUT", f"{base}/{k}", b"x")
+        import re
+        entries, token = [], ""
+        for _ in range(8):
+            q = "delimiter=/&max-keys=1" + (f"&continuation-token={token}" if token else "")
+            code, body, _ = _req("GET", f"{base}?{q}")
+            assert code == 200
+            entries += re.findall(rb"<(?:Key|Prefix)>([^<]+)</", body)
+            m = re.search(rb"<NextContinuationToken>([^<]+)<", body)
+            if not m:
+                break
+            token = m.group(1).decode()
+        # root Prefix element of the response also matches; filter empties
+        got = sorted(set(e for e in entries if e))
+        assert got == [b"a/", b"b/", b"c.txt"]
+        # bad max-keys is a clean 400
+        code, body, _ = _req("GET", f"{base}?max-keys=abc")
+        assert code == 400 and b"InvalidArgument" in body
+    finally:
+        s3.stop()
