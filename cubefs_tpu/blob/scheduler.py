@@ -448,13 +448,23 @@ class Scheduler:
                     self.cm.set_disk_status(src, DiskStatus.REPAIRED)
             self._checkpoint()
 
+    MAX_ATTEMPTS = 5
+
     def fail_task(self, task_id: str, worker_id: str, error: str) -> None:
         with self._lock:
             t = self.tasks.get(task_id)
             if t and t["worker"] == worker_id:
-                t["state"] = "pending"
+                # deterministic failures (e.g. the worker's crc-conflict
+                # refusal) must not hot-loop forever: after MAX_ATTEMPTS
+                # the task parks for operator attention
+                if t["attempts"] >= self.MAX_ATTEMPTS:
+                    t["state"] = "parked"
+                else:
+                    t["state"] = "pending"
                 t["last_error"] = error
-                self._record(task_id, "failed", worker=worker_id, error=error[:120])
+                self._record(task_id, "failed" if t["state"] == "pending"
+                             else "parked",
+                             worker=worker_id, error=error[:120])
                 self._checkpoint()
 
     def stats(self) -> dict:

@@ -75,6 +75,28 @@ class RepairWorker:
 
     # ---------------- execution ----------------
     def execute(self, task: dict) -> None:
+        # renew the lease on a timer for the whole execution: survivor
+        # downloads for a large chunk can exceed one lease period long
+        # before the first batch writes back
+        renew_stop = threading.Event()
+
+        def renew_loop():
+            while not renew_stop.wait(10.0):
+                try:
+                    self.sched.call("renew_task",
+                                    {"task_id": task["task_id"],
+                                     "worker_id": self.worker_id})
+                except Exception:
+                    pass
+
+        renewer = threading.Thread(target=renew_loop, daemon=True)
+        renewer.start()
+        try:
+            self._execute(task)
+        finally:
+            renew_stop.set()
+
+    def _execute(self, task: dict) -> None:
         vol = VolumeInfo.from_dict(
             self.cm.call("get_volume", {"vid": task["vid"]})[0]["volume"]
         )
@@ -103,33 +125,51 @@ class RepairWorker:
             code_pos = {u: u for u in read_set}
             bad_sub = bad
 
-        # per-bid survivor reads; the ACTUALLY-read survivor set selects
-        # the decode matrix, so per-shard read failures mid-task are fine
+        # per-bid survivor reads (one EXTRA when available: the extra is
+        # reconstructed from the first n and compared, the pre-writeback
+        # consistency check — a corrupted download must not become the
+        # new truth). The ACTUALLY-read survivor set selects the decode
+        # matrix, so per-shard read failures mid-task are fine.
+        want = min(n_solve + 1, len(read_set))
         by_key: dict[tuple, list] = defaultdict(list)
         for bid in bids:
-            subs, shards = self._read_survivors(vol, read_set, code_pos, bid, n_solve)
+            subs, shards = self._read_survivors(vol, read_set, code_pos, bid,
+                                                need=n_solve, want=want)
             by_key[(len(shards[0]), tuple(subs))].append((bid, shards))
 
         for (size, subs), group in by_key.items():
+            solve_subs = list(subs[:n_solve])
+            wanted_out = [bad_sub]
+            if len(subs) > n_solve:  # reconstruct bad + the extra survivor
+                wanted_out = sorted({bad_sub, subs[n_solve]})
+                verify_pos = wanted_out.index(subs[n_solve])
             rows = rs_kernel.reconstruct_rows(
-                n_solve, total_code, list(subs), [bad_sub]
+                n_solve, total_code, solve_subs, wanted_out
             )
+            out_pos = wanted_out.index(bad_sub)
             for start in range(0, len(group), self.batch_stripes):
                 chunk = group[start : start + self.batch_stripes]
                 batch = np.stack([
-                    np.stack([np.frombuffer(s, dtype=np.uint8) for s in shards])
+                    np.stack([np.frombuffer(s, dtype=np.uint8)
+                              for s in shards[:n_solve]])
                     for _, shards in chunk
                 ])  # (B, n_solve, size)
-                recovered = self.engine.matrix_apply(rows, batch)  # (B, 1, size)
-                for (bid, _), rec in zip(chunk, recovered):
+                recovered = self.engine.matrix_apply(rows, batch)
+                for (bid, shards), rec in zip(chunk, recovered):
+                    if len(subs) > n_solve:
+                        expect = np.frombuffer(shards[n_solve], dtype=np.uint8)
+                        if not np.array_equal(rec[verify_pos], expect):
+                            raise RuntimeError(
+                                f"bid {bid}: reconstruction disagrees with "
+                                f"extra survivor {subs[n_solve]} — refusing "
+                                f"writeback (crc-conflict role)"
+                            )
                     dest.call(
                         "put_shard",
                         {"disk_id": task["dest_disk"],
                          "chunk_id": task["dest_chunk"], "bid": bid},
-                        rec[0].tobytes(),
+                        rec[out_pos].tobytes(),
                     )
-                self.sched.call("renew_task", {"task_id": task["task_id"],
-                                               "worker_id": self.worker_id})
 
     def _list_bids(self, vol: VolumeInfo, exclude: int) -> list[int]:
         for u in vol.units:
@@ -146,14 +186,16 @@ class RepairWorker:
 
     def _read_survivors(
         self, vol: VolumeInfo, read_set: list[int], code_pos: dict[int, int],
-        bid: int, n_solve: int,
+        bid: int, need: int, want: int | None = None,
     ) -> tuple[list[int], list[bytes]]:
-        """Read n_solve survivors for bid; returns (code-space indices of
-        the shards actually read, shard payloads), ascending."""
+        """Read up to `want` survivors for bid (at least `need`, which is
+        fatal to miss; the extras enable pre-writeback verification).
+        Returns (code-space indices actually read, payloads), ascending."""
+        want = want or need
         subs: list[int] = []
         shards: list[bytes] = []
         for idx in read_set:
-            if len(shards) == n_solve:
+            if len(shards) == want:
                 break
             u = vol.units[idx]
             try:
@@ -165,7 +207,7 @@ class RepairWorker:
                 continue
             subs.append(code_pos[idx])
             shards.append(payload)
-        if len(shards) < n_solve:
-            raise RuntimeError(f"bid {bid}: only {len(shards)}/{n_solve} survivors")
+        if len(shards) < need:
+            raise RuntimeError(f"bid {bid}: only {len(shards)}/{need} survivors")
         order = np.argsort(subs)
         return [subs[i] for i in order], [shards[i] for i in order]

@@ -208,7 +208,40 @@ class ObjectNode:
                         f"</ListBucketResult>"
                     ).encode()
                     return self._reply(200, body)
+                rng_hdr = self.headers.get("Range", "")
+                span = None
+                if rng_hdr.startswith("bytes=") and "," not in rng_hdr:
+                    try:
+                        lo_s, _, hi_s = rng_hdr[6:].partition("-")
+                        span = ((int(lo_s) if lo_s else None),
+                                (int(hi_s) if hi_s else None))
+                        if span == (None, None):
+                            span = None
+                    except ValueError:
+                        # RFC 9110 / S3: an unparseable Range header is
+                        # IGNORED (full 200 body), never an error
+                        span = None
                 try:
+                    if span is not None:
+                        st = fs.stat("/" + key)
+                        size = st["size"]
+                        lo, hi = span
+                        if lo is None:  # suffix range: last N bytes
+                            lo, hi = max(0, size - hi), size - 1
+                        else:
+                            hi = size - 1 if hi is None else min(hi, size - 1)
+                        if lo > hi or lo >= size:
+                            return self._reply(
+                                416,
+                                b"<?xml version='1.0'?><Error>"
+                                b"<Code>InvalidRange</Code></Error>",
+                                headers={"Content-Range": f"bytes */{size}"})
+                        data = fs.read_file("/" + key, offset=lo,
+                                            length=hi - lo + 1)
+                        return self._reply(
+                            206, data, ctype="application/octet-stream",
+                            headers={"Content-Range":
+                                     f"bytes {lo}-{hi}/{size}"})
                     data = fs.read_file("/" + key)
                 except FsError:
                     return self._error(404, "NoSuchKey", key)

@@ -123,6 +123,16 @@ class RpcServer:
                         args = json.loads(self.headers.get("X-Rpc-Args") or "{}")
                         n = int(self.headers.get("Content-Length") or 0)
                         body = self.rfile.read(n) if n else b""
+                        want_crc = self.headers.get("X-Rpc-Crc")
+                        if want_crc is not None:
+                            import zlib as _z
+
+                            try:
+                                expect = int(want_crc)
+                            except ValueError:
+                                raise RpcError(400, "malformed X-Rpc-Crc") from None
+                            if _z.crc32(body) != expect:
+                                raise RpcError(400, "request body crc mismatch")
                         out = fn(args, body)
                         meta, payload = _normalize(out)
                     self._reply(200, meta, payload)
@@ -180,6 +190,10 @@ def call(
     from . import trace as tracelib
 
     headers = {"X-Rpc-Args": json.dumps(args or {})}
+    if body:  # every hop carries a body CRC (packet-CRC framing parity)
+        import zlib as _z
+
+        headers["X-Rpc-Crc"] = str(_z.crc32(body))
     span = tracelib.current()
     if span is not None:
         headers["X-Trace"] = span.header()

@@ -408,3 +408,45 @@ def test_compaction_sweep_reclaims(cluster, rng):
     cluster.access._delete_now(loc)
     rep = cluster.sched.compact_chunks()
     assert rep["compacted"] > 0 and rep["reclaimed"] > 0
+
+
+def test_worker_refuses_writeback_on_corrupt_survivor(cluster, rng):
+    """A corrupt (CRC-consistent) survivor makes reconstruction disagree
+    with the extra shard: the worker must fail the task, not install
+    garbage as the rebuilt unit."""
+    data = payload(rng, 25_000)
+    loc = cluster.access.put(data, codemode=cmode.CodeMode.EC6P3)
+    vol = cluster.cm.get_volume(loc.slices[0].vid)
+    bid = loc.slices[0].min_bid
+    # corrupt one survivor in place (put_shard recomputes CRC: reads clean)
+    u = vol.units[3]
+    node = cluster.node_of(u.node_addr)
+    good, _ = node.get_shard(u.disk_id, u.chunk_id, bid)
+    node.put_shard(u.disk_id, u.chunk_id, bid, bytes(b ^ 0xFF for b in good))
+    victim = vol.units[0]
+    cluster.node_of(victim.node_addr).break_disk(victim.disk_id)
+    cluster.sched.mark_disk_broken(victim.disk_id)
+    ran = cluster.worker.run_once()
+    assert ran and cluster.worker.failed >= 1  # refused, not silently wrong
+    task = next(iter(cluster.sched.tasks.values()))
+    assert "disagrees" in task.get("last_error", "")
+
+
+def test_repeated_failures_park_the_task(cluster, rng):
+    data = payload(rng, 20_000)
+    loc = cluster.access.put(data, codemode=cmode.CodeMode.EC6P3)
+    vol = cluster.cm.get_volume(loc.slices[0].vid)
+    bid = loc.slices[0].min_bid
+    u = vol.units[3]
+    node = cluster.node_of(u.node_addr)
+    good, _ = node.get_shard(u.disk_id, u.chunk_id, bid)
+    node.put_shard(u.disk_id, u.chunk_id, bid, bytes(b ^ 0xFF for b in good))
+    victim = vol.units[0]
+    cluster.node_of(victim.node_addr).break_disk(victim.disk_id)
+    cluster.sched.mark_disk_broken(victim.disk_id)
+    for _ in range(cluster.sched.MAX_ATTEMPTS + 2):
+        if not cluster.worker.run_once():
+            break
+    task = next(iter(cluster.sched.tasks.values()))
+    assert task["state"] == "parked"  # no infinite hot retry
+    assert cluster.worker.run_once() is False  # nothing left to lease
