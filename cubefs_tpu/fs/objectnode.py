@@ -100,6 +100,10 @@ class ObjectNode:
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")
                 if "uploadId" in query and "partNumber" in query:  # UploadPart
+                    if self.headers.get("x-amz-copy-source"):
+                        # refusing beats silently storing the empty body
+                        return self._error(501, "NotImplemented",
+                                           "UploadPartCopy is not supported")
                     upload_id = query["uploadId"][0]
                     try:
                         part = int(query["partNumber"][0])
@@ -114,11 +118,30 @@ class ObjectNode:
                     except FsError as e:
                         return self._error(404, "NoSuchUpload", str(e))
                     return self._reply(200, headers={"ETag": f'"{etag}"'})
+                src = self.headers.get("x-amz-copy-source", "")
+                is_copy = bool(src)
+                if is_copy:  # CopyObject: data comes from /bucket/key
+                    sb, _, sk = src.lstrip("/").partition("/")
+                    sk = urllib.parse.unquote(sk)
+                    sfs = self._fs(sb)
+                    if sfs is None or not sk:
+                        return self._error(404, "NoSuchBucket", sb)
+                    if self._key_reserved(sk):
+                        return self._error(403, "AccessDenied",
+                                           ".multipart is a reserved namespace")
+                    try:
+                        data = sfs.read_file("/" + sk)
+                    except FsError:
+                        return self._error(404, "NoSuchKey", sk)
                 try:
                     outer._put_object(fs, key, data)
                 except FsError as e:
                     return self._error(500, "InternalError", str(e))
                 etag = hashlib.md5(data).hexdigest()
+                if is_copy:
+                    body = (f"<?xml version='1.0'?><CopyObjectResult>"
+                            f"<ETag>\"{etag}\"</ETag></CopyObjectResult>").encode()
+                    return self._reply(200, body)
                 self._reply(200, headers={"ETag": f'"{etag}"'})
 
             def do_POST(self):
