@@ -32,13 +32,10 @@ def _backend_watchdog(seconds: float = 180.0) -> None:
 
     def arm():
         if not done.wait(seconds):
-            env = {
-                k: v
-                for k, v in os.environ.items()
-                if not k.startswith(("PALLAS_AXON", "AXON_"))
-            }
+            import tpuenv
+
+            env = tpuenv.scrubbed_cpu_env(os.environ)
             env["_CUBEFS_BENCH_CPU"] = "1"
-            env["JAX_PLATFORMS"] = "cpu"
             sys.stderr.write("bench: backend init timed out; rerunning on CPU\n")
             sys.stderr.flush()
             os.execve(sys.executable, list(sys.orig_argv), env)

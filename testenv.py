@@ -13,12 +13,17 @@ child's stdout on the real terminal fds.
 import os
 import sys
 
-if os.environ.get("PALLAS_AXON_POOL_IPS") and not os.environ.get("_CUBEFS_TPU_REEXEC"):
-    env = {k: v for k, v in os.environ.items() if not k.startswith(("PALLAS_AXON", "AXON_"))}
+import tpuenv
+
+if tpuenv.needs_scrub(os.environ) and not os.environ.get("_CUBEFS_TPU_REEXEC"):
+    env = tpuenv.scrubbed_cpu_env(os.environ)
     env["_CUBEFS_TPU_REEXEC"] = "1"
     os.execve(sys.executable, list(sys.orig_argv), env)
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Respect an explicitly set device count (e.g. a developer reproducing a
+# 4-device mesh bug); pin the suite's default of 8 otherwise.
+_pinned = "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
+_env = tpuenv.scrubbed_cpu_env(os.environ, n_devices=None if _pinned else 8)
+for _k in set(os.environ) - set(_env):
+    del os.environ[_k]
+os.environ.update(_env)

@@ -1,0 +1,41 @@
+"""Shared axon-tunnel env scrub for CPU-pinned interpreter (re)spawns.
+
+The image's sitecustomize registers the axon real-TPU PJRT plugin in every
+interpreter whose env carries the PALLAS_AXON*/AXON_* vars; when the tunnel
+relay is down, that plugin hangs backend init forever — even for CPU. Any
+code that wants a CPU run (tests, the bench watchdog fallback, the
+multichip dryrun) must therefore start a FRESH interpreter with those vars
+stripped. This module is the one definition of what "scrubbed" means, used
+by testenv.py (pytest bootstrap), bench.py (watchdog), and
+__graft_entry__.py (dryrun child).
+"""
+
+from __future__ import annotations
+
+_SCRUB_PREFIXES = ("PALLAS_AXON", "AXON_")
+
+
+def needs_scrub(environ) -> bool:
+    """True if any axon tunnel var is present (the plugin arms on any of
+    them, so a scrub-and-reexec is required for a safe CPU run)."""
+    return any(k.startswith(_SCRUB_PREFIXES) for k in environ)
+
+
+def scrubbed_cpu_env(environ, n_devices: int | None = None) -> dict:
+    """A copy of ``environ`` with the axon tunnel vars dropped and
+    JAX pinned to CPU; with ``n_devices``, also pin the virtual host
+    device count (overriding any pre-existing value, so the mesh size
+    always matches the caller's request)."""
+    env = {
+        k: v for k, v in environ.items() if not k.startswith(_SCRUB_PREFIXES)
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={int(n_devices)}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
