@@ -1,13 +1,14 @@
 """crc32block: per-64KiB-block CRC framing for blob payloads.
 
 Role parity: blobstore/common/crc32block (streaming CRC framing of every
-blob payload on disk and on the wire, encode.go/decode.go) — each
-payload block is followed by its CRC32, so corruption is localized to a
-block and detected at every hop.
+blob payload on disk and on the wire; block.go, encode.go/decode.go) —
+corruption is localized to a block and detected at every hop.
 
-Frame layout (block_len B = 64KiB payload per block):
-    [payload b0][crc32(b0) LE u32][payload b1][crc32(b1)] ... ;
-the final block may be short. Encoded size = n + 4*ceil(n/B).
+Frame layout is byte-compatible with the reference (block.go:29-49): a
+block UNIT is [crc32 LE u32][payload], and the block size (default
+64KiB) INCLUDES the 4 CRC bytes, so each full unit carries 64Ki-4
+payload bytes. The final unit may be short (but always > 4 bytes).
+Encoded size = n + 4*ceil(n/(B-4)).
 
 TPU tie-in: `verify_batch` re-CRCs many equal-sized frames as one
 batched device call (decode-side scrub).
@@ -19,7 +20,8 @@ import zlib
 
 import numpy as np
 
-BLOCK = 64 << 10
+BLOCK = 64 << 10  # unit size INCLUDING the leading 4-byte CRC
+CRC_LEN = 4
 
 
 class CrcFrameError(Exception):
@@ -27,36 +29,36 @@ class CrcFrameError(Exception):
 
 
 def encoded_size(n: int, block: int = BLOCK) -> int:
-    return n + 4 * ((n + block - 1) // block) if n else 0
+    payload = block - CRC_LEN
+    return n + CRC_LEN * ((n + payload - 1) // payload) if n else 0
 
 
 def decoded_size(n: int, block: int = BLOCK) -> int:
-    full = block + 4
-    blocks, rem = divmod(n, full)
+    blocks, rem = divmod(n, block)
     if rem == 0:
-        return blocks * block
-    if rem <= 4:
+        return blocks * (block - CRC_LEN)
+    if rem <= CRC_LEN:
         raise CrcFrameError(f"frame tail of {rem} bytes is not a block")
-    return blocks * block + rem - 4
+    return blocks * (block - CRC_LEN) + rem - CRC_LEN
 
 
 def encode(data: bytes, block: int = BLOCK) -> bytes:
+    payload = block - CRC_LEN
     out = bytearray()
-    for off in range(0, len(data), block):
-        chunk = data[off : off + block]
-        out += chunk
+    for off in range(0, len(data), payload):
+        chunk = data[off : off + payload]
         out += zlib.crc32(chunk).to_bytes(4, "little")
+        out += chunk
     return bytes(out)
 
 
 def decode(frame: bytes, block: int = BLOCK) -> bytes:
     out = bytearray()
-    full = block + 4
-    if len(frame) % full and len(frame) % full <= 4:
+    if len(frame) % block and len(frame) % block <= CRC_LEN:
         raise CrcFrameError("truncated frame")
-    for off in range(0, len(frame), full):
-        rec = frame[off : off + full]
-        chunk, crc_raw = rec[:-4], rec[-4:]
+    for off in range(0, len(frame), block):
+        rec = frame[off : off + block]
+        crc_raw, chunk = rec[:CRC_LEN], rec[CRC_LEN:]
         if zlib.crc32(chunk) != int.from_bytes(crc_raw, "little"):
             raise CrcFrameError(f"crc mismatch in block at offset {off}")
         out += chunk
@@ -70,12 +72,13 @@ def verify_batch(frames: np.ndarray, block: int = BLOCK) -> np.ndarray:
     from ..ops import crc32_kernel
 
     b, frame_len = frames.shape
-    full = block + 4
-    if frame_len % full:
+    if frame_len % block:
         raise CrcFrameError(f"frame length {frame_len} not whole blocks")
-    nblk = frame_len // full
-    recs = frames.reshape(b, nblk, full)
-    payloads = np.ascontiguousarray(recs[:, :, :block]).reshape(b * nblk, block)
+    nblk = frame_len // block
+    recs = frames.reshape(b, nblk, block)
+    payloads = np.ascontiguousarray(recs[:, :, CRC_LEN:]).reshape(
+        b * nblk, block - CRC_LEN
+    )
     crcs = np.asarray(crc32_kernel.crc32_blocks(payloads)).reshape(b, nblk)
-    stored = recs[:, :, block:].copy().view("<u4")[:, :, 0]
+    stored = np.ascontiguousarray(recs[:, :, :CRC_LEN]).view("<u4")[:, :, 0]
     return (crcs == stored).all(axis=1)

@@ -8,9 +8,13 @@ SDKs use); presigned URLs can layer on the same primitives.
 
 from __future__ import annotations
 
+import calendar
 import hashlib
 import hmac
+import time
 import urllib.parse
+
+MAX_CLOCK_SKEW = 15 * 60.0  # seconds, AWS's +/-15min request-time window
 
 
 def _hmac(key: bytes, msg: str) -> bytes:
@@ -27,7 +31,11 @@ def signing_key(secret: str, date: str, region: str, service: str) -> bytes:
 def canonical_request(method: str, path: str, query: str,
                       headers: dict[str, str], signed_headers: list[str],
                       payload_hash: str) -> str:
-    canon_uri = urllib.parse.quote(urllib.parse.unquote(path), safe="/-_.~")
+    # S3 rule: the canonical URI is the raw request path exactly as sent
+    # (single-encoded by the client). Re-encoding via quote(unquote(..))
+    # would collapse client escapes like %2F inside a key and diverge
+    # from what AWS SDKs sign.
+    canon_uri = path or "/"
     pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
     canon_query = "&".join(
         f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
@@ -43,7 +51,8 @@ def canonical_request(method: str, path: str, query: str,
 
 
 def verify_v4(method: str, path: str, query: str, headers: dict[str, str],
-              payload: bytes, secret_for) -> tuple[bool, str]:
+              payload: bytes, secret_for,
+              now: float | None = None) -> tuple[bool, str]:
     """Returns (ok, access_key_or_reason). headers keys must be
     lower-cased. secret_for(ak) -> sk | None."""
     auth = headers.get("authorization", "")
@@ -63,12 +72,30 @@ def verify_v4(method: str, path: str, query: str, headers: dict[str, str],
     sk = secret_for(ak)
     if sk is None:
         return False, f"unknown access key {ak}"
+    # host and x-amz-date must be covered by the signature, or an
+    # attacker could replay the request against another host/time
+    if "host" not in signed_headers or "x-amz-date" not in signed_headers:
+        return False, "host and x-amz-date must be signed"
     amz_date = headers.get("x-amz-date", "")
-    payload_hash = headers.get("x-amz-content-sha256") or hashlib.sha256(payload).hexdigest()
-    if payload_hash == "UNSIGNED-PAYLOAD":
-        pass
-    elif hashlib.sha256(payload).hexdigest() != payload_hash:
-        return False, "payload hash mismatch"
+    if not amz_date.startswith(date):
+        return False, "x-amz-date does not match credential scope date"
+    try:
+        req_time = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+    except ValueError:
+        return False, "malformed x-amz-date"
+    skew = abs((time.time() if now is None else now) - req_time)
+    if skew > MAX_CLOCK_SKEW:
+        return False, "request time too skewed (replay window exceeded)"
+    if "x-amz-content-sha256" in signed_headers:
+        payload_hash = headers.get("x-amz-content-sha256", "")
+        if (payload_hash != "UNSIGNED-PAYLOAD"
+                and hashlib.sha256(payload).hexdigest() != payload_hash):
+            return False, "payload hash mismatch"
+    else:
+        # the header is not covered by the signature, so its value proves
+        # nothing: bind the signature to the actual body instead (blocks
+        # body substitution via an attacker-supplied UNSIGNED-PAYLOAD)
+        payload_hash = hashlib.sha256(payload).hexdigest()
     creq = canonical_request(method, path, query, headers, signed_headers,
                              payload_hash)
     scope = f"{date}/{region}/{service}/aws4_request"

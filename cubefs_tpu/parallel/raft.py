@@ -25,7 +25,13 @@ Design notes:
     and the slot is overwritten by another leader's entry, the waiter
     gets NotLeaderError instead of a false success.
   * Persistence: (term, voted_for, log_base/term) in meta.json; log
-    entries as jsonl; FSM snapshot bytes beside them.
+    entries as jsonl; FSM snapshot bytes beside them. Every WAL record
+    carries its ABSOLUTE index, so the log file is self-aligning: a
+    crash between snapshot/meta persistence and the WAL rewrite can
+    never replay entries at wrong positions — load() simply skips
+    records at or below the restored log_base. Appends are fsync'd
+    before an entry is acknowledged; rewrites go through tmp +
+    os.replace + directory fsync.
 """
 
 from __future__ import annotations
@@ -83,10 +89,17 @@ class RaftNode:
         self._waiting: dict[int, int] = {}  # absolute index -> proposed term
         self._results: dict[int, tuple[object, BaseException | None]] = {}
         self._wal = None
+        self._wal_unclean = False
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._load()
             self._wal = open(self._wal_path(), "a")
+            if self._wal_unclean:
+                # the file held garbage/skipped records beyond the loaded
+                # prefix: rewrite it before appending, or new acknowledged
+                # entries would land after the garbage and be dropped by
+                # the next load
+                self._persist_entries([], rewrote=True)
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
 
     # ---------------- index helpers (absolute <-> list) ----------------
@@ -108,41 +121,65 @@ class RaftNode:
     def _snap_path(self) -> str:
         return os.path.join(self.data_dir, "snapshot.json")
 
+    def _fsync_dir(self) -> None:
+        fd = os.open(self.data_dir, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def _write_atomic(self, path: str, payload: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._fsync_dir()
+
     def _persist_meta(self) -> None:
         if not self.data_dir:
             return
-        tmp = os.path.join(self.data_dir, "meta.tmp")
-        with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for,
-                       "log_base": self.log_base,
-                       "log_base_term": self.log_base_term}, f)
-        os.replace(tmp, os.path.join(self.data_dir, "meta.json"))
+        self._write_atomic(
+            os.path.join(self.data_dir, "meta.json"),
+            json.dumps({"term": self.term, "voted_for": self.voted_for,
+                        "log_base": self.log_base,
+                        "log_base_term": self.log_base_term}),
+        )
 
     def _persist_entries(self, appended: list[dict], rewrote: bool) -> None:
         """appended = strict suffix newly appended to self.log; rewrote =
         a conflict truncated/overwrote earlier entries (or compaction):
-        rewrite the whole wal so it never holds duplicates."""
+        rewrite the whole wal so it never holds duplicates. Records carry
+        absolute indices; appends are fsync'd before returning (= before
+        the entry can be acknowledged to a leader or proposer)."""
         if self._wal is None:
             return
         if rewrote:
             self._wal.close()
-            with open(self._wal_path(), "w") as f:
-                for rec in self.log:
-                    f.write(json.dumps(rec) + "\n")
+            lines = [
+                json.dumps({"idx": self.log_base + i + 1, **rec})
+                for i, rec in enumerate(self.log)
+            ]
+            self._write_atomic(
+                self._wal_path(), "".join(ln + "\n" for ln in lines)
+            )
             self._wal = open(self._wal_path(), "a")
         else:
-            for rec in appended:
-                self._wal.write(json.dumps(rec) + "\n")
+            base = self._last_index() - len(appended)
+            for i, rec in enumerate(appended):
+                self._wal.write(json.dumps({"idx": base + i + 1, **rec}) + "\n")
             self._wal.flush()
+            os.fsync(self._wal.fileno())
 
     def _persist_snapshot(self, data: bytes) -> None:
         if not self.data_dir:
             return
-        tmp = self._snap_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"index": self.log_base, "term": self.log_base_term,
-                       "data": base64.b64encode(data).decode()}, f)
-        os.replace(tmp, self._snap_path())
+        self._write_atomic(
+            self._snap_path(),
+            json.dumps({"index": self.log_base, "term": self.log_base_term,
+                        "data": base64.b64encode(data).decode()}),
+        )
 
     def _load(self) -> None:
         meta = os.path.join(self.data_dir, "meta.json")
@@ -160,11 +197,27 @@ class RaftNode:
         if os.path.exists(self._wal_path()):
             for line in open(self._wal_path()):
                 line = line.strip()
-                if line:
-                    try:
-                        self.log.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        break
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    # torn tail write: entry was never acknowledged
+                    self._wal_unclean = True
+                    break
+                idx = rec.pop("idx", None)
+                if idx is None:
+                    # legacy record without absolute index: sequential
+                    self.log.append(rec)
+                    self._wal_unclean = True  # rewrite with indices
+                elif idx <= self.log_base:
+                    self._wal_unclean = True  # covered by the snapshot
+                elif idx == self.log_base + len(self.log) + 1:
+                    self.log.append(rec)
+                else:
+                    # gap/misalignment: trust only the contiguous prefix
+                    self._wal_unclean = True
+                    break
 
     # ---------------- lifecycle ----------------
     def start(self) -> "RaftNode":

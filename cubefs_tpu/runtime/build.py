@@ -1,12 +1,16 @@
 """Build + load the native runtime (ctypes, no pybind11).
 
 g++ compiles cubefs_tpu/runtime/src/*.cc into libcubefs_rt.so next to
-this file; rebuilt automatically when sources are newer than the .so.
+this file. The .so is never committed (gitignored): it is always built
+from the reviewed sources, and rebuilt whenever the content hash of the
+sources (recorded beside the .so) changes — mtimes are useless after a
+git clone, which does not preserve them.
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -14,27 +18,39 @@ import threading
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src")
 _SO = os.path.join(_DIR, "libcubefs_rt.so")
+_STAMP = _SO + ".srchash"
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 
 
+def _src_hash() -> str:
+    h = hashlib.sha256()
+    for f in sorted(os.listdir(_SRC)):
+        if f.endswith((".cc", ".h")):
+            h.update(f.encode() + b"\0")
+            with open(os.path.join(_SRC, f), "rb") as fh:
+                h.update(fh.read())
+    return h.hexdigest()
+
+
 def _needs_build() -> bool:
-    if not os.path.exists(_SO):
+    if not os.path.exists(_SO) or not os.path.exists(_STAMP):
         return True
-    so_mtime = os.path.getmtime(_SO)
-    return any(
-        os.path.getmtime(os.path.join(_SRC, f)) > so_mtime
-        for f in os.listdir(_SRC)
-        if f.endswith((".cc", ".h"))
-    )
+    with open(_STAMP) as f:
+        return f.read().strip() != _src_hash()
 
 
 def build() -> str:
+    # hash BEFORE compiling: if a source changes mid-compile, the stamp
+    # reflects the pre-edit inputs and the next check rebuilds
+    src_hash = _src_hash()
     srcs = [
         os.path.join(_SRC, f) for f in sorted(os.listdir(_SRC)) if f.endswith(".cc")
     ]
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, *srcs]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
+    with open(_STAMP, "w") as f:
+        f.write(src_hash)
     return _SO
 
 

@@ -22,6 +22,8 @@
 #include <map>
 #include <mutex>
 #include <vector>
+#include <cstdlib>
+#include <dirent.h>
 #include <fcntl.h>
 #include <unistd.h>
 #include <sys/stat.h>
@@ -125,6 +127,36 @@ std::string data_path(Store* s, uint64_t id, uint64_t gen) {
   return s->dir + buf;
 }
 
+void fsync_dir(Store* s) {
+  int fd = ::open(s->dir.c_str(), O_RDONLY);
+  if (fd >= 0) {
+    fsync(fd);
+    close(fd);
+  }
+}
+
+// Remove every data file of this chunk whose generation is not the
+// committed one: a crash between the compaction commit rename and the
+// old-file unlink leaves gen N-1 behind; a crash before the rename
+// leaves gen N+1 — scan rather than guess, so nothing leaks.
+void gc_stale_generations(Store* s, uint64_t id, uint64_t live_gen) {
+  DIR* d = opendir(s->dir.c_str());
+  if (!d) return;
+  char prefix[64];
+  snprintf(prefix, sizeof prefix, "chunk_%016llx.g", (unsigned long long)id);
+  size_t plen = strlen(prefix);
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    if (strncmp(e->d_name, prefix, plen) != 0) continue;
+    char* end = nullptr;
+    unsigned long long g = strtoull(e->d_name + plen, &end, 10);
+    if (end == e->d_name + plen || strcmp(end, ".data") != 0) continue;
+    if (g != live_gen) unlink((s->dir + "/" + e->d_name).c_str());
+  }
+  closedir(d);
+  if (live_gen != 0) unlink(chunk_path(s, id, "data").c_str());  // legacy g0
+}
+
 bool load_chunk(Store* s, uint64_t id, Chunk* c) {
   std::string ip = chunk_path(s, id, "idx");
   c->idx_fd = ::open(ip.c_str(), O_RDWR | O_CREAT | O_APPEND, 0644);
@@ -161,9 +193,10 @@ bool load_chunk(Store* s, uint64_t id, Chunk* c) {
       c->shards[r.bid] = ShardLoc{r.offset, r.size, r.crc};
     pos += sizeof r;
   }
-  // a crash between data write and idx commit can leave a stray
-  // next-generation data file: remove it (its idx never committed)
-  unlink(data_path(s, id, c->gen + 1).c_str());
+  // crashes around compaction can leave stray data files of any other
+  // generation (uncommitted gen+1, or the replaced gen-1 if the crash
+  // hit between commit rename and unlink): sweep them all
+  gc_stale_generations(s, id, c->gen);
   return true;
 }
 
@@ -396,8 +429,10 @@ int64_t cs_compact_chunk(void* h, uint64_t chunk_id) {
   // SINGLE commit point: the idx rename flips both idx records and (via
   // the header) the data generation; a crash before it leaves the old
   // pair fully intact, a crash after it leaves the new pair in effect
+  fsync_dir(s);  // make the new-generation data file's dirent durable
   if (rename(itmp.c_str(), ip.c_str()) != 0)
     return fail("compact commit rename", -1);
+  fsync_dir(s);  // make the commit rename itself durable
   close(c->data_fd);
   close(c->idx_fd);
   c->data_fd = dfd;

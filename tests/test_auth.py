@@ -77,9 +77,12 @@ import urllib.parse  # noqa: E402
 
 
 def test_sigv4_sign_verify_unit():
+    import calendar
+
     users = UserStore()
     cred = users.create_user("alice")
     amz_date = "20260728T120000Z"
+    now = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
     headers = {"host": "example", "x-amz-date": amz_date}
     payload = b"hello"
     auth = s3auth.sign_v4("PUT", "/bkt/key", "", headers, payload,
@@ -87,11 +90,52 @@ def test_sigv4_sign_verify_unit():
     headers["authorization"] = auth
     headers["x-amz-content-sha256"] = hashlib.sha256(payload).hexdigest()
     ok, who = s3auth.verify_v4("PUT", "/bkt/key", "", headers, payload,
-                               users.secret_for)
+                               users.secret_for, now=now)
     assert ok and who == cred["access_key"]
     bad, why = s3auth.verify_v4("PUT", "/bkt/other", "", headers, payload,
-                                users.secret_for)
+                                users.secret_for, now=now)
     assert not bad and why == "signature mismatch"
+    # outside the +/-15min window: the signature no longer authenticates
+    late, why = s3auth.verify_v4("PUT", "/bkt/key", "", headers, payload,
+                                 users.secret_for, now=now + 16 * 60)
+    assert not late and "skew" in why
+
+
+def test_sigv4_requires_signed_host_and_date():
+    import calendar
+
+    users = UserStore()
+    cred = users.create_user("bob")
+    amz_date = "20260728T120000Z"
+    now = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+    headers = {"x-amz-date": amz_date}  # host deliberately not signed
+    auth = s3auth.sign_v4("GET", "/bkt/key", "", headers, b"",
+                          cred["access_key"], cred["secret_key"], amz_date)
+    headers["authorization"] = auth
+    headers["host"] = "example"
+    ok, why = s3auth.verify_v4("GET", "/bkt/key", "", headers, b"",
+                               users.secret_for, now=now)
+    assert not ok and "must be signed" in why
+
+
+def test_sigv4_canonical_uri_preserves_client_encoding():
+    """%2F inside a key must survive verification round-trip — the
+    canonical URI is the raw single-encoded path, not re-encoded."""
+    import calendar
+
+    users = UserStore()
+    cred = users.create_user("carol")
+    amz_date = "20260728T120000Z"
+    now = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+    path = "/bkt/dir%2Fnested%20key"
+    headers = {"host": "example", "x-amz-date": amz_date}
+    auth = s3auth.sign_v4("GET", path, "", headers, b"",
+                          cred["access_key"], cred["secret_key"], amz_date)
+    headers["authorization"] = auth
+    headers["x-amz-content-sha256"] = hashlib.sha256(b"").hexdigest()
+    ok, who = s3auth.verify_v4("GET", path, "", headers, b"",
+                               users.secret_for, now=now)
+    assert ok and who == cred["access_key"]
 
 
 def test_s3_gateway_with_sigv4(tmp_path, rng):

@@ -119,11 +119,25 @@ def test_crc32block_detects_corruption(rng):
         crc32block.decode(bytes(frame))
 
 
+def test_crc32block_layout_matches_reference(rng):
+    """Byte layout pin (blobstore/common/crc32block/block.go:29-49): each
+    unit is [crc32 LE][payload], unit size includes the CRC."""
+    import zlib
+
+    data = rng.integers(0, 256, 2000, dtype=np.uint8).tobytes()
+    frame = crc32block.encode(data, block=1024)
+    p = 1024 - 4
+    assert frame[:4] == zlib.crc32(data[:p]).to_bytes(4, "little")
+    assert frame[4 : 4 + p] == data[:p]
+    assert frame[1024 : 1028] == zlib.crc32(data[p:]).to_bytes(4, "little")
+    assert frame[1028:] == data[p:]
+
+
 def test_crc32block_verify_batch(rng):
     block = 1024
     frames = []
     for _ in range(4):
-        data = rng.integers(0, 256, 2 * block, dtype=np.uint8).tobytes()
+        data = rng.integers(0, 256, 2 * (block - 4), dtype=np.uint8).tobytes()
         frames.append(np.frombuffer(crc32block.encode(data, block), dtype=np.uint8))
     arr = np.stack(frames)
     ok = crc32block.verify_batch(arr, block)

@@ -138,3 +138,26 @@ def test_compaction_survives_reopen(tmp_path, rng):
         assert cs.get_shard(1, 3)[0] == b"after"
         with pytest.raises(chunkstore.ShardNotFoundError):
             cs.get_shard(1, 2)
+
+
+def test_stale_generation_files_swept_at_open(tmp_path, rng):
+    """Crash windows around compaction can leave data files of OTHER
+    generations (the replaced gen N-1, or an uncommitted gen N+1);
+    reopening the chunk removes them all without touching live data."""
+    d = str(tmp_path / "gdisk")
+    with chunkstore.ChunkStore(d) as cs:
+        cs.create_chunk(5)
+        cs.put_shard(5, 1, b"live-payload")
+        cs.delete_shard(5, 1)
+        cs.put_shard(5, 2, b"keep")
+        cs.compact(5)  # live generation is now 1
+    # simulate crash-leftovers: replaced legacy gen-0 file and a stray
+    # uncommitted next-generation file
+    legacy = os.path.join(d, "chunk_%016x.data" % 5)
+    stray = os.path.join(d, "chunk_%016x.g2.data" % 5)
+    open(legacy, "wb").write(b"old generation leftover")
+    open(stray, "wb").write(b"uncommitted next generation")
+    with chunkstore.ChunkStore(d) as cs:
+        assert cs.get_shard(5, 2)[0] == b"keep"
+        assert not os.path.exists(legacy)
+        assert not os.path.exists(stray)

@@ -245,3 +245,111 @@ def test_log_compaction_and_snapshot_install(tmp_path):
         assert len(lag.applied) == 60
     finally:
         stop_all(members)
+
+
+def _solo_with_snapshots(tmp_path, state):
+    """Single-node group whose FSM is an applied list, with snapshot
+    hooks wired (compaction machinery active)."""
+    pool = NodePool()
+
+    class M(Member):
+        def __init__(self):
+            self.applied = state
+            self.routes = {}
+            self.node = raft.RaftNode(
+                "g1", "r0", ["r0"], self.applied.append, pool,
+                data_dir=str(tmp_path / "r0"),
+                snapshot_fn=lambda: repr(self.applied).encode(),
+                restore_fn=lambda b: self.applied.__init__(eval(b.decode())),
+            )
+            raft.register_routes(self.routes, self.node)
+
+    m = M()
+    pool.bind("r0", _Routes(m.routes))
+    m.node.start()
+    return m
+
+
+def test_wal_survives_snapshot_crash_window(tmp_path):
+    """Crash between snapshot+meta persistence (new log_base) and the WAL
+    rewrite must not replay old-base entries at wrong absolute indices:
+    WAL records carry their absolute index, so load() skips the covered
+    prefix and keeps the acknowledged tail."""
+    import json as _json
+
+    state = []
+    m = _solo_with_snapshots(tmp_path, state)
+    try:
+        wait_leader({"r0": m})
+        for i in range(8):
+            m.node.propose({"i": i})
+    finally:
+        m.node.stop()
+    time.sleep(0.1)
+
+    d = tmp_path / "r0"
+    # simulate the crash window: snapshot + meta say log_base=N (first 5
+    # applied entries compacted), but the WAL was never rewritten.
+    wal = [_json.loads(ln) for ln in open(d / "raft.jsonl") if ln.strip()]
+    cut = wal[4]["idx"]  # compact through the 5th record
+    snap_term = wal[4]["term"]
+    covered = [rec["entry"] for rec in wal[:5] if not rec["entry"].get("__raft_noop__")]
+    (d / "snapshot.json").write_text(_json.dumps({
+        "index": cut, "term": snap_term,
+        "data": __import__("base64").b64encode(repr(covered).encode()).decode(),
+    }))
+    meta = _json.loads((d / "meta.json").read_text())
+    meta["log_base"], meta["log_base_term"] = cut, snap_term
+    (d / "meta.json").write_text(_json.dumps(meta))
+
+    state2 = []
+    m2 = _solo_with_snapshots(tmp_path, state2)
+    try:
+        wait_leader({"r0": m2})
+        assert m2.node.status()["log_base"] == cut
+        m2.node.propose({"i": 99})
+        # every pre-crash entry exactly once, at the right position
+        assert state2 == covered + [
+            rec["entry"] for rec in wal[5:] if not rec["entry"].get("__raft_noop__")
+        ] + [{"i": 99}]
+    finally:
+        m2.node.stop()
+
+
+def test_wal_torn_tail_dropped(tmp_path):
+    """A torn (half-written) trailing WAL record was never acknowledged;
+    reload keeps the intact prefix and drops the tail."""
+    state = []
+    m = _solo_with_snapshots(tmp_path, state)
+    try:
+        wait_leader({"r0": m})
+        for i in range(4):
+            m.node.propose({"i": i})
+    finally:
+        m.node.stop()
+    time.sleep(0.1)
+
+    wal_path = tmp_path / "r0" / "raft.jsonl"
+    with open(wal_path, "a") as f:
+        f.write('{"idx": 999, "term": 1, "ent')  # torn write
+
+    state2 = []
+    m2 = _solo_with_snapshots(tmp_path, state2)
+    try:
+        wait_leader({"r0": m2})
+        m2.node.propose({"i": 4})
+        assert state2 == [{"i": i} for i in range(5)]
+    finally:
+        m2.node.stop()
+    time.sleep(0.1)
+
+    # the post-crash entry {"i": 4} was acknowledged AFTER the torn tail:
+    # the reload must have rewritten the WAL so a further restart keeps it
+    state3 = []
+    m3 = _solo_with_snapshots(tmp_path, state3)
+    try:
+        wait_leader({"r0": m3})
+        m3.node.propose({"i": 5})
+        assert state3 == [{"i": i} for i in range(6)]
+    finally:
+        m3.node.stop()
