@@ -1,16 +1,20 @@
 """North-star benchmark: EC encode+repair GiB/s/chip + CRC GB/s.
 
-Replicates BASELINE.json's judged configs on whatever backend jax
-resolves (the real TPU chip under the driver; CPU as fallback):
+Replicates ALL FIVE of BASELINE.json's judged configs on whatever
+backend jax resolves (the real TPU chip under the driver; CPU as a
+scaled-down fallback):
 
-  * RS(12+4), 4MiB shards: batched encode GiB/s (data bytes / s)
-  * RS(12+4), 4MiB shards: reconstruct 2 missing data shards GiB/s
-  * 128KiB-block CRC32 verify GB/s
+  1. RS(6+3), 1MiB shards, single-stripe encode — CPU engine vs device
+     engine (the size-class crossover measurement)
+  2. RS(12+4), 4MiB shards, batched encode, 1024 stripes streamed
+  3. RS(12+4), 4MiB shards, reconstruct 2 missing — THE judged metric,
+     with the fused Pallas kernel autotuned over tile sizes on TPU
+  4. extent-store CRC32 verify, 10k x 128KiB blocks, batched
+  5. full-disk migrate replay: mixed RS(12+4)/RS(6+3) task stream
+     (the scheduler's disk-repair shape)
 
-Prints ONE JSON line. `value` is the repair number (the judged metric);
-vs_baseline is value / 8 GiB/s — the BASELINE.json target for v5e-1
-(the reference publishes no EC kernel benchmark; 8 GiB/s/chip ≈ the
-AVX2-path target multiple it names).
+Prints ONE JSON line. `value` is the repair number (config 3);
+vs_baseline is value / 8 GiB/s — the BASELINE.json target for v5e-1.
 """
 
 from __future__ import annotations
@@ -47,7 +51,7 @@ def _backend_watchdog(seconds: float = 180.0) -> None:
     done.set()
 
 
-def _time_fn(fn, *args, iters: int = 5) -> float:
+def _time_fn(fn, *args, iters: int = 3) -> float:
     import jax
 
     out = fn(*args)  # compile + warmup
@@ -64,56 +68,111 @@ def main() -> None:
     import jax
     import numpy as np
 
+    from cubefs_tpu.codec import engine as ec_engine
     from cubefs_tpu.models import repair
     from cubefs_tpu.ops import crc32_kernel, rs_kernel
 
     dev = jax.devices()[0]
     platform = dev.platform
     on_tpu = "tpu" in str(dev).lower() or platform in ("tpu", "axon")
-
-    S = 4 << 20 if on_tpu else 1 << 18  # 4MiB shards (scaled down on CPU)
-    B = 4 if on_tpu else 2  # stripes per step
-    n, m = 12, 4
     rng = np.random.default_rng(7)
-    data = rng.integers(0, 256, (B, n, S), dtype=np.uint8)
 
-    # --- encode ---------------------------------------------------------
-    x = jax.device_put(data, dev)
-    dt = _time_fn(lambda a: rs_kernel.encode_parity(a, m), x)
-    encode_gibs = B * n * S / dt / (1 << 30)
+    # ---- config 1: RS(6+3), 1MiB shards, SINGLE stripe encode ----------
+    # (the CPU-vs-device crossover backing the size-class policy: one
+    # small stripe cannot amortize device dispatch)
+    s63 = 1 << 20 if on_tpu else 1 << 17
+    one_stripe = rng.integers(0, 256, (6, s63), dtype=np.uint8)
+    cpu_eng = ec_engine.get_engine("numpy")
+    t0 = time.perf_counter()
+    cpu_iters = 3
+    for _ in range(cpu_iters):
+        cpu_eng.encode_parity(one_stripe, 3)
+    rs63_cpu_gibs = cpu_iters * 6 * s63 / (time.perf_counter() - t0) / (1 << 30)
+    x1 = jax.device_put(one_stripe, dev)
+    dt = _time_fn(lambda a: rs_kernel.encode_parity(a, 3), x1)
+    rs63_dev_gibs = 6 * s63 / dt / (1 << 30)
 
-    # --- repair: 2 missing data shards ----------------------------------
+    # ---- config 2: RS(12+4), 4MiB shards, 1024 stripes streamed --------
+    n, m = 12, 4
+    S = 4 << 20 if on_tpu else 1 << 18
+    B = 8 if on_tpu else 2  # stripes resident per device step
+    steps = 128 if on_tpu else 4  # B*steps = 1024 streamed stripes on TPU
+    batch = rng.integers(0, 256, (B, n, S), dtype=np.uint8)
+    x2 = jax.device_put(batch, dev)
+    fn2 = lambda a: rs_kernel.encode_parity(a, m)
+    jax.block_until_ready(fn2(x2))  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn2(x2)
+    jax.block_until_ready(out)
+    encode_gibs = steps * B * n * S / (time.perf_counter() - t0) / (1 << 30)
+
+    # ---- config 3 (JUDGED): RS(12+4) reconstruct, 2 missing ------------
     plan = repair.make_plan(n, m, bad=[1, 7])
     rows = plan.rows
+    Br = 4 if on_tpu else 2
     surv = jax.device_put(
-        rng.integers(0, 256, (B, n, S), dtype=np.uint8), dev
+        rng.integers(0, 256, (Br, n, S), dtype=np.uint8), dev
     )  # any bytes; throughput only (math is data-independent)
     dt = _time_fn(lambda a: rs_kernel.gf_matrix_apply(rows, a), surv)
-    repair_gibs = B * n * S / dt / (1 << 30)
+    repair_gibs = Br * n * S / dt / (1 << 30)
 
-    # fused pallas path (TPU): avoids the 8x bit tensor in HBM
-    pallas_gibs = None
+    # fused pallas path (TPU): avoids the 8x bit tensor in HBM; autotune
+    # the tile size on the real chip
+    pallas_gibs, pallas_tile = None, None
     if on_tpu:
-        try:
-            from cubefs_tpu.ops import pallas_gf
+        from cubefs_tpu.ops import pallas_gf
 
-            dt = _time_fn(
-                lambda a: pallas_gf.gf_matrix_apply_pallas(rows, a), surv
-            )
-            pallas_gibs = B * n * S / dt / (1 << 30)
+        for tile in pallas_gf.TILE_CANDIDATES:
+            try:
+                dt = _time_fn(
+                    lambda a: pallas_gf.gf_matrix_apply_pallas(rows, a, tile=tile),
+                    surv,
+                )
+            except Exception as e:  # one tile failing must not void others
+                print(f"bench: pallas tile {tile} failed: {e}", file=sys.stderr)
+                continue
+            gibs = Br * n * S / dt / (1 << 30)
+            if pallas_gibs is None or gibs > pallas_gibs:
+                pallas_gibs, pallas_tile = gibs, tile
+        if pallas_gibs is not None:
             repair_gibs = max(repair_gibs, pallas_gibs)
-        except Exception as e:
-            import sys
 
-            print(f"bench: pallas path failed: {e}", file=sys.stderr)
-
-    # --- CRC32, 128KiB blocks -------------------------------------------
-    nblk = 256 if on_tpu else 32
+    # ---- config 4: CRC32 verify, 10k x 128KiB blocks -------------------
+    nblk = 10_000 if on_tpu else 64
     blocks = jax.device_put(
         rng.integers(0, 256, (nblk, 128 << 10), dtype=np.uint8), dev
     )
     dt = _time_fn(lambda a: crc32_kernel.crc32_blocks(a, chunk_len=4096), blocks)
     crc_gbs = nblk * (128 << 10) / dt / 1e9
+
+    # ---- config 5: full-disk migrate replay, mixed codemodes -----------
+    # the scheduler's disk-repair stream: alternating RS(12+4)@4MiB and
+    # RS(6+3)@1MiB stripe batches through the fused repair step (the
+    # worker's reconstruct+verify+CRC graph), one task per step
+    plan63 = repair.make_plan(6, 3, bad=[2])
+    s63m = 1 << 20 if on_tpu else 1 << 17
+    surv124 = jax.device_put(
+        rng.integers(0, 256, (Br, len(plan.present), S), dtype=np.uint8), dev
+    )
+    surv63 = jax.device_put(
+        rng.integers(0, 256, (Br * 2, len(plan63.present), s63m), dtype=np.uint8),
+        dev,
+    )
+    f124 = lambda a: repair.repair_step(plan, a, chunk_len=4096)
+    f63 = lambda a: repair.repair_step(plan63, a, chunk_len=4096)
+    jax.block_until_ready(f124(surv124))
+    jax.block_until_ready(f63(surv63))
+    tasks = 32 if on_tpu else 4
+    t0 = time.perf_counter()
+    for _ in range(tasks):
+        o1 = f124(surv124)
+        o2 = f63(surv63)
+    jax.block_until_ready((o1, o2))
+    migrate_bytes = tasks * (
+        surv124.size + surv63.size
+    )  # bytes read by the worker per replayed task pair
+    migrate_gibs = migrate_bytes / (time.perf_counter() - t0) / (1 << 30)
 
     target_gibs = 8.0  # BASELINE.json: >=8 GiB/s/chip RS(12+4) repair on v5e-1
     print(
@@ -124,12 +183,16 @@ def main() -> None:
                 "unit": "GiB/s",
                 "vs_baseline": round(repair_gibs / target_gibs, 3),
                 "extras": {
-                    "encode_gibs": round(encode_gibs, 3),
+                    "rs63_1mib_single_cpu_gibs": round(rs63_cpu_gibs, 3),
+                    "rs63_1mib_single_dev_gibs": round(rs63_dev_gibs, 3),
+                    "encode_1024stripes_gibs": round(encode_gibs, 3),
                     "crc32_gbs": round(crc_gbs, 3),
+                    "migrate_mixed_gibs": round(migrate_gibs, 3),
                     "pallas_repair_gibs": round(pallas_gibs, 3) if pallas_gibs else None,
+                    "pallas_tile": pallas_tile,
                     "platform": platform,
                     "shard_bytes": S,
-                    "stripes_per_step": B,
+                    "stripes_per_step": Br,
                 },
             }
         )

@@ -44,44 +44,22 @@ class MetaWrapper:
     REDIRECT = 421  # metanode "not leader" status
 
     def _call(self, mp: dict, method: str, args: dict):
-        """Call the partition, following leader redirects and failing
-        over across its replica set. Mutations ("submit") carry a unique
-        op_id so a retry after a lost response is exactly-once."""
+        """Call the partition via the shared replica/redirect loop
+        (rpc.call_replicas). Mutations ("submit") carry a unique op_id
+        so a retry after a lost response is exactly-once; metanode 4xx
+        codes map back to errnos."""
         addrs = list(mp.get("addrs") or [mp["addr"]])
         payload = {"pid": mp["pid"], **args}
         if method == "submit":
             payload["record"] = dict(payload["record"])
             payload["record"].setdefault("op_id", uuid.uuid4().hex)
-        last: Exception | None = None
-        tried: set[str] = set()
-        queue = list(addrs)
-        deadline = time.time() + 10.0
-        while queue and time.time() < deadline:
-            addr = queue.pop(0)
-            if addr in tried:
-                continue
-            try:
-                return self.nodes.get(addr).call(method, payload)
-            except rpc.RpcError as e:
-                if e.code == self.REDIRECT:
-                    leader = e.message.removeprefix("leader=").strip()
-                    if leader and leader not in tried:
-                        queue.insert(0, leader)
-                    elif not leader:  # election in progress: retry shortly
-                        time.sleep(0.05)
-                        queue.append(addr)
-                    last = e
-                    continue
-                if isinstance(e, rpc.ServiceUnavailable) or e.code >= 500 or e.code == 404:
-                    # 404 = method/partition not on that node (dead or
-                    # stale view): fail over like a down node
-                    tried.add(addr)
-                    last = e
-                    continue
-                if 400 <= e.code < 500:  # metanode errno mapping
-                    raise FsError(e.code - 400, e.message) from None
-                raise
-        raise last if last else FsError(5, f"mp {mp['pid']}: no replica reachable")
+        try:
+            return rpc.call_replicas(self.nodes, addrs, method, payload,
+                                     deadline=10.0)
+        except rpc.RpcError as e:
+            if 400 <= e.code < 500 and e.code not in (404, self.REDIRECT):
+                raise FsError(e.code - 400, e.message) from None
+            raise
 
     def pick_create_mp(self) -> dict:
         with self._lock:
@@ -153,6 +131,87 @@ class MetaWrapper:
         res = self._call(mp, "submit", {"record": {
             "op": "truncate", "ino": ino, "size": size}})
         return res[0]["result"].get("extents", [])
+
+    # ---- rename (atomic; metanode/transaction.go analog) ----
+    def rename_local(self, src_parent: int, src_name: str,
+                     dst_parent: int, dst_name: str, ino: int,
+                     victim: int | None = None) -> int | None:
+        """Same-partition atomic rename; `victim` is the dst inode the
+        caller validated (re-asserted inside the apply). Returns the
+        replaced victim inode (or None)."""
+        mp = self._mp_for(src_parent)
+        res = self._call(mp, "submit", {"record": {
+            "op": "rename_local", "src_parent": src_parent,
+            "src_name": src_name, "dst_parent": dst_parent,
+            "dst_name": dst_name, "ino": ino, "victim": victim}})
+        return res[0]["result"].get("victim")
+
+    def _mp_ref(self, mp: dict) -> dict:
+        return {"pid": mp["pid"],
+                "addrs": list(mp.get("addrs") or [mp["addr"]])}
+
+    def rename_tx(self, src_parent: int, src_name: str,
+                  dst_parent: int, dst_name: str, ino: int,
+                  victim: int | None = None,
+                  victim_is_dir: bool = False) -> int | None:
+        """Cross-partition rename as a two-phase transaction. The DST
+        partition is the coordinator: it is prepared and committed FIRST,
+        so its durable commit decision is what an expired participant
+        consults (roll forward) — no crash point leaves the file linked
+        twice or lost. The coordinator's prepare lists the participants,
+        so its scanner pushes the decision and only drops the commit
+        record once everyone has resolved. A dir victim gets a
+        guard_empty_dir participant on its own partition, locking out
+        new children while the tx is in flight. Returns the replaced
+        victim inode (or None)."""
+        src_mp = self._mp_for(src_parent)
+        dst_mp = self._mp_for(dst_parent)
+        tx_id = uuid.uuid4().hex
+        coord = self._mp_ref(dst_mp)
+        ts = time.time()
+        # group sub-ops by owning partition (src/dst/guard may coincide)
+        by_pid: dict[int, tuple[dict, list[dict]]] = {}
+
+        def add_op(mp_, op_):
+            by_pid.setdefault(mp_["pid"], (mp_, []))[1].append(op_)
+
+        add_op(dst_mp, {"kind": "link", "parent": dst_parent,
+                        "name": dst_name, "ino": ino, "victim": victim})
+        add_op(src_mp, {"kind": "rm", "parent": src_parent,
+                        "name": src_name, "ino": ino})
+        if victim is not None and victim_is_dir:
+            # lock the victim dir on ITS partition so no child can appear
+            # between the client's emptiness check and the commit
+            add_op(self._mp_for(victim),
+                   {"kind": "guard_empty_dir", "parent": victim, "name": ""})
+        dst_ops = by_pid.pop(dst_mp["pid"])[1]
+        part_preps = list(by_pid.values())
+        parts = [self._mp_ref(mp_) for mp_, _ in part_preps]
+        self._call(dst_mp, "submit", {"record": {
+            "op": "tx_prepare", "tx_id": tx_id, "coord": coord,
+            "parts": parts, "ts": ts, "ops": dst_ops}})
+        prepared: list[dict] = []
+        try:
+            for mp_, ops_ in part_preps:
+                self._call(mp_, "submit", {"record": {
+                    "op": "tx_prepare", "tx_id": tx_id, "coord": coord,
+                    "ts": ts, "ops": ops_}})
+                prepared.append(mp_)
+        except FsError:
+            for mp_ in [dst_mp] + prepared:
+                try:
+                    self._call(mp_, "submit", {"record": {
+                        "op": "tx_abort", "tx_id": tx_id}})
+                except FsError:
+                    pass
+            raise
+        res = self._call(dst_mp, "submit", {"record": {
+            "op": "tx_commit", "tx_id": tx_id, "ts": ts}})
+        for mp_, _ in part_preps:
+            self._call(mp_, "submit", {"record": {
+                "op": "tx_commit", "tx_id": tx_id, "ts": ts}})
+        victims = res[0]["result"].get("victims") or []
+        return victims[0] if victims else None
 
 
 class ExtentClient:
@@ -448,9 +507,88 @@ class FileSystem:
     def rename(self, old: str, new: str) -> None:
         old_parent, old_name = self._parent_of(old)
         new_parent, new_name = self._parent_of(new)
+        self.rename_at(old_parent, old_name, new_parent, new_name)
+
+    def rename_at(self, old_parent: int, old_name: str,
+                  new_parent: int, new_name: str) -> None:
+        """POSIX rename: atomic, replacing an existing target (file over
+        file, dir over empty dir). Same-partition renames are ONE fsm
+        apply; cross-partition renames run the two-phase transaction —
+        either way no crash point leaves the file linked twice or lost.
+        Inode-based so the FUSE opcode handler can call it directly."""
         ino = self.meta.lookup(old_parent, old_name)
-        self.meta.dentry_create(new_parent, new_name, ino)
-        self.meta.dentry_delete(old_parent, old_name)
+        try:
+            victim_ino = self.meta.lookup(new_parent, new_name)
+        except FsError:
+            victim_ino = None
+        if victim_ino == ino:
+            return  # same file: POSIX says do nothing
+        src = self.meta.inode_get(ino)
+        victim_is_dir = False
+        if victim_ino is not None:
+            vic = self.meta.inode_get(victim_ino)
+            victim_is_dir = vic["type"] == mn.DIR
+            if victim_is_dir:
+                if src["type"] != mn.DIR:
+                    raise FsError(mn.EISDIR, f"{new_name!r} is a directory")
+                if self.meta.dentry_count(victim_ino) > 0:
+                    raise FsError(mn.ENOTEMPTY, f"{new_name!r} not empty")
+            elif src["type"] == mn.DIR:
+                raise FsError(mn.ENOTDIR, f"{new_name!r} is not a directory")
+        if src["type"] == mn.DIR and self._in_subtree(ino, new_parent):
+            # POSIX: renaming a dir into its own subtree is EINVAL — it
+            # would detach the subtree into an unreachable cycle
+            raise FsError(22, "cannot move a directory into itself")
+        src_mp = self.meta._mp_for(old_parent)
+        dst_mp = self.meta._mp_for(new_parent)
+        # the single-apply fast path needs every touched structure on ONE
+        # partition: both parent dentry maps, and (for a dir victim) the
+        # victim's own children map — its emptiness is re-asserted inside
+        # the apply, which only sees local state
+        local_ok = src_mp["pid"] == dst_mp["pid"] and not (
+            victim_is_dir
+            and self.meta._mp_for(victim_ino)["pid"] != src_mp["pid"]
+        )
+        if local_ok:
+            victim = self.meta.rename_local(
+                old_parent, old_name, new_parent, new_name, ino,
+                victim=victim_ino)
+        else:
+            victim = self.meta.rename_tx(
+                old_parent, old_name, new_parent, new_name, ino,
+                victim=victim_ino, victim_is_dir=victim_is_dir)
+        if victim is not None:
+            # replaced target: drop its inode + storage (post-commit
+            # cleanup; a crash here leaves an unreferenced inode for
+            # fsck, never a dangling dentry)
+            freed = self.meta.inode_delete(victim)
+            self.data.close_stream(victim)
+            self.data.release_extents(freed)
+
+    def _in_subtree(self, root_ino: int, target_ino: int) -> bool:
+        """True if target_ino is root_ino or lives anywhere under it
+        (walks DOWN from root — inodes carry no parent pointers)."""
+        if root_ino == target_ino:
+            return True
+        queue = [root_ino]
+        seen = {root_ino}
+        while queue:
+            cur = queue.pop()
+            try:
+                entries = self.meta.readdir(cur)
+            except FsError:
+                continue
+            for child in entries.values():
+                if child == target_ino:
+                    return True
+                if child not in seen:
+                    seen.add(child)
+                    try:
+                        if self.meta.inode_get(child)["type"] == mn.DIR:
+                            queue.append(child)
+                    except FsError:
+                        pass
+        return False
 
     def setxattr(self, path: str, key: str, value: str) -> None:
         self.meta.set_xattr(self.resolve(path), key, value)

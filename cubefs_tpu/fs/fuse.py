@@ -311,21 +311,9 @@ class FuseMount:
             newdir = struct.unpack_from("<Q", body)[0]
             names = body[8:].split(b"\x00")
             old_name, new_name = names[0].decode(), names[1].decode()
-            ino = fs.meta.lookup(nodeid, old_name)
-            try:  # clobber an existing target like rename(2)
-                old_target = fs.meta.lookup(newdir, new_name)
-            except FsError:
-                old_target = None
-            if old_target is not None:
-                target = fs.meta.inode_get(old_target)
-                if target["type"] == mn.DIR and fs.meta.dentry_count(old_target) > 0:
-                    raise FsError(mn.ENOTEMPTY, "rename target dir not empty")
-                fs.meta.dentry_delete(newdir, new_name)
-                freed = fs.meta.inode_delete(old_target)
-                fs.data.close_stream(old_target)
-                fs.data.release_extents(freed)
-            fs.meta.dentry_create(newdir, new_name, ino)
-            fs.meta.dentry_delete(nodeid, old_name)
+            # atomic rename(2) semantics (replace-existing) via the
+            # client's single-apply / two-phase-tx path
+            fs.rename_at(nodeid, old_name, newdir, new_name)
             self._reply(unique)
 
         elif opcode == FUSE_GETXATTR:

@@ -35,12 +35,17 @@ class MetaError(Exception):
 
 ENOENT = 2
 EEXIST = 17
+EBUSY = 16
+EISDIR = 21
 ENOTDIR = 20
 ENOTEMPTY = 39
 
 
 class MetaPartition:
     """One inode-range shard: [start, end)."""
+
+    TX_TTL = 30.0  # seconds a prepared tx may stay undecided
+    TX_COMMIT_TTL = 3600.0  # how long commit decisions stay queryable
 
     def __init__(self, pid: int, start: int, end: int, data_dir: str | None = None):
         self.pid = pid
@@ -49,6 +54,11 @@ class MetaPartition:
         self._lock = threading.RLock()
         self.inodes: dict[int, dict] = {}
         self.dentries: dict[int, dict[str, int]] = {}  # parent -> name -> ino
+        # two-phase transactions (metanode/transaction.go analog):
+        # prepared sub-ops hold dentry locks until commit/abort; commit
+        # decisions stay queryable so participants can roll forward
+        self.tx_pending: dict[str, dict] = {}  # tx_id -> {ops, ts, coord}
+        self.tx_committed: dict[str, dict] = {}  # tx_id -> {victims, ts}
         self.apply_id = 0
         self._next_ino = start
         self._op_cache: dict[str, tuple] = {}  # op_id -> (result, err)
@@ -107,22 +117,34 @@ class MetaPartition:
                 del self._op_cache[k]
 
     # ---------------- raft FSM snapshot interface ----------------
+    def _state_dict(self) -> dict:
+        """The ONE serialized form of the FSM state — used by raft
+        snapshots and the on-disk checkpoint alike, so a new field can
+        never be persisted in one path and dropped in the other."""
+        return {
+            "apply_id": self.apply_id, "next_ino": self._next_ino,
+            "inodes": {str(k): v for k, v in self.inodes.items()},
+            "dentries": {str(k): v for k, v in self.dentries.items()},
+            "tx_pending": self.tx_pending,
+            "tx_committed": self.tx_committed,
+        }
+
+    def _load_state_dict(self, st: dict) -> None:
+        self.apply_id = st["apply_id"]
+        self._next_ino = st["next_ino"]
+        self.inodes = {int(k): v for k, v in st["inodes"].items()}
+        self.dentries = {int(k): v for k, v in st["dentries"].items()}
+        self.tx_pending = st.get("tx_pending", {})
+        self.tx_committed = st.get("tx_committed", {})
+
     def state_bytes(self) -> bytes:
         """Serialize the whole partition state (raft snapshot payload)."""
         with self._lock:
-            return json.dumps({
-                "apply_id": self.apply_id, "next_ino": self._next_ino,
-                "inodes": {str(k): v for k, v in self.inodes.items()},
-                "dentries": {str(k): v for k, v in self.dentries.items()},
-            }).encode()
+            return json.dumps(self._state_dict()).encode()
 
     def restore_state(self, data: bytes) -> None:
         with self._lock:
-            st = json.loads(data)
-            self.apply_id = st["apply_id"]
-            self._next_ino = st["next_ino"]
-            self.inodes = {int(k): v for k, v in st["inodes"].items()}
-            self.dentries = {int(k): v for k, v in st["dentries"].items()}
+            self._load_state_dict(json.loads(data))
 
     # ---------------- snapshot / recovery ----------------
     def snapshot(self) -> None:
@@ -131,9 +153,7 @@ class MetaPartition:
         with self._lock:
             state = json.dumps({
                 "pid": self.pid, "start": self.start, "end": self.end,
-                "apply_id": self.apply_id, "next_ino": self._next_ino,
-                "inodes": {str(k): v for k, v in self.inodes.items()},
-                "dentries": {str(k): v for k, v in self.dentries.items()},
+                **self._state_dict(),
             }).encode()
             crc = zlib.crc32(state)
             tmp = os.path.join(self.data_dir, "snap.tmp")
@@ -152,11 +172,7 @@ class MetaPartition:
             crc, state = int.from_bytes(raw[:4], "little"), raw[4:]
             if zlib.crc32(state) != crc:
                 raise MetaError(5, f"snapshot crc mismatch for mp {self.pid}")
-            st = json.loads(state)
-            self.apply_id = st["apply_id"]
-            self._next_ino = st["next_ino"]
-            self.inodes = {int(k): v for k, v in st["inodes"].items()}
-            self.dentries = {int(k): v for k, v in st["dentries"].items()}
+            self._load_state_dict(json.loads(state))
         oplog = os.path.join(self.data_dir, "oplog.jsonl")
         if os.path.exists(oplog):
             for line in open(oplog):
@@ -207,6 +223,7 @@ class MetaPartition:
 
     def _apply_mk_dentry(self, r: dict) -> dict:
         parent, name = r["parent"], r["name"]
+        self._check_unlocked(parent, name)
         d = self.dentries.get(parent)
         if d is None:
             raise MetaError(ENOENT, f"parent dir {parent} not here")
@@ -217,11 +234,177 @@ class MetaPartition:
 
     def _apply_rm_dentry(self, r: dict) -> dict:
         parent, name = r["parent"], r["name"]
+        self._check_unlocked(parent, name)
         d = self.dentries.get(parent)
         if d is None or name not in d:
             raise MetaError(ENOENT, f"{name!r} not in {parent}")
         ino = d.pop(name)
         return {"ino": ino}
+
+    # ---------------- transactions (metanode/transaction.go analog) ----
+    # Two-phase protocol for multi-partition atomicity (rename across
+    # parents). Prepare validates the sub-ops and locks their dentry
+    # keys; commit applies them; abort releases. One involved partition
+    # is the COORDINATOR (the reference's TM): the client commits there
+    # first, and its durable commit decision is what participants (RMs)
+    # consult when an undecided prepared tx expires — roll forward if the
+    # coordinator committed, roll back otherwise. Reference:
+    # metanode/transaction.go:1, partition_fsmop_transaction.go.
+
+    def _tx_lock_owner(self, parent: int, name: str) -> str | None:
+        for tx_id, tx in self.tx_pending.items():
+            for op in tx["ops"]:
+                if op["parent"] == parent and (
+                    op["name"] == name or op["kind"] == "guard_empty_dir"
+                ):
+                    # a guard op locks the WHOLE parent (no new children
+                    # may appear under a dir being replaced)
+                    return tx_id
+        return None
+
+    def _check_unlocked(self, parent: int, name: str, tx_id: str | None = None):
+        owner = self._tx_lock_owner(parent, name)
+        if owner is not None and owner != tx_id:
+            raise MetaError(
+                EBUSY, f"dentry ({parent}, {name!r}) locked by tx {owner}"
+            )
+
+    def _gc_tx(self, now: float) -> None:
+        # commit records that name participants are GC'd only by
+        # tx_finish (after every participant has provably resolved) — a
+        # TTL here would let a long-partitioned participant later read
+        # "unknown" and roll BACK a committed tx. Recordless (local)
+        # commits expire by TTL.
+        for k in [k for k, v in self.tx_committed.items()
+                  if not v.get("parts") and now - v["ts"] > self.TX_COMMIT_TTL]:
+            del self.tx_committed[k]
+
+    def _apply_tx_prepare(self, r: dict) -> dict:
+        """r: {tx_id, ops: [...], coord, parts?, ts}. Op kinds:
+          * ``link`` — install parent/name -> ino, replacing the target
+            the client validated (`victim` = expected current ino or
+            None; asserted here, and the key stays locked until commit,
+            so the target cannot change in between).
+          * ``rm`` — remove; with `ino`, assert the dentry still points
+            at it.
+          * ``guard_empty_dir`` — assert the dir's local dentry map is
+            empty and lock the whole parent so no child can be created
+            while a replace-over-dir tx is in flight.
+        On the COORDINATOR, `parts` lists the participant partitions so
+        its scanner can push the decision and only GC the commit record
+        once every participant has resolved."""
+        tx_id = r["tx_id"]
+        now = r.get("ts", time.time())
+        self._gc_tx(now)
+        if tx_id in self.tx_pending or tx_id in self.tx_committed:
+            return {}  # idempotent retry
+        for op in r["ops"]:
+            self._check_unlocked(op["parent"], op["name"], tx_id)
+            if op["kind"] == "guard_empty_dir":
+                children = self.dentries.get(op["parent"])
+                if children:
+                    raise MetaError(
+                        ENOTEMPTY, f"dir {op['parent']} not empty")
+                continue
+            d = self.dentries.get(op["parent"])
+            if d is None:
+                raise MetaError(ENOENT, f"parent dir {op['parent']} not here")
+            if op["kind"] == "rm":
+                if op["name"] not in d:
+                    raise MetaError(ENOENT, f"{op['name']!r} not in {op['parent']}")
+                if op.get("ino") is not None and d[op["name"]] != op["ino"]:
+                    raise MetaError(ENOENT, f"{op['name']!r} changed under tx")
+            elif "victim" in op and d.get(op["name"]) != op["victim"]:
+                raise MetaError(
+                    ENOENT, f"target {op['name']!r} changed under tx")
+        self.tx_pending[tx_id] = {
+            "ops": r["ops"], "ts": now, "coord": r.get("coord"),
+            "parts": r.get("parts"),
+        }
+        return {}
+
+    def _apply_tx_commit(self, r: dict) -> dict:
+        tx_id = r["tx_id"]
+        done = self.tx_committed.get(tx_id)
+        if done is not None:
+            return {"victims": done["victims"]}  # idempotent retry
+        tx = self.tx_pending.pop(tx_id, None)
+        if tx is None:
+            raise MetaError(ENOENT, f"tx {tx_id} not prepared here")
+        victims: list[int] = []
+        for op in tx["ops"]:
+            if op["kind"] == "guard_empty_dir":
+                continue
+            d = self.dentries.setdefault(op["parent"], {})
+            if op["kind"] == "rm":
+                d.pop(op["name"], None)
+            else:
+                old = d.get(op["name"])
+                if old is not None and old != op["ino"]:
+                    victims.append(old)
+                d[op["name"]] = op["ino"]
+        self.tx_committed[tx_id] = {
+            "victims": victims, "ts": r.get("ts", time.time()),
+            "parts": tx.get("parts"),
+        }
+        return {"victims": victims}
+
+    def _apply_tx_finish(self, r: dict) -> dict:
+        """Coordinator-only: every participant has resolved — the commit
+        record is no longer needed for recovery and can be dropped."""
+        self.tx_committed.pop(r["tx_id"], None)
+        return {}
+
+    def _apply_tx_abort(self, r: dict) -> dict:
+        self.tx_pending.pop(r["tx_id"], None)
+        return {}
+
+    def _apply_rename_local(self, r: dict) -> dict:
+        """Atomic same-partition rename: unlink src and (re)link dst in
+        ONE fsm apply — no intermediate double-link or missing-link state
+        is ever visible or persisted. Returns the replaced victim inode
+        (or None). The client validates POSIX type rules and passes its
+        expectations ("ino" for src, "victim" for dst); the apply
+        re-asserts them, so a concurrent mutation between validation and
+        apply fails cleanly instead of silently clobbering."""
+        sp, sn = r["src_parent"], r["src_name"]
+        dp, dn = r["dst_parent"], r["dst_name"]
+        self._check_unlocked(sp, sn)
+        self._check_unlocked(dp, dn)
+        sd = self.dentries.get(sp)
+        if sd is None or sn not in sd:
+            raise MetaError(ENOENT, f"{sn!r} not in {sp}")
+        if r.get("ino") is not None and sd[sn] != r["ino"]:
+            raise MetaError(ENOENT, f"{sn!r} changed under rename")
+        dd = self.dentries.get(dp)
+        if dd is None:
+            raise MetaError(ENOENT, f"parent dir {dp} not here")
+        victim = dd.get(dn)
+        if "victim" in r and victim != r["victim"]:
+            raise MetaError(ENOENT, f"target {dn!r} changed under rename")
+        if victim is not None and self.dentries.get(victim):
+            # victim is a dir with local children: re-assert emptiness
+            # inside the atomic apply (the client's check raced)
+            raise MetaError(ENOTEMPTY, f"target dir {victim} not empty")
+        ino = sd.pop(sn)
+        if victim == ino:
+            victim = None
+        dd[dn] = ino
+        return {"victim": victim}
+
+    def tx_status(self, tx_id: str) -> str:
+        with self._lock:
+            if tx_id in self.tx_committed:
+                return "committed"
+            if tx_id in self.tx_pending:
+                return "pending"
+            return "unknown"
+
+    def expired_txs(self, now: float | None = None) -> list[tuple[str, dict]]:
+        now = time.time() if now is None else now
+        with self._lock:
+            return [(tx_id, dict(tx)) for tx_id, tx in self.tx_pending.items()
+                    if now - tx["ts"] > self.TX_TTL]
 
     def _apply_append_extents(self, r: dict) -> dict:
         inode = self.inodes.get(r["ino"])
@@ -301,6 +484,8 @@ class MetaNode:
 
     REDIRECT = 421  # "not leader; retry at meta['leader']"
 
+    TX_SCAN_INTERVAL = 5.0
+
     def __init__(self, node_id: int, data_dir: str | None = None,
                  addr: str | None = None, node_pool=None):
         self.node_id = node_id
@@ -311,6 +496,10 @@ class MetaNode:
         self.rafts: dict[int, object] = {}  # pid -> RaftNode
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._tx_scanner = threading.Thread(target=self._tx_scan_loop,
+                                            daemon=True)
+        self._tx_scanner.start()
 
     def create_partition(self, pid: int, start: int, end: int,
                          peers: list[str] | None = None) -> MetaPartition:
@@ -364,8 +553,141 @@ class MetaNode:
         return mp
 
     def stop(self) -> None:
+        self._stop.set()
         for r in self.rafts.values():
             r.stop()
+
+    # ---------------- transaction resolution (the TM scan) --------------
+    def _submit_local(self, pid: int, record: dict):
+        """Push a record through the partition's commit door (raft if
+        replicated, direct submit otherwise)."""
+        raft_node = self.rafts.get(pid)
+        if raft_node is None:
+            return self._mp(pid).submit(record)
+        return raft_node.propose(record)
+
+    def _coord_status(self, coord: dict, tx_id: str) -> str:
+        """Ask the coordinator partition whether tx_id committed.
+        Returns committed|pending|unknown; "pending" (= keep waiting) on
+        any doubt, so an unreachable coordinator never causes a
+        unilateral rollback of a possibly-committed tx."""
+        pid = coord["pid"]
+        local = self.partitions.get(pid)
+        if local is not None:
+            node = self.rafts.get(pid)
+            if node is None or node.status()["role"] == "leader":
+                return local.tx_status(tx_id)
+        if self.pool is None:
+            return "pending"
+        try:
+            meta, _ = rpc.call_replicas(
+                self.pool, list(coord.get("addrs") or []), "tx_status",
+                {"pid": pid, "tx_id": tx_id}, timeout=2.0, deadline=4.0)
+            return meta["status"]
+        except Exception:
+            return "pending"
+
+    def _resolve_expired_txs(self) -> None:
+        for pid, mp in list(self.partitions.items()):
+            node = self.rafts.get(pid)
+            if node is not None and node.status()["role"] != "leader":
+                continue
+            for tx_id, tx in mp.expired_txs():
+                coord = tx.get("coord")
+                if coord and coord.get("pid") != pid:
+                    st = self._coord_status(coord, tx_id)
+                    if st == "pending":
+                        continue  # coordinator undecided: keep waiting
+                    op = "tx_commit" if st == "committed" else "tx_abort"
+                else:
+                    # we ARE the coordinator and the client never decided
+                    # within the TTL: abort (participants will follow)
+                    op = "tx_abort"
+                try:
+                    self._submit_local(pid, {
+                        "op": op, "tx_id": tx_id, "ts": time.time(),
+                        "op_id": f"txres-{tx_id}-{op}",
+                    })
+                except Exception:
+                    pass  # retried on the next scan
+
+    def _push_committed_txs(self) -> None:
+        """Coordinator side: push the commit decision to any participant
+        still pending, and drop the commit record (tx_finish) once every
+        participant has provably resolved — the presumed-abort hazard of
+        a TTL-based GC never arises."""
+        for pid, mp in list(self.partitions.items()):
+            node = self.rafts.get(pid)
+            if node is not None and node.status()["role"] != "leader":
+                continue
+            with mp._lock:
+                items = [(tx_id, dict(rec))
+                         for tx_id, rec in mp.tx_committed.items()
+                         if rec.get("parts")]
+            for tx_id, rec in items:
+                all_resolved = True
+                for part in rec["parts"]:
+                    st = self._participant_status(part, tx_id)
+                    if st == "pending":
+                        all_resolved = self._push_commit(part, tx_id) and all_resolved
+                    elif st is None:  # unreachable: keep the record
+                        all_resolved = False
+                if all_resolved:
+                    try:
+                        self._submit_local(pid, {
+                            "op": "tx_finish", "tx_id": tx_id,
+                            "op_id": f"txfin-{tx_id}",
+                        })
+                    except Exception:
+                        pass
+
+    def _participant_status(self, part: dict, tx_id: str) -> str | None:
+        local = self.partitions.get(part["pid"])
+        if local is not None:
+            node = self.rafts.get(part["pid"])
+            if node is None or node.status()["role"] == "leader":
+                return local.tx_status(tx_id)
+        if self.pool is None:
+            return None
+        try:
+            meta, _ = rpc.call_replicas(
+                self.pool, list(part.get("addrs") or []), "tx_status",
+                {"pid": part["pid"], "tx_id": tx_id}, timeout=2.0,
+                deadline=4.0)
+            return meta["status"]
+        except Exception:
+            return None
+
+    def _push_commit(self, part: dict, tx_id: str) -> bool:
+        record = {"op": "tx_commit", "tx_id": tx_id, "ts": time.time(),
+                  "op_id": f"txpush-{tx_id}"}
+        local = self.partitions.get(part["pid"])
+        if local is not None:
+            node = self.rafts.get(part["pid"])
+            if node is None or node.status()["role"] == "leader":
+                try:
+                    self._submit_local(part["pid"], record)
+                    return True
+                except Exception:
+                    return False
+        if self.pool is None:
+            return False
+        try:
+            rpc.call_replicas(
+                self.pool, list(part.get("addrs") or []), "submit",
+                {"pid": part["pid"], "record": record}, timeout=5.0,
+                deadline=6.0)
+            return True
+        except Exception:
+            return False
+
+    def _tx_scan_loop(self) -> None:
+        while not self._stop.wait(self.TX_SCAN_INTERVAL):
+            try:
+                self._resolve_expired_txs()
+                self._push_committed_txs()
+            except Exception:
+                pass
 
     # ---------------- RPC surface ----------------
     def rpc_create_partition(self, args, body):
@@ -414,6 +736,9 @@ class MetaNode:
 
     def rpc_dentry_count(self, args, body):
         return {"count": self._mp_leader(args["pid"]).dentry_count(args["parent"])}
+
+    def rpc_tx_status(self, args, body):
+        return {"status": self._mp_leader(args["pid"]).tx_status(args["tx_id"])}
 
     def rpc_snapshot(self, args, body):
         self._mp(args["pid"]).snapshot()

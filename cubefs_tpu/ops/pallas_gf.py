@@ -27,7 +27,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import bitlin
 
-DEFAULT_TILE = 4096  # bytes of shard per grid step (per-tile VMEM ~ N*T + 8N*T)
+# Bytes of shard per grid step. VMEM per step ~ (C + 8C + 4*8R + R) * T
+# for C input shards and R output rows: at T=32KiB and RS(12+4) repair
+# (C=12, R<=6) that is ~8 MiB — comfortably inside a v5e core's ~16 MiB
+# VMEM while amortizing grid overhead far better than tiny tiles.
+# bench.py autotunes over TILE_CANDIDATES on real hardware.
+DEFAULT_TILE = 32768
+TILE_CANDIDATES = (8192, 16384, 32768)
 
 
 def _kernel(w_ref, x_ref, o_ref):
@@ -58,6 +64,13 @@ def _apply_fn(coeff_bytes: bytes, rows: int, cols: int, tile: int,
         """(N, S) uint8 -> (R, S) uint8; S must be a tile multiple."""
         n, s = shards.shape
         grid = (s // tile,)
+        kwargs = {}
+        if not interpret:
+            # every grid step writes a disjoint output tile: let Mosaic
+            # schedule them in any order / overlapping DMA
+            kwargs["compiler_params"] = pltpu.CompilerParams(
+                dimension_semantics=("parallel",)
+            )
         return pl.pallas_call(
             _kernel,
             out_shape=jax.ShapeDtypeStruct((rows, s), jnp.uint8),
@@ -71,6 +84,7 @@ def _apply_fn(coeff_bytes: bytes, rows: int, cols: int, tile: int,
             out_specs=pl.BlockSpec((rows, tile), lambda i: (0, i),
                                    memory_space=pltpu.VMEM),
             interpret=interpret,
+            **kwargs,
         )(w, shards)
 
     return apply

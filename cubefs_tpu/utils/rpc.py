@@ -287,3 +287,49 @@ class Client:
                     continue
                 raise
         raise RpcError(503, f"{self._addr}/{method}: leader unresolved")
+
+
+def call_replicas(pool: NodePool, addrs: list[str], method: str,
+                  args: dict | None = None, body: bytes = b"",
+                  timeout: float = 30.0,
+                  deadline: float = 10.0) -> tuple[dict, bytes]:
+    """Call one member of a replica set, following 421 leader redirects
+    (with election backoff) and failing over across replicas on
+    transport errors / 5xx / 404. The ONE redirect-following loop shared
+    by the meta SDK and the metanode tx scanner — raises the last error
+    if no replica answers."""
+    import time as _t
+
+    last: Exception | None = None
+    tried: set[str] = set()
+    queue = list(addrs)
+    end = _t.time() + deadline
+    while queue and _t.time() < end:
+        addr = queue.pop(0)
+        if addr in tried:
+            continue
+        try:
+            return pool.get(addr).call(method, args, body, timeout)
+        except RpcError as e:
+            if e.code == Client.REDIRECT:
+                leader = e.message.removeprefix("leader=").strip()
+                if leader and leader not in tried:
+                    queue.insert(0, leader)
+                elif not leader:  # election in progress: retry shortly
+                    _t.sleep(0.05)
+                    queue.append(addr)
+                last = e
+                continue
+            if isinstance(e, ServiceUnavailable) or e.code >= 500 or e.code == 404:
+                # 404 = method/partition not on that node (dead or stale
+                # view): fail over like a down node
+                tried.add(addr)
+                last = e
+                continue
+            raise
+        except (OSError, urllib.error.URLError) as e:
+            tried.add(addr)
+            last = e
+            continue
+    raise last if last else RpcError(
+        503, f"{method}: no replica reachable of {addrs}")

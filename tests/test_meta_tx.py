@@ -1,0 +1,424 @@
+"""Metanode transactions: POSIX atomic rename (replace-existing),
+concurrent renames, and two-phase crash recovery — no crash point may
+leave a file linked twice or lost (reference: metanode/transaction.go,
+partition_fsmop_transaction.go)."""
+
+import threading
+import time
+
+import pytest
+
+from cubefs_tpu.blob.access import NodePool
+from cubefs_tpu.fs import metanode as mn
+from cubefs_tpu.fs.client import FileSystem, FsError
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+
+
+class FsCluster:
+    def __init__(self, tmp_path, n_data=3, n_meta=2, mp_count=2):
+        self.pool = NodePool()
+        self.master = Master(self.pool)
+        self.pool.bind("master", self.master)
+        self.metas, self.datas = [], []
+        for i in range(n_meta):
+            addr = f"meta{i}"
+            node = MetaNode(i, data_dir=str(tmp_path / f"meta{i}"),
+                            addr=addr, node_pool=self.pool)
+            self.pool.bind(addr, node)
+            self.master.register_metanode(addr)
+            self.metas.append(node)
+        for i in range(n_data):
+            addr = f"data{i}"
+            node = DataNode(i, str(tmp_path / f"data{i}"), addr, self.pool)
+            self.pool.bind(addr, node)
+            self.master.register_datanode(addr)
+            self.datas.append(node)
+        self.view = self.master.create_volume("vol1", mp_count=mp_count,
+                                              dp_count=3)
+        self.fs = FileSystem(self.view, self.pool)
+
+    def stop(self):
+        for m in self.metas:
+            m.stop()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = FsCluster(tmp_path)
+    yield c
+    c.stop()
+
+
+def _dirs_on_distinct_mps(fs):
+    """Create directories until two land on different meta partitions;
+    returns (path_a, ino_a, path_b, ino_b)."""
+    first_path, first_ino = "/d0", fs.mkdir("/d0")
+    first_pid = fs.meta._mp_for(first_ino)["pid"]
+    for i in range(1, 64):
+        p = f"/d{i}"
+        ino = fs.mkdir(p)
+        if fs.meta._mp_for(ino)["pid"] != first_pid:
+            return first_path, first_ino, p, ino
+    raise AssertionError("could not place dirs on distinct partitions")
+
+
+def test_rename_replaces_existing_file(cluster):
+    fs = cluster.fs
+    fs.write_file("/src", b"new content")
+    fs.write_file("/dst", b"old content")
+    victim_ino = fs.resolve("/dst")
+    fs.rename("/src", "/dst")
+    assert fs.read_file("/dst") == b"new content"
+    with pytest.raises(FsError):
+        fs.resolve("/src")
+    with pytest.raises(FsError):  # victim inode is gone
+        fs.meta.inode_get(victim_ino)
+
+
+def test_rename_dir_over_empty_dir_and_type_errors(cluster):
+    fs = cluster.fs
+    fs.mkdir("/a")
+    fs.write_file("/a/f", b"x")
+    fs.mkdir("/empty")
+    fs.rename("/a", "/empty")  # dir over empty dir: allowed
+    assert fs.read_file("/empty/f") == b"x"
+    fs.mkdir("/nonempty")
+    fs.write_file("/nonempty/g", b"y")
+    fs.mkdir("/b")
+    with pytest.raises(FsError) as e:
+        fs.rename("/b", "/nonempty")
+    assert e.value.errno == mn.ENOTEMPTY
+    fs.write_file("/file", b"z")
+    with pytest.raises(FsError):  # dir over file
+        fs.rename("/b", "/file")
+    with pytest.raises(FsError):  # file over dir
+        fs.rename("/file", "/b")
+
+
+def test_rename_cross_partition(cluster):
+    fs = cluster.fs
+    pa, ia, pb, ib = _dirs_on_distinct_mps(fs)
+    fs.write_file(f"{pa}/src", b"payload")
+    fs.rename(f"{pa}/src", f"{pb}/dst")
+    assert fs.read_file(f"{pb}/dst") == b"payload"
+    with pytest.raises(FsError):
+        fs.resolve(f"{pa}/src")
+    # replace-existing across partitions
+    fs.write_file(f"{pa}/src2", b"v2")
+    fs.write_file(f"{pb}/dst", b"old", append=False)
+    fs.rename(f"{pa}/src2", f"{pb}/dst")
+    assert fs.read_file(f"{pb}/dst") == b"v2"
+
+
+def test_concurrent_renames_single_winner(cluster):
+    """Two movers race the same source to different destinations:
+    exactly one wins, the file exists exactly once afterwards."""
+    fs = cluster.fs
+    for trial in range(4):
+        src = f"/race{trial}"
+        fs.write_file(src, b"contested")
+        results = {}
+
+        def mover(dst, key):
+            try:
+                fs.rename(src, dst)
+                results[key] = "ok"
+            except FsError as e:
+                results[key] = e
+
+        t1 = threading.Thread(target=mover, args=(f"/w{trial}a", "a"))
+        t2 = threading.Thread(target=mover, args=(f"/w{trial}b", "b"))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        wins = [k for k, v in results.items() if v == "ok"]
+        assert len(wins) >= 1
+        # however the race resolved, the inode is linked exactly once
+        links = [p for p in (f"/w{trial}a", f"/w{trial}b", src)
+                 if _exists(fs, p)]
+        assert len(links) == 1, (results, links)
+
+
+def _exists(fs, path):
+    try:
+        fs.resolve(path)
+        return True
+    except FsError:
+        return False
+
+
+def _find_pending(cluster, tx_id):
+    out = []
+    for node in cluster.metas:
+        for mp in node.partitions.values():
+            if tx_id in mp.tx_pending:
+                out.append((node, mp))
+    return out
+
+
+def _force_expiry(cluster, tx_id):
+    for node in cluster.metas:
+        for mp in node.partitions.values():
+            with mp._lock:
+                if tx_id in mp.tx_pending:
+                    mp.tx_pending[tx_id]["ts"] -= mp.TX_TTL + 1
+
+
+def _scan_all(cluster):
+    for node in cluster.metas:
+        node._resolve_expired_txs()
+
+
+def _scan_until_resolved(cluster, tx_id, timeout=5.0):
+    """Scan + wait: the leader resolves immediately; follower replicas
+    converge via raft replication a heartbeat later."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        _scan_all(cluster)
+        if not _find_pending(cluster, tx_id):
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"tx {tx_id} still pending on "
+        f"{[(n.node_id, mp.pid) for n, mp in _find_pending(cluster, tx_id)]}"
+    )
+
+
+def test_tx_crash_after_coordinator_commit_rolls_forward(cluster):
+    """Client dies between coordinator-commit and participant-commit:
+    the participant's expired tx consults the coordinator's durable
+    decision and rolls FORWARD — never a double link."""
+    fs = cluster.fs
+    pa, ia, pb, ib = _dirs_on_distinct_mps(fs)
+    fs.write_file(f"{pa}/f", b"data")
+    ino = fs.resolve(f"{pa}/f")
+    meta = fs.meta
+    src_mp = meta._mp_for(ia)
+    dst_mp = meta._mp_for(ib)
+    tx_id = "crashtx1"
+    coord = {"pid": dst_mp["pid"],
+             "addrs": list(dst_mp.get("addrs") or [dst_mp["addr"]])}
+    ts = time.time()
+    meta._call(dst_mp, "submit", {"record": {
+        "op": "tx_prepare", "tx_id": tx_id, "coord": coord, "ts": ts,
+        "ops": [{"kind": "link", "parent": ib, "name": "moved", "ino": ino}]}})
+    meta._call(src_mp, "submit", {"record": {
+        "op": "tx_prepare", "tx_id": tx_id, "coord": coord, "ts": ts,
+        "ops": [{"kind": "rm", "parent": ia, "name": "f", "ino": ino}]}})
+    # coordinator commits; then the "client" crashes
+    meta._call(dst_mp, "submit", {"record": {
+        "op": "tx_commit", "tx_id": tx_id, "ts": ts}})
+    assert _exists(fs, f"{pb}/moved")
+    assert len(_find_pending(cluster, tx_id)) >= 1  # src still prepared
+    _force_expiry(cluster, tx_id)
+    _scan_until_resolved(cluster, tx_id)
+    assert _exists(fs, f"{pb}/moved")
+    assert not _exists(fs, f"{pa}/f"), "rolled forward: src link removed"
+
+
+def test_tx_crash_before_decision_rolls_back(cluster):
+    """Client dies after both prepares but before any commit: both
+    partitions roll back; the original link is intact."""
+    fs = cluster.fs
+    pa, ia, pb, ib = _dirs_on_distinct_mps(fs)
+    fs.write_file(f"{pa}/g", b"data")
+    ino = fs.resolve(f"{pa}/g")
+    meta = fs.meta
+    src_mp = meta._mp_for(ia)
+    dst_mp = meta._mp_for(ib)
+    tx_id = "crashtx2"
+    coord = {"pid": dst_mp["pid"],
+             "addrs": list(dst_mp.get("addrs") or [dst_mp["addr"]])}
+    ts = time.time()
+    meta._call(dst_mp, "submit", {"record": {
+        "op": "tx_prepare", "tx_id": tx_id, "coord": coord, "ts": ts,
+        "ops": [{"kind": "link", "parent": ib, "name": "gone", "ino": ino}]}})
+    meta._call(src_mp, "submit", {"record": {
+        "op": "tx_prepare", "tx_id": tx_id, "coord": coord, "ts": ts,
+        "ops": [{"kind": "rm", "parent": ia, "name": "g", "ino": ino}]}})
+    _force_expiry(cluster, tx_id)
+    # first scan: coordinator aborts itself; second: participant sees
+    # "unknown" at the coordinator and follows
+    _scan_until_resolved(cluster, tx_id)
+    assert _exists(fs, f"{pa}/g"), "rolled back: original link intact"
+    assert not _exists(fs, f"{pb}/gone")
+
+
+def test_tx_locks_block_conflicting_mutations(cluster):
+    """While a tx holds a dentry lock, plain mutations on that dentry
+    fail EBUSY instead of interleaving with the transaction."""
+    fs = cluster.fs
+    pa, ia, pb, ib = _dirs_on_distinct_mps(fs)
+    fs.write_file(f"{pa}/locked", b"data")
+    ino = fs.resolve(f"{pa}/locked")
+    meta = fs.meta
+    src_mp = meta._mp_for(ia)
+    tx_id = "locktx"
+    ts = time.time()
+    meta._call(src_mp, "submit", {"record": {
+        "op": "tx_prepare", "tx_id": tx_id,
+        "coord": {"pid": src_mp["pid"], "addrs": []}, "ts": ts,
+        "ops": [{"kind": "rm", "parent": ia, "name": "locked", "ino": ino}]}})
+    with pytest.raises(FsError) as e:
+        fs.unlink(f"{pa}/locked")
+    assert e.value.errno == mn.EBUSY
+    meta._call(src_mp, "submit", {"record": {"op": "tx_abort", "tx_id": tx_id}})
+    fs.unlink(f"{pa}/locked")  # lock released
+
+
+def test_rename_survives_metanode_restartless_replay(cluster, tmp_path):
+    """rename_local is ONE oplog record: replay after 'crash' (fresh
+    MetaPartition over the same dir) yields the renamed state, never an
+    intermediate."""
+    fs = cluster.fs
+    fs.write_file("/r1", b"abc")
+    fs.rename("/r1", "/r2")
+    # find a standalone partition with an oplog and reload it
+    for node in cluster.metas:
+        for pid, mp in node.partitions.items():
+            if mp.data_dir:
+                clone = mn.MetaPartition(mp.pid, mp.start, mp.end,
+                                         data_dir=mp.data_dir)
+                assert clone.dentries == mp.dentries
+
+
+def test_rename_into_own_subtree_einval(cluster):
+    fs = cluster.fs
+    fs.mkdir("/top")
+    fs.mkdir("/top/mid")
+    with pytest.raises(FsError) as e:
+        fs.rename("/top", "/top/mid/loop")
+    assert e.value.errno == 22  # EINVAL
+    with pytest.raises(FsError):
+        fs.rename("/top", "/top/self")
+    assert _exists(fs, "/top/mid")  # nothing was detached
+
+
+def test_rename_victim_changed_race_detected(cluster):
+    """If the dst dentry changes between the client's validation and the
+    apply, the rename fails instead of silently clobbering."""
+    fs = cluster.fs
+    fs.write_file("/rsrc", b"new")
+    fs.write_file("/rdst", b"old")
+    ino = fs.resolve("/rsrc")
+    parent, _ = fs._parent_of("/rsrc")
+    stale_victim = fs.resolve("/rdst")
+    # simulate the race: someone replaces /rdst after we validated it
+    fs.unlink("/rdst")
+    fs.write_file("/rdst", b"other")
+    with pytest.raises(FsError):
+        fs.meta.rename_local(parent, "rsrc", parent, "rdst", ino,
+                             victim=stale_victim)
+    assert fs.read_file("/rdst") == b"other"  # untouched
+
+
+def test_rename_over_dir_guard_blocks_concurrent_fill(cluster):
+    """A replace-over-dir tx guards the victim dir on ITS partition:
+    prepare fails if the dir is already non-empty, and while prepared no
+    new child can be created under it — the subtree can never be
+    silently orphaned."""
+    fs = cluster.fs
+    fs.mkdir("/vdst")
+    victim = fs.resolve("/vdst")
+    meta = fs.meta
+    gmp = meta._mp_for(victim)
+    # guard on a non-empty dir: prepare fails ENOTEMPTY
+    fs.write_file("/vdst/child", b"x")
+    with pytest.raises(FsError) as e:
+        meta._call(gmp, "submit", {"record": {
+            "op": "tx_prepare", "tx_id": "gtx1", "ts": time.time(),
+            "coord": {"pid": gmp["pid"], "addrs": []},
+            "ops": [{"kind": "guard_empty_dir", "parent": victim,
+                     "name": ""}]}})
+    assert e.value.errno == mn.ENOTEMPTY
+    fs.unlink("/vdst/child")
+    # guard on an empty dir locks out new children until abort
+    meta._call(gmp, "submit", {"record": {
+        "op": "tx_prepare", "tx_id": "gtx2", "ts": time.time(),
+        "coord": {"pid": gmp["pid"], "addrs": []},
+        "ops": [{"kind": "guard_empty_dir", "parent": victim,
+                 "name": ""}]}})
+    with pytest.raises(FsError) as e:
+        fs.write_file("/vdst/sneaky", b"y")
+    assert e.value.errno == mn.EBUSY
+    meta._call(gmp, "submit", {"record": {"op": "tx_abort", "tx_id": "gtx2"}})
+    fs.write_file("/vdst/ok", b"z")  # lock released
+
+
+def test_rename_over_remote_dir_victim_uses_guarded_tx(cluster):
+    """When the victim dir's children live on another partition, the
+    rename routes through the guarded tx even if both parents share a
+    partition — end-to-end replace-over-empty-dir works and the victim
+    inode is cleaned up."""
+    fs = cluster.fs
+    # find a dir victim whose inode lands on a different mp than root
+    root_pid = fs.meta._mp_for(1)["pid"]
+    victim_path = None
+    for i in range(32):
+        p = f"/vic{i}"
+        ino = fs.mkdir(p)
+        if fs.meta._mp_for(ino)["pid"] != root_pid:
+            victim_path = p
+            victim_ino = ino
+            break
+    assert victim_path, "no cross-mp dir victim found"
+    fs.mkdir("/mover")
+    fs.write_file("/mover/f", b"inside")
+    fs.rename("/mover", victim_path)
+    assert fs.read_file(f"{victim_path}/f") == b"inside"
+    with pytest.raises(FsError):
+        fs.meta.inode_get(victim_ino)  # victim inode cleaned up
+
+
+def test_commit_record_retained_until_participants_resolve(cluster):
+    """The coordinator keeps the commit decision until every participant
+    has resolved (pushed or queried), then drops it via tx_finish — a
+    long-partitioned participant can never read "unknown" for a
+    committed tx."""
+    fs = cluster.fs
+    pa, ia, pb, ib = _dirs_on_distinct_mps(fs)
+    fs.write_file(f"{pa}/h", b"data")
+    ino = fs.resolve(f"{pa}/h")
+    meta = fs.meta
+    src_mp = meta._mp_for(ia)
+    dst_mp = meta._mp_for(ib)
+    tx_id = "retaintx"
+    coord = {"pid": dst_mp["pid"],
+             "addrs": list(dst_mp.get("addrs") or [dst_mp["addr"]])}
+    parts = [{"pid": src_mp["pid"],
+              "addrs": list(src_mp.get("addrs") or [src_mp["addr"]])}]
+    ts = time.time()
+    meta._call(dst_mp, "submit", {"record": {
+        "op": "tx_prepare", "tx_id": tx_id, "coord": coord, "parts": parts,
+        "ts": ts,
+        "ops": [{"kind": "link", "parent": ib, "name": "kept", "ino": ino,
+                 "victim": None}]}})
+    meta._call(src_mp, "submit", {"record": {
+        "op": "tx_prepare", "tx_id": tx_id, "coord": coord, "ts": ts,
+        "ops": [{"kind": "rm", "parent": ia, "name": "h", "ino": ino}]}})
+    meta._call(dst_mp, "submit", {"record": {
+        "op": "tx_commit", "tx_id": tx_id, "ts": ts}})
+
+    def committed_somewhere():
+        # the coordinator's decision record (the one carrying the
+        # participant list) is what must persist until resolution;
+        # participants keep plain idempotency records that TTL out
+        return any(
+            tx_id in mp.tx_committed and mp.tx_committed[tx_id].get("parts")
+            for node in cluster.metas
+            for mp in node.partitions.values())
+
+    assert committed_somewhere()
+    # coordinator scan pushes the commit to the pending participant and
+    # then finishes (drops) the record
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        for node in cluster.metas:
+            node._push_committed_txs()
+        if not _find_pending(cluster, tx_id) and not committed_somewhere():
+            break
+        time.sleep(0.05)
+    assert not _find_pending(cluster, tx_id)
+    assert not committed_somewhere(), "commit record dropped after resolution"
+    assert _exists(fs, f"{pb}/kept")
+    assert not _exists(fs, f"{pa}/h")
