@@ -208,10 +208,43 @@ class MetaWrapper:
         res = self._call(dst_mp, "submit", {"record": {
             "op": "tx_commit", "tx_id": tx_id, "ts": ts}})
         for mp_, _ in part_preps:
-            self._call(mp_, "submit", {"record": {
-                "op": "tx_commit", "tx_id": tx_id, "ts": ts}})
+            try:
+                self._call(mp_, "submit", {"record": {
+                    "op": "tx_commit", "tx_id": tx_id, "ts": ts}})
+            except (FsError, rpc.RpcError):
+                # the coordinator's commit IS the outcome: a transiently
+                # unreachable participant gets the decision pushed by the
+                # coordinator's scanner; reporting failure here would be
+                # wrong (and would skip the victim cleanup)
+                pass
         victims = res[0]["result"].get("victims") or []
         return victims[0] if victims else None
+
+    # ---- the cluster-wide dir-rename mutex (s_vfs_rename_mutex analog):
+    # cross-directory DIR renames serialize on one named lock on the
+    # root-owning partition, so two concurrent dir moves cannot weave a
+    # detached cycle past each other's ancestry checks. Held as a
+    # prepared tx: a crashed holder is auto-released by TX_TTL expiry.
+    def lock_dir_rename(self, timeout: float = 10.0) -> str:
+        mp = self._mp_for(1)
+        tx_id = uuid.uuid4().hex
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self._call(mp, "submit", {"record": {
+                    "op": "tx_prepare", "tx_id": tx_id, "ts": time.time(),
+                    "coord": self._mp_ref(mp),
+                    "ops": [{"kind": "mutex", "parent": 0,
+                             "name": "__dir_rename__"}]}})
+                return tx_id
+            except FsError as e:
+                if e.errno != 16 or time.time() > deadline:  # EBUSY
+                    raise
+                time.sleep(0.05)
+
+    def unlock_dir_rename(self, tx_id: str) -> None:
+        self._call(self._mp_for(1), "submit", {"record": {
+            "op": "tx_abort", "tx_id": tx_id}})
 
 
 class ExtentClient:
@@ -535,28 +568,42 @@ class FileSystem:
                     raise FsError(mn.ENOTEMPTY, f"{new_name!r} not empty")
             elif src["type"] == mn.DIR:
                 raise FsError(mn.ENOTDIR, f"{new_name!r} is not a directory")
-        if src["type"] == mn.DIR and self._in_subtree(ino, new_parent):
-            # POSIX: renaming a dir into its own subtree is EINVAL — it
-            # would detach the subtree into an unreachable cycle
-            raise FsError(22, "cannot move a directory into itself")
-        src_mp = self.meta._mp_for(old_parent)
-        dst_mp = self.meta._mp_for(new_parent)
-        # the single-apply fast path needs every touched structure on ONE
-        # partition: both parent dentry maps, and (for a dir victim) the
-        # victim's own children map — its emptiness is re-asserted inside
-        # the apply, which only sees local state
-        local_ok = src_mp["pid"] == dst_mp["pid"] and not (
-            victim_is_dir
-            and self.meta._mp_for(victim_ino)["pid"] != src_mp["pid"]
-        )
-        if local_ok:
-            victim = self.meta.rename_local(
-                old_parent, old_name, new_parent, new_name, ino,
-                victim=victim_ino)
-        else:
-            victim = self.meta.rename_tx(
-                old_parent, old_name, new_parent, new_name, ino,
-                victim=victim_ino, victim_is_dir=victim_is_dir)
+        # cross-directory DIR moves serialize on the cluster-wide rename
+        # mutex, then re-run the ancestry check under it: two concurrent
+        # dir moves can no longer weave a detached cycle past each
+        # other's checks (the kernel does the same with
+        # s_vfs_rename_mutex)
+        dir_move = src["type"] == mn.DIR and old_parent != new_parent
+        mutex_tx = self.meta.lock_dir_rename() if dir_move else None
+        try:
+            if src["type"] == mn.DIR and self._in_subtree(ino, new_parent):
+                # POSIX: renaming a dir into its own subtree is EINVAL —
+                # it would detach the subtree into an unreachable cycle
+                raise FsError(22, "cannot move a directory into itself")
+            src_mp = self.meta._mp_for(old_parent)
+            dst_mp = self.meta._mp_for(new_parent)
+            # the single-apply fast path needs every touched structure on
+            # ONE partition: both parent dentry maps, and (for a dir
+            # victim) the victim's own children map — its emptiness is
+            # re-asserted inside the apply, which only sees local state
+            local_ok = src_mp["pid"] == dst_mp["pid"] and not (
+                victim_is_dir
+                and self.meta._mp_for(victim_ino)["pid"] != src_mp["pid"]
+            )
+            if local_ok:
+                victim = self.meta.rename_local(
+                    old_parent, old_name, new_parent, new_name, ino,
+                    victim=victim_ino)
+            else:
+                victim = self.meta.rename_tx(
+                    old_parent, old_name, new_parent, new_name, ino,
+                    victim=victim_ino, victim_is_dir=victim_is_dir)
+        finally:
+            if mutex_tx is not None:
+                try:
+                    self.meta.unlock_dir_rename(mutex_tx)
+                except (FsError, rpc.RpcError):
+                    pass  # TX_TTL expiry releases a stranded lock
         if victim is not None:
             # replaced target: drop its inode + storage (post-commit
             # cleanup; a crash here leaves an unreferenced inode for

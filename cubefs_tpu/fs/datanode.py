@@ -4,13 +4,24 @@ Role parity: datanode/ — per-partition extent storage on the native
 engine (datanode/storage), leader→followers chain replication with ack
 aggregation (repl/repl_protocol.go:311 sendRequestToAllFollowers), CRC
 fingerprint diffing for replica repair (data_partition_repair.go:102).
+
+Writes take two paths, like the reference:
+  * APPENDS (beyond the extent's written end) ride the chain — leader
+    writes locally and fans out to followers, acking when all applied.
+  * OVERWRITES of already-written ranges go through a PER-PARTITION
+    RAFT group (datanode/partition_raft.go, ApplyRandomWrite at
+    partition_op_by_raft.go:224): concurrent overwrites commit in one
+    total order on every replica, so a leader change mid-storm cannot
+    leave replicas diverged the way racing chain-forwards could.
 """
 
 from __future__ import annotations
 
+import base64
 import json
 import os
 import threading
+import time
 
 from ..utils import rpc
 from .extent_store import BlockCrcError, ExtentError, ExtentStore
@@ -22,6 +33,7 @@ class DataPartition:
         self.store = ExtentStore(path)
         self.peers = list(peers)  # all replica addrs incl. leader
         self.leader = leader
+        self.raft = None  # per-dp raft group for the random-write path
         self._meta_path = os.path.join(path, "dp_meta.json")
         self._lock = threading.Lock()
         self.next_extent = 1
@@ -31,6 +43,13 @@ class DataPartition:
             self.peers = meta.get("peers", self.peers)
             self.leader = meta.get("leader", self.leader)
         self._persist()
+
+    def apply_random_write(self, entry: dict) -> dict:
+        """Raft apply: serialize one overwrite onto the local store —
+        runs identically on every replica at the same log position."""
+        self.store.write(entry["extent_id"], entry["offset"],
+                         base64.b64decode(entry["data"]))
+        return {}
 
     def _persist(self) -> None:
         tmp = self._meta_path + ".tmp"
@@ -55,16 +74,19 @@ class DataNode:
         self.addr = addr
         self.nodes = node_pool  # addr -> rpc client (for chain forward)
         self.partitions: dict[int, DataPartition] = {}
+        self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = threading.RLock()
         self.broken = False
         os.makedirs(root_dir, exist_ok=True)
-        # reopen partitions found on disk
+        # reopen partitions found on disk (raft rejoins via its wal once
+        # the master re-pushes the peer set through create_partition)
         for name in os.listdir(root_dir):
             if name.startswith("dp_") and os.path.isdir(os.path.join(root_dir, name)):
                 dp_id = int(name[3:])
-                self.partitions[dp_id] = DataPartition(
-                    dp_id, os.path.join(root_dir, name), [], ""
-                )
+                dp = DataPartition(dp_id, os.path.join(root_dir, name), [], "")
+                self.partitions[dp_id] = dp
+                if len(dp.peers) > 1:
+                    self._start_dp_raft(dp)
 
     def create_partition(self, dp_id: int, peers: list[str], leader: str) -> None:
         with self._lock:
@@ -76,6 +98,29 @@ class DataNode:
                 dp = self.partitions[dp_id]
                 dp.peers, dp.leader = list(peers), leader
                 dp._persist()
+            dp = self.partitions[dp_id]
+            if dp.raft is not None:
+                current = set(dp.raft.peers) | {self.addr}
+                if current != set(dp.peers):
+                    # master re-pushed a changed replica set (e.g. dead
+                    # replica swapped): restart the group on the new
+                    # membership over the same wal (crude but safe
+                    # reconfiguration — no joint consensus yet)
+                    dp.raft.stop()
+                    dp.raft = None
+            if dp.raft is None and len(dp.peers) > 1:
+                self._start_dp_raft(dp)
+
+    def _start_dp_raft(self, dp: DataPartition) -> None:
+        from ..parallel import raft as raftlib
+
+        node = raftlib.RaftNode(
+            f"dp{dp.dp_id}", self.addr, dp.peers, dp.apply_random_write,
+            self.nodes,
+            data_dir=os.path.join(self.root, f"dp_{dp.dp_id}", "raft"),
+        )
+        raftlib.register_routes(self.extra_routes, node)
+        dp.raft = node.start()
 
     def _dp(self, dp_id: int) -> DataPartition:
         if self.broken:
@@ -90,8 +135,13 @@ class DataNode:
               chain: bool = True) -> None:
         """Leader entry point: local write then parallel forward to the
         followers; the write acks only when EVERY replica applied it
-        (3-replica strong consistency, like the repl chain)."""
+        (3-replica strong consistency, like the repl chain). Overwrites
+        of already-written ranges divert to the per-dp raft group."""
         dp = self._dp(dp_id)
+        if (chain and dp.raft is not None
+                and offset < dp.store.size(extent_id)):
+            self._random_write(dp, extent_id, offset, data)
+            return
         dp.store.write(extent_id, offset, data)
         if not chain:
             return
@@ -118,6 +168,41 @@ class DataNode:
         if errs:
             peers = ", ".join(p for p, _ in errs)
             raise rpc.RpcError(500, f"chain write failed on {peers}: {errs[0][1]}")
+
+    def _random_write(self, dp: DataPartition, extent_id: int, offset: int,
+                      data: bytes, attempts: int = 4) -> None:
+        """Commit an overwrite through the dp raft group, forwarding to
+        the current raft leader if this replica isn't it (ApplyRandomWrite
+        analog: one total order for overwrites across leader changes)."""
+        from ..parallel.raft import NotLeaderError
+
+        entry = {"op": "random_write", "extent_id": extent_id,
+                 "offset": offset, "data": base64.b64encode(data).decode()}
+        last: Exception | None = None
+        for _ in range(attempts):
+            try:
+                # wait_all: readers may hit ANY replica right after the
+                # ack (k-faster selection), so the overwrite must be
+                # applied everywhere before acking — the same contract
+                # the chain gives appends
+                dp.raft.propose(entry, wait_all=True)
+                return
+            except NotLeaderError as e:
+                last = e
+                if not e.leader or e.leader == self.addr:
+                    time.sleep(0.1)  # election in progress
+                    continue
+                try:
+                    self.nodes.get(e.leader).call(
+                        "write", {"dp_id": dp.dp_id, "extent_id": extent_id,
+                                  "offset": offset}, data, timeout=15.0)
+                    return
+                except Exception as fwd_err:
+                    last = fwd_err
+                    time.sleep(0.1)
+            except TimeoutError as e:
+                last = e
+        raise rpc.RpcError(503, f"dp {dp.dp_id} random write failed: {last}")
 
     def read(self, dp_id: int, extent_id: int, offset: int, length: int) -> bytes:
         dp = self._dp(dp_id)
@@ -196,5 +281,7 @@ class DataNode:
 
     def stop(self) -> None:
         for dp in self.partitions.values():
+            if dp.raft is not None:
+                dp.raft.stop()
             dp.store.close()
         self.partitions.clear()

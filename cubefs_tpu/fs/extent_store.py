@@ -6,11 +6,17 @@ per-128KiB-block CRC32 headers, whole-extent crc-of-crcs
 autoComputeExtentCrc:718). The TPU tie-in: block CRC tables read out via
 block_crcs() feed the batched CRC kernel for scrub/repair verification
 (a whole disk's blocks re-CRC'd as one device batch).
+
+Every native call (and its error fetch) runs under a store-level lock
+with a liveness check, so close() racing in-flight ops — e.g. a raft
+apply arriving while the node shuts down — raises ExtentError instead
+of handing the C engine a freed handle.
 """
 
 from __future__ import annotations
 
 import ctypes
+import threading
 import zlib
 
 import numpy as np
@@ -31,18 +37,27 @@ class BlockCrcError(ExtentError):
 class ExtentStore:
     def __init__(self, directory: str):
         self._lib = rt.load()
+        self._lock = threading.RLock()
         self._h = self._lib.es_open(directory.encode())
         if not self._h:
             raise ExtentError(f"cannot open extent store at {directory}")
         self.directory = directory
 
     def _err(self) -> str:
+        # caller holds self._lock with the handle verified live
         return (self._lib.es_last_error(self._h) or b"").decode()
 
+    def _handle(self):
+        h = self._h
+        if not h:
+            raise ExtentError(f"extent store {self.directory} is closed")
+        return h
+
     def close(self) -> None:
-        if self._h:
-            self._lib.es_close(self._h)
-            self._h = None
+        with self._lock:
+            if self._h:
+                self._lib.es_close(self._h)
+                self._h = None
 
     def __enter__(self):
         return self
@@ -51,34 +66,44 @@ class ExtentStore:
         self.close()
 
     def create(self, extent_id: int) -> None:
-        if self._lib.es_create(self._h, extent_id) != 0:
-            raise ExtentError(self._err())
+        with self._lock:
+            if self._lib.es_create(self._handle(), extent_id) != 0:
+                raise ExtentError(self._err())
 
     def write(self, extent_id: int, offset: int, data: bytes | np.ndarray) -> None:
         buf = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
-        if self._lib.es_write(self._h, extent_id, offset, buf, len(buf)) != 0:
-            raise ExtentError(self._err())
+        with self._lock:
+            if self._lib.es_write(self._handle(), extent_id, offset, buf,
+                                  len(buf)) != 0:
+                raise ExtentError(self._err())
 
     def read(self, extent_id: int, offset: int, length: int) -> bytes:
         buf = ctypes.create_string_buffer(length)
-        rc = self._lib.es_read(self._h, extent_id, offset, buf, length)
+        with self._lock:
+            rc = self._lib.es_read(self._handle(), extent_id, offset, buf,
+                                   length)
+            err = self._err() if rc < 0 else None
         if rc == -2:
-            raise BlockCrcError(self._err())
+            raise BlockCrcError(err)
         if rc < 0:
-            raise ExtentError(self._err())
+            raise ExtentError(err)
         return buf.raw[:rc]
 
     def size(self, extent_id: int) -> int:
-        return self._lib.es_size(self._h, extent_id)
+        with self._lock:
+            return self._lib.es_size(self._handle(), extent_id)
 
     def block_crcs(self, extent_id: int) -> np.ndarray:
         n = (self.size(extent_id) + BLOCK_SIZE - 1) // BLOCK_SIZE
         out = np.zeros(max(n, 1), dtype=np.uint32)
-        got = self._lib.es_block_crcs(
-            self._h, extent_id, out.ctypes.data_as(ctypes.c_void_p), out.size
-        )
+        with self._lock:
+            got = self._lib.es_block_crcs(
+                self._handle(), extent_id,
+                out.ctypes.data_as(ctypes.c_void_p), out.size
+            )
+            err = self._err() if got < 0 else None
         if got < 0:
-            raise ExtentError(self._err())
+            raise ExtentError(err)
         return out[:got]
 
     def extent_crc(self, extent_id: int) -> int:
@@ -97,9 +122,11 @@ class ExtentStore:
         return sorted(out)
 
     def delete(self, extent_id: int) -> None:
-        if self._lib.es_delete(self._h, extent_id) != 0:
-            raise ExtentError(self._err())
+        with self._lock:
+            if self._lib.es_delete(self._handle(), extent_id) != 0:
+                raise ExtentError(self._err())
 
     def sync(self, extent_id: int) -> None:
-        if self._lib.es_sync(self._h, extent_id) != 0:
-            raise ExtentError(self._err())
+        with self._lock:
+            if self._lib.es_sync(self._handle(), extent_id) != 0:
+                raise ExtentError(self._err())

@@ -300,6 +300,12 @@ class MetaPartition:
             return {}  # idempotent retry
         for op in r["ops"]:
             self._check_unlocked(op["parent"], op["name"], tx_id)
+            if op["kind"] == "mutex":
+                # pure named lock (no dentry semantics): held from
+                # prepare to commit/abort — the cluster-wide
+                # serialization primitive for cross-directory dir
+                # renames (the kernel's s_vfs_rename_mutex analog)
+                continue
             if op["kind"] == "guard_empty_dir":
                 children = self.dentries.get(op["parent"])
                 if children:
@@ -333,7 +339,7 @@ class MetaPartition:
             raise MetaError(ENOENT, f"tx {tx_id} not prepared here")
         victims: list[int] = []
         for op in tx["ops"]:
-            if op["kind"] == "guard_empty_dir":
+            if op["kind"] in ("guard_empty_dir", "mutex"):
                 continue
             d = self.dentries.setdefault(op["parent"], {})
             if op["kind"] == "rm":

@@ -101,6 +101,15 @@ class RaftNode:
                 # the next load
                 self._persist_entries([], rewrote=True)
         self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+        # one long-lived replication thread per peer (the tiglabs-raft
+        # dedicated-transport analog): signaled on propose/leadership,
+        # self-firing every HEARTBEAT while leader — no per-heartbeat
+        # thread churn even with hundreds of groups in one process
+        self._repl_events = {p: threading.Event() for p in self.peers}
+        self._repl_threads = [
+            threading.Thread(target=self._repl_loop, args=(p,), daemon=True)
+            for p in self.peers
+        ]
 
     # ---------------- index helpers (absolute <-> list) ----------------
     def _last_index(self) -> int:
@@ -222,10 +231,14 @@ class RaftNode:
     # ---------------- lifecycle ----------------
     def start(self) -> "RaftNode":
         self._ticker.start()
+        for t in self._repl_threads:
+            t.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        for ev in self._repl_events.values():
+            ev.set()  # wake replication threads so they exit promptly
         with self._apply_cv:
             self._apply_cv.notify_all()
 
@@ -247,10 +260,28 @@ class RaftNode:
             if want_compact:
                 self.take_snapshot()
             if role == "leader":
-                self._broadcast_append()
+                # replication (incl. heartbeats) is driven by the
+                # per-peer threads; nothing to do here
                 time.sleep(self.HEARTBEAT)
             elif overdue:
                 self._run_election()
+
+    def _repl_loop(self, peer: str) -> None:
+        ev = self._repl_events[peer]
+        while not self._stop.is_set():
+            with self._lock:
+                leading = self.role == "leader"
+            if not leading:
+                # block with no timeout: woken by _become_leader/stop,
+                # so follower groups cost zero idle wakeups
+                ev.wait()
+                ev.clear()
+                continue
+            # append first (immediate on election or signal), then pace:
+            # a signal mid-wait short-circuits straight into the next one
+            self._append_to(peer)
+            ev.wait(self.HEARTBEAT)
+            ev.clear()
 
     # ---------------- snapshot / compaction ----------------
     def take_snapshot(self) -> None:
@@ -270,6 +301,8 @@ class RaftNode:
             self._persist_entries([], rewrote=True)
 
     def handle_install_snapshot(self, args: dict, body: bytes) -> dict:
+        if self._stop.is_set():
+            return {"ok": False, "term": 0}
         with self._lock:
             if args["term"] < self.term:
                 return {"ok": False, "term": self.term}
@@ -357,6 +390,8 @@ class RaftNode:
             rec = {"term": self.term, "entry": dict(self.NOOP)}
             self.log.append(rec)
             self._persist_entries([rec], rewrote=False)
+        for ev in self._repl_events.values():
+            ev.set()  # wake blocked follower-mode repl threads
         self._broadcast_append()
 
     def _step_down(self, term: int) -> None:
@@ -369,12 +404,22 @@ class RaftNode:
         self._election_due = self._rand_timeout()
 
     # ---------------- replication ----------------
-    def propose(self, entry: dict, timeout: float = 5.0):
+    def propose(self, entry: dict, timeout: float = 5.0,
+                wait_all: bool = False):
         """Leader-only: append + replicate + wait for commit+apply.
         Returns the state machine's apply result (re-raising the apply
         exception if the op failed deterministically). A leadership
         change that drops the entry raises NotLeaderError — never a
-        false success."""
+        false success.
+
+        wait_all=True additionally waits until EVERY peer has
+        acknowledged replication through this entry before returning
+        (all-replica ack, the chain-replication consistency contract):
+        use it when readers may hit any replica right after the ack.
+        Raises TimeoutError if a peer stays behind — the entry is
+        committed, but not yet everywhere."""
+        if self._stop.is_set():
+            raise NotLeaderError(None, "node stopped")
         with self._lock:
             if self.role != "leader":
                 raise NotLeaderError(self.leader)
@@ -394,6 +439,15 @@ class RaftNode:
                 self._apply_cv.wait(remaining)
             result, exc = self._results.pop(index)
             self._waiting.pop(index, None)
+            if exc is None and wait_all:
+                while any(self.match_index.get(p, 0) < index
+                          for p in self.peers):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or self._stop.is_set():
+                        raise TimeoutError(
+                            f"entry {index} committed but not yet on all "
+                            f"replicas")
+                    self._apply_cv.wait(remaining)
         if exc is not None:
             raise exc
         return result
@@ -402,13 +456,12 @@ class RaftNode:
         with self._lock:
             if self.role != "leader":
                 return
-            peers = list(self.peers)
-        if not peers:  # single node: commit = log end
+        if not self.peers:  # single node: commit = log end
             with self._lock:
                 self._advance_commit()
             return
-        for p in peers:
-            threading.Thread(target=self._append_to, args=(p,), daemon=True).start()
+        for ev in self._repl_events.values():
+            ev.set()
 
     def _append_to(self, peer: str) -> None:
         snapshot_args = None
@@ -445,6 +498,7 @@ class RaftNode:
                     elif meta.get("ok"):
                         self.match_index[peer] = snapshot_args["index"]
                         self.next_index[peer] = snapshot_args["index"] + 1
+                        self._apply_cv.notify_all()
                 return
             meta, _ = self.pool.get(peer).call(
                 f"raft_{self.group_id}_append", args, timeout=1.0
@@ -461,6 +515,7 @@ class RaftNode:
                 self.match_index[peer] = args["prev_index"] + len(args["entries"])
                 self.next_index[peer] = self.match_index[peer] + 1
                 self._advance_commit()
+                self._apply_cv.notify_all()  # wait_all proposers watch match
             else:
                 hint = meta.get("conflict_index")
                 self.next_index[peer] = max(
@@ -508,6 +563,8 @@ class RaftNode:
 
     # ---------------- RPC handlers ----------------
     def handle_vote(self, args: dict, body: bytes) -> dict:
+        if self._stop.is_set():
+            return {"granted": False, "term": 0}
         with self._lock:
             if args["term"] < self.term:
                 return {"granted": False, "term": self.term}
@@ -524,6 +581,10 @@ class RaftNode:
             return {"granted": False, "term": self.term}
 
     def handle_append(self, args: dict, body: bytes) -> dict:
+        # a stopped node must not apply entries: its FSM's resources
+        # (stores, files) may already be closed
+        if self._stop.is_set():
+            return {"ok": False, "term": 0}
         with self._lock:
             if args["term"] < self.term:
                 return {"ok": False, "term": self.term}

@@ -152,10 +152,12 @@ def test_s3_gateway_with_sigv4(tmp_path, rng):
         node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
         pool.bind(f"meta{i}", node)
         master.register_metanode(f"meta{i}")
+    datas = []
     for i in range(3):
         node = DataNode(i, str(tmp_path / f"d{i}"), f"data{i}", pool)
         pool.bind(f"data{i}", node)
         master.register_datanode(f"data{i}")
+        datas.append(node)
     view = master.create_volume("secvol", mp_count=1, dp_count=2)
     fs = FileSystem(view, pool)
 
@@ -198,5 +200,7 @@ def test_s3_gateway_with_sigv4(tmp_path, rng):
         assert code == 403
     finally:
         s3.stop()
+        for d in datas:
+            d.stop()
         for i in range(2):
             pool.get(f"meta{i}")._target.stop()

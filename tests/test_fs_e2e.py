@@ -43,6 +43,8 @@ class FsCluster:
     def stop(self):
         for m in self.metas:
             m.stop()
+        for d in self.datas:
+            d.stop()
 
 
 @pytest.fixture

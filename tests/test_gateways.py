@@ -25,16 +25,20 @@ def fscluster(tmp_path):
         node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
         pool.bind(f"meta{i}", node)
         master.register_metanode(f"meta{i}")
+    datas = []
     for i in range(3):
         node = DataNode(i, str(tmp_path / f"d{i}"), f"data{i}", pool)
         pool.bind(f"data{i}", node)
         master.register_datanode(f"data{i}")
+        datas.append(node)
     view = master.create_volume("s3vol", mp_count=1, dp_count=2)
     fs = FileSystem(view, pool)
     fs._meta_nodes = [pool.get(f"meta{i}")._target for i in range(2)]
     yield fs
     for n in fs._meta_nodes:
         n.stop()
+    for d in datas:
+        d.stop()
 
 
 def _req(method, url, data=None):
