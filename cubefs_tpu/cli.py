@@ -39,11 +39,22 @@ def main(argv=None):
     p_cluster.add_argument("--clustermgr")
 
     p_vol = sub.add_parser("vol")
-    p_vol.add_argument("action", choices=["create", "view"])
+    p_vol.add_argument("action", choices=["create", "view", "update"])
     p_vol.add_argument("name")
     p_vol.add_argument("--master", required=True)
     p_vol.add_argument("--mp-count", type=int, default=3)
     p_vol.add_argument("--dp-count", type=int, default=4)
+    p_vol.add_argument("--capacity", type=int,
+                       help="volume capacity in bytes (0 = unlimited)")
+
+    p_quota = sub.add_parser("quota")
+    p_quota.add_argument("action", choices=["set", "list", "delete", "enforce"])
+    p_quota.add_argument("--master", required=True)
+    p_quota.add_argument("--vol", required=True)
+    p_quota.add_argument("--path", help="quota dir path (for set)")
+    p_quota.add_argument("--qid", type=int, help="quota id (for delete)")
+    p_quota.add_argument("--max-bytes", type=int, default=0)
+    p_quota.add_argument("--max-files", type=int, default=0)
 
     p_fs = sub.add_parser("fs")
     p_fs.add_argument("action",
@@ -72,8 +83,34 @@ def main(argv=None):
             out = master.call("create_volume", {
                 "name": args.name, "mp_count": args.mp_count,
                 "dp_count": args.dp_count})[0]
+        elif args.action == "update":
+            if args.capacity is None:
+                sys.exit("vol update needs --capacity")
+            out = master.call("set_vol_capacity", {
+                "name": args.name, "capacity": args.capacity})[0]
         else:
             out = master.call("client_view", {"name": args.name})[0]
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "quota":
+        master = rpc.Client(args.master)
+        if args.action == "set":
+            if not args.path:
+                sys.exit("quota set needs --path")
+            fs_args = argparse.Namespace(master=args.master, vol=args.vol)
+            dir_ino = _fs(fs_args).resolve(args.path)
+            out = master.call("set_quota", {
+                "name": args.vol, "dir_ino": dir_ino,
+                "max_bytes": args.max_bytes, "max_files": args.max_files})[0]
+        elif args.action == "delete":
+            if args.qid is None:
+                sys.exit("quota delete needs --qid")
+            out = master.call("delete_quota",
+                              {"name": args.vol, "qid": args.qid})[0]
+        elif args.action == "enforce":
+            out = master.call("enforce_quotas", {})[0]
+        else:
+            out = master.call("list_quotas", {"name": args.vol})[0]
         print(json.dumps(out, indent=2))
 
     elif args.group == "fs":

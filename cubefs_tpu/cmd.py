@@ -104,7 +104,8 @@ def run_role(cfg: dict):
         vols = {}
         for bucket, vol_name in cfg.get("vols", {}).items():
             view = master.call("client_view", {"name": vol_name})[0]["volume"]
-            vols[bucket] = FileSystem(view, pool)
+            vols[bucket] = FileSystem(view, pool,
+                                      master_addr=cfg["master_addr"])
         auth = None
         if cfg.get("users"):  # [{access_key, secret_key, grants:{vol:perm}}]
             from .fs.authnode import UserStore
@@ -130,7 +131,8 @@ def run_role(cfg: dict):
 
         master = rpc.Client(cfg["master_addr"])
         view = master.call("client_view", {"name": cfg["vol"]})[0]["volume"]
-        m = fuse_mount(FileSystem(view, pool), cfg["mountpoint"])
+        m = fuse_mount(FileSystem(view, pool, master_addr=cfg["master_addr"]),
+                       cfg["mountpoint"])
         print(f"[fuseclient] {cfg['vol']} mounted at {cfg['mountpoint']}",
               flush=True)
         return m, m

@@ -57,6 +57,9 @@ class MetaWrapper:
             return rpc.call_replicas(self.nodes, addrs, method, payload,
                                      deadline=10.0)
         except rpc.RpcError as e:
+            if e.code == 499 and e.message.startswith("errno="):
+                errno_ = int(e.message[len("errno="):].split(":", 1)[0])
+                raise FsError(errno_, e.message) from None
             if 400 <= e.code < 500 and e.code not in (404, self.REDIRECT):
                 raise FsError(e.code - 400, e.message) from None
             raise
@@ -68,13 +71,16 @@ class MetaWrapper:
             return mp
 
     # ---- inode/dentry API (reference sdk/meta/api.go shapes) ----
-    def inode_create(self, typ: str, mode: int = 0o644, target=None) -> dict:
+    def inode_create(self, typ: str, mode: int = 0o644, target=None,
+                     quota_ids: list[int] | None = None) -> dict:
         mp = self.pick_create_mp()
         ino = self._call(mp, "alloc_ino", {})[0]["ino"]
         rec = {"op": "mk_inode", "ino": ino, "type": typ, "mode": mode,
                "ts": time.time()}
         if target is not None:
             rec["target"] = target
+        if quota_ids:
+            rec["quota_ids"] = list(quota_ids)
         self._call(mp, "submit", {"record": rec})
         return self.inode_get(ino)
 
@@ -459,9 +465,39 @@ class ExtentClient:
 class FileSystem:
     """Path-level facade over meta + data clients (the VFS layer)."""
 
-    def __init__(self, vol_view: dict, node_pool):
+    QUOTA_TTL = 30.0  # seconds between quota-table refreshes
+
+    def __init__(self, vol_view: dict, node_pool, master_addr: str | None = None):
         self.meta = MetaWrapper(vol_view, node_pool)
         self.data = ExtentClient(vol_view, node_pool)
+        self.vol_name = vol_view.get("name")
+        self.nodes = node_pool
+        self.master_addr = master_addr
+        # dir_ino -> [qid]: files created under a quota dir inherit its
+        # ids (master_quota_manager.go analog); long-lived clients with a
+        # master configured re-pull the table every QUOTA_TTL, so quotas
+        # set after mount still take effect (sdk/meta quota-cache analog)
+        self.quotas: dict[int, list[int]] = {}
+        self._quota_ts = time.time()
+        self.update_quotas(vol_view.get("quotas") or {})
+
+    def update_quotas(self, quotas: dict) -> None:
+        table: dict[int, list[int]] = {}
+        for qid, q in quotas.items():
+            table.setdefault(int(q["dir_ino"]), []).append(int(qid))
+        self.quotas = table
+
+    def _maybe_refresh_quotas(self) -> None:
+        if (self.master_addr is None
+                or time.time() - self._quota_ts < self.QUOTA_TTL):
+            return
+        self._quota_ts = time.time()  # even on failure: don't hammer
+        try:
+            view = self.nodes.get(self.master_addr).call(
+                "client_view", {"name": self.vol_name})[0]["volume"]
+            self.update_quotas(view.get("quotas") or {})
+        except Exception:
+            pass  # stale table; retried after the next TTL
 
     # ---- path helpers ----
     def resolve(self, path: str) -> int:
@@ -471,13 +507,30 @@ class FileSystem:
         return ino
 
     def _parent_of(self, path: str) -> tuple[int, str]:
+        parent, _, name = self._walk_parent(path)
+        return parent, name
+
+    def _walk_parent(self, path: str) -> tuple[int, list[int], str]:
+        """Resolve the parent dir, returning (parent_ino, ancestor_inos
+        incl. parent, leaf_name) — the ancestor chain feeds quota
+        inheritance."""
         parts = [p for p in path.split("/") if p]
         if not parts:
             raise FsError(22, "root has no parent")
         parent = mn.ROOT_INO
+        chain = [parent]
         for part in parts[:-1]:
             parent = self.meta.lookup(parent, part)
-        return parent, parts[-1]
+            chain.append(parent)
+        return parent, chain, parts[-1]
+
+    def _inherited_quota_ids(self, ancestors: list[int]) -> list[int]:
+        out: list[int] = []
+        for ino in ancestors:
+            for qid in self.quotas.get(ino, []):
+                if qid not in out:
+                    out.append(qid)
+        return out
 
     # ---- files & dirs ----
     def mkdir(self, path: str, mode: int = 0o755) -> int:
@@ -491,8 +544,10 @@ class FileSystem:
         return inode["ino"]
 
     def create(self, path: str, mode: int = 0o644) -> int:
-        parent, name = self._parent_of(path)
-        inode = self.meta.inode_create(mn.FILE, mode)
+        self._maybe_refresh_quotas()
+        parent, ancestors, name = self._walk_parent(path)
+        qids = self._inherited_quota_ids(ancestors)
+        inode = self.meta.inode_create(mn.FILE, mode, quota_ids=qids)
         try:
             self.meta.dentry_create(parent, name, inode["ino"])
         except FsError:

@@ -58,6 +58,18 @@ class DataPartition:
                        "peers": self.peers, "leader": self.leader}, f)
         os.replace(tmp, self._meta_path)
 
+    def extent_lock(self, extent_id: int) -> threading.Lock:
+        """Per-extent writer lock, held by the DESIGNATED leader across a
+        whole write (classify + replicate-everywhere). Both paths ack
+        only when every replica applied, so serializing initiation here
+        totally orders overlapping writes — a chain append and a raft
+        overwrite can never interleave differently on different
+        replicas."""
+        with self._lock:
+            if not hasattr(self, "_ext_locks"):
+                self._ext_locks = {}
+            return self._ext_locks.setdefault(extent_id, threading.Lock())
+
     def alloc_extent(self) -> int:
         with self._lock:
             eid = self.next_extent
@@ -132,19 +144,36 @@ class DataNode:
 
     # ---------------- write path (chain replication) ----------------
     def write(self, dp_id: int, extent_id: int, offset: int, data: bytes,
-              chain: bool = True) -> None:
-        """Leader entry point: local write then parallel forward to the
-        followers; the write acks only when EVERY replica applied it
-        (3-replica strong consistency, like the repl chain). Overwrites
-        of already-written ranges divert to the per-dp raft group."""
+              chain: bool = True, hops: int = 2) -> None:
+        """Write entry point. Follower legs (chain=False) apply locally.
+        Everything else routes to the DESIGNATED leader, which holds the
+        per-extent lock across the whole operation and classifies it
+        exactly once: appends ride the chain, overwrites of
+        already-written ranges divert to the per-dp raft group. Both
+        paths ack only when every replica applied, so the lock totally
+        orders overlapping writes — no replica can see a chain append
+        and a raft overwrite in different orders."""
         dp = self._dp(dp_id)
-        if (chain and dp.raft is not None
-                and offset < dp.store.size(extent_id)):
-            self._random_write(dp, extent_id, offset, data)
-            return
-        dp.store.write(extent_id, offset, data)
         if not chain:
+            dp.store.write(extent_id, offset, data)
             return
+        if dp.leader and dp.leader != self.addr:
+            if hops <= 0:
+                raise rpc.RpcError(503, f"dp {dp_id}: leader route loop")
+            self.nodes.get(dp.leader).call(
+                "write", {"dp_id": dp_id, "extent_id": extent_id,
+                          "offset": offset, "hops": hops - 1},
+                data, timeout=30.0)
+            return
+        with dp.extent_lock(extent_id):
+            if dp.raft is not None and offset < dp.store.size(extent_id):
+                self._random_write(dp, extent_id, offset, data)
+                return
+            dp.store.write(extent_id, offset, data)
+            self._chain_forward(dp, extent_id, offset, data)
+
+    def _chain_forward(self, dp: DataPartition, extent_id: int, offset: int,
+                       data: bytes) -> None:
         errs = []
         followers = [p for p in dp.peers if p != self.addr]
         threads = []
@@ -153,7 +182,8 @@ class DataNode:
             try:
                 self.nodes.get(peer).call(
                     "write_replica",
-                    {"dp_id": dp_id, "extent_id": extent_id, "offset": offset},
+                    {"dp_id": dp.dp_id, "extent_id": extent_id,
+                     "offset": offset},
                     data, timeout=15.0,
                 )
             except Exception as e:
@@ -193,9 +223,12 @@ class DataNode:
                     time.sleep(0.1)  # election in progress
                     continue
                 try:
+                    # dedicated forward: the raft leader proposes as-is,
+                    # never re-classifies (its local extent size may lag)
                     self.nodes.get(e.leader).call(
-                        "write", {"dp_id": dp.dp_id, "extent_id": extent_id,
-                                  "offset": offset}, data, timeout=15.0)
+                        "random_write_forward",
+                        {"dp_id": dp.dp_id, "extent_id": extent_id,
+                         "offset": offset}, data, timeout=15.0)
                     return
                 except Exception as fwd_err:
                     last = fwd_err
@@ -242,7 +275,17 @@ class DataNode:
         return {"extent_id": self._dp(args["dp_id"]).alloc_extent()}
 
     def rpc_write(self, args, body):
-        self.write(args["dp_id"], args["extent_id"], args["offset"], body)
+        self.write(args["dp_id"], args["extent_id"], args["offset"], body,
+                   hops=args.get("hops", 2))
+        return {}
+
+    def rpc_random_write_forward(self, args, body):
+        # raft-leader leg of an overwrite classified by the designated
+        # leader: propose only, never re-classify
+        dp = self._dp(args["dp_id"])
+        if dp.raft is None:
+            raise rpc.RpcError(500, f"dp {args['dp_id']} has no raft group")
+        self._random_write(dp, args["extent_id"], args["offset"], body)
         return {}
 
     def rpc_write_replica(self, args, body):

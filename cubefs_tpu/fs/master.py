@@ -37,6 +37,9 @@ class Master(ReplicatedFsm):
         self.datanodes: dict[str, dict] = {}  # addr -> info (heartbeat-local)
         self.metanodes: dict[str, dict] = {}
         self.volumes: dict[str, dict] = {}
+        # soft usage view from the latest quota sweep — NOT part of the
+        # replicated FSM (a new leader re-learns it on its first sweep)
+        self.vol_usage: dict[str, int] = {}
         self._next_pid = 1
         self._next_dp = 1
         self.data_dir = data_dir
@@ -70,6 +73,107 @@ class Master(ReplicatedFsm):
                              + [m["pid"] + 1 for m in vol["mps"]])
         self._next_dp = max([self._next_dp]
                             + [d["dp_id"] + 1 for d in vol["dps"]])
+
+    # ---------------- quotas (master_quota_manager.go analog) ----------
+    def _apply_set_vol_capacity(self, name: str, capacity: int) -> None:
+        self.volumes[name]["capacity"] = capacity
+
+    def _apply_set_quota(self, name: str, quota: dict) -> None:
+        vol = self.volumes[name]
+        vol.setdefault("quotas", {})[str(quota["qid"])] = quota
+
+    def _apply_delete_quota(self, name: str, qid: int) -> None:
+        self.volumes[name].get("quotas", {}).pop(str(qid), None)
+
+    def set_vol_capacity(self, name: str, capacity: int) -> None:
+        with self._lock:
+            if name not in self.volumes:
+                raise MasterError(f"no volume {name!r}")
+        self._commit({"op": "set_vol_capacity", "name": name,
+                      "capacity": capacity})
+
+    def set_quota(self, name: str, dir_ino: int, max_bytes: int = 0,
+                  max_files: int = 0) -> int:
+        """Register a dir quota; files created under the dir inherit its
+        quota id and metanodes enforce the limits. Returns the quota id
+        (master_quota_manager.go setQuota analog)."""
+        with self._propose_lock:
+            with self._lock:
+                if name not in self.volumes:
+                    raise MasterError(f"no volume {name!r}")
+                quotas = self.volumes[name].get("quotas", {})
+                qid = 1 + max([int(k) for k in quotas], default=0)
+            self._commit({"op": "set_quota", "name": name, "quota": {
+                "qid": qid, "dir_ino": dir_ino, "max_bytes": max_bytes,
+                "max_files": max_files}})
+            return qid
+
+    def delete_quota(self, name: str, qid: int) -> None:
+        with self._lock:
+            if name not in self.volumes:
+                raise MasterError(f"no volume {name!r}")
+            if str(qid) not in self.volumes[name].get("quotas", {}):
+                raise MasterError(f"no quota {qid} on volume {name!r}")
+        self._commit({"op": "delete_quota", "name": name, "qid": qid})
+
+    def list_quotas(self, name: str) -> dict:
+        with self._lock:
+            vol = self.volumes.get(name)
+            if vol is None:
+                raise MasterError(f"no volume {name!r}")
+            return dict(vol.get("quotas", {}))
+
+    def enforce_quotas(self) -> dict:
+        """Aggregation sweep (the reference's quota report/enforce loop):
+        pull per-partition usage from metanode leaders, sum per volume
+        and per quota id, then push vol-full + exceeded-quota flags to
+        every partition replica. Enforcement is advisory-pushed (one
+        sweep of lag), exactly like the reference. Returns the usage
+        summary per volume."""
+        with self._lock:
+            vols = {n: ({"mps": [dict(m) for m in v["mps"]],
+                         "capacity": v.get("capacity", 0),
+                         "quotas": dict(v.get("quotas", {}))})
+                    for n, v in self.volumes.items()}
+        summary = {}
+        for name, v in vols.items():
+            used_bytes = used_files = 0
+            per_quota: dict[str, dict] = {}
+            for mp in v["mps"]:
+                try:
+                    meta, _ = rpc.call_replicas(
+                        self.nodes, mp.get("addrs") or [mp["addr"]],
+                        "usage_report", {"pid": mp["pid"]}, deadline=5.0)
+                except Exception:
+                    continue  # partition unreachable: retried next sweep
+                used_bytes += meta["bytes"]
+                used_files += meta["files"]
+                for qid, u in meta.get("per_quota", {}).items():
+                    agg = per_quota.setdefault(qid, {"bytes": 0, "files": 0})
+                    agg["bytes"] += u["bytes"]
+                    agg["files"] += u["files"]
+            vol_full = bool(v["capacity"]) and used_bytes >= v["capacity"]
+            exceeded = []
+            for qid, q in v["quotas"].items():
+                u = per_quota.get(qid, {"bytes": 0, "files": 0})
+                if ((q["max_bytes"] and u["bytes"] >= q["max_bytes"])
+                        or (q["max_files"] and u["files"] >= q["max_files"])):
+                    exceeded.append(int(qid))
+            for mp in v["mps"]:
+                for addr in mp.get("addrs") or [mp["addr"]]:
+                    try:
+                        self.nodes.get(addr).call("set_enforcement", {
+                            "pid": mp["pid"], "vol_full": vol_full,
+                            "exceeded": exceeded})
+                    except Exception:
+                        pass
+            with self._lock:
+                self.vol_usage[name] = used_bytes
+            summary[name] = {"used_bytes": used_bytes,
+                             "used_files": used_files,
+                             "vol_full": vol_full, "exceeded": exceeded,
+                             "per_quota": per_quota}
+        return summary
 
     def _apply_update_dp(self, name: str, dp_id: int, replicas: list[str],
                          leader: str) -> None:
@@ -180,7 +284,8 @@ class Master(ReplicatedFsm):
             if vol is None:
                 raise MasterError(f"no volume {name!r}")
             return {"name": name, "mps": [dict(m) for m in vol["mps"]],
-                    "dps": [dict(d) for d in vol["dps"]]}
+                    "dps": [dict(d) for d in vol["dps"]],
+                    "quotas": dict(vol.get("quotas", {}))}
 
     # ---------------- failure handling ----------------
     def check_replicas(self) -> list[tuple[int, str, str]]:
@@ -282,6 +387,42 @@ class Master(ReplicatedFsm):
         # a deposed leader must not run datanode-mutating rebuilds
         self._leader_gate()
         return {"actions": self.check_replicas()}
+
+    def rpc_set_quota(self, args, body):
+        self._leader_gate()
+        try:
+            qid = self.set_quota(args["name"], args["dir_ino"],
+                                 args.get("max_bytes", 0),
+                                 args.get("max_files", 0))
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+        return {"qid": qid}
+
+    def rpc_delete_quota(self, args, body):
+        self._leader_gate()
+        try:
+            self.delete_quota(args["name"], args["qid"])
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+        return {}
+
+    def rpc_list_quotas(self, args, body):
+        try:
+            return {"quotas": self.list_quotas(args["name"])}
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+
+    def rpc_set_vol_capacity(self, args, body):
+        self._leader_gate()
+        try:
+            self.set_vol_capacity(args["name"], args["capacity"])
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+        return {}
+
+    def rpc_enforce_quotas(self, args, body):
+        self._leader_gate()
+        return {"summary": self.enforce_quotas()}
 
     def rpc_stat(self, args, body):
         with self._lock:

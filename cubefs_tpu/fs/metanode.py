@@ -38,7 +38,18 @@ EEXIST = 17
 EBUSY = 16
 EISDIR = 21
 ENOTDIR = 20
+ENOSPC = 28
 ENOTEMPTY = 39
+EDQUOT = 122
+
+
+def _rpc_err(e: "MetaError") -> "rpc.RpcError":
+    """Encode a metanode errno for the wire: 400+errno for small errnos
+    (back-compat), or 499 with an errno= prefix for errnos >= 100 (e.g.
+    EDQUOT=122 must not collide with 5xx failover semantics)."""
+    if e.code < 99:
+        return rpc.RpcError(400 + e.code, str(e))
+    return rpc.RpcError(499, f"errno={e.code}: {e}")
 
 
 class MetaPartition:
@@ -62,6 +73,10 @@ class MetaPartition:
         self.apply_id = 0
         self._next_ino = start
         self._op_cache: dict[str, tuple] = {}  # op_id -> (result, err)
+        # advisory enforcement flags pushed by the master's quota sweep
+        # (meta_quota_manager.go analog) — NOT part of the FSM: they gate
+        # the leader's submit door, never the deterministic apply
+        self.enforce = {"vol_full": False, "exceeded": set()}
         self.data_dir = data_dir
         self._oplog = None
         if data_dir:
@@ -209,6 +224,7 @@ class MetaPartition:
             "uid": r.get("uid", 0), "gid": r.get("gid", 0),
             "mtime": now, "ctime": now, "atime": now,
             "extents": [], "xattr": {}, "target": r.get("target"),
+            "quota_ids": list(r.get("quota_ids") or []),
         }
         if r["type"] == DIR:
             self.dentries.setdefault(ino, {})
@@ -478,6 +494,48 @@ class MetaPartition:
         with self._lock:
             return len(self.dentries.get(parent, {}))
 
+    def usage_report(self) -> dict:
+        """Per-partition usage: total file bytes/count plus per-quota-id
+        sums — recomputed from the inode table (deterministic, no delta
+        bookkeeping to drift). The master's quota sweep aggregates these
+        across partitions."""
+        with self._lock:
+            total_b = total_f = 0
+            per_quota: dict[str, dict] = {}
+            for inode in self.inodes.values():
+                if inode["type"] != FILE:
+                    continue
+                total_b += inode["size"]
+                total_f += 1
+                for qid in inode.get("quota_ids") or []:
+                    u = per_quota.setdefault(str(qid), {"bytes": 0, "files": 0})
+                    u["bytes"] += inode["size"]
+                    u["files"] += 1
+            return {"bytes": total_b, "files": total_f,
+                    "per_quota": per_quota}
+
+    def check_limits(self, record: dict) -> None:
+        """Leader-side submit-door gate (never in apply — replicas must
+        stay deterministic): reject writes that exceed pushed limits."""
+        op = record.get("op")
+        with self._lock:
+            enf = self.enforce
+            if op == "mk_inode" and record.get("type") == FILE:
+                if any(int(q) in enf["exceeded"]
+                       for q in record.get("quota_ids") or []):
+                    raise MetaError(EDQUOT, "dir quota exceeded")
+            elif op in ("append_extents", "truncate"):
+                inode = self.inodes.get(record.get("ino"))
+                grows = inode is not None and (
+                    record.get("size", 0) > inode["size"])
+                if not grows:
+                    return
+                if enf["vol_full"]:
+                    raise MetaError(ENOSPC, "volume is full")
+                if inode and any(int(q) in enf["exceeded"]
+                                 for q in inode.get("quota_ids") or []):
+                    raise MetaError(EDQUOT, "dir quota exceeded")
+
 
 class MetaNode:
     """Hosts many MetaPartitions; RPC surface for the meta SDK.
@@ -705,6 +763,7 @@ class MetaNode:
         pid = args["pid"]
         raft_node = self.rafts.get(pid)
         try:
+            self._mp(pid).check_limits(args["record"])
             if raft_node is None:
                 res = self._mp(pid).submit(args["record"])
             else:
@@ -716,7 +775,7 @@ class MetaNode:
                     raise rpc.RpcError(self.REDIRECT,
                                        f"leader={e.leader or ''}") from None
         except MetaError as e:
-            raise rpc.RpcError(400 + e.code, str(e)) from None
+            raise _rpc_err(e) from None
         return {"result": res}
 
     def rpc_alloc_ino(self, args, body):
@@ -726,25 +785,37 @@ class MetaNode:
         try:
             return {"inode": self._mp_leader(args["pid"]).inode_get(args["ino"])}
         except MetaError as e:
-            raise rpc.RpcError(400 + e.code, str(e)) from None
+            raise _rpc_err(e) from None
 
     def rpc_lookup(self, args, body):
         try:
             return {"ino": self._mp_leader(args["pid"]).lookup(args["parent"], args["name"])}
         except MetaError as e:
-            raise rpc.RpcError(400 + e.code, str(e)) from None
+            raise _rpc_err(e) from None
 
     def rpc_readdir(self, args, body):
         try:
             return {"entries": self._mp_leader(args["pid"]).readdir(args["parent"])}
         except MetaError as e:
-            raise rpc.RpcError(400 + e.code, str(e)) from None
+            raise _rpc_err(e) from None
 
     def rpc_dentry_count(self, args, body):
         return {"count": self._mp_leader(args["pid"]).dentry_count(args["parent"])}
 
     def rpc_tx_status(self, args, body):
         return {"status": self._mp_leader(args["pid"]).tx_status(args["tx_id"])}
+
+    def rpc_usage_report(self, args, body):
+        return self._mp_leader(args["pid"]).usage_report()
+
+    def rpc_set_enforcement(self, args, body):
+        # advisory flags from the master's quota sweep; pushed to every
+        # replica so the gate survives leader changes
+        mp = self._mp(args["pid"])
+        with mp._lock:
+            mp.enforce = {"vol_full": bool(args.get("vol_full")),
+                          "exceeded": set(args.get("exceeded") or [])}
+        return {}
 
     def rpc_snapshot(self, args, body):
         self._mp(args["pid"]).snapshot()

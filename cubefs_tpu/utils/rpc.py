@@ -261,7 +261,15 @@ class Client:
             fn = resolve_route(self._target, method)
             if fn is None:
                 raise RpcError(404, f"no such method {method!r}")
-            return _normalize(fn(args or {}, body))
+            try:
+                return _normalize(fn(args or {}, body))
+            except RpcError:
+                raise
+            except Exception as e:
+                # transport parity with HTTP: an unexpected handler error
+                # is a 500, never a raw exception leaking into (and
+                # killing) the caller's thread
+                raise RpcError(500, f"{type(e).__name__}: {e}") from e
         # leader redirects (421 with "leader=<addr>") are followed
         # transparently and the learned leader is preferred afterwards,
         # so a clustermgr failover never strands access/blobnode clients

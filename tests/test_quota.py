@@ -1,0 +1,124 @@
+"""Quota subsystem: volume capacity + per-dir quotas, enforced at the
+metanode submit door from flags pushed by the master's aggregation
+sweep (reference: master/master_quota_manager.go,
+metanode/meta_quota_manager.go)."""
+
+import pytest
+
+from cubefs_tpu.blob.access import NodePool
+from cubefs_tpu.fs import metanode as mn
+from cubefs_tpu.fs.client import FileSystem, FsError
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+
+
+class Cluster:
+    def __init__(self, tmp_path):
+        self.pool = NodePool()
+        self.master = Master(self.pool)
+        self.pool.bind("master", self.master)
+        self.metas, self.datas = [], []
+        for i in range(2):
+            node = MetaNode(i, addr=f"meta{i}", node_pool=self.pool)
+            self.pool.bind(f"meta{i}", node)
+            self.master.register_metanode(f"meta{i}")
+            self.metas.append(node)
+        for i in range(3):
+            node = DataNode(i, str(tmp_path / f"d{i}"), f"data{i}", self.pool)
+            self.pool.bind(f"data{i}", node)
+            self.master.register_datanode(f"data{i}")
+            self.datas.append(node)
+        self.view = self.master.create_volume("qvol", mp_count=2, dp_count=2)
+        self.fs = FileSystem(self.view, self.pool)
+
+    def refresh(self):
+        self.fs.update_quotas(self.master.client_view("qvol")["quotas"])
+
+    def stop(self):
+        for m in self.metas:
+            m.stop()
+        for d in self.datas:
+            d.stop()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    yield c
+    c.stop()
+
+
+def test_volume_capacity_enforced(cluster):
+    fs, master = cluster.fs, cluster.master
+    master.set_vol_capacity("qvol", 10_000)
+    fs.write_file("/a", b"x" * 6_000)
+    s = master.enforce_quotas()["qvol"]
+    assert s["used_bytes"] == 6_000 and not s["vol_full"]
+    fs.write_file("/b", b"y" * 6_000)  # crosses capacity
+    s = master.enforce_quotas()["qvol"]
+    assert s["vol_full"]
+    with pytest.raises(FsError) as e:  # growth now refused
+        fs.write_file("/a", b"z" * 100, append=True)
+    assert e.value.errno == mn.ENOSPC
+    # reads and deletes still work; freeing space lifts the gate
+    assert fs.read_file("/b") == b"y" * 6_000
+    fs.unlink("/b")
+    s = master.enforce_quotas()["qvol"]
+    assert not s["vol_full"]
+    fs.write_file("/a", b"z" * 100, append=True)
+
+
+def test_dir_quota_bytes(cluster):
+    fs, master = cluster.fs, cluster.master
+    qdir = fs.mkdir("/limited")
+    fs.mkdir("/free")
+    qid = master.set_quota("qvol", qdir, max_bytes=5_000)
+    cluster.refresh()
+    fs.write_file("/limited/f1", b"a" * 3_000)
+    master.enforce_quotas()
+    fs.write_file("/limited/f2", b"b" * 3_000)  # crosses the quota
+    s = master.enforce_quotas()["qvol"]
+    assert qid in s["exceeded"]
+    with pytest.raises(FsError) as e:
+        fs.write_file("/limited/f1", b"c" * 10, append=True)
+    assert e.value.errno == mn.EDQUOT
+    # ...but the rest of the volume is unaffected
+    fs.write_file("/free/ok", b"d" * 3_000)
+    # freeing space under the dir lifts the quota gate
+    fs.unlink("/limited/f2")
+    s = master.enforce_quotas()["qvol"]
+    assert qid not in s["exceeded"]
+    fs.write_file("/limited/f1", b"c" * 10, append=True)
+
+
+def test_dir_quota_files_and_nested_inheritance(cluster):
+    fs, master = cluster.fs, cluster.master
+    qdir = fs.mkdir("/counted")
+    fs.mkdir("/counted/sub")
+    qid = master.set_quota("qvol", qdir, max_files=2)
+    cluster.refresh()
+    fs.write_file("/counted/one", b"1")
+    fs.write_file("/counted/sub/two", b"2")  # nested files inherit
+    s = master.enforce_quotas()["qvol"]
+    assert qid in s["exceeded"]
+    assert s["per_quota"][str(qid)]["files"] == 2
+    with pytest.raises(FsError) as e:
+        fs.write_file("/counted/three", b"3")
+    assert e.value.errno == mn.EDQUOT
+    fs.write_file("/elsewhere", b"fine")
+
+
+def test_quota_crud_and_view(cluster):
+    fs, master = cluster.fs, cluster.master
+    d = fs.mkdir("/q")
+    qid = master.set_quota("qvol", d, max_bytes=100)
+    assert str(qid) in master.list_quotas("qvol")
+    view = master.client_view("qvol")
+    assert view["quotas"][str(qid)]["dir_ino"] == d
+    master.delete_quota("qvol", qid)
+    assert master.list_quotas("qvol") == {}
+    # deleting the quota and re-enforcing clears the gate
+    master.enforce_quotas()
+    cluster.refresh()
+    fs.write_file("/q/any", b"x" * 500)
