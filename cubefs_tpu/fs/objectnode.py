@@ -18,7 +18,10 @@ import urllib.parse
 import xml.sax.saxutils as xs
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import json
+
 from . import metanode as mn
+from . import s3policy
 from .client import FileSystem, FsError
 
 
@@ -60,7 +63,9 @@ class ObjectNode:
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
-                if body:
+                # RFC 9110: a HEAD response carries headers only — writing
+                # the body would desync keep-alive clients
+                if body and self.command != "HEAD":
                     self.wfile.write(body)
 
             def _error(self, code, s3code, msg):
@@ -70,35 +75,164 @@ class ObjectNode:
                 ).encode()
                 self._reply(code, body)
 
-            def _authorized(self) -> bool:
+            def _begin(self):
+                """Drain+stash the body and authenticate. Returns the
+                (bucket, key, query) triple, or None if a 403 was
+                already sent. Sets self._principal (None = anonymous)."""
+                if outer.auth is None:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    self._stashed_body = self.rfile.read(n) if n else b""
+                    self._principal = None
+                    return self._split()
+                ok, who, reason = outer.auth.authenticate(self)
+                if not ok:
+                    self._error(403, "AccessDenied",
+                                reason or "bad signature")
+                    return None
+                self._principal = who
+                return self._split()
+
+            def _bucket_conf(self, bucket) -> dict:
+                """ACL/policy/CORS config for the bucket — ONE root-inode
+                fetch per request, cached on the handler."""
+                cache = getattr(self, "_conf_cache", None)
+                if cache is not None and cache[0] == bucket:
+                    return cache[1]
+                conf: dict = {}
+                fs = self._fs(bucket)
+                if fs is not None:
+                    try:
+                        xattr = fs.meta.inode_get(fs.resolve("/"))["xattr"]
+                        conf = {k: xattr.get(k) for k in
+                                (s3policy.XA_ACL, s3policy.XA_POLICY,
+                                 s3policy.XA_CORS)}
+                    except FsError:
+                        conf = {}
+                self._conf_cache = (bucket, conf)
+                return conf
+
+            def _check(self, action, bucket, key="") -> bool:
+                """Authorization (policy -> ACL -> user grant); replies
+                403 and returns False on denial. A gateway with no
+                authenticator configured skips authorization."""
                 if outer.auth is None:
                     return True
-                return outer.auth(self)
+                conf = self._bucket_conf(bucket)
+                acl = conf.get(s3policy.XA_ACL)
+                policy = None
+                raw = conf.get(s3policy.XA_POLICY)
+                if raw:
+                    try:
+                        policy = json.loads(raw)
+                    except json.JSONDecodeError:
+                        policy = None
+                write = action not in s3policy.READ_ACTIONS
+                grant = outer.auth.grant_ok(self._principal, bucket, write)
+                if action.endswith(("BucketPolicy", "BucketAcl",
+                                    "BucketCors")):
+                    # bucket configuration is owner-only: policy/ACL
+                    # cannot grant it away
+                    allowed = grant
+                else:
+                    allowed = s3policy.authorize(
+                        action, bucket, key, self._principal, acl, policy,
+                        grant)
+                if not allowed:
+                    self._error(403, "AccessDenied", f"{action} denied")
+                return allowed
+
+            def _cors(self, bucket) -> dict:
+                """CORS response headers for the request's Origin."""
+                origin = self.headers.get("Origin")
+                if not origin or self._fs(bucket) is None:
+                    return {}
+                raw = self._bucket_conf(bucket).get(s3policy.XA_CORS)
+                rules = json.loads(raw) if raw else None
+                rule = s3policy.cors_match(rules, origin, self.command)
+                return s3policy.cors_headers(rule, origin) if rule else {}
+
+            def do_OPTIONS(self):
+                # CORS preflight
+                bucket, key, _ = self._split()
+                origin = self.headers.get("Origin", "")
+                method = self.headers.get("Access-Control-Request-Method", "")
+                fs = self._fs(bucket)
+                if fs is None:
+                    return self._error(404, "NoSuchBucket", bucket)
+                raw = self._bucket_conf(bucket).get(s3policy.XA_CORS)
+                rules = json.loads(raw) if raw else None
+                rule = s3policy.cors_match(rules, origin, method)
+                if rule is None:
+                    return self._error(403, "AccessDenied",
+                                       "CORS rules do not allow this origin")
+                self._reply(200, headers=s3policy.cors_headers(rule, origin))
 
             # ---- verbs ----
             def do_PUT(self):
-                # drain the body BEFORE any reply: leftover body bytes
-                # desync HTTP/1.1 keep-alive clients. The authenticator
-                # drains (and stashes) it as part of signature hashing.
-                if outer.auth is None:
-                    n = int(self.headers.get("Content-Length") or 0)
-                    data = self.rfile.read(n)
-                else:
-                    if not self._authorized():
-                        return self._error(403, "AccessDenied", "bad signature")
-                    data = getattr(self, "_stashed_body", b"")
-                bucket, key, query = self._split()
+                # the body is drained BEFORE any reply (leftover bytes
+                # desync HTTP/1.1 keep-alive clients): _begin stashes it
+                # as part of signature hashing
+                begun = self._begin()
+                if begun is None:
+                    return
+                bucket, key, query = begun
+                data = getattr(self, "_stashed_body", b"")
+                fs = self._fs(bucket)
+                if fs is None:
+                    return self._error(404, "NoSuchBucket", bucket)
+                # bucket subresources: ?acl / ?policy / ?cors
+                if not key and "acl" in query:
+                    if not self._check("s3:PutBucketAcl", bucket):
+                        return
+                    canned = self.headers.get("x-amz-acl", "private")
+                    if canned not in s3policy.CANNED_ACLS:
+                        return self._error(400, "InvalidArgument",
+                                           f"unsupported ACL {canned!r}")
+                    outer._bucket_cfg_set(fs, s3policy.XA_ACL, canned)
+                    return self._reply(200)
+                if not key and "policy" in query:
+                    if not self._check("s3:PutBucketPolicy", bucket):
+                        return
+                    try:
+                        s3policy.parse_policy(data)
+                    except s3policy.S3ConfigError as e:
+                        return self._error(400, "MalformedPolicy", str(e))
+                    outer._bucket_cfg_set(fs, s3policy.XA_POLICY,
+                                          data.decode())
+                    return self._reply(200)
+                if not key and "cors" in query:
+                    if not self._check("s3:PutBucketCors", bucket):
+                        return
+                    try:
+                        rules = s3policy.parse_cors(data)
+                    except s3policy.S3ConfigError as e:
+                        return self._error(400, "MalformedXML", str(e))
+                    outer._bucket_cfg_set(fs, s3policy.XA_CORS,
+                                          json.dumps(rules))
+                    return self._reply(200)
                 if not key:  # CreateBucket
                     if bucket not in outer.volumes:
                         return self._error(404, "NoSuchBucket",
                                            f"no volume backs {bucket}")
                     return self._reply(200)
-                fs = self._fs(bucket)
-                if fs is None:
-                    return self._error(404, "NoSuchBucket", bucket)
                 if self._key_reserved(key):
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")
+                if "tagging" in query:  # PutObjectTagging
+                    if not self._check("s3:PutObjectTagging", bucket, key):
+                        return
+                    try:
+                        tags = s3policy.parse_tagging(data)
+                    except s3policy.S3ConfigError as e:
+                        return self._error(400, "MalformedXML", str(e))
+                    try:
+                        fs.setxattr("/" + key, s3policy.XA_TAGS,
+                                    json.dumps(tags))
+                    except FsError:
+                        return self._error(404, "NoSuchKey", key)
+                    return self._reply(200)
+                if not self._check("s3:PutObject", bucket, key):
+                    return
                 if "uploadId" in query and "partNumber" in query:  # UploadPart
                     if self.headers.get("x-amz-copy-source"):
                         # refusing beats silently storing the empty body
@@ -129,6 +263,10 @@ class ObjectNode:
                     if self._key_reserved(sk):
                         return self._error(403, "AccessDenied",
                                            ".multipart is a reserved namespace")
+                    # the caller must be allowed to READ the source too,
+                    # or copy becomes cross-bucket exfiltration
+                    if not self._check("s3:GetObject", sb, sk):
+                        return
                     try:
                         data = sfs.read_file("/" + sk)
                     except FsError:
@@ -136,26 +274,32 @@ class ObjectNode:
                 try:
                     outer._put_object(fs, key, data)
                 except FsError as e:
+                    if e.errno in (mn.ENOSPC, mn.EDQUOT):
+                        return self._error(507, "QuotaExceeded", str(e))
                     return self._error(500, "InternalError", str(e))
                 etag = hashlib.md5(data).hexdigest()
                 if is_copy:
                     body = (f"<?xml version='1.0'?><CopyObjectResult>"
                             f"<ETag>\"{etag}\"</ETag></CopyObjectResult>").encode()
                     return self._reply(200, body)
-                self._reply(200, headers={"ETag": f'"{etag}"'})
+                self._reply(200, headers={"ETag": f'"{etag}"',
+                                          **self._cors(bucket)})
 
             def do_POST(self):
                 # multipart lifecycle: InitiateMultipartUpload (?uploads)
                 # and CompleteMultipartUpload (?uploadId=...)
-                if outer.auth is None:
-                    n = int(self.headers.get("Content-Length") or 0)
-                    self.rfile.read(n)
-                elif not self._authorized():
-                    return self._error(403, "AccessDenied", "bad signature")
-                bucket, key, query = self._split()
+                begun = self._begin()
+                if begun is None:
+                    return
+                bucket, key, query = begun
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
+                if key and self._key_reserved(key):
+                    return self._error(403, "AccessDenied",
+                                       ".multipart is a reserved namespace")
+                if not self._check("s3:PutObject", bucket, key):
+                    return
                 if "uploads" in query:
                     if not key:
                         return self._error(400, "InvalidRequest",
@@ -184,16 +328,68 @@ class ObjectNode:
                 self._error(400, "InvalidRequest", "unsupported POST")
 
             def do_GET(self):
-                if not self._authorized():
-                    return self._error(403, "AccessDenied", "bad signature")
-                bucket, key, query = self._split()
+                begun = self._begin()
+                if begun is None:
+                    return
+                bucket, key, query = begun
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
                 if key and self._key_reserved(key):
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")
+                if not key and "acl" in query:  # GetBucketAcl
+                    if not self._check("s3:GetBucketAcl", bucket):
+                        return
+                    acl = (self._bucket_conf(bucket).get(s3policy.XA_ACL)
+                           or "private")
+                    owner = self._principal or "owner"
+                    return self._reply(200, s3policy.acl_to_xml(acl, owner))
+                if not key and "policy" in query:  # GetBucketPolicy
+                    if not self._check("s3:GetBucketPolicy", bucket):
+                        return
+                    raw = self._bucket_conf(bucket).get(s3policy.XA_POLICY)
+                    if not raw:
+                        return self._error(404, "NoSuchBucketPolicy", bucket)
+                    return self._reply(200, raw.encode(),
+                                       ctype="application/json")
+                if not key and "cors" in query:  # GetBucketCors
+                    if not self._check("s3:GetBucketCors", bucket):
+                        return
+                    raw = self._bucket_conf(bucket).get(s3policy.XA_CORS)
+                    if not raw:
+                        return self._error(404,
+                                           "NoSuchCORSConfiguration", bucket)
+                    rules = json.loads(raw)
+                    body = "".join(
+                        "<CORSRule>"
+                        + "".join(f"<AllowedOrigin>{xs.escape(o)}"
+                                  f"</AllowedOrigin>" for o in r["origins"])
+                        + "".join(f"<AllowedMethod>{m}</AllowedMethod>"
+                                  for m in r["methods"])
+                        + "".join(f"<AllowedHeader>{xs.escape(h)}"
+                                  f"</AllowedHeader>" for h in r["headers"])
+                        + (f"<MaxAgeSeconds>{r['max_age']}</MaxAgeSeconds>"
+                           if r["max_age"] else "")
+                        + "</CORSRule>"
+                        for r in rules
+                    )
+                    return self._reply(
+                        200,
+                        (f"<?xml version='1.0'?><CORSConfiguration>{body}"
+                         f"</CORSConfiguration>").encode())
+                if key and "tagging" in query:  # GetObjectTagging
+                    if not self._check("s3:GetObjectTagging", bucket, key):
+                        return
+                    try:
+                        raw = fs.getxattr("/" + key, s3policy.XA_TAGS)
+                    except FsError:
+                        return self._error(404, "NoSuchKey", key)
+                    tags = json.loads(raw) if raw else {}
+                    return self._reply(200, s3policy.tagging_to_xml(tags))
                 if not key:  # ListObjectsV2 (+ delimiter and pagination)
+                    if not self._check("s3:ListBucket", bucket):
+                        return
                     prefix = query.get("prefix", [""])[0]
                     delimiter = query.get("delimiter", [""])[0]
                     try:
@@ -231,6 +427,8 @@ class ObjectNode:
                         f"</ListBucketResult>"
                     ).encode()
                     return self._reply(200, body)
+                if not self._check("s3:GetObject", bucket, key):
+                    return
                 rng_hdr = self.headers.get("Range", "")
                 span = None
                 if rng_hdr.startswith("bytes=") and "," not in rng_hdr:
@@ -268,12 +466,16 @@ class ObjectNode:
                     data = fs.read_file("/" + key)
                 except FsError:
                     return self._error(404, "NoSuchKey", key)
-                self._reply(200, data, ctype="application/octet-stream")
+                self._reply(200, data, ctype="application/octet-stream",
+                            headers=self._cors(bucket))
 
             def do_HEAD(self):
-                if not self._authorized():
-                    return self._error(403, "AccessDenied", "bad signature")
-                bucket, key, _ = self._split()
+                begun = self._begin()
+                if begun is None:
+                    return
+                bucket, key, _ = begun
+                if not self._check("s3:GetObject", bucket, key):
+                    return
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
@@ -292,18 +494,41 @@ class ObjectNode:
                 self.end_headers()
 
             def do_DELETE(self):
-                if not self._authorized():
-                    return self._error(403, "AccessDenied", "bad signature")
-                bucket, key, query = self._split()
+                begun = self._begin()
+                if begun is None:
+                    return
+                bucket, key, query = begun
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
+                if not key and "policy" in query:  # DeleteBucketPolicy
+                    if not self._check("s3:DeleteBucketPolicy", bucket):
+                        return
+                    outer._bucket_cfg_set(fs, s3policy.XA_POLICY, None)
+                    return self._reply(204)
+                if not key and "cors" in query:  # DeleteBucketCors
+                    if not self._check("s3:DeleteBucketCors", bucket):
+                        return
+                    outer._bucket_cfg_set(fs, s3policy.XA_CORS, None)
+                    return self._reply(204)
                 if "uploadId" in query:  # AbortMultipartUpload
+                    if not self._check("s3:PutObject", bucket, key):
+                        return
                     outer._abort_multipart(fs, query["uploadId"][0])
                     return self._reply(204)
                 if self._key_reserved(key):
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")
+                if key and "tagging" in query:  # DeleteObjectTagging
+                    if not self._check("s3:DeleteObjectTagging", bucket, key):
+                        return
+                    try:
+                        fs.setxattr("/" + key, s3policy.XA_TAGS, None)
+                    except FsError:
+                        return self._error(404, "NoSuchKey", key)
+                    return self._reply(204)
+                if not self._check("s3:DeleteObject", bucket, key):
+                    return
                 try:
                     fs.unlink("/" + key)
                     outer._prune_empty_dirs(fs, key)
@@ -315,6 +540,17 @@ class ObjectNode:
         self._httpd.daemon_threads = True
         self.addr = f"{host}:{self._httpd.server_address[1]}"
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    # ---- bucket configuration (xattrs on the volume root) ----
+    def _bucket_cfg(self, fs: FileSystem, xa_key: str) -> str | None:
+        try:
+            return fs.getxattr("/", xa_key)
+        except FsError:
+            return None
+
+    def _bucket_cfg_set(self, fs: FileSystem, xa_key: str,
+                        value: str | None) -> None:
+        fs.setxattr("/", xa_key, value)
 
     # ---- multipart (staged under /.multipart/<uploadId>/) ----
     def _initiate_multipart(self, fs: FileSystem, key: str) -> str:

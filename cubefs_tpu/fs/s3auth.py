@@ -8,6 +8,7 @@ SDKs use); presigned URLs can layer on the same primitives.
 
 from __future__ import annotations
 
+import base64
 import calendar
 import hashlib
 import hmac
@@ -132,26 +133,210 @@ def sign_v4(method: str, path: str, query: str, headers: dict[str, str],
             f"SignedHeaders={';'.join(signed_headers)}, Signature={sig}")
 
 
+def _canonical_query(pairs: list[tuple[str, str]]) -> str:
+    return "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(pairs)
+    )
+
+
+def presign_v4(method: str, path: str, host: str, ak: str, sk: str,
+               amz_date: str, expires: int = 3600,
+               region: str = "us-east-1", service: str = "s3",
+               extra_query: list[tuple[str, str]] | None = None) -> str:
+    """Build a presigned-URL query string (SigV4 query auth): the
+    signature covers the query itself (minus X-Amz-Signature) and the
+    host header; the payload is UNSIGNED-PAYLOAD."""
+    date = amz_date[:8]
+    scope = f"{date}/{region}/{service}/aws4_request"
+    q = [
+        ("X-Amz-Algorithm", "AWS4-HMAC-SHA256"),
+        ("X-Amz-Credential", f"{ak}/{scope}"),
+        ("X-Amz-Date", amz_date),
+        ("X-Amz-Expires", str(expires)),
+        ("X-Amz-SignedHeaders", "host"),
+        *(extra_query or []),
+    ]
+    creq = "\n".join([
+        method, path or "/", _canonical_query(q),
+        f"host:{host}\n", "host", "UNSIGNED-PAYLOAD",
+    ])
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+    sig = hmac.new(signing_key(sk, date, region, service), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    return _canonical_query(q) + "&X-Amz-Signature=" + sig
+
+
+def verify_presigned_v4(method: str, path: str, query: str,
+                        host: str, secret_for,
+                        now: float | None = None) -> tuple[bool, str]:
+    """Verify SigV4 query-string auth. Returns (ok, ak_or_reason)."""
+    pairs = urllib.parse.parse_qsl(query, keep_blank_values=True)
+    params = dict(pairs)
+    if params.get("X-Amz-Algorithm") != "AWS4-HMAC-SHA256":
+        return False, "unsupported algorithm"
+    try:
+        cred = params["X-Amz-Credential"]
+        amz_date = params["X-Amz-Date"]
+        expires = int(params["X-Amz-Expires"])
+        signed_headers = params["X-Amz-SignedHeaders"].split(";")
+        signature = params["X-Amz-Signature"]
+        ak, date, region, service, _term = cred.split("/", 4)
+    except (KeyError, ValueError):
+        return False, "malformed presigned query"
+    sk = secret_for(ak)
+    if sk is None:
+        return False, f"unknown access key {ak}"
+    if "host" not in signed_headers:
+        return False, "host must be signed"
+    if not amz_date.startswith(date):
+        return False, "X-Amz-Date does not match credential scope date"
+    try:
+        t0 = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+    except ValueError:
+        return False, "malformed X-Amz-Date"
+    t = time.time() if now is None else now
+    if t > t0 + min(expires, 7 * 86400):
+        return False, "presigned URL expired"
+    if t < t0 - MAX_CLOCK_SKEW:
+        return False, "presigned URL not yet valid"
+    unsigned = [(k, v) for k, v in pairs if k != "X-Amz-Signature"]
+    creq = "\n".join([
+        method, path or "/", _canonical_query(unsigned),
+        "".join(f"{h}:{host if h == 'host' else ''}\n"
+                for h in signed_headers),
+        ";".join(signed_headers), "UNSIGNED-PAYLOAD",
+    ])
+    scope = f"{date}/{region}/{service}/aws4_request"
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+    expect = hmac.new(signing_key(sk, date, region, service), sts.encode(),
+                      hashlib.sha256).hexdigest()
+    if not hmac.compare_digest(expect, signature):
+        return False, "signature mismatch"
+    return True, ak
+
+
+# ---------------- Signature V2 (objectnode/auth_signature_v2.go) -------
+_V2_SUBRESOURCES = ("acl", "policy", "cors", "tagging", "uploads",
+                    "uploadId", "partNumber")
+
+
+def _v2_string_to_sign(method: str, path: str, query: str,
+                       headers: dict[str, str]) -> str:
+    amz = sorted(
+        (k.lower(), " ".join(v.split()))
+        for k, v in headers.items() if k.lower().startswith("x-amz-")
+    )
+    canon_amz = "".join(f"{k}:{v}\n" for k, v in amz)
+    sub = [(k, v) for k, v in
+           urllib.parse.parse_qsl(query, keep_blank_values=True)
+           if k in _V2_SUBRESOURCES]
+    resource = path or "/"
+    if sub:
+        resource += "?" + "&".join(
+            k if not v else f"{k}={v}" for k, v in sorted(sub))
+    date = "" if "x-amz-date" in {k for k, _ in amz} else headers.get("date", "")
+    return "\n".join([
+        method,
+        headers.get("content-md5", ""),
+        headers.get("content-type", ""),
+        date,
+    ]) + "\n" + canon_amz + resource
+
+
+def sign_v2(method: str, path: str, query: str, headers: dict[str, str],
+            ak: str, sk: str) -> str:
+    sts = _v2_string_to_sign(method, path, query, headers)
+    sig = base64.b64encode(
+        hmac.new(sk.encode(), sts.encode(), hashlib.sha1).digest()
+    ).decode()
+    return f"AWS {ak}:{sig}"
+
+
+def verify_v2(method: str, path: str, query: str, headers: dict[str, str],
+              secret_for, now: float | None = None) -> tuple[bool, str]:
+    auth = headers.get("authorization", "")
+    if not auth.startswith("AWS ") or ":" not in auth:
+        return False, "missing AWS v2 authorization"
+    ak, _, sig = auth[4:].rpartition(":")
+    sk = secret_for(ak)
+    if sk is None:
+        return False, f"unknown access key {ak}"
+    # replay window on Date / x-amz-date
+    date_hdr = headers.get("x-amz-date") or headers.get("date", "")
+    req_time = None
+    for fmt in ("%a, %d %b %Y %H:%M:%S GMT", "%Y%m%dT%H%M%SZ"):
+        try:
+            req_time = calendar.timegm(time.strptime(date_hdr, fmt))
+            break
+        except ValueError:
+            continue
+    if req_time is None:
+        return False, "missing/malformed Date"
+    if abs((time.time() if now is None else now) - req_time) > MAX_CLOCK_SKEW:
+        return False, "request time too skewed (replay window exceeded)"
+    sts = _v2_string_to_sign(method, path, query, headers)
+    expect = base64.b64encode(
+        hmac.new(sk.encode(), sts.encode(), hashlib.sha1).digest()
+    ).decode()
+    if not hmac.compare_digest(expect, sig):
+        return False, "signature mismatch"
+    return True, ak
+
+
 class S3V4Authenticator:
-    """Pluggable objectnode authenticator backed by a UserStore: verifies
-    the signature AND the key's grant on the target bucket/volume."""
+    """Pluggable objectnode authenticator backed by a UserStore.
+
+    `authenticate` establishes WHO the caller is — V4 header auth, V4
+    presigned query auth, or V2 header auth; a request with no
+    credentials at all is the anonymous principal (None), left for the
+    authorization layer (ACL/policy) to judge. `__call__` keeps the
+    legacy boolean authn+grant contract."""
 
     def __init__(self, user_store, bucket_volume: dict[str, str] | None = None):
         self.users = user_store
         self.bucket_volume = bucket_volume or {}
 
-    def __call__(self, handler) -> bool:
+    def authenticate(self, handler) -> tuple[bool, str | None, str]:
+        """Returns (ok, principal, reason). ok=False means credentials
+        were presented but are INVALID (reject 403); principal None with
+        ok=True means anonymous."""
         n = int(handler.headers.get("Content-Length") or 0)
         # read + stash the body so the verb handler can reuse it
         body = handler.rfile.read(n) if n else b""
         handler._stashed_body = body
         parsed = urllib.parse.urlsplit(handler.path)
         headers = {k.lower(): v for k, v in handler.headers.items()}
-        ok, who = verify_v4(handler.command, parsed.path, parsed.query,
-                            headers, body, self.users.secret_for)
-        if not ok:
+        auth_hdr = headers.get("authorization", "")
+        if auth_hdr.startswith("AWS4-HMAC-SHA256 "):
+            ok, who = verify_v4(handler.command, parsed.path, parsed.query,
+                                headers, body, self.users.secret_for)
+            return (ok, who if ok else None, "" if ok else who)
+        if auth_hdr.startswith("AWS "):
+            ok, who = verify_v2(handler.command, parsed.path, parsed.query,
+                                headers, self.users.secret_for)
+            return (ok, who if ok else None, "" if ok else who)
+        if "X-Amz-Signature" in parsed.query:
+            ok, who = verify_presigned_v4(
+                handler.command, parsed.path, parsed.query,
+                headers.get("host", ""), self.users.secret_for)
+            return (ok, who if ok else None, "" if ok else who)
+        return True, None, ""  # anonymous
+
+    def grant_ok(self, principal: str | None, bucket: str,
+                 write: bool) -> bool:
+        if principal is None:
             return False
-        bucket = parsed.path.lstrip("/").split("/", 1)[0]
         volume = self.bucket_volume.get(bucket, bucket)
+        return self.users.allowed(principal, volume, write)
+
+    def __call__(self, handler) -> bool:
+        ok, who, _ = self.authenticate(handler)
+        if not ok or who is None:
+            return False
+        parsed = urllib.parse.urlsplit(handler.path)
+        bucket = parsed.path.lstrip("/").split("/", 1)[0]
         write = handler.command in ("PUT", "POST", "DELETE")
-        return self.users.allowed(who, volume, write)
+        return self.grant_ok(who, bucket, write)

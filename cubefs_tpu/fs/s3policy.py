@@ -1,0 +1,222 @@
+"""S3 authorization surface: ACLs, bucket policy, CORS, object tagging.
+
+Role parity: objectnode/acl.go (canned ACLs + grants), policy.go
+(bucket policy statements with Effect/Principal/Action/Resource and
+wildcard matching; explicit Deny wins), cors.go, tagging.go. Bucket
+configuration documents persist as xattrs on the backing volume's root
+inode (replicated through the metanode plane); object tags as xattrs on
+the object's inode.
+
+Evaluation order (the reference's policy-check flow):
+    1. bucket policy explicit Deny  -> deny
+    2. bucket policy Allow          -> allow
+    3. ACL grant covers the action  -> allow
+    4. user-store volume grant      -> allow (authenticated users only)
+    5.                              -> deny
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import xml.etree.ElementTree as ET
+import xml.sax.saxutils as xs
+
+# xattr keys on the volume root / object inode
+XA_ACL = "s3.acl"
+XA_POLICY = "s3.policy"
+XA_CORS = "s3.cors"
+XA_TAGS = "s3.tags"
+
+CANNED_ACLS = ("private", "public-read", "public-read-write",
+               "authenticated-read")
+
+READ_ACTIONS = {"s3:GetObject", "s3:ListBucket", "s3:HeadObject",
+                "s3:GetObjectTagging"}
+WRITE_ACTIONS = {"s3:PutObject", "s3:DeleteObject", "s3:PutObjectTagging",
+                 "s3:DeleteObjectTagging"}
+
+
+class S3ConfigError(Exception):
+    pass
+
+
+# ---------------- ACL ----------------
+def acl_allows(acl: str | None, action: str, principal: str | None) -> bool:
+    """Canned-ACL evaluation: does this ACL grant `action` to
+    `principal` (None = anonymous)?"""
+    acl = acl or "private"
+    if action in READ_ACTIONS:
+        if acl in ("public-read", "public-read-write"):
+            return True
+        if acl == "authenticated-read" and principal is not None:
+            return True
+    if action in WRITE_ACTIONS and acl == "public-read-write":
+        return True
+    return False
+
+
+def acl_to_xml(acl: str, owner: str) -> bytes:
+    grants = [("FULL_CONTROL", owner)]
+    if acl in ("public-read", "public-read-write"):
+        grants.append(("READ", "AllUsers"))
+    if acl == "public-read-write":
+        grants.append(("WRITE", "AllUsers"))
+    if acl == "authenticated-read":
+        grants.append(("READ", "AuthenticatedUsers"))
+    body = "".join(
+        f"<Grant><Grantee>{xs.escape(who)}</Grantee>"
+        f"<Permission>{perm}</Permission></Grant>"
+        for perm, who in grants
+    )
+    return (f"<?xml version='1.0'?><AccessControlPolicy>"
+            f"<Owner><ID>{xs.escape(owner)}</ID></Owner>"
+            f"<AccessControlList>{body}</AccessControlList>"
+            f"</AccessControlPolicy>").encode()
+
+
+# ---------------- bucket policy ----------------
+def parse_policy(doc: bytes) -> dict:
+    """Validate a bucket-policy JSON document; returns the parsed dict.
+    Statement shape: Effect Allow|Deny, Principal "*"|ak|{"AWS": [...]},
+    Action str|[...], Resource str|[...] (arn:aws:s3:::bucket[/key])."""
+    try:
+        pol = json.loads(doc)
+    except ValueError as e:  # JSONDecodeError or non-UTF-8 body
+        raise S3ConfigError(f"policy is not valid JSON: {e}") from None
+    stmts = pol.get("Statement")
+    if not isinstance(stmts, list) or not stmts:
+        raise S3ConfigError("policy needs a non-empty Statement list")
+    for s in stmts:
+        if s.get("Effect") not in ("Allow", "Deny"):
+            raise S3ConfigError("statement Effect must be Allow or Deny")
+        if "Action" not in s or "Resource" not in s:
+            raise S3ConfigError("statement needs Action and Resource")
+    return pol
+
+
+def _as_list(v) -> list:
+    if isinstance(v, dict):  # {"AWS": [...]} principal form
+        v = v.get("AWS", [])
+    return v if isinstance(v, list) else [v]
+
+
+def _principal_matches(stmt, principal: str | None) -> bool:
+    pr = _as_list(stmt.get("Principal", "*"))
+    for p in pr:
+        if p == "*" or (principal is not None and p == principal):
+            return True
+    return False
+
+
+def _glob_any(patterns: list, value: str) -> bool:
+    return any(fnmatch.fnmatchcase(value, p) for p in patterns)
+
+
+def policy_decision(policy: dict | None, action: str, bucket: str,
+                    key: str, principal: str | None) -> str | None:
+    """Returns "Allow", "Deny", or None (policy silent)."""
+    if not policy:
+        return None
+    resource = f"arn:aws:s3:::{bucket}" + (f"/{key}" if key else "")
+    decision = None
+    for stmt in policy.get("Statement", []):
+        if not _principal_matches(stmt, principal):
+            continue
+        if not _glob_any(_as_list(stmt["Action"]), action):
+            continue
+        if not _glob_any(_as_list(stmt["Resource"]), resource):
+            continue
+        if stmt["Effect"] == "Deny":
+            return "Deny"  # explicit deny wins immediately
+        decision = "Allow"
+    return decision
+
+
+def authorize(action: str, bucket: str, key: str, principal: str | None,
+              acl: str | None, policy: dict | None,
+              user_grant_ok: bool) -> bool:
+    """The combined authorization decision (see module docstring)."""
+    decision = policy_decision(policy, action, bucket, key, principal)
+    if decision == "Deny":
+        return False
+    if decision == "Allow":
+        return True
+    if acl_allows(acl, action, principal):
+        return True
+    return principal is not None and user_grant_ok
+
+
+# ---------------- CORS ----------------
+def parse_cors(doc: bytes) -> list[dict]:
+    """<CORSConfiguration><CORSRule><AllowedOrigin/><AllowedMethod/>
+    <AllowedHeader/><MaxAgeSeconds/></CORSRule>...</CORSConfiguration>"""
+    try:
+        root = ET.fromstring(doc)
+    except ET.ParseError as e:
+        raise S3ConfigError(f"bad CORS XML: {e}") from None
+    rules = []
+    for r in root.findall("CORSRule"):
+        rule = {
+            "origins": [e.text or "" for e in r.findall("AllowedOrigin")],
+            "methods": [e.text or "" for e in r.findall("AllowedMethod")],
+            "headers": [e.text or "" for e in r.findall("AllowedHeader")],
+            "max_age": int(r.findtext("MaxAgeSeconds", "0") or 0),
+        }
+        if not rule["origins"] or not rule["methods"]:
+            raise S3ConfigError("CORSRule needs AllowedOrigin and "
+                                "AllowedMethod")
+        rules.append(rule)
+    if not rules:
+        raise S3ConfigError("CORSConfiguration needs at least one CORSRule")
+    return rules
+
+
+def cors_match(rules: list[dict] | None, origin: str,
+               method: str) -> dict | None:
+    """First rule matching origin+method, or None."""
+    for rule in rules or []:
+        if method not in rule["methods"]:
+            continue
+        if any(fnmatch.fnmatchcase(origin, o) for o in rule["origins"]):
+            return rule
+    return None
+
+
+def cors_headers(rule: dict, origin: str) -> dict:
+    out = {
+        "Access-Control-Allow-Origin": origin,
+        "Access-Control-Allow-Methods": ", ".join(rule["methods"]),
+    }
+    if rule["headers"]:
+        out["Access-Control-Allow-Headers"] = ", ".join(rule["headers"])
+    if rule["max_age"]:
+        out["Access-Control-Max-Age"] = str(rule["max_age"])
+    return out
+
+
+# ---------------- object tagging ----------------
+def parse_tagging(doc: bytes) -> dict[str, str]:
+    try:
+        root = ET.fromstring(doc)
+    except ET.ParseError as e:
+        raise S3ConfigError(f"bad Tagging XML: {e}") from None
+    tags: dict[str, str] = {}
+    ts = root.find("TagSet")
+    for t in (ts.findall("Tag") if ts is not None else []):
+        k = t.findtext("Key")
+        if not k:
+            raise S3ConfigError("Tag needs a Key")
+        tags[k] = t.findtext("Value") or ""
+    if len(tags) > 10:  # S3's object-tag limit
+        raise S3ConfigError("at most 10 tags per object")
+    return tags
+
+
+def tagging_to_xml(tags: dict[str, str]) -> bytes:
+    body = "".join(
+        f"<Tag><Key>{xs.escape(k)}</Key><Value>{xs.escape(v)}</Value></Tag>"
+        for k, v in sorted(tags.items())
+    )
+    return (f"<?xml version='1.0'?><Tagging><TagSet>{body}</TagSet>"
+            f"</Tagging>").encode()
