@@ -79,6 +79,10 @@ class ObjectNode:
                 """Drain+stash the body and authenticate. Returns the
                 (bucket, key, query) triple, or None if a 403 was
                 already sent. Sets self._principal (None = anonymous)."""
+                # the handler object lives for a whole keep-alive
+                # connection: bucket config must be re-read per REQUEST
+                # or an ACL/policy revocation never reaches it
+                self._conf_cache = None
                 if outer.auth is None:
                     n = int(self.headers.get("Content-Length") or 0)
                     self._stashed_body = self.rfile.read(n) if n else b""
@@ -153,6 +157,7 @@ class ObjectNode:
 
             def do_OPTIONS(self):
                 # CORS preflight
+                self._conf_cache = None
                 bucket, key, _ = self._split()
                 origin = self.headers.get("Origin", "")
                 method = self.headers.get("Access-Control-Request-Method", "")
@@ -211,6 +216,8 @@ class ObjectNode:
                                           json.dumps(rules))
                     return self._reply(200)
                 if not key:  # CreateBucket
+                    if not self._check("s3:CreateBucket", bucket):
+                        return
                     if bucket not in outer.volumes:
                         return self._error(404, "NoSuchBucket",
                                            f"no volume backs {bucket}")
@@ -365,7 +372,8 @@ class ObjectNode:
                         "<CORSRule>"
                         + "".join(f"<AllowedOrigin>{xs.escape(o)}"
                                   f"</AllowedOrigin>" for o in r["origins"])
-                        + "".join(f"<AllowedMethod>{m}</AllowedMethod>"
+                        + "".join(f"<AllowedMethod>{xs.escape(m)}"
+                                  f"</AllowedMethod>"
                                   for m in r["methods"])
                         + "".join(f"<AllowedHeader>{xs.escape(h)}"
                                   f"</AllowedHeader>" for h in r["headers"])

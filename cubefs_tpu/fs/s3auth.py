@@ -323,6 +323,10 @@ class S3V4Authenticator:
                 handler.command, parsed.path, parsed.query,
                 headers.get("host", ""), self.users.secret_for)
             return (ok, who if ok else None, "" if ok else who)
+        if auth_hdr:
+            # an unrecognized/malformed Authorization scheme must be
+            # rejected, never silently downgraded to anonymous
+            return False, None, "unsupported authorization scheme"
         return True, None, ""  # anonymous
 
     def grant_ok(self, principal: str | None, bucket: str,

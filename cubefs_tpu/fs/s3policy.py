@@ -31,8 +31,9 @@ XA_TAGS = "s3.tags"
 CANNED_ACLS = ("private", "public-read", "public-read-write",
                "authenticated-read")
 
-READ_ACTIONS = {"s3:GetObject", "s3:ListBucket", "s3:HeadObject",
-                "s3:GetObjectTagging"}
+# HEAD authorizes as s3:GetObject, matching AWS (there is no separate
+# HeadObject permission)
+READ_ACTIONS = {"s3:GetObject", "s3:ListBucket", "s3:GetObjectTagging"}
 WRITE_ACTIONS = {"s3:PutObject", "s3:DeleteObject", "s3:PutObjectTagging",
                  "s3:DeleteObjectTagging"}
 
@@ -155,6 +156,7 @@ def parse_cors(doc: bytes) -> list[dict]:
         root = ET.fromstring(doc)
     except ET.ParseError as e:
         raise S3ConfigError(f"bad CORS XML: {e}") from None
+    valid_methods = {"GET", "PUT", "POST", "DELETE", "HEAD"}
     rules = []
     for r in root.findall("CORSRule"):
         rule = {
@@ -166,6 +168,13 @@ def parse_cors(doc: bytes) -> list[dict]:
         if not rule["origins"] or not rule["methods"]:
             raise S3ConfigError("CORSRule needs AllowedOrigin and "
                                 "AllowedMethod")
+        for m in rule["methods"]:
+            if m not in valid_methods:
+                raise S3ConfigError(f"unsupported AllowedMethod {m!r}")
+        for v in rule["origins"] + rule["headers"]:
+            # these values flow into response headers: no control chars
+            if any(ord(ch) < 0x20 or ch == "\x7f" for ch in v):
+                raise S3ConfigError("control characters in CORS rule")
         rules.append(rule)
     if not rules:
         raise S3ConfigError("CORSConfiguration needs at least one CORSRule")

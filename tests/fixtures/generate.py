@@ -1,0 +1,170 @@
+"""Golden-fixture generator — INDEPENDENT of the production codec.
+
+Regenerates tests/fixtures/*.bin. Every piece of GF(2^8) arithmetic
+here is deliberately implemented differently from cubefs_tpu/ops/gf256:
+multiplication is carry-less polynomial ("Russian peasant") reduction
+mod 0x11D (no log/antilog tables), inverses are found by brute-force
+search, exponentiation by repeated multiplication, and the matrix
+inverse by straight Gauss-Jordan over those primitives. The matrix
+CONSTRUCTION follows the published klauspost/reedsolomon default the
+reference uses (vendor/github.com/klauspost/reedsolomon/reedsolomon.go:
+472 buildMatrix = vandermonde(total, data) * inv(top square),
+matrix.go:271 vandermonde V[r][c] = r^c), and the LRC local-stripe
+layout follows blobstore/common/codemode/codemode.go:300
+GetECLayoutByAZ. If production and these fixtures agree byte-for-byte,
+both independently implement the reference's math.
+
+Run: python tests/fixtures/generate.py   (writes *.bin next to itself)
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+POLY = 0x11D
+
+
+# ---------------- independent GF(2^8) primitives ----------------
+def gf_mul(a: int, b: int) -> int:
+    """Carry-less multiply with on-the-fly reduction mod POLY."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a & 0x100:
+            a ^= POLY
+    return r
+
+
+def gf_pow(a: int, e: int) -> int:
+    r = 1
+    for _ in range(e):
+        r = gf_mul(r, a)
+    return r
+
+
+def gf_inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("no inverse of 0")
+    for b in range(1, 256):
+        if gf_mul(a, b) == 1:
+            return b
+    raise AssertionError("unreachable: GF(256) is a field")
+
+
+def mat_mul(A: list[list[int]], B: list[list[int]]) -> list[list[int]]:
+    rows, inner, cols = len(A), len(B), len(B[0])
+    out = [[0] * cols for _ in range(rows)]
+    for i in range(rows):
+        for k in range(inner):
+            a = A[i][k]
+            if a:
+                for j in range(cols):
+                    out[i][j] ^= gf_mul(a, B[k][j])
+    return out
+
+
+def mat_inv(M: list[list[int]]) -> list[list[int]]:
+    n = len(M)
+    aug = [list(row) + [1 if i == j else 0 for j in range(n)]
+           for i, row in enumerate(M)]
+    for col in range(n):
+        pivot = next(r for r in range(col, n) if aug[r][col] != 0)
+        aug[col], aug[pivot] = aug[pivot], aug[col]
+        inv_p = gf_inv(aug[col][col])
+        aug[col] = [gf_mul(x, inv_p) for x in aug[col]]
+        for r in range(n):
+            if r != col and aug[r][col]:
+                f = aug[r][col]
+                aug[r] = [x ^ gf_mul(f, y)
+                          for x, y in zip(aug[r], aug[col])]
+    return [row[n:] for row in aug]
+
+
+def encode_matrix(n: int, total: int) -> list[list[int]]:
+    """klauspost default: vandermonde(total, n) * inv(top n x n)."""
+    vm = [[gf_pow(r, c) for c in range(n)] for r in range(total)]
+    return mat_mul(vm, mat_inv([row[:n] for row in vm[:n]]))
+
+
+# ---------------- deterministic input bytes ----------------
+def det_bytes(count: int, seed: int) -> bytes:
+    """Self-contained LCG (not numpy, not random module): the fixture
+    inputs must be reproducible from this file alone, forever."""
+    out = bytearray()
+    x = seed & 0xFFFFFFFF
+    for _ in range(count):
+        x = (1103515245 * x + 12345) & 0xFFFFFFFF
+        out.append((x >> 16) & 0xFF)
+    return bytes(out)
+
+
+def encode_shards(data: list[bytes], m: int) -> list[bytes]:
+    """Parity shards for the given data shards (full-stripe encode)."""
+    n = len(data)
+    enc = encode_matrix(n, n + m)
+    s = len(data[0])
+    parity = []
+    for r in range(n, n + m):
+        row = enc[r]
+        out = bytearray(s)
+        for c in range(n):
+            coeff = row[c]
+            if coeff:
+                shard = data[c]
+                for i in range(s):
+                    out[i] ^= gf_mul(coeff, shard[i])
+        parity.append(bytes(out))
+    return parity
+
+
+def lrc_locals(shards: list[bytes], n: int, m: int, l: int,
+               az_count: int) -> list[bytes]:
+    """Local parity per AZ over that AZ's data+global-parity shards
+    (codemode.go GetECLayoutByAZ + ec/lrcencoder.go:35 encode)."""
+    ln, lm = (n + m) // az_count, l // az_count
+    locals_out = [b""] * l
+    for az in range(az_count):
+        idx = ([az * (n // az_count) + i for i in range(n // az_count)]
+               + [n + az * (m // az_count) + i for i in range(m // az_count)])
+        local_parity = encode_shards([shards[i] for i in idx], lm)
+        for k in range(lm):
+            locals_out[az * lm + k] = local_parity[k]
+    assert ln == (n + m) // az_count
+    return locals_out
+
+
+def main() -> None:
+    shard = 512  # bytes per shard: plenty to pin the math byte-for-byte
+
+    for name, n, m in (("rs6p3", 6, 3), ("rs12p4", 12, 4)):
+        data = [det_bytes(shard, seed=1000 + i) for i in range(n)]
+        parity = encode_shards(data, m)
+        with open(os.path.join(HERE, f"{name}.bin"), "wb") as f:
+            for s in data + parity:
+                f.write(s)
+
+    # LRC EC16P20L2: 16 data + 20 global parity + 2 local (2 AZs)
+    n, m, l, az = 16, 20, 2, 2
+    data = [det_bytes(shard, seed=2000 + i) for i in range(n)]
+    parity = encode_shards(data, m)
+    locals_ = lrc_locals(data + parity, n, m, l, az)
+    with open(os.path.join(HERE, "ec16p20l2.bin"), "wb") as f:
+        for s in data + parity + locals_:
+            f.write(s)
+
+    # CRC32 of the first rs6p3 data shard + of all shards concatenated
+    data6 = [det_bytes(shard, seed=1000 + i) for i in range(6)]
+    with open(os.path.join(HERE, "crc32.bin"), "wb") as f:
+        f.write(zlib.crc32(data6[0]).to_bytes(4, "little"))
+        f.write(zlib.crc32(b"".join(data6)).to_bytes(4, "little"))
+
+    print("fixtures written to", HERE)
+
+
+if __name__ == "__main__":
+    main()

@@ -310,3 +310,45 @@ def test_multipart_cannot_target_reserved_namespace(gateway):
     code, _, _ = _signed(
         "POST", f"{base}/bkt/.multipart/evil?uploads", owner)
     assert code == 403
+
+
+def test_unsupported_auth_scheme_rejected(gateway):
+    s3, owner, other, fs = gateway
+    base = f"http://{s3.addr}"
+    code, _, _ = _anon("GET", f"{base}/bkt/x",
+                       headers={"Authorization": "Basic dXNlcjpwdw=="})
+    assert code == 403
+
+
+def test_create_bucket_requires_authorization(gateway):
+    s3, owner, other, fs = gateway
+    base = f"http://{s3.addr}"
+    assert _anon("PUT", f"{base}/bkt")[0] == 403
+    assert _signed("PUT", f"{base}/bkt", owner)[0] == 200
+
+
+def test_policy_revocation_visible_on_keepalive_connection(gateway):
+    """Bucket config is re-read per request, not cached for the life of
+    a keep-alive connection."""
+    import http.client
+
+    s3, owner, other, fs = gateway
+    base = f"http://{s3.addr}"
+    _signed("PUT", f"{base}/bkt/ka", owner, b"x")
+    policy = json.dumps({"Statement": [{
+        "Effect": "Allow", "Principal": "*", "Action": "s3:GetObject",
+        "Resource": "arn:aws:s3:::bkt/*"}]}).encode()
+    assert _signed("PUT", f"{base}/bkt?policy", owner, policy)[0] == 200
+    conn = http.client.HTTPConnection(*s3.addr.split(":"), timeout=10)
+    try:
+        conn.request("GET", "/bkt/ka")
+        r1 = conn.getresponse()
+        assert r1.status == 200 and r1.read() == b"x"
+        # revoke on a DIFFERENT connection
+        assert _signed("DELETE", f"{base}/bkt?policy", owner)[0] == 204
+        conn.request("GET", "/bkt/ka")  # same keep-alive connection
+        r2 = conn.getresponse()
+        r2.read()
+        assert r2.status == 403, "revocation must reach open connections"
+    finally:
+        conn.close()
