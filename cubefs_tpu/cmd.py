@@ -78,9 +78,11 @@ def run_role(cfg: dict):
         srv = _serve(svc, cfg)  # live routing: per-partition raft handlers
         svc.addr = srv.addr
         master = rpc.Client(cfg["master_addr"])
-        master.call("register", {"kind": "meta", "addr": srv.addr})
+        zone = cfg.get("zone", "default")
+        master.call("register", {"kind": "meta", "addr": srv.addr,
+                                 "zone": zone})
         _heartbeat_loop(lambda: master.call(
-            "heartbeat", {"kind": "meta", "addr": srv.addr}))
+            "heartbeat", {"kind": "meta", "addr": srv.addr, "zone": zone}))
         return srv, svc
 
     if role == "datanode":
@@ -91,9 +93,11 @@ def run_role(cfg: dict):
         srv = _serve(rpc.expose(svc), cfg)
         svc.addr = srv.addr
         master = rpc.Client(cfg["master_addr"])
-        master.call("register", {"kind": "data", "addr": srv.addr})
+        zone = cfg.get("zone", "default")
+        master.call("register", {"kind": "data", "addr": srv.addr,
+                                 "zone": zone})
         _heartbeat_loop(lambda: master.call(
-            "heartbeat", {"kind": "data", "addr": srv.addr}))
+            "heartbeat", {"kind": "data", "addr": srv.addr, "zone": zone}))
         return srv, svc
 
     if role == "objectnode":

@@ -73,16 +73,39 @@ class MetaWrapper:
     # ---- inode/dentry API (reference sdk/meta/api.go shapes) ----
     def inode_create(self, typ: str, mode: int = 0o644, target=None,
                      quota_ids: list[int] | None = None) -> dict:
-        mp = self.pick_create_mp()
-        ino = self._call(mp, "alloc_ino", {})[0]["ino"]
-        rec = {"op": "mk_inode", "ino": ino, "type": typ, "mode": mode,
-               "ts": time.time()}
-        if target is not None:
-            rec["target"] = target
-        if quota_ids:
-            rec["quota_ids"] = list(quota_ids)
-        self._call(mp, "submit", {"record": rec})
-        return self.inode_get(ino)
+        # rotate across a SNAPSHOT of the partition table from a
+        # captured offset, so every partition is tried exactly once even
+        # when concurrent creates advance the shared cursor; a
+        # range-exhausted mp (ENOSPC from alloc_ino) is skipped — the
+        # master's split sweep appends fresh partitions, which a view
+        # refresh picks up
+        mps = list(self.mps)
+        with self._lock:
+            offset = self._rr
+            self._rr += 1
+        last: FsError | None = None
+        for step in range(len(mps)):
+            mp = mps[(offset + step) % len(mps)]
+            try:
+                ino = self._call(mp, "alloc_ino", {})[0]["ino"]
+            except FsError as e:
+                if e.errno == 28:  # inode range exhausted
+                    last = e
+                    continue
+                raise
+            rec = {"op": "mk_inode", "ino": ino, "type": typ, "mode": mode,
+                   "ts": time.time()}
+            if target is not None:
+                rec["target"] = target
+            if quota_ids:
+                rec["quota_ids"] = list(quota_ids)
+            self._call(mp, "submit", {"record": rec})
+            return self.inode_get(ino)
+        raise last if last else FsError(28, "no meta partition has free inodes")
+
+    def update_mps(self, mps: list[dict]) -> None:
+        """Adopt a refreshed partition table (e.g. after an mp split)."""
+        self.mps = mps
 
     def inode_get(self, ino: int) -> dict:
         mp = self._mp_for(ino)
@@ -496,6 +519,8 @@ class FileSystem:
             view = self.nodes.get(self.master_addr).call(
                 "client_view", {"name": self.vol_name})[0]["volume"]
             self.update_quotas(view.get("quotas") or {})
+            if len(view.get("mps") or []) > len(self.meta.mps):
+                self.meta.update_mps(view["mps"])  # mp split landed
         except Exception:
             pass  # stale table; retried after the next TTL
 

@@ -166,7 +166,14 @@ class DataNode:
                 data, timeout=30.0)
             return
         with dp.extent_lock(extent_id):
-            if dp.raft is not None and offset < dp.store.size(extent_id):
+            if len(dp.peers) > 1 and offset < dp.store.size(extent_id):
+                raft = dp.raft
+                if raft is None:
+                    # membership restart in flight: an overwrite must
+                    # NEVER fall back to the chain (it would bypass the
+                    # raft log and silently diverge a rejoining replica)
+                    raise rpc.RpcError(
+                        503, f"dp {dp_id} raft reconfiguring; retry")
                 self._random_write(dp, extent_id, offset, data)
                 return
             dp.store.write(extent_id, offset, data)

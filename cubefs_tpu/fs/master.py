@@ -4,7 +4,16 @@ Role parity: master/ — volume lifecycle (meta-partition inode ranges +
 data-partition replica sets, cluster.go:3992 vol create / :1901 dp
 create), node registries with heartbeat health checks (cluster.go:
 851-902), and replica-repair orchestration on node death (decommission
-machinery, cluster.go:2525). Placement is least-loaded over live nodes.
+machinery, cluster.go:2525).
+
+Topology (master/topology.go): nodes belong to ZONES; within a zone
+they chunk into NODESETS (failure domains). Placement spreads a
+partition's replicas across zones when several exist (one per zone),
+and keeps them inside one nodeset otherwise — via a PLUGGABLE node
+selector (master/node_selector.go: carry-weight, round-robin,
+least-load). Meta partitions SPLIT when their inode range fills
+(docs/source/design/master.md:23-34): the maintenance sweep appends a
+fresh mp for the next range, with zero interruption to existing ones.
 """
 
 from __future__ import annotations
@@ -24,15 +33,57 @@ class MasterError(Exception):
     pass
 
 
+# ---------------- pluggable node selectors (node_selector.go) ----------
+def _select_least_load(cands: list[str], k: int, load: dict,
+                       state: dict) -> list[str]:
+    return sorted(cands, key=lambda a: (load.get(a, 0), a))[:k]
+
+
+def _select_round_robin(cands: list[str], k: int, load: dict,
+                        state: dict) -> list[str]:
+    cands = sorted(cands)
+    start = state.get("rr", 0) % len(cands)
+    state["rr"] = start + k
+    return [cands[(start + i) % len(cands)] for i in range(k)]
+
+
+def _select_carry_weight(cands: list[str], k: int, load: dict,
+                         state: dict) -> list[str]:
+    """CarryWeightNodeSelector analog: each node accumulates carry
+    proportional to its headroom; the k highest carries win and pay 1."""
+    carry = state.setdefault("carry", {})
+    for a in cands:
+        carry[a] = carry.get(a, 0.0) + 1.0 / (1.0 + load.get(a, 0))
+    picks = sorted(cands, key=lambda a: (-carry.get(a, 0.0), a))[:k]
+    for a in picks:
+        carry[a] -= 1.0
+    return picks
+
+
+SELECTORS = {
+    "least_load": _select_least_load,
+    "round_robin": _select_round_robin,
+    "carry_weight": _select_carry_weight,
+}
+
+
 class Master(ReplicatedFsm):
     HEARTBEAT_TIMEOUT = 10.0
+    INO_RANGE = INO_RANGE  # inodes per meta partition (tests shrink it)
+    MP_SPLIT_THRESHOLD = 0.8  # fill fraction that triggers an mp split
+    NODESET_SIZE = 3
 
     def __init__(self, node_pool, replicas: int = 3, allow_single_node: bool = False,
                  data_dir: str | None = None, me: str | None = None,
-                 peers: list[str] | None = None):
+                 peers: list[str] | None = None, selector: str = "least_load"):
         self.nodes = node_pool
         self.replicas = replicas
         self.allow_single_node = allow_single_node
+        if selector not in SELECTORS:
+            raise MasterError(f"unknown selector {selector!r}; "
+                              f"have {sorted(SELECTORS)}")
+        self.selector = selector
+        self._selector_state: dict = {}
         self._lock = threading.RLock()
         self.datanodes: dict[str, dict] = {}  # addr -> info (heartbeat-local)
         self.metanodes: dict[str, dict] = {}
@@ -183,24 +234,82 @@ class Master(ReplicatedFsm):
                 dp["leader"] = leader
 
     # ---------------- registries ----------------
-    def register_datanode(self, addr: str) -> None:
+    def register_datanode(self, addr: str, zone: str = "default") -> None:
         with self._lock:
-            self.datanodes.setdefault(addr, {"addr": addr})["hb"] = time.time()
+            info = self.datanodes.setdefault(addr, {"addr": addr})
+            info["hb"] = time.time()
+            info["zone"] = zone
 
-    def register_metanode(self, addr: str) -> None:
+    def register_metanode(self, addr: str, zone: str = "default") -> None:
         with self._lock:
-            self.metanodes.setdefault(addr, {"addr": addr})["hb"] = time.time()
+            info = self.metanodes.setdefault(addr, {"addr": addr})
+            info["hb"] = time.time()
+            info["zone"] = zone
 
-    def heartbeat(self, addr: str, kind: str) -> None:
+    def heartbeat(self, addr: str, kind: str, zone: str | None = None) -> None:
         with self._lock:
             reg = self.datanodes if kind == "data" else self.metanodes
             # unknown addr re-registers: a restarted master recovers its
             # registries from the heartbeat stream
-            reg.setdefault(addr, {"addr": addr})["hb"] = time.time()
+            info = reg.setdefault(addr, {"addr": addr})
+            info["hb"] = time.time()
+            if zone or "zone" not in info:
+                info["zone"] = zone or "default"
 
     def _live(self, reg: dict) -> list[str]:
         now = time.time()
         return [a for a, i in reg.items() if now - i["hb"] <= self.HEARTBEAT_TIMEOUT]
+
+    # ---------------- topology (zones / nodesets) ----------------
+    def _zones_of(self, reg: dict, live: list[str]) -> dict[str, list[str]]:
+        zones: dict[str, list[str]] = {}
+        for a in live:
+            zones.setdefault(reg[a].get("zone", "default"), []).append(a)
+        return zones
+
+    def _nodesets(self, members: list[str]) -> list[list[str]]:
+        """Chunk a zone's nodes into nodesets (failure domains) of
+        NODESET_SIZE, deterministically by address order."""
+        members = sorted(members)
+        return [members[i:i + self.NODESET_SIZE]
+                for i in range(0, len(members), self.NODESET_SIZE)]
+
+    def _pick(self, cands: list[str], k: int, load: dict) -> list[str]:
+        fn = SELECTORS[self.selector]
+        return fn(cands, k, load, self._selector_state)
+
+    def _select_hosts(self, reg: dict, live: list[str], k: int,
+                      load: dict) -> list[str]:
+        """Topology-aware placement: one replica per zone when k zones
+        exist (cross-AZ volumes); otherwise all replicas from one
+        nodeset of the least-loaded zone (the reference keeps a
+        partition's replicas inside one failure domain)."""
+        zones = self._zones_of(reg, live)
+        if len(zones) >= k > 1:
+            zone_load = {z: sum(load.get(a, 0) for a in m)
+                         for z, m in zones.items()}
+            picked_zones = sorted(zones, key=lambda z: (zone_load[z], z))[:k]
+            return [self._pick(zones[z], 1, load)[0] for z in picked_zones]
+        if len(zones) > 1:
+            # fewer zones than replicas: spread as evenly as possible
+            out: list[str] = []
+            ordered = sorted(zones, key=lambda z: (-len(zones[z]), z))
+            zi = 0
+            while len(out) < k:
+                z = ordered[zi % len(ordered)]
+                remaining = [a for a in zones[z] if a not in out]
+                if remaining:
+                    out.append(self._pick(remaining, 1, load)[0])
+                zi += 1
+                if zi > 4 * k:
+                    break
+            return out
+        members = next(iter(zones.values()))
+        full = [ns for ns in self._nodesets(members) if len(ns) >= k]
+        if full:
+            ns = min(full, key=lambda s: (sum(load.get(a, 0) for a in s), s[0]))
+            return self._pick(ns, k, load)
+        return self._pick(members, k, load)  # no full nodeset: whole zone
 
     # ---------------- volume lifecycle ----------------
     def create_volume(self, name: str, mp_count: int = 3, dp_count: int = 4) -> dict:
@@ -211,6 +320,8 @@ class Master(ReplicatedFsm):
             return self._create_volume_locked(name, mp_count, dp_count)
 
     def _create_volume_locked(self, name: str, mp_count: int, dp_count: int) -> dict:
+        if mp_count < 1 or dp_count < 1:
+            raise MasterError("mp_count and dp_count must be >= 1")
         with self._lock:
             if name in self.volumes:
                 raise MasterError(f"volume {name!r} exists")
@@ -225,14 +336,16 @@ class Master(ReplicatedFsm):
 
             mps = []
             meta_replicas = min(self.replicas, len(live_meta))
+            meta_load = self._meta_load()
             for i in range(mp_count):
                 pid = self._next_pid
                 self._next_pid += 1
-                start = 1 if i == 0 else i * INO_RANGE
-                end = (i + 1) * INO_RANGE
-                addrs = [live_meta[(i + k) % len(live_meta)]
-                         for k in range(meta_replicas)]
+                start = 1 if i == 0 else i * self.INO_RANGE
+                end = (i + 1) * self.INO_RANGE
+                addrs = self._select_hosts(self.metanodes, live_meta,
+                                           meta_replicas, meta_load)
                 for a in addrs:
+                    meta_load[a] = meta_load.get(a, 0) + 1
                     self.nodes.get(a).call(
                         "create_partition",
                         {"pid": pid, "start": start, "end": end, "peers": addrs},
@@ -253,9 +366,10 @@ class Master(ReplicatedFsm):
         dp_id = self._next_dp
         self._next_dp += 1
         k = min(self.replicas, len(live_data))
-        # least-loaded spread: count dps per node, INCLUDING ones placed
-        # earlier in this same create_volume call (intra_load), and rotate
-        # leadership so one node is not the write leader of every dp
+        # load counts dps per node, INCLUDING ones placed earlier in
+        # this same create_volume call (intra_load); topology-aware
+        # selection spreads across zones / keeps inside a nodeset, and
+        # leadership rotates so one node is not every dp's write leader
         load = {a: 0 for a in live_data}
         for v in self.volumes.values():
             for dp in v["dps"]:
@@ -265,7 +379,7 @@ class Master(ReplicatedFsm):
         for a, n in (intra_load or {}).items():
             if a in load:
                 load[a] += n
-        picks = sorted(live_data, key=lambda a: (load[a], a))[:k]
+        picks = self._select_hosts(self.datanodes, live_data, k, load)
         leader = min(picks, key=lambda a: (intra_load or {}).get(a, 0))
         if intra_load is not None:
             for a in picks:
@@ -286,6 +400,101 @@ class Master(ReplicatedFsm):
             return {"name": name, "mps": [dict(m) for m in vol["mps"]],
                     "dps": [dict(d) for d in vol["dps"]],
                     "quotas": dict(vol.get("quotas", {}))}
+
+    def _meta_load(self) -> dict[str, int]:
+        """Replica count per metanode across all volumes (placement load)."""
+        load: dict[str, int] = {}
+        for v in self.volumes.values():
+            for mp in v["mps"]:
+                for a in mp.get("addrs") or [mp["addr"]]:
+                    load[a] = load.get(a, 0) + 1
+        return load
+
+    # ---------------- meta-partition split ----------------
+    def _apply_add_mp(self, name: str, mp: dict) -> None:
+        self.volumes[name]["mps"].append(mp)
+        self._next_pid = max(self._next_pid, mp["pid"] + 1)
+
+    def check_meta_partitions(self) -> list[tuple[str, int]]:
+        """Split sweep (docs/source/design/master.md:23-34): when a
+        volume's LAST meta partition passes the fill threshold, append a
+        fresh partition for the next inode range. Existing partitions
+        and in-flight IO are untouched — clients pick up the new one on
+        their next view refresh. Returns (volume, new_pid) actions."""
+        with self._lock:
+            vols = {n: [dict(m) for m in v["mps"]]
+                    for n, v in self.volumes.items()}
+        actions = []
+        for name, mps in vols.items():
+            if not mps:
+                continue
+            last = max(mps, key=lambda m: m["end"])
+            try:
+                meta, _ = rpc.call_replicas(
+                    self.nodes, last.get("addrs") or [last["addr"]],
+                    "mp_fill", {"pid": last["pid"]}, deadline=5.0)
+            except Exception:
+                continue  # retried next sweep
+            span = last["end"] - last["start"]
+            if span <= 0 or (meta["next_ino"] - last["start"]) / span \
+                    < self.MP_SPLIT_THRESHOLD:
+                continue
+            try:
+                # after_end pins the observed state: a concurrent sweep
+                # that already split makes this a no-op, not a second
+                # redundant partition
+                new_pid = self.split_meta_partition(name,
+                                                    after_end=last["end"])
+            except (MasterError, rpc.RpcError):
+                continue  # one volume's failure must not end the sweep
+            if new_pid is not None:
+                actions.append((name, new_pid))
+        return actions
+
+    def split_meta_partition(self, name: str,
+                             after_end: int | None = None) -> int | None:
+        with self._propose_lock:
+            with self._lock:
+                vol = self.volumes.get(name)
+                if vol is None:
+                    raise MasterError(f"no volume {name!r}")
+                if not vol["mps"]:
+                    return None
+                live_meta = self._live(self.metanodes)
+                if not live_meta:
+                    return None
+                start = max(m["end"] for m in vol["mps"])
+                if after_end is not None and start != after_end:
+                    return None  # someone already split past our snapshot
+                end = start + self.INO_RANGE
+                pid = self._next_pid
+                self._next_pid += 1
+                meta_load = self._meta_load()
+                k = min(self.replicas, len(live_meta))
+                addrs = self._select_hosts(self.metanodes, live_meta, k,
+                                           meta_load)
+            created = []
+            try:
+                for a in addrs:
+                    self.nodes.get(a).call(
+                        "create_partition",
+                        {"pid": pid, "start": start, "end": end,
+                         "peers": addrs})
+                    created.append(a)
+            except Exception as e:
+                # roll back best-effort so failed splits don't leak
+                # orphan partitions on the nodes that did succeed
+                for a in created:
+                    try:
+                        self.nodes.get(a).call("drop_partition",
+                                               {"pid": pid})
+                    except Exception:
+                        pass
+                raise MasterError(f"split of {name!r} failed: {e}") from e
+            self._commit({"op": "add_mp", "name": name, "mp": {
+                "pid": pid, "start": start, "end": end,
+                "addr": addrs[0], "addrs": addrs}})
+            return pid
 
     # ---------------- failure handling ----------------
     def check_replicas(self) -> list[tuple[int, str, str]]:
@@ -360,15 +569,27 @@ class Master(ReplicatedFsm):
 
     # ---------------- RPC surface ----------------
     def rpc_register(self, args, body):
+        zone = args.get("zone", "default")
         if args["kind"] == "data":
-            self.register_datanode(args["addr"])
+            self.register_datanode(args["addr"], zone)
         else:
-            self.register_metanode(args["addr"])
+            self.register_metanode(args["addr"], zone)
         return {}
 
     def rpc_heartbeat(self, args, body):
-        self.heartbeat(args["addr"], args["kind"])
+        self.heartbeat(args["addr"], args["kind"], args.get("zone"))
         return {}
+
+    def rpc_check_meta_partitions(self, args, body):
+        self._leader_gate()
+        return {"actions": self.check_meta_partitions()}
+
+    def rpc_split_meta_partition(self, args, body):
+        self._leader_gate()
+        try:
+            return {"pid": self.split_meta_partition(args["name"])}
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
 
     def rpc_create_volume(self, args, body):
         self._leader_gate()

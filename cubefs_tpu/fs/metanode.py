@@ -779,7 +779,10 @@ class MetaNode:
         return {"result": res}
 
     def rpc_alloc_ino(self, args, body):
-        return {"ino": self._mp_leader(args["pid"]).alloc_ino()}
+        try:
+            return {"ino": self._mp_leader(args["pid"]).alloc_ino()}
+        except MetaError as e:
+            raise _rpc_err(e) from None
 
     def rpc_inode_get(self, args, body):
         try:
@@ -807,6 +810,23 @@ class MetaNode:
 
     def rpc_usage_report(self, args, body):
         return self._mp_leader(args["pid"]).usage_report()
+
+    def rpc_mp_fill(self, args, body):
+        mp = self._mp_leader(args["pid"])
+        with mp._lock:
+            return {"next_ino": mp._next_ino, "start": mp.start,
+                    "end": mp.end}
+
+    def rpc_drop_partition(self, args, body):
+        """Remove a partition (failed-split rollback): stops its raft
+        member and forgets the in-RAM trees."""
+        with self._lock:
+            pid = args["pid"]
+            raft_node = self.rafts.pop(pid, None)
+            if raft_node is not None:
+                raft_node.stop()
+            self.partitions.pop(pid, None)
+        return {}
 
     def rpc_set_enforcement(self, args, body):
         # advisory flags from the master's quota sweep; pushed to every

@@ -82,6 +82,7 @@ class RaftNode:
         self.leader: str | None = None
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
+        self.applied_index: dict[str, int] = {}  # peer's last_applied
         self._last_heard = time.monotonic()
         self._election_due = self._rand_timeout()
         self._stop = threading.Event()
@@ -241,6 +242,15 @@ class RaftNode:
             ev.set()  # wake replication threads so they exit promptly
         with self._apply_cv:
             self._apply_cv.notify_all()
+        # drain barrier: an apply already inside the lock finishes before
+        # stop() returns, and handlers that were queued ON the lock are
+        # rejected by the inside-lock stop checks — so a successor node
+        # over the same wal/FSM can never interleave with late applies
+        # from this instance
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
 
     def _rand_timeout(self) -> float:
         return random.uniform(self.ELECTION_MIN, self.ELECTION_MAX)
@@ -304,6 +314,8 @@ class RaftNode:
         if self._stop.is_set():
             return {"ok": False, "term": 0}
         with self._lock:
+            if self._stop.is_set():
+                return {"ok": False, "term": 0}
             if args["term"] < self.term:
                 return {"ok": False, "term": self.term}
             if args["term"] > self.term or self.role != "follower":
@@ -440,13 +452,13 @@ class RaftNode:
             result, exc = self._results.pop(index)
             self._waiting.pop(index, None)
             if exc is None and wait_all:
-                while any(self.match_index.get(p, 0) < index
+                while any(self.applied_index.get(p, 0) < index
                           for p in self.peers):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0 or self._stop.is_set():
                         raise TimeoutError(
-                            f"entry {index} committed but not yet on all "
-                            f"replicas")
+                            f"entry {index} committed but not yet applied "
+                            f"on all replicas")
                     self._apply_cv.wait(remaining)
         if exc is not None:
             raise exc
@@ -493,11 +505,16 @@ class RaftNode:
                     f"raft_{self.group_id}_snapshot", snapshot_args, timeout=5.0
                 )
                 with self._lock:
+                    if self._stop.is_set():
+                        return
                     if meta.get("term", 0) > self.term:
                         self._step_down(meta["term"])
                     elif meta.get("ok"):
                         self.match_index[peer] = snapshot_args["index"]
                         self.next_index[peer] = snapshot_args["index"] + 1
+                        self.applied_index[peer] = max(
+                            self.applied_index.get(peer, 0),
+                            snapshot_args["index"])
                         self._apply_cv.notify_all()
                 return
             meta, _ = self.pool.get(peer).call(
@@ -506,6 +523,8 @@ class RaftNode:
         except Exception:
             return
         with self._lock:
+            if self._stop.is_set():
+                return  # a successor instance owns the FSM now
             if meta.get("term", 0) > self.term:
                 self._step_down(meta["term"])
                 return
@@ -514,8 +533,15 @@ class RaftNode:
             if meta.get("ok"):
                 self.match_index[peer] = args["prev_index"] + len(args["entries"])
                 self.next_index[peer] = self.match_index[peer] + 1
+                self.applied_index[peer] = meta.get("applied", 0)
+                before = self.commit_index
                 self._advance_commit()
-                self._apply_cv.notify_all()  # wait_all proposers watch match
+                if self.commit_index > before:
+                    # push the new commit index out NOW so followers
+                    # apply within one round-trip, not one heartbeat
+                    for ev in self._repl_events.values():
+                        ev.set()
+                self._apply_cv.notify_all()  # wait_all proposers watch applied
             else:
                 hint = meta.get("conflict_index")
                 self.next_index[peer] = max(
@@ -582,10 +608,13 @@ class RaftNode:
 
     def handle_append(self, args: dict, body: bytes) -> dict:
         # a stopped node must not apply entries: its FSM's resources
-        # (stores, files) may already be closed
+        # (stores, files) may already be closed — or a successor raft
+        # instance may already be applying over the same FSM
         if self._stop.is_set():
             return {"ok": False, "term": 0}
         with self._lock:
+            if self._stop.is_set():  # re-check: we may have queued on the
+                return {"ok": False, "term": 0}  # lock across a stop()
             if args["term"] < self.term:
                 return {"ok": False, "term": self.term}
             if args["term"] > self.term or self.role != "follower":
@@ -627,7 +656,8 @@ class RaftNode:
             if args["commit"] > self.commit_index:
                 self.commit_index = min(args["commit"], self._last_index())
                 self._apply_committed()
-            return {"ok": True, "term": self.term}
+            return {"ok": True, "term": self.term,
+                    "applied": self.last_applied}
 
     def status(self) -> dict:
         with self._lock:

@@ -168,5 +168,33 @@ def test_leader_killed_mid_overwrite_storm_replicas_identical(trio, rng):
         except rpc.RpcError:
             pass
         time.sleep(0.1)
-    assert len(set(fps.values())) == 1, f"reborn replica diverged: {fps}"
+    statuses = {}
+    for n in [reborn] + nodes:
+        dp = n.partitions.get(1)
+        if dp and dp.raft:
+            statuses[f"{n.addr}#{n.node_id}"] = dp.raft.status()
+    if len(set(fps.values())) != 1:
+        # dump differing byte ranges for diagnosis
+        blobs = {}
+        for a in addrs:
+            _, d = pool.get(a).call(
+                "read", {"dp_id": 1, "extent_id": 1, "offset": 0,
+                         "length": size})
+            blobs[a] = d
+        ref = blobs[survivors[0]]
+        diffs = []
+        other = blobs[victim.addr]
+        i = 0
+        while i < size:
+            if ref[i] != other[i]:
+                j = i
+                while j < size and ref[j] != other[j]:
+                    j += 1
+                diffs.append((i, j))
+                i = j
+            else:
+                i += 1
+        raise AssertionError(
+            f"reborn diverged in ranges {diffs[:10]} (of {len(diffs)}); "
+            f"fps {fps}; raft {statuses}")
     reborn.stop()

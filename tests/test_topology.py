@@ -1,0 +1,132 @@
+"""Master topology: zone-spread placement, nodesets as failure domains,
+pluggable selectors, and meta-partition split on range exhaustion
+(reference: master/topology.go, node_selector.go,
+docs/source/design/master.md:23-34)."""
+
+import pytest
+
+from cubefs_tpu.blob.access import NodePool
+from cubefs_tpu.fs.client import FileSystem
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master, MasterError
+from cubefs_tpu.fs.metanode import MetaNode
+
+
+def _cluster(tmp_path, zones: dict[str, int], n_meta=2, selector="least_load",
+             **master_kw):
+    """zones: zone name -> datanode count."""
+    pool = NodePool()
+    master = Master(pool, selector=selector, **master_kw)
+    pool.bind("master", master)
+    metas, datas = [], []
+    for i in range(n_meta):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    i = 0
+    for zone, count in zones.items():
+        for _ in range(count):
+            addr = f"data{i}"
+            node = DataNode(i, str(tmp_path / addr), addr, pool)
+            pool.bind(addr, node)
+            master.register_datanode(addr, zone=zone)
+            datas.append(node)
+            i += 1
+    return pool, master, metas, datas
+
+
+def _zone_of(master, addr):
+    return master.datanodes[addr]["zone"]
+
+
+def test_replicas_spread_across_zones(tmp_path):
+    pool, master, metas, datas = _cluster(
+        tmp_path, {"z0": 2, "z1": 2, "z2": 2})
+    try:
+        view = master.create_volume("zv", mp_count=1, dp_count=6)
+        for dp in view["dps"]:
+            zones = {_zone_of(master, a) for a in dp["replicas"]}
+            assert zones == {"z0", "z1", "z2"}, \
+                f"dp {dp['dp_id']} not zone-spread: {dp['replicas']}"
+    finally:
+        for m in metas:
+            m.stop()
+        for d in datas:
+            d.stop()
+
+
+def test_single_zone_uses_one_nodeset(tmp_path):
+    pool, master, metas, datas = _cluster(tmp_path, {"z0": 6})
+    try:
+        nodesets = master._nodesets(sorted(master.datanodes))
+        assert len(nodesets) == 2
+        view = master.create_volume("nv", mp_count=1, dp_count=4)
+        for dp in view["dps"]:
+            # replicas land entirely inside ONE nodeset (failure domain)
+            assert any(set(dp["replicas"]) <= set(ns) for ns in nodesets), \
+                f"dp {dp['dp_id']} straddles nodesets: {dp['replicas']}"
+    finally:
+        for m in metas:
+            m.stop()
+        for d in datas:
+            d.stop()
+
+
+@pytest.mark.parametrize("selector", ["least_load", "round_robin",
+                                      "carry_weight"])
+def test_selectors_balance_load(tmp_path, selector):
+    pool, master, metas, datas = _cluster(
+        tmp_path, {"z0": 3}, selector=selector)
+    try:
+        view = master.create_volume("sv", mp_count=1, dp_count=6)
+        load = {}
+        for dp in view["dps"]:
+            for a in dp["replicas"]:
+                load[a] = load.get(a, 0) + 1
+        # 6 dps x 3 replicas over 3 nodes: perfectly balanced = 6 each
+        assert set(load.values()) == {6}, (selector, load)
+    finally:
+        for m in metas:
+            m.stop()
+        for d in datas:
+            d.stop()
+
+
+def test_unknown_selector_rejected():
+    with pytest.raises(MasterError):
+        Master(NodePool(), selector="nope")
+
+
+def test_meta_partition_split_without_interruption(tmp_path):
+    pool, master, metas, datas = _cluster(tmp_path, {"z0": 3})
+    master.INO_RANGE = 32  # tiny ranges so the split triggers fast
+    try:
+        view = master.create_volume("splitv", mp_count=1, dp_count=2)
+        fs = FileSystem(view, pool, master_addr="master")
+        fs.QUOTA_TTL = 0.0  # refresh the view on every create
+        assert len(master.client_view("splitv")["mps"]) == 1
+        # fill past the threshold; the sweep appends a new partition
+        for i in range(26):
+            fs.write_file(f"/f{i}", b"x")
+        actions = master.check_meta_partitions()
+        assert actions and actions[0][0] == "splitv"
+        mps = master.client_view("splitv")["mps"]
+        assert len(mps) == 2
+        assert mps[1]["start"] == mps[0]["end"]
+        # no interruption: existing files still readable, new creates
+        # keep landing (spilling into the new partition as ranges fill)
+        assert fs.read_file("/f0") == b"x"
+        for i in range(26, 40):
+            fs.write_file(f"/g{i}", b"y")
+        for i in range(26, 40):
+            assert fs.read_file(f"/g{i}") == b"y"
+        # the new partition actually absorbed inodes
+        used = {fs.meta._mp_for(fs.resolve(f"/g{i}"))["pid"]
+                for i in range(26, 40)}
+        assert mps[1]["pid"] in used
+    finally:
+        for m in metas:
+            m.stop()
+        for d in datas:
+            d.stop()
