@@ -64,12 +64,6 @@ class MetaWrapper:
                 raise FsError(e.code - 400, e.message) from None
             raise
 
-    def pick_create_mp(self) -> dict:
-        with self._lock:
-            mp = self.mps[self._rr % len(self.mps)]
-            self._rr += 1
-            return mp
-
     # ---- inode/dentry API (reference sdk/meta/api.go shapes) ----
     def inode_create(self, typ: str, mode: int = 0o644, target=None,
                      quota_ids: list[int] | None = None) -> dict:
@@ -594,10 +588,29 @@ class FileSystem:
         self.data.write(self.meta, ino, off, data)
         return ino
 
+    def pwrite_file(self, path: str, offset: int, data: bytes) -> int:
+        """pwrite(2)-style offset write, creating the file on demand
+        (the native C ABI's write leg)."""
+        try:
+            ino = self.resolve(path)
+        except FsError:
+            ino = self.create(path)
+        self.data.write(self.meta, ino, offset, data)
+        return ino
+
+    def truncate_file(self, path: str, size: int) -> None:
+        ino = self.resolve(path)
+        freed = self.meta.truncate(ino, size)
+        self.data.close_stream(ino)
+        self.data.release_extents(freed)
+
     def read_file(self, path: str, offset: int = 0, length: int | None = None) -> bytes:
         inode = self.meta.inode_get(self.resolve(path))
         if length is None:
-            length = inode["size"] - offset
+            length = max(0, inode["size"] - offset)
+        else:
+            # pread(2) semantics: reads at/past EOF return short/empty
+            length = max(0, min(length, inode["size"] - offset))
         return self.data.read(inode, offset, length)
 
     def readdir(self, path: str) -> dict[str, int]:

@@ -461,12 +461,29 @@ class MetaPartition:
         inode = self.inodes.get(r["ino"])
         if inode is None:
             raise MetaError(ENOENT, f"inode {r['ino']}")
-        inode["size"] = r["size"]
-        if r["size"] == 0:
+        size = r["size"]
+        inode["size"] = size
+        if size == 0:
             old = inode["extents"]
             inode["extents"] = []
             return {"extents": old}
-        return {"extents": []}
+        # shrink: drop keys entirely past the new EOF (freed for GC) and
+        # clip a straddling key's mapped length — reads in [size, later
+        # writes) then fall into an uncovered gap and return zeros, never
+        # resurrected pre-truncate bytes
+        kept, freed = [], []
+        for ek in inode["extents"]:
+            fo = ek["file_offset"]
+            if fo >= size:
+                freed.append(ek)
+            elif fo + ek["size"] > size:
+                clipped = dict(ek)
+                clipped["size"] = size - fo
+                kept.append(clipped)  # physical tail stays allocated
+            else:
+                kept.append(ek)
+        inode["extents"] = kept
+        return {"extents": freed}
 
     # ---------------- reads (no apply) ----------------
     def inode_get(self, ino: int) -> dict:

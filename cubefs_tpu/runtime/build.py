@@ -124,5 +124,47 @@ def load() -> ctypes.CDLL:
                 c.c_char_p, c.c_void_p]
             lib.cfs_codec_crc32.argtypes = [
                 c.c_char_p, c.c_int, c.c_uint64, c.c_char_p, c.c_uint64, c.c_void_p]
+            # POSIX file surface over the FsGateway (libcfs analog)
+            lib.cfs_mount.restype = c.c_void_p
+            lib.cfs_mount.argtypes = [c.c_char_p, c.c_int]
+            lib.cfs_unmount.argtypes = [c.c_void_p]
+            lib.cfs_open.restype = c.c_int
+            lib.cfs_open.argtypes = [c.c_void_p, c.c_char_p, c.c_int, c.c_int]
+            lib.cfs_close.restype = c.c_int
+            lib.cfs_close.argtypes = [c.c_void_p, c.c_int]
+            lib.cfs_read.restype = c.c_int64
+            lib.cfs_read.argtypes = [c.c_void_p, c.c_int, c.c_void_p,
+                                     c.c_uint64]
+            lib.cfs_pread.restype = c.c_int64
+            lib.cfs_pread.argtypes = [c.c_void_p, c.c_int, c.c_void_p,
+                                      c.c_uint64, c.c_uint64]
+            lib.cfs_write.restype = c.c_int64
+            lib.cfs_write.argtypes = [c.c_void_p, c.c_int, c.c_char_p,
+                                      c.c_uint64]
+            lib.cfs_pwrite.restype = c.c_int64
+            lib.cfs_pwrite.argtypes = [c.c_void_p, c.c_int, c.c_char_p,
+                                       c.c_uint64, c.c_uint64]
+            lib.cfs_lseek.restype = c.c_int64
+            lib.cfs_lseek.argtypes = [c.c_void_p, c.c_int, c.c_int64, c.c_int]
+            lib.cfs_stat_path.restype = c.c_int
+            lib.cfs_stat_path.argtypes = [
+                c.c_void_p, c.c_char_p, c.POINTER(c.c_uint64),
+                c.POINTER(c.c_uint32), c.POINTER(c.c_uint32),
+                c.POINTER(c.c_uint64)]
+            lib.cfs_mkdirs.restype = c.c_int
+            lib.cfs_mkdirs.argtypes = [c.c_void_p, c.c_char_p]
+            lib.cfs_readdir.restype = c.c_int64
+            lib.cfs_readdir.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
+                                        c.c_uint64]
+            lib.cfs_unlink.restype = c.c_int
+            lib.cfs_unlink.argtypes = [c.c_void_p, c.c_char_p]
+            lib.cfs_rmdir.restype = c.c_int
+            lib.cfs_rmdir.argtypes = [c.c_void_p, c.c_char_p]
+            lib.cfs_rename.restype = c.c_int
+            lib.cfs_rename.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p]
+            lib.cfs_truncate.restype = c.c_int
+            lib.cfs_truncate.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+            lib.cfs_flush.restype = c.c_int
+            lib.cfs_flush.argtypes = [c.c_void_p, c.c_int]
             _lib = lib
     return _lib

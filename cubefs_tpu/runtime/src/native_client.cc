@@ -1,14 +1,19 @@
 // Native client library: the libcfs-analog C ABI.
 //
 // Role parity: client/libsdk (cgo libcfs.so with //export cfs_* symbols
-// consumed by the Java SDK) and the cgo/gRPC sidecar boundary named in
-// BASELINE.json. This is a dependency-free C++ HTTP/1.1 client for the
-// framework's RPC wire shape (POST /method, JSON args in X-Rpc-Args,
-// binary body), exposing:
+// consumed by the Java SDK, libsdk.go:289-840) and the cgo/gRPC sidecar
+// boundary named in BASELINE.json. This is a dependency-free C++
+// HTTP/1.1 client for the framework's RPC wire shape (POST /method,
+// JSON args in X-Rpc-Args, binary body), exposing:
 //   cfs_blob_put / cfs_blob_get / cfs_blob_delete  — access gateway
 //   cfs_codec_encode / cfs_codec_crc32             — codec sidecar
-// so Go/Java/C++ storage nodes can drive the TPU codec and the blob
-// plane without a Python runtime.
+//   cfs_mount + cfs_open/read/write/lseek/close, cfs_stat_path,
+//   cfs_mkdirs, cfs_readdir, cfs_unlink, cfs_rename, cfs_truncate
+//     — the POSIX file surface over an FsGateway daemon (the
+//       reference embeds the SDK via cgo; this framework's native
+//       boundary is a local daemon instead, the bcache pattern)
+// so Go/Java/C++ consumers can drive the TPU codec, the blob plane and
+// the file plane without a Python runtime.
 //
 // Build: part of libcubefs_rt.so (see runtime/build.py).
 
@@ -21,6 +26,8 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <map>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -108,12 +115,375 @@ int http_post(const char* host, int port, const std::string& path,
   return status;
 }
 
+std::string json_escape(const char* s) {
+  std::string out;
+  for (const char* p = s; *p; ++p) {
+    unsigned char c = (unsigned char)*p;
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += c;
+    } else if (c < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof buf, "\\u%04x", c);
+      out += buf;
+    } else {
+      out += c;
+    }
+  }
+  return out;
+}
+
+// ---------------- POSIX-surface client state ----------------
+struct CfsFile {
+  std::string path;
+  uint64_t offset = 0;
+  bool append = false;
+};
+
+struct CfsClient {
+  std::string host;
+  int port = 0;
+  std::mutex mu;
+  std::map<int, CfsFile> fds;
+  int next_fd = 3;
+};
+
+// open(2) flag bits (Linux values, the ABI contract)
+constexpr int kO_WRONLY = 01;
+constexpr int kO_RDWR = 02;
+constexpr int kO_CREAT = 0100;
+constexpr int kO_TRUNC = 01000;
+constexpr int kO_APPEND = 02000;
+
+int fs_call(CfsClient* c, const std::string& method,
+            const std::string& args, const uint8_t* body, size_t body_len,
+            std::vector<uint8_t>* resp) {
+  return http_post(c->host.c_str(), c->port, method, args, body, body_len,
+                   resp);
+}
+
+// pull an integer field out of the stashed X-Rpc-Resp JSON meta
+bool meta_int(const char* key, long long* out) {
+  std::string pat = std::string("\"") + key + "\":";
+  size_t p = g_nc_meta.find(pat);
+  if (p == std::string::npos) return false;
+  *out = atoll(g_nc_meta.c_str() + p + pat.size());
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
 
 const char* cfs_last_error() { return g_nc_err.c_str(); }
 const char* cfs_last_meta() { return g_nc_meta.c_str(); }
+
+// ---------------- POSIX file surface (libsdk.go:289-840 analog) ------
+
+void* cfs_mount(const char* host, int port) {
+  CfsClient* c = new CfsClient();
+  c->host = host;
+  c->port = port;
+  // probe the gateway so a bad address fails at mount, not first IO
+  std::vector<uint8_t> resp;
+  if (http_post(host, port, "fs_stat", "{\"path\": \"/\"}", nullptr, 0,
+                &resp) != 200) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void cfs_unmount(void* h) { delete (CfsClient*)h; }
+
+int cfs_open(void* h, const char* path, int flags, int mode) {
+  CfsClient* c = (CfsClient*)h;
+  std::string p = json_escape(path);
+  std::vector<uint8_t> resp;
+  int st = fs_call(c, "fs_stat", "{\"path\": \"" + p + "\"}", nullptr, 0,
+                   &resp);
+  uint64_t size = 0;
+  if (st == 200 && resp.size() >= 8) {
+    memcpy(&size, resp.data(), 8);
+    if (flags & kO_TRUNC) {
+      if (fs_call(c, "fs_truncate",
+                  "{\"path\": \"" + p + "\", \"size\": 0}", nullptr, 0,
+                  nullptr) != 200)
+        return -1;
+      size = 0;
+    }
+  } else if (flags & kO_CREAT) {
+    char args[4352];
+    snprintf(args, sizeof args, "{\"path\": \"%s\", \"mode\": %d}",
+             p.c_str(), mode);
+    int cst = fs_call(c, "fs_create", args, nullptr, 0, nullptr);
+    if (cst == 417) {
+      // lost the create race (EEXIST): O_CREAT without O_EXCL must open
+      // the existing file, honoring O_TRUNC
+      if (flags & kO_TRUNC) {
+        if (fs_call(c, "fs_truncate",
+                    "{\"path\": \"" + p + "\", \"size\": 0}", nullptr,
+                    0, nullptr) != 200)
+          return -1;
+      } else if (fs_call(c, "fs_stat", "{\"path\": \"" + p + "\"}",
+                         nullptr, 0, &resp) == 200 && resp.size() >= 8) {
+        memcpy(&size, resp.data(), 8);
+      }
+    } else if (cst != 200) {
+      return -1;
+    }
+  } else {
+    return -1;  // ENOENT; detail in cfs_last_error()
+  }
+  std::lock_guard<std::mutex> g(c->mu);
+  int fd = c->next_fd++;
+  CfsFile f;
+  f.path = path;
+  f.append = (flags & kO_APPEND) != 0;
+  f.offset = f.append ? size : 0;
+  c->fds[fd] = f;
+  return fd;
+}
+
+int cfs_close(void* h, int fd) {
+  CfsClient* c = (CfsClient*)h;
+  std::lock_guard<std::mutex> g(c->mu);
+  return c->fds.erase(fd) ? 0 : -1;
+}
+
+int64_t cfs_pread(void* h, int fd, void* buf, uint64_t n, uint64_t off) {
+  CfsClient* c = (CfsClient*)h;
+  std::string path;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->fds.find(fd);
+    if (it == c->fds.end()) {
+      nc_set_err("bad fd");
+      return -1;
+    }
+    path = it->second.path;
+  }
+  char args[4352];
+  snprintf(args, sizeof args,
+           "{\"path\": \"%s\", \"offset\": %llu, \"length\": %llu}",
+           json_escape(path.c_str()).c_str(), (unsigned long long)off,
+           (unsigned long long)n);
+  std::vector<uint8_t> resp;
+  if (fs_call(c, "fs_read", args, nullptr, 0, &resp) != 200) return -1;
+  if (resp.size() > n) {
+    nc_set_err("gateway returned more than requested");
+    return -1;
+  }
+  memcpy(buf, resp.data(), resp.size());
+  return (int64_t)resp.size();
+}
+
+int64_t cfs_read(void* h, int fd, void* buf, uint64_t n) {
+  CfsClient* c = (CfsClient*)h;
+  uint64_t off;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->fds.find(fd);
+    if (it == c->fds.end()) {
+      nc_set_err("bad fd");
+      return -1;
+    }
+    off = it->second.offset;
+  }
+  int64_t got = cfs_pread(h, fd, buf, n, off);
+  if (got > 0) {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->fds.find(fd);
+    if (it != c->fds.end()) it->second.offset = off + got;
+  }
+  return got;
+}
+
+int64_t cfs_pwrite(void* h, int fd, const void* buf, uint64_t n,
+                   uint64_t off) {
+  CfsClient* c = (CfsClient*)h;
+  std::string path;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->fds.find(fd);
+    if (it == c->fds.end()) {
+      nc_set_err("bad fd");
+      return -1;
+    }
+    path = it->second.path;
+  }
+  char args[4352];
+  snprintf(args, sizeof args, "{\"path\": \"%s\", \"offset\": %llu}",
+           json_escape(path.c_str()).c_str(), (unsigned long long)off);
+  if (fs_call(c, "fs_write", args, (const uint8_t*)buf, n, nullptr) != 200)
+    return -1;
+  return (int64_t)n;
+}
+
+int64_t cfs_write(void* h, int fd, const void* buf, uint64_t n) {
+  CfsClient* c = (CfsClient*)h;
+  uint64_t off;
+  bool append;
+  std::string path;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->fds.find(fd);
+    if (it == c->fds.end()) {
+      nc_set_err("bad fd");
+      return -1;
+    }
+    off = it->second.offset;
+    append = it->second.append;
+    path = it->second.path;
+  }
+  if (append) {
+    // O_APPEND: every write lands at the CURRENT end of file; a failed
+    // size probe must fail the write (a stale cached offset would
+    // silently overwrite existing bytes)
+    std::vector<uint8_t> resp;
+    if (fs_call(c, "fs_stat",
+                "{\"path\": \"" + json_escape(path.c_str()) + "\"}",
+                nullptr, 0, &resp) != 200 || resp.size() < 8) {
+      nc_set_err("O_APPEND size probe failed: " + g_nc_err);
+      return -1;
+    }
+    memcpy(&off, resp.data(), 8);
+  }
+  int64_t put = cfs_pwrite(h, fd, buf, n, off);
+  if (put > 0) {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->fds.find(fd);
+    if (it != c->fds.end()) it->second.offset = off + put;
+  }
+  return put;
+}
+
+int64_t cfs_lseek(void* h, int fd, int64_t off, int whence) {
+  CfsClient* c = (CfsClient*)h;
+  uint64_t size = 0;
+  std::string path;
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    auto it = c->fds.find(fd);
+    if (it == c->fds.end()) {
+      nc_set_err("bad fd");
+      return -1;
+    }
+    path = it->second.path;
+  }
+  if (whence == 2) {  // SEEK_END
+    std::vector<uint8_t> resp;
+    if (fs_call(c, "fs_stat",
+                "{\"path\": \"" + json_escape(path.c_str()) + "\"}",
+                nullptr, 0, &resp) != 200 || resp.size() < 8)
+      return -1;
+    memcpy(&size, resp.data(), 8);
+  }
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->fds.find(fd);
+  if (it == c->fds.end()) return -1;
+  int64_t base = whence == 0 ? 0
+                 : whence == 1 ? (int64_t)it->second.offset
+                               : (int64_t)size;
+  int64_t pos = base + off;
+  if (pos < 0) {
+    nc_set_err("negative seek");
+    return -1;
+  }
+  it->second.offset = (uint64_t)pos;
+  return pos;
+}
+
+// out: size (u64), mode (u32), type (u32: 0 file / 1 dir / 2 symlink),
+// mtime seconds (u64) — the gateway's fixed-layout stat record
+int cfs_stat_path(void* h, const char* path, uint64_t* size, uint32_t* mode,
+                  uint32_t* type, uint64_t* mtime) {
+  CfsClient* c = (CfsClient*)h;
+  std::vector<uint8_t> resp;
+  if (fs_call(c, "fs_stat",
+              "{\"path\": \"" + json_escape(path) + "\"}", nullptr, 0,
+              &resp) != 200 || resp.size() < 24)
+    return -1;
+  if (size) memcpy(size, resp.data(), 8);
+  if (mode) memcpy(mode, resp.data() + 8, 4);
+  if (type) memcpy(type, resp.data() + 12, 4);
+  if (mtime) memcpy(mtime, resp.data() + 16, 8);
+  return 0;
+}
+
+int cfs_mkdirs(void* h, const char* path) {
+  CfsClient* c = (CfsClient*)h;
+  std::string acc;
+  std::string p(path);
+  size_t i = 0;
+  while (i < p.size()) {
+    size_t j = p.find('/', i + 1);
+    if (j == std::string::npos) j = p.size();
+    acc = p.substr(0, j);
+    if (!acc.empty() && acc != "/") {
+      int st = fs_call(c, "fs_mkdir",
+                       "{\"path\": \"" + json_escape(acc.c_str()) + "\"}",
+                       nullptr, 0, nullptr);
+      if (st != 200 && st != 417) return -1;  // 417 = EEXIST: fine
+    }
+    i = j;
+  }
+  return 0;
+}
+
+// newline-joined names into out; returns entry count or -1
+int64_t cfs_readdir(void* h, const char* path, char* out, uint64_t cap) {
+  CfsClient* c = (CfsClient*)h;
+  std::vector<uint8_t> resp;
+  if (fs_call(c, "fs_readdir",
+              "{\"path\": \"" + json_escape(path) + "\"}", nullptr, 0,
+              &resp) != 200)
+    return -1;
+  if (resp.size() + 1 > cap) {
+    nc_set_err("readdir buffer too small");
+    return -2;
+  }
+  memcpy(out, resp.data(), resp.size());
+  out[resp.size()] = 0;
+  long long n = 0;
+  meta_int("count", &n);
+  return n;
+}
+
+int cfs_unlink(void* h, const char* path) {
+  CfsClient* c = (CfsClient*)h;
+  return fs_call(c, "fs_unlink",
+                 "{\"path\": \"" + json_escape(path) + "\"}", nullptr, 0,
+                 nullptr) == 200
+             ? 0
+             : -1;
+}
+
+int cfs_rmdir(void* h, const char* path) { return cfs_unlink(h, path); }
+
+int cfs_rename(void* h, const char* oldp, const char* newp) {
+  CfsClient* c = (CfsClient*)h;
+  return fs_call(c, "fs_rename",
+                 "{\"old\": \"" + json_escape(oldp) + "\", \"new\": \"" +
+                     json_escape(newp) + "\"}",
+                 nullptr, 0, nullptr) == 200
+             ? 0
+             : -1;
+}
+
+int cfs_truncate(void* h, const char* path, uint64_t size) {
+  CfsClient* c = (CfsClient*)h;
+  char args[4352];
+  snprintf(args, sizeof args, "{\"path\": \"%s\", \"size\": %llu}",
+           json_escape(path).c_str(), (unsigned long long)size);
+  return fs_call(c, "fs_truncate", args, nullptr, 0, nullptr) == 200 ? 0 : -1;
+}
+
+int cfs_flush(void* h, int fd) {
+  (void)h;
+  (void)fd;
+  return 0;  // writes are synchronous through the gateway
+}
 
 // PUT via access; returns 0 and writes the location JSON into loc_out.
 int cfs_blob_put(const char* host, int port, const uint8_t* data,

@@ -1,0 +1,116 @@
+/* POSIX C ABI end-to-end: a real C program round-tripping files through
+ * libcubefs_rt.so against a live FsGateway (usage: fs_abi_test HOST PORT).
+ * Exercises mount, mkdirs, open(O_CREAT|O_TRUNC|O_APPEND), write/read,
+ * pread/pwrite, lseek, stat, readdir, rename, truncate, unlink. */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern void* cfs_mount(const char* host, int port);
+extern void cfs_unmount(void* h);
+extern int cfs_open(void* h, const char* path, int flags, int mode);
+extern int cfs_close(void* h, int fd);
+extern int64_t cfs_read(void* h, int fd, void* buf, uint64_t n);
+extern int64_t cfs_pread(void* h, int fd, void* buf, uint64_t n,
+                         uint64_t off);
+extern int64_t cfs_write(void* h, int fd, const void* buf, uint64_t n);
+extern int64_t cfs_pwrite(void* h, int fd, const void* buf, uint64_t n,
+                          uint64_t off);
+extern int64_t cfs_lseek(void* h, int fd, int64_t off, int whence);
+extern int cfs_stat_path(void* h, const char* p, uint64_t* size,
+                         uint32_t* mode, uint32_t* type, uint64_t* mtime);
+extern int cfs_mkdirs(void* h, const char* path);
+extern int64_t cfs_readdir(void* h, const char* path, char* out,
+                           uint64_t cap);
+extern int cfs_unlink(void* h, const char* path);
+extern int cfs_rename(void* h, const char* o, const char* n);
+extern int cfs_truncate(void* h, const char* path, uint64_t size);
+extern const char* cfs_last_error(void);
+
+#define O_WRONLY 01
+#define O_CREAT 0100
+#define O_TRUNC 01000
+#define O_APPEND 02000
+
+#define CHECK(cond, msg)                                          \
+  do {                                                            \
+    if (!(cond)) {                                                \
+      fprintf(stderr, "FAIL %s: %s\n", msg, cfs_last_error());    \
+      return 1;                                                   \
+    }                                                             \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc != 3) return 2;
+  void* h = cfs_mount(argv[1], atoi(argv[2]));
+  CHECK(h != NULL, "mount");
+
+  CHECK(cfs_mkdirs(h, "/c/abi/deep") == 0, "mkdirs");
+
+  int fd = cfs_open(h, "/c/abi/deep/file.bin", O_WRONLY | O_CREAT, 0644);
+  CHECK(fd >= 0, "open-create");
+  const char* msg = "hello from plain C";
+  CHECK(cfs_write(h, fd, msg, strlen(msg)) == (int64_t)strlen(msg),
+        "write");
+  CHECK(cfs_write(h, fd, "!", 1) == 1, "write2");  /* offset advanced */
+  CHECK(cfs_close(h, fd) == 0, "close");
+
+  uint64_t size = 0, mtime = 0;
+  uint32_t mode = 0, type = 9;
+  CHECK(cfs_stat_path(h, "/c/abi/deep/file.bin", &size, &mode, &type,
+                      &mtime) == 0, "stat");
+  CHECK(size == strlen(msg) + 1, "stat-size");
+  CHECK(type == 0, "stat-type-file");
+
+  fd = cfs_open(h, "/c/abi/deep/file.bin", 0, 0);
+  CHECK(fd >= 0, "open-read");
+  char buf[64] = {0};
+  CHECK(cfs_read(h, fd, buf, sizeof buf) == (int64_t)size, "read");
+  CHECK(strncmp(buf, "hello from plain C!", size) == 0, "read-bytes");
+  /* pread does not move the cursor; lseek does */
+  memset(buf, 0, sizeof buf);
+  CHECK(cfs_pread(h, fd, buf, 5, 6) == 5, "pread");
+  CHECK(strncmp(buf, "from ", 5) == 0, "pread-bytes");
+  CHECK(cfs_lseek(h, fd, 0, 0) == 0, "lseek-set");
+  CHECK(cfs_lseek(h, fd, 0, 2) == (int64_t)size, "lseek-end");
+  CHECK(cfs_close(h, fd) == 0, "close2");
+
+  /* overwrite a range */
+  fd = cfs_open(h, "/c/abi/deep/file.bin", O_WRONLY, 0);
+  CHECK(cfs_pwrite(h, fd, "HELLO", 5, 0) == 5, "pwrite");
+  CHECK(cfs_close(h, fd) == 0, "close3");
+  fd = cfs_open(h, "/c/abi/deep/file.bin", 0, 0);
+  memset(buf, 0, sizeof buf);
+  CHECK(cfs_read(h, fd, buf, 5) == 5 && strncmp(buf, "HELLO", 5) == 0,
+        "pwrite-visible");
+  CHECK(cfs_close(h, fd) == 0, "close4");
+
+  /* O_APPEND lands at EOF */
+  fd = cfs_open(h, "/c/abi/deep/file.bin", O_WRONLY | O_APPEND, 0);
+  CHECK(cfs_write(h, fd, "+tail", 5) == 5, "append");
+  CHECK(cfs_close(h, fd) == 0, "close5");
+  CHECK(cfs_stat_path(h, "/c/abi/deep/file.bin", &size, &mode, &type,
+                      &mtime) == 0 && size == strlen(msg) + 1 + 5,
+        "append-size");
+
+  /* readdir + rename + truncate + unlink */
+  char names[256] = {0};
+  CHECK(cfs_readdir(h, "/c/abi/deep", names, sizeof names) == 1,
+        "readdir-count");
+  CHECK(strcmp(names, "file.bin") == 0, "readdir-names");
+  CHECK(cfs_rename(h, "/c/abi/deep/file.bin", "/c/abi/deep/moved.bin") == 0,
+        "rename");
+  CHECK(cfs_stat_path(h, "/c/abi/deep/file.bin", &size, &mode, &type,
+                      &mtime) != 0, "rename-old-gone");
+  CHECK(cfs_truncate(h, "/c/abi/deep/moved.bin", 5) == 0, "truncate");
+  CHECK(cfs_stat_path(h, "/c/abi/deep/moved.bin", &size, &mode, &type,
+                      &mtime) == 0 && size == 5, "truncate-size");
+  CHECK(cfs_unlink(h, "/c/abi/deep/moved.bin") == 0, "unlink");
+  CHECK(cfs_stat_path(h, "/c/abi/deep/moved.bin", &size, &mode, &type,
+                      &mtime) != 0, "unlink-gone");
+
+  cfs_unmount(h);
+  printf("fs_abi_test OK\n");
+  return 0;
+}

@@ -1,0 +1,141 @@
+"""Native POSIX C ABI over the FsGateway: ctypes-level checks plus a
+real compiled C program (tests/c/fs_abi_test.c) round-tripping files
+through libcubefs_rt.so (reference: client/libsdk/libsdk.go:289-840)."""
+
+import ctypes
+import os
+import subprocess
+
+import pytest
+
+from cubefs_tpu.blob.access import NodePool
+from cubefs_tpu.fs.client import FileSystem
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.fsgateway import FsGateway
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+from cubefs_tpu.runtime import build as rt
+from cubefs_tpu.utils import rpc
+
+
+@pytest.fixture
+def gateway(tmp_path):
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas, datas = [], []
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    for i in range(3):
+        node = DataNode(i, str(tmp_path / f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", node)
+        master.register_datanode(f"data{i}")
+        datas.append(node)
+    view = master.create_volume("abivol", mp_count=2, dp_count=2)
+    fs = FileSystem(view, pool)
+    srv = rpc.RpcServer(rpc.expose(FsGateway(fs)), service="fsgw").start()
+    host, port = srv.addr.split(":")
+    yield host.encode(), int(port), fs
+    srv.stop()
+    for m in metas:
+        m.stop()
+    for d in datas:
+        d.stop()
+
+
+O_WRONLY, O_CREAT, O_TRUNC, O_APPEND = 0o1, 0o100, 0o1000, 0o2000
+
+
+def test_ctypes_roundtrip(gateway):
+    host, port, fs = gateway
+    lib = rt.load()
+    h = lib.cfs_mount(host, port)
+    assert h, lib.cfs_last_error()
+    try:
+        assert lib.cfs_mkdirs(h, b"/py/dir") == 0
+        fd = lib.cfs_open(h, b"/py/dir/f", O_WRONLY | O_CREAT, 0o644)
+        assert fd >= 0, lib.cfs_last_error()
+        assert lib.cfs_write(h, fd, b"abcdef", 6) == 6
+        assert lib.cfs_close(h, fd) == 0
+        # visible through the Python SDK too (same metadata plane)
+        assert fs.read_file("/py/dir/f") == b"abcdef"
+        # and the reverse: SDK writes visible to the C side
+        fs.write_file("/py/dir/g", b"from python")
+        fd = lib.cfs_open(h, b"/py/dir/g", 0, 0)
+        buf = ctypes.create_string_buffer(64)
+        n = lib.cfs_read(h, fd, buf, 64)
+        assert buf.raw[:n] == b"from python"
+        assert lib.cfs_close(h, fd) == 0
+        size = ctypes.c_uint64()
+        mode = ctypes.c_uint32()
+        typ = ctypes.c_uint32()
+        mtime = ctypes.c_uint64()
+        assert lib.cfs_stat_path(h, b"/py/dir", ctypes.byref(size),
+                                 ctypes.byref(mode), ctypes.byref(typ),
+                                 ctypes.byref(mtime)) == 0
+        assert typ.value == 1  # dir
+        names = ctypes.create_string_buffer(256)
+        cnt = lib.cfs_readdir(h, b"/py/dir", names, 256)
+        assert cnt == 2
+        assert set(names.value.split(b"\n")) == {b"f", b"g"}
+    finally:
+        lib.cfs_unmount(h)
+
+
+def test_open_semantics(gateway):
+    host, port, fs = gateway
+    lib = rt.load()
+    h = lib.cfs_mount(host, port)
+    try:
+        # O_CREAT off + missing file -> error
+        assert lib.cfs_open(h, b"/nope", 0, 0) == -1
+        fs.write_file("/t", b"0123456789")
+        # O_TRUNC empties
+        fd = lib.cfs_open(h, b"/t", O_WRONLY | O_TRUNC, 0)
+        assert fd >= 0
+        assert lib.cfs_close(h, fd) == 0
+        assert fs.stat("/t")["size"] == 0
+    finally:
+        lib.cfs_unmount(h)
+
+
+def test_mount_bad_address_fails():
+    lib = rt.load()
+    assert not lib.cfs_mount(b"127.0.0.1", 1)  # nothing listening
+
+
+def test_compiled_c_program_roundtrip(gateway, tmp_path):
+    """The VERDICT criterion: an actual C binary linked against
+    libcubefs_rt.so drives the full POSIX surface."""
+    host, port, fs = gateway
+    so = rt.build()
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "c", "fs_abi_test.c")
+    exe = str(tmp_path / "fs_abi_test")
+    subprocess.run(
+        ["gcc", "-o", exe, src, so],
+        check=True, capture_output=True, text=True)
+    out = subprocess.run(
+        [exe, host.decode(), str(port)],
+        capture_output=True, text=True,
+        env={**os.environ, "LD_LIBRARY_PATH": os.path.dirname(so)})
+    assert out.returncode == 0, f"stdout={out.stdout} stderr={out.stderr}"
+    assert "fs_abi_test OK" in out.stdout
+
+
+def test_truncate_then_extend_reads_zeros(gateway):
+    """POSIX: bytes between a shrink-truncate and a later write past it
+    read as ZEROS, never as resurrected pre-truncate data."""
+    host, port, fs = gateway
+    fs.write_file("/tz", bytes(range(1, 251)) * 4)  # 1000 non-zero bytes
+    fs.truncate_file("/tz", 100)
+    assert fs.stat("/tz")["size"] == 100
+    fs.pwrite_file("/tz", 500, b"tail")
+    assert fs.stat("/tz")["size"] == 504
+    data = fs.read_file("/tz")
+    assert data[:100] == (bytes(range(1, 251)) * 4)[:100]
+    assert data[100:500] == bytes(400), "hole must read as zeros"
+    assert data[500:] == b"tail"
