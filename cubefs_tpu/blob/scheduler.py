@@ -515,5 +515,23 @@ class Scheduler:
         self.fail_task(args["task_id"], args["worker_id"], args.get("error", ""))
         return {}
 
+    TASK_KINDS = ("disk_repair", "shard_repair", "blob_delete", "balance",
+                  "volume_inspect", "compact")
+
+    def rpc_task_switch(self, args, body):
+        """Runtime kill-switches per background task kind (taskswitch
+        analog): action=enable|disable|list. Unknown kinds are rejected
+        so a typo can never silently leave a task running."""
+        action = args.get("action", "list")
+        if action in ("enable", "disable"):
+            kind = args.get("kind")
+            if kind not in self.TASK_KINDS:
+                raise rpc.RpcError(
+                    400, f"unknown task kind {kind!r}; "
+                         f"have {list(self.TASK_KINDS)}")
+            getattr(self.switch, action)(kind)
+        return {"switches": {k: self.switch.enabled(k)
+                             for k in self.TASK_KINDS}}
+
     def rpc_stats(self, args, body):
         return self.stats()

@@ -68,6 +68,21 @@ def main(argv=None):
     p_blob.add_argument("args", nargs="*")
     p_blob.add_argument("--access", required=True)
 
+    p_node = sub.add_parser("node")
+    p_node.add_argument("action", choices=["list", "decommission"])
+    p_node.add_argument("--master", required=True)
+    p_node.add_argument("--addr", help="datanode address (for decommission)")
+
+    p_mp = sub.add_parser("mp")
+    p_mp.add_argument("action", choices=["split", "check"])
+    p_mp.add_argument("--master", required=True)
+    p_mp.add_argument("--vol", help="volume name (for split)")
+
+    p_tasks = sub.add_parser("tasks")
+    p_tasks.add_argument("action", choices=["list", "enable", "disable"])
+    p_tasks.add_argument("--scheduler", required=True)
+    p_tasks.add_argument("--kind", help="task kind (for enable/disable)")
+
     args = ap.parse_args(argv)
     from .utils import rpc
 
@@ -135,6 +150,34 @@ def main(argv=None):
             fs.mkdir(a[0])
         elif args.action == "mv":
             fs.rename(a[0], a[1])
+
+    elif args.group == "node":
+        master = rpc.Client(args.master)
+        if args.action == "decommission":
+            if not args.addr:
+                sys.exit("node decommission needs --addr")
+            out = master.call("decommission_datanode", {"addr": args.addr})[0]
+        else:
+            out = master.call("node_list", {})[0]
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "mp":
+        master = rpc.Client(args.master)
+        if args.action == "split":
+            if not args.vol:
+                sys.exit("mp split needs --vol")
+            out = master.call("split_meta_partition", {"name": args.vol})[0]
+        else:
+            out = master.call("check_meta_partitions", {})[0]
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "tasks":
+        sched = rpc.Client(args.scheduler)
+        if args.action in ("enable", "disable") and not args.kind:
+            sys.exit(f"tasks {args.action} needs --kind")
+        out = sched.call("task_switch", {"action": args.action,
+                                         "kind": args.kind})[0]
+        print(json.dumps(out, indent=2))
 
     elif args.group == "blob":
         a = args.args

@@ -91,6 +91,9 @@ class Master(ReplicatedFsm):
         # soft usage view from the latest quota sweep — NOT part of the
         # replicated FSM (a new leader re-learns it on its first sweep)
         self.vol_usage: dict[str, int] = {}
+        # operator drains ARE replicated state: a restart or failover
+        # must not re-place partitions on a drained node
+        self.decommissioned: set[str] = set()
         self._next_pid = 1
         self._next_dp = 1
         self.data_dir = data_dir
@@ -98,11 +101,13 @@ class Master(ReplicatedFsm):
 
     def _state_dict(self) -> dict:
         return {"volumes": self.volumes,
-                "next": [self._next_pid, self._next_dp]}
+                "next": [self._next_pid, self._next_dp],
+                "decommissioned": sorted(self.decommissioned)}
 
     def _load_state_dict(self, state: dict) -> None:
         self.volumes = state["volumes"]
         self._next_pid, self._next_dp = state["next"]
+        self.decommissioned = set(state.get("decommissioned", []))
 
     def _state_bytes(self) -> bytes:
         with self._lock:
@@ -258,7 +263,41 @@ class Master(ReplicatedFsm):
 
     def _live(self, reg: dict) -> list[str]:
         now = time.time()
-        return [a for a, i in reg.items() if now - i["hb"] <= self.HEARTBEAT_TIMEOUT]
+        return [a for a, i in reg.items()
+                if now - i["hb"] <= self.HEARTBEAT_TIMEOUT
+                and a not in self.decommissioned]
+
+    def _apply_decommission(self, addr: str) -> None:
+        self.decommissioned.add(addr)
+
+    def decommission_datanode(self, addr: str) -> list:
+        """Operator-driven drain (cluster.go:2525 decommission analog):
+        exclude the node from placement — committed through the
+        replicated FSM, so restarts/failovers keep the drain — then
+        rebuild every dp replica it holds onto live nodes. Returns the
+        rebuild actions."""
+        with self._lock:
+            if addr not in self.datanodes:
+                raise MasterError(f"unknown datanode {addr!r}")
+        self._commit({"op": "decommission", "addr": addr})
+        # the node no longer counts as live: the standard repair sweep
+        # moves its replicas exactly as if it had died
+        return self.check_replicas()
+
+    def node_list(self) -> dict:
+        with self._lock:
+            now = time.time()
+
+            def view(reg):
+                return {
+                    a: {"zone": i.get("zone", "default"),
+                        "live": now - i["hb"] <= self.HEARTBEAT_TIMEOUT,
+                        "decommissioned": a in self.decommissioned}
+                    for a, i in reg.items()
+                }
+
+            return {"datanodes": view(self.datanodes),
+                    "metanodes": view(self.metanodes)}
 
     # ---------------- topology (zones / nodesets) ----------------
     def _zones_of(self, reg: dict, live: list[str]) -> dict[str, list[str]]:
@@ -579,6 +618,17 @@ class Master(ReplicatedFsm):
     def rpc_heartbeat(self, args, body):
         self.heartbeat(args["addr"], args["kind"], args.get("zone"))
         return {}
+
+    def rpc_node_list(self, args, body):
+        return self.node_list()
+
+    def rpc_decommission_datanode(self, args, body):
+        self._leader_gate()
+        try:
+            actions = self.decommission_datanode(args["addr"])
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+        return {"actions": actions}
 
     def rpc_check_meta_partitions(self, args, body):
         self._leader_gate()

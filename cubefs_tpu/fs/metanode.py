@@ -72,6 +72,9 @@ class MetaPartition:
         self.tx_committed: dict[str, dict] = {}  # tx_id -> {victims, ts}
         self.apply_id = 0
         self._next_ino = start
+        self._dirty: set[str] = set(self._SEGMENTS)
+        self._seg_crcs: dict[str, int] = {}
+        self._oplog_records = 0
         self._op_cache: dict[str, tuple] = {}  # op_id -> (result, err)
         # advisory enforcement flags pushed by the master's quota sweep
         # (meta_quota_manager.go analog) — NOT part of the FSM: they gate
@@ -88,12 +91,17 @@ class MetaPartition:
 
     # ---------------- apply door (replication interface) ----------------
     def submit(self, record: dict) -> dict:
-        """Validate + apply + log one mutation; returns the result."""
+        """Validate + apply + log one mutation; returns the result.
+        Auto-checkpoints every SNAPSHOT_EVERY records so oplog replay
+        stays bounded without O(partition) work per external call."""
         with self._lock:
             result = self.apply(record)
             if self._oplog is not None:
                 self._oplog.write(json.dumps(record) + "\n")
                 self._oplog.flush()
+                self._oplog_records += 1
+                if self._oplog_records >= self.SNAPSHOT_EVERY:
+                    self.snapshot()
             return result
 
     OP_CACHE_SIZE = 4096
@@ -114,6 +122,7 @@ class MetaPartition:
             op = record["op"]
             try:
                 result = getattr(self, f"_apply_{op}")(record)
+                self._dirty |= self._DIRTY_MAP.get(op, set(self._SEGMENTS))
                 outcome = (result, None)
             except MetaError as e:
                 outcome = (None, (e.code, str(e)))
@@ -160,30 +169,122 @@ class MetaPartition:
     def restore_state(self, data: bytes) -> None:
         with self._lock:
             self._load_state_dict(json.loads(data))
+            self._dirty = set(self._SEGMENTS)  # checkpoint must re-dump
 
     # ---------------- snapshot / recovery ----------------
+    # Segmented checkpoint (partition_store.go analog: each tree dumps
+    # to its own CRC'd file; the applyID watermark file commits the set
+    # LAST). Only trees dirtied since the previous checkpoint are
+    # rewritten — an append-only workload re-dumps inodes but never the
+    # dentry tree. The oplog is truncated at checkpoint; auto-checkpoint
+    # fires every SNAPSHOT_EVERY records, so per-op cost is amortized
+    # O(1) instead of O(partition) on every external snapshot call.
+    SNAPSHOT_EVERY = 4096
+    _SEGMENTS = ("inodes", "dentries", "tx")
+    _DIRTY_MAP = {
+        "mk_inode": {"inodes", "dentries"},
+        "rm_inode": {"inodes", "dentries"},
+        "mk_dentry": {"dentries"},
+        "rm_dentry": {"dentries"},
+        "rename_local": {"dentries"},
+        "append_extents": {"inodes"},
+        "set_attr": {"inodes"},
+        "set_xattr": {"inodes"},
+        "truncate": {"inodes"},
+        "tx_prepare": {"tx"},
+        "tx_abort": {"tx"},
+        "tx_finish": {"tx"},
+        "tx_commit": {"tx", "dentries"},
+    }
+
+    def _seg_payload(self, name: str) -> dict:
+        if name == "inodes":
+            return {"inodes": {str(k): v for k, v in self.inodes.items()},
+                    "next_ino": self._next_ino}
+        if name == "dentries":
+            return {"dentries": {str(k): v for k, v in self.dentries.items()}}
+        return {"tx_pending": self.tx_pending,
+                "tx_committed": self.tx_committed}
+
+    def _mark_dirty(self, name: str) -> None:
+        self._dirty.add(name)
+
     def snapshot(self) -> None:
         if not self.data_dir:
             return
         with self._lock:
-            state = json.dumps({
+            seg_crcs = dict(getattr(self, "_seg_crcs", {}))
+            for name in self._SEGMENTS:
+                if name in seg_crcs and name not in self._dirty:
+                    continue  # unchanged since the last checkpoint
+                payload = json.dumps(self._seg_payload(name)).encode()
+                crc = zlib.crc32(payload)
+                # content-addressed filename: a dirty segment writes a NEW
+                # file and the old one stays intact until the watermark
+                # flips — a crash mid-checkpoint always leaves a fully
+                # consistent (old or new) set referenced by the watermark
+                fname = f"{name}.{crc:08x}.seg"
+                tmp = os.path.join(self.data_dir, fname + ".tmp")
+                with open(tmp, "wb") as f:
+                    f.write(payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, os.path.join(self.data_dir, fname))
+                seg_crcs[name] = crc
+            # the watermark commits the segment set atomically, LAST
+            meta = json.dumps({
                 "pid": self.pid, "start": self.start, "end": self.end,
-                **self._state_dict(),
+                "apply_id": self.apply_id, "seg_crcs": seg_crcs,
             }).encode()
-            crc = zlib.crc32(state)
-            tmp = os.path.join(self.data_dir, "snap.tmp")
+            tmp = os.path.join(self.data_dir, "apply.meta.tmp")
             with open(tmp, "wb") as f:
-                f.write(crc.to_bytes(4, "little") + state)
-            os.replace(tmp, os.path.join(self.data_dir, "snap.bin"))
+                f.write(meta)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.data_dir, "apply.meta"))
+            # GC segment files the committed watermark no longer references
+            live = {f"{n}.{c:08x}.seg" for n, c in seg_crcs.items()}
+            for f in os.listdir(self.data_dir):
+                if f.endswith(".seg") and f not in live:
+                    try:
+                        os.unlink(os.path.join(self.data_dir, f))
+                    except OSError:
+                        pass
+            self._seg_crcs = seg_crcs
+            self._dirty = set()
+            self._oplog_records = 0
             open(os.path.join(self.data_dir, "oplog.jsonl"), "w").close()
             if self._oplog is not None:
                 self._oplog.close()
             self._oplog = open(os.path.join(self.data_dir, "oplog.jsonl"), "a")
 
     def _load(self) -> None:
-        snap = os.path.join(self.data_dir, "snap.bin")
-        if os.path.exists(snap):
-            raw = open(snap, "rb").read()
+        self._dirty = set(self._SEGMENTS)
+        self._oplog_records = 0
+        watermark = os.path.join(self.data_dir, "apply.meta")
+        legacy = os.path.join(self.data_dir, "snap.bin")
+        if os.path.exists(watermark):
+            wm = json.loads(open(watermark, "rb").read())
+            state: dict = {"apply_id": wm["apply_id"], "next_ino": self.start,
+                           "inodes": {}, "dentries": {}}
+            for name, crc in wm["seg_crcs"].items():
+                path = os.path.join(self.data_dir, f"{name}.{crc:08x}.seg")
+                if not os.path.exists(path):
+                    # a referenced-but-missing segment is CORRUPTION, not
+                    # an empty tree: booting without it would silently
+                    # drop every record it held
+                    raise MetaError(
+                        5, f"segment {name} missing for mp {self.pid}")
+                payload = open(path, "rb").read()
+                if zlib.crc32(payload) != crc:
+                    raise MetaError(
+                        5, f"segment {name} crc mismatch for mp {self.pid}")
+                state.update(json.loads(payload))
+            self._load_state_dict(state)
+            self._seg_crcs = {n: c for n, c in wm["seg_crcs"].items()}
+            self._dirty = set()
+        elif os.path.exists(legacy):
+            raw = open(legacy, "rb").read()
             crc, state = int.from_bytes(raw[:4], "little"), raw[4:]
             if zlib.crc32(state) != crc:
                 raise MetaError(5, f"snapshot crc mismatch for mp {self.pid}")
@@ -857,3 +958,12 @@ class MetaNode:
     def rpc_snapshot(self, args, body):
         self._mp(args["pid"]).snapshot()
         return {}
+
+    def rpc_export_state(self, args, body):
+        """Point-in-time FSM state for the snapshot tool (leader-routed,
+        CRC'd so transit corruption is detected). apply_id comes out of
+        the serialized state itself, so it always matches the payload."""
+        mp = self._mp_leader(args["pid"])
+        state = mp.state_bytes()
+        return {"crc": zlib.crc32(state),
+                "apply_id": json.loads(state)["apply_id"]}, state

@@ -15,10 +15,13 @@ datanode and populates on miss.
 from __future__ import annotations
 
 import hashlib
+import json
 import threading
+import time
 from collections import OrderedDict
 
 from ..utils import metrics, rpc
+from ..utils.fsm import ReplicatedFsm
 
 CACHE_BLOCK = 128 << 10
 
@@ -74,43 +77,137 @@ class FlashNode:
         return self.stats()
 
 
-class FlashGroupManager:
-    """Slot ring: SLOTS hash slots spread over flash groups (each group =
-    a set of flashnode addrs; reads hit the first healthy member)."""
+class FlashGroupManager(ReplicatedFsm):
+    """Flash-group control service (remotecache/flashgroupmanager/
+    cluster.go analog): a raft/wal-replicated registry of flash groups
+    (each group = a set of flashnode addrs owning a share of the hash
+    slot ring), with flashnode heartbeats deciding member health. Group
+    membership mutations flow through the ONE replicated commit door;
+    the ring view carries an epoch so clients can cache and refresh."""
 
     SLOTS = 1024
+    HEARTBEAT_TIMEOUT = 10.0
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.groups: dict[int, list[str]] = {}
+    def __init__(self, data_dir: str | None = None, me: str | None = None,
+                 peers: list[str] | None = None, node_pool=None):
+        self._lock = threading.RLock()
+        self.groups: dict[int, dict] = {}  # gid -> {addrs, status}
+        self.epoch = 0
+        self._hb: dict[str, float] = {}  # flashnode addr -> last heartbeat
+        self._init_fsm("fgm", data_dir, me, peers, node_pool)
 
-    def register_group(self, group_id: int, addrs: list[str]) -> None:
+    # ---- FSM contract ----
+    def _state_dict(self) -> dict:
+        return {"groups": {str(g): v for g, v in self.groups.items()},
+                "epoch": self.epoch}
+
+    def _load_state_dict(self, st: dict) -> None:
+        self.groups = {int(g): v for g, v in st["groups"].items()}
+        self.epoch = st.get("epoch", 0)
+
+    def _state_bytes(self) -> bytes:
         with self._lock:
-            self.groups[group_id] = list(addrs)
+            return json.dumps(self._state_dict()).encode()
+
+    def _restore_bytes(self, data: bytes) -> None:
+        with self._lock:
+            self._load_state_dict(json.loads(data))
+
+    def _apply(self, rec: dict):
+        rec = dict(rec)
+        op = rec.pop("op")
+        with self._lock:
+            self.epoch += 1
+            return getattr(self, f"_apply_{op}")(**rec)
+
+    def _apply_put_group(self, group_id: int, addrs: list[str],
+                         status: str = "active") -> None:
+        self.groups[int(group_id)] = {"addrs": list(addrs), "status": status}
+
+    def _apply_remove_group(self, group_id: int) -> None:
+        self.groups.pop(int(group_id), None)
+
+    def _apply_set_status(self, group_id: int, status: str) -> None:
+        g = self.groups.get(int(group_id))
+        if g is not None:  # tolerate replay after a concurrent removal
+            g["status"] = status
+
+    # ---- admin / heartbeat ----
+    def register_group(self, group_id: int, addrs: list[str]) -> None:
+        self._commit({"op": "put_group", "group_id": group_id,
+                      "addrs": list(addrs)})
+
+    def remove_group(self, group_id: int) -> None:
+        self._commit({"op": "remove_group", "group_id": group_id})
+
+    def set_group_status(self, group_id: int, status: str) -> None:
+        if status not in ("active", "inactive"):
+            raise ValueError(f"bad status {status!r}")
+        with self._lock:
+            if int(group_id) not in self.groups:
+                raise ValueError(f"unknown flash group {group_id}")
+        self._commit({"op": "set_status", "group_id": group_id,
+                      "status": status})
+
+    def flashnode_heartbeat(self, addr: str) -> None:
+        with self._lock:
+            self._hb[addr] = time.time()
+
+    def _member_alive(self, addr: str) -> bool:
+        hb = self._hb.get(addr)
+        # never-heartbeated members count as alive (static deployments
+        # without the heartbeat loop keep working)
+        return hb is None or time.time() - hb <= self.HEARTBEAT_TIMEOUT
 
     def ring(self) -> dict[int, list[str]]:
+        """Active groups with their LIVE members only."""
         with self._lock:
-            return {g: list(a) for g, a in self.groups.items()}
+            out = {}
+            for g, info in self.groups.items():
+                if info.get("status") != "active":
+                    continue
+                live = [a for a in info["addrs"] if self._member_alive(a)]
+                if live:
+                    out[g] = live
+            return out
 
     @classmethod
     def slot_of(cls, key: str) -> int:
         return int.from_bytes(hashlib.md5(key.encode()).digest()[:4], "big") % cls.SLOTS
 
     def group_for(self, key: str) -> list[str]:
-        with self._lock:
-            if not self.groups:
-                return []
-            ids = sorted(self.groups)
-            gid = ids[self.slot_of(key) % len(ids)]
-            return list(self.groups[gid])
+        ring = self.ring()
+        if not ring:
+            return []
+        ids = sorted(ring)
+        gid = ids[self.slot_of(key) % len(ids)]
+        return list(ring[gid])
 
     # ---------------- RPC surface ----------------
     def rpc_register_group(self, args, body):
+        self._leader_gate()
         self.register_group(args["group_id"], args["addrs"])
         return {}
 
+    def rpc_remove_group(self, args, body):
+        self._leader_gate()
+        self.remove_group(args["group_id"])
+        return {}
+
+    def rpc_set_group_status(self, args, body):
+        self._leader_gate()
+        self.set_group_status(args["group_id"], args["status"])
+        return {}
+
+    def rpc_flashnode_heartbeat(self, args, body):
+        self.flashnode_heartbeat(args["addr"])
+        return {}
+
     def rpc_ring(self, args, body):
-        return {"groups": {str(k): v for k, v in self.ring().items()}}
+        with self._lock:
+            epoch = self.epoch
+        return {"groups": {str(k): v for k, v in self.ring().items()},
+                "epoch": epoch}
 
 
 class CachedReader:

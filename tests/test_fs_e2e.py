@@ -407,3 +407,98 @@ def test_master_raft_replication(tmp_path):
             if m.raft:
                 m.raft.stop()
         mn_node.stop()
+
+
+def test_segmented_snapshot_watermark_and_dirty_tracking(tmp_path):
+    """partition_store.go analog: per-tree CRC'd segments committed by
+    an applyID watermark written last; clean segments are not rewritten;
+    auto-checkpoint bounds oplog replay."""
+    import os as _os
+
+    d = str(tmp_path / "mp")
+    mp = mn.MetaPartition(7, 1, 1 << 20, data_dir=d)
+    for i in range(5):
+        ino = mp.alloc_ino()
+        mp.submit({"op": "mk_inode", "ino": ino, "type": mn.FILE,
+                   "ts": 1000.0 + i})
+        mp.submit({"op": "mk_dentry", "parent": 1, "name": f"f{i}",
+                   "ino": ino})
+    mp.snapshot()
+
+    def seg(name):
+        return next(f for f in _os.listdir(d)
+                    if f.startswith(name + ".") and f.endswith(".seg"))
+
+    assert _os.path.exists(_os.path.join(d, "apply.meta"))
+    inode_seg1, dentry_seg1 = seg("inodes"), seg("dentries")
+    # append-only mutations dirty ONLY the inode segment: its
+    # content-addressed file changes, the dentry one is untouched
+    first = mp.lookup(1, "f0")
+    mp.submit({"op": "append_extents", "ino": first,
+               "extents": [{"dp_id": 1, "extent_id": 1, "ext_offset": 0,
+                            "file_offset": 0, "size": 10}], "size": 10})
+    mp.snapshot()
+    assert seg("inodes") != inode_seg1
+    assert seg("dentries") == dentry_seg1
+    # reload from segments + watermark
+    clone = mn.MetaPartition(7, 1, 1 << 20, data_dir=d)
+    assert clone.inodes == mp.inodes
+    assert clone.dentries == mp.dentries
+    assert clone.apply_id == mp.apply_id
+    # auto-checkpoint: oplog stays bounded
+    mp.SNAPSHOT_EVERY = 8
+    for i in range(20):
+        ino = mp.alloc_ino()
+        mp.submit({"op": "mk_inode", "ino": ino, "type": mn.FILE,
+                   "ts": 2000.0 + i})
+    n_lines = sum(1 for _ in open(_os.path.join(d, "oplog.jsonl")))
+    assert n_lines < 8, f"oplog grew unbounded: {n_lines} records"
+    clone2 = mn.MetaPartition(7, 1, 1 << 20, data_dir=d)
+    assert clone2.inodes == mp.inodes
+
+
+def test_legacy_snapshot_format_still_loads(tmp_path):
+    import json as _json
+    import os as _os
+    import zlib as _zlib
+
+    d = str(tmp_path / "legacy")
+    _os.makedirs(d)
+    state = _json.dumps({
+        "pid": 9, "start": 1, "end": 100, "apply_id": 3, "next_ino": 5,
+        "inodes": {"1": {"ino": 1, "type": "dir", "mode": 0o755, "size": 0,
+                         "nlink": 2, "uid": 0, "gid": 0, "mtime": 0,
+                         "ctime": 0, "atime": 0, "extents": [], "xattr": {},
+                         "target": None, "quota_ids": []}},
+        "dentries": {"1": {}},
+    }).encode()
+    with open(_os.path.join(d, "snap.bin"), "wb") as f:
+        f.write(_zlib.crc32(state).to_bytes(4, "little") + state)
+    mp = mn.MetaPartition(9, 1, 100, data_dir=d)
+    assert mp.apply_id == 3 and 1 in mp.inodes
+
+
+def test_checkpoint_crash_window_and_missing_segment(tmp_path):
+    """A crash between segment writes and the watermark leaves the OLD
+    referenced set fully loadable (content-addressed files are never
+    overwritten); a watermark-referenced segment that is MISSING is
+    corruption and must refuse to boot."""
+    import os as _os
+
+    d = str(tmp_path / "mp")
+    mp = mn.MetaPartition(3, 1, 1 << 20, data_dir=d)
+    ino = mp.alloc_ino()
+    mp.submit({"op": "mk_inode", "ino": ino, "type": mn.FILE, "ts": 1.0})
+    mp.snapshot()
+    golden_inodes = dict(mp.inodes)
+    # simulate a crash mid-checkpoint: a NEW orphan segment appears but
+    # the watermark was never rewritten
+    (tmp_path / "mp" / "inodes.deadbeef.seg").write_bytes(b"garbage half-write")
+    clone = mn.MetaPartition(3, 1, 1 << 20, data_dir=d)
+    assert clone.inodes == golden_inodes  # old set loads untouched
+    # a MISSING referenced segment refuses to boot (never an empty tree)
+    seg = next(f for f in _os.listdir(d)
+               if f.startswith("inodes.") and f != "inodes.deadbeef.seg")
+    _os.unlink(_os.path.join(d, seg))
+    with pytest.raises(mn.MetaError):
+        mn.MetaPartition(3, 1, 1 << 20, data_dir=d)

@@ -1,0 +1,164 @@
+"""Operator surface: CLI node/mp/tasks groups, datanode decommission,
+the volume snapshot tool (export/verify/restore) and the autofs map
+helper (reference: cli/, tool/snapshot, tool/autofs)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob.access import NodePool
+from cubefs_tpu.fs.client import FileSystem
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+from cubefs_tpu.tool import autofs, snapshot
+
+
+class Cluster:
+    def __init__(self, tmp_path, n_data=4):
+        self.pool = NodePool()
+        self.master = Master(self.pool)
+        self.pool.bind("master", self.master)
+        self.metas, self.datas = [], []
+        for i in range(2):
+            node = MetaNode(i, addr=f"meta{i}", node_pool=self.pool)
+            self.pool.bind(f"meta{i}", node)
+            self.master.register_metanode(f"meta{i}")
+            self.metas.append(node)
+        for i in range(n_data):
+            addr = f"data{i}"
+            node = DataNode(i, str(tmp_path / addr), addr, self.pool)
+            self.pool.bind(addr, node)
+            self.master.register_datanode(addr)
+            self.datas.append(node)
+        self.view = self.master.create_volume("opvol", mp_count=2, dp_count=3)
+        self.fs = FileSystem(self.view, self.pool)
+
+    def stop(self):
+        for m in self.metas:
+            m.stop()
+        for d in self.datas:
+            d.stop()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = Cluster(tmp_path)
+    yield c
+    c.stop()
+
+
+def test_node_list_and_decommission(cluster, rng):
+    payload = rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    cluster.fs.write_file("/f.bin", payload)
+    nodes = cluster.master.node_list()
+    assert len(nodes["datanodes"]) == 4
+    assert all(v["live"] for v in nodes["datanodes"].values())
+    # drain one replica-holding node: its dps rebuild onto others
+    victim = next(a for a in nodes["datanodes"]
+                  if any(a in dp["replicas"]
+                         for dp in cluster.view["dps"]))
+    actions = cluster.master.decommission_datanode(victim)
+    assert actions, "decommission must trigger rebuilds"
+    view = cluster.master.client_view("opvol")
+    for dp in view["dps"]:
+        assert victim not in dp["replicas"]
+    assert cluster.master.node_list()["datanodes"][victim]["decommissioned"]
+    # data still fully readable after the drain
+    fs2 = FileSystem(view, cluster.pool)
+    assert fs2.read_file("/f.bin") == payload
+
+
+def test_scheduler_task_switches(tmp_path):
+    from cubefs_tpu.blob.clustermgr import ClusterMgr
+    from cubefs_tpu.blob.scheduler import Scheduler
+
+    cm = ClusterMgr(allow_colocated_units=True)
+    sched = Scheduler(cm)
+    out = sched.rpc_task_switch({"action": "list"}, b"")["switches"]
+    assert out["disk_repair"] is True
+    sched.rpc_task_switch({"action": "disable", "kind": "disk_repair"}, b"")
+    assert not sched.switch.enabled("disk_repair")
+    out = sched.rpc_task_switch({"action": "enable",
+                                 "kind": "disk_repair"}, b"")["switches"]
+    assert out["disk_repair"] is True
+
+
+def test_snapshot_tool_export_verify_restore(cluster, tmp_path, rng):
+    fs = cluster.fs
+    fs.mkdir("/keep")
+    fs.write_file("/keep/a", b"alpha")
+    fs.write_file("/keep/b", b"beta")
+    out_dir = str(tmp_path / "snap")
+    manifest = snapshot.export("master", "opvol", out_dir, pool=cluster.pool)
+    assert len(manifest["mps"]) == 2
+    assert snapshot.verify(out_dir)["volume"] == "opvol"
+    # corruption is detected
+    mp0 = manifest["mps"][0]
+    p = tmp_path / "snap" / mp0["file"]
+    raw = bytearray(p.read_bytes())
+    raw[10] ^= 0xFF
+    p.write_bytes(bytes(raw))
+    with pytest.raises(RuntimeError):
+        snapshot.verify(out_dir)
+    p.write_bytes(bytes(raw[:10] + bytes([raw[10] ^ 0xFF]) + raw[11:]))
+    # restore materializes bootable partition checkpoints
+    restore_dir = str(tmp_path / "restored")
+    pids = snapshot.restore(out_dir, restore_dir)
+    assert sorted(pids) == sorted(m["pid"] for m in manifest["mps"])
+    from cubefs_tpu.fs import metanode as mn
+
+    for m in manifest["mps"]:
+        part = mn.MetaPartition(m["pid"], m["start"], m["end"],
+                                data_dir=str(tmp_path / "restored" /
+                                             f"mp_{m['pid']}"))
+        assert part.apply_id == m["apply_id"]
+    # the partition holding the dentries can resolve the files
+    roots = [mn.MetaPartition(m["pid"], m["start"], m["end"],
+                              data_dir=str(tmp_path / "restored" /
+                                           f"mp_{m['pid']}"))
+             for m in manifest["mps"]]
+    holder = next(p for p in roots if 1 in p.dentries)
+    assert "keep" in holder.dentries[1]
+
+
+def test_autofs_map_parse_check_and_mount(cluster, tmp_path):
+    mp = tmp_path / "mnt" / "vol1"
+    map_file = tmp_path / "auto.map"
+    map_file.write_text(
+        "# automount map\n"
+        f"{mp} opvol master\n")
+    entries = autofs.parse_map(str(map_file))
+    assert entries == [{"mountpoint": str(mp), "vol": "opvol",
+                        "master": "master"}]
+    checked = autofs.check(entries, pool=cluster.pool)
+    assert checked[0]["mps"] == 2 and checked[0]["dps"] == 3
+    mounted = []
+    out = autofs.ensure_mounted(
+        entries, pool=cluster.pool,
+        mount_fn=lambda fs, mnt: mounted.append((fs, mnt)))
+    assert out[0]["status"] == "mounted"
+    assert mounted and mounted[0][1] == str(mp)
+    # malformed lines are rejected with the line number
+    bad = tmp_path / "bad.map"
+    bad.write_text("two fields\n")
+    with pytest.raises(ValueError):
+        autofs.parse_map(str(bad))
+
+
+def test_cli_node_and_tasks_groups(cluster, capsys, tmp_path):
+    from cubefs_tpu import cli
+    from cubefs_tpu.utils import rpc as rpclib
+
+    srv = rpclib.RpcServer(rpclib.expose(cluster.master),
+                           service="master").start()
+    try:
+        cli.main(["node", "list", "--master", srv.addr])
+        out = json.loads(capsys.readouterr().out)
+        assert len(out["datanodes"]) == 4
+        cli.main(["mp", "check", "--master", srv.addr])
+        out = json.loads(capsys.readouterr().out)
+        assert "actions" in out
+    finally:
+        srv.stop()

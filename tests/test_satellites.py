@@ -163,3 +163,69 @@ def test_readahead_prefetches_next_block(fscluster, rng):
     assert (fs.read_file("/ra.bin", offset=cached.BLOCK, length=1000)
             == payload[cached.BLOCK : cached.BLOCK + 1000])
     assert cached.cache.misses == m0  # served by readahead
+
+
+# ---------------- FlashGroupManager (raft-replicated control) ----------
+def test_fgm_replicated_group_registry(tmp_path):
+    """flashgroupmanager/cluster.go analog: group mutations commit
+    through raft, survive leader failover, and followers redirect."""
+    from cubefs_tpu.fs.remotecache import FlashGroupManager
+    from cubefs_tpu.utils.rpc import NodePool
+
+    pool = NodePool()
+    peers = ["fgm0", "fgm1", "fgm2"]
+    mgrs = []
+    for i, me in enumerate(peers):
+        m = FlashGroupManager(data_dir=str(tmp_path / me), me=me,
+                              peers=peers, node_pool=pool)
+        pool.bind(me, m)
+        mgrs.append(m)
+    try:
+        deadline = time.time() + 5
+        leader = None
+        while time.time() < deadline and leader is None:
+            leader = next((m for m in mgrs if m.is_leader()
+                           and m.raft.status()["role"] == "leader"), None)
+            time.sleep(0.02)
+        assert leader is not None
+        follower = next(m for m in mgrs if m is not leader)
+        # follower redirects writes
+        with pytest.raises(Exception):
+            follower.rpc_register_group(
+                {"group_id": 1, "addrs": ["fn0"]}, b"")
+        leader.register_group(1, ["fn0", "fn1"])
+        leader.register_group(2, ["fn2"])
+        # replicated to followers
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(len(m.groups) == 2 for m in mgrs):
+                break
+            time.sleep(0.02)
+        assert all(m.groups[1]["addrs"] == ["fn0", "fn1"] for m in mgrs)
+        # inactive groups drop out of the ring
+        leader.set_group_status(2, "inactive")
+        assert 2 not in leader.ring()
+        assert 1 in leader.ring()
+        # dead members are filtered by heartbeat age
+        leader.flashnode_heartbeat("fn0")
+        with leader._lock:
+            leader._hb["fn1"] = time.time() - 60
+        assert leader.ring()[1] == ["fn0"]
+        # leader failover: the registry survives on a new leader
+        leader.raft.stop()
+        deadline = time.time() + 5
+        new_leader = None
+        while time.time() < deadline and new_leader is None:
+            new_leader = next(
+                (m for m in mgrs
+                 if m is not leader and m.raft.status()["role"] == "leader"),
+                None)
+            time.sleep(0.02)
+        assert new_leader is not None
+        assert set(new_leader.groups) == {1, 2}
+        new_leader.register_group(3, ["fn9"])
+        assert 3 in new_leader.groups
+    finally:
+        for m in mgrs:
+            if m.raft is not None:
+                m.raft.stop()
