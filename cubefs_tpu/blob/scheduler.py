@@ -523,6 +523,8 @@ class Scheduler:
         analog): action=enable|disable|list. Unknown kinds are rejected
         so a typo can never silently leave a task running."""
         action = args.get("action", "list")
+        if action not in ("enable", "disable", "list"):
+            raise rpc.RpcError(400, f"unknown action {action!r}")
         if action in ("enable", "disable"):
             kind = args.get("kind")
             if kind not in self.TASK_KINDS:

@@ -92,10 +92,14 @@ def run_role(cfg: dict):
         svc = DataNode(int(cfg.get("node_id", 0)), cfg["data_dir"], "pending", pool)
         srv = _serve(rpc.expose(svc), cfg)
         svc.addr = srv.addr
+        # the binary packet plane (hot data path) listens beside HTTP
+        psrv = svc.serve_packets(host=cfg.get("listen_host", "127.0.0.1"),
+                                 port=int(cfg.get("packet_port", 0)))
+        print(f"[datanode] packet plane on {psrv.addr}", flush=True)
         master = rpc.Client(cfg["master_addr"])
         zone = cfg.get("zone", "default")
         master.call("register", {"kind": "data", "addr": srv.addr,
-                                 "zone": zone})
+                                 "zone": zone, "packet_addr": psrv.addr})
         _heartbeat_loop(lambda: master.call(
             "heartbeat", {"kind": "data", "addr": srv.addr, "zone": zone}))
         return srv, svc

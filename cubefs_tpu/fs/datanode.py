@@ -329,7 +329,62 @@ class DataNode:
     def rpc_stat(self, args, body):
         return {"node_id": self.node_id, "partitions": sorted(self.partitions)}
 
+    # ---------------- binary packet plane (proto/packet.go analog) -----
+    # The HOT data path speaks the 64-byte-header binary protocol over
+    # persistent TCP, not HTTP: the packet server maps opcodes straight
+    # onto the same write/read/repair logic, so both transports share
+    # one consistency story (leader routing, raft overwrites, chain).
+    def serve_packets(self, host: str = "127.0.0.1",
+                      port: int = 0) -> "packet.PacketServer":
+        from ..utils import packet
+
+        def op_write(hdr, args, payload):
+            self.write(hdr["partition"], hdr["extent"], hdr["offset"],
+                       payload, hops=args.get("hops", 2))
+            return {}, b""
+
+        def op_write_replica(hdr, args, payload):
+            self.write(hdr["partition"], hdr["extent"], hdr["offset"],
+                       payload, chain=False)
+            return {}, b""
+
+        def op_read(hdr, args, payload):
+            try:
+                data = self.read(hdr["partition"], hdr["extent"],
+                                 hdr["offset"], args["length"])
+            except BlockCrcError as e:
+                raise packet.PacketError(0xC1, str(e)) from None
+            except ExtentError as e:
+                raise packet.PacketError(0xC2, str(e)) from None
+            return {}, data
+
+        def op_fingerprint(hdr, args, payload):
+            size, crc = self.extent_fingerprint(hdr["partition"],
+                                                hdr["extent"])
+            return {"size": size, "crc": crc}, b""
+
+        def op_alloc(hdr, args, payload):
+            return {"extent_id": self._dp(hdr["partition"]).alloc_extent()}, b""
+
+        def op_ping(hdr, args, payload):
+            return {"node_id": self.node_id}, b""
+
+        srv = packet.PacketServer({
+            packet.OP_WRITE: op_write,
+            packet.OP_WRITE_REPLICA: op_write_replica,
+            packet.OP_READ: op_read,
+            packet.OP_FINGERPRINT: op_fingerprint,
+            packet.OP_ALLOC_EXTENT: op_alloc,
+            packet.OP_PING: op_ping,
+        }, host=host, port=port).start()
+        self.packet_addr = srv.addr
+        self._packet_srv = srv
+        return srv
+
     def stop(self) -> None:
+        srv = getattr(self, "_packet_srv", None)
+        if srv is not None:
+            srv.stop()
         for dp in self.partitions.values():
             if dp.raft is not None:
                 dp.raft.stop()

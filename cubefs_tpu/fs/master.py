@@ -239,11 +239,14 @@ class Master(ReplicatedFsm):
                 dp["leader"] = leader
 
     # ---------------- registries ----------------
-    def register_datanode(self, addr: str, zone: str = "default") -> None:
+    def register_datanode(self, addr: str, zone: str = "default",
+                          packet_addr: str | None = None) -> None:
         with self._lock:
             info = self.datanodes.setdefault(addr, {"addr": addr})
             info["hb"] = time.time()
             info["zone"] = zone
+            if packet_addr:
+                info["packet_addr"] = packet_addr
 
     def register_metanode(self, addr: str, zone: str = "default") -> None:
         with self._lock:
@@ -436,9 +439,15 @@ class Master(ReplicatedFsm):
             vol = self.volumes.get(name)
             if vol is None:
                 raise MasterError(f"no volume {name!r}")
+            # packet-plane discovery: every replica's binary-protocol
+            # address (when the node registered one) rides the view
+            packet_addrs = {a: i["packet_addr"]
+                            for a, i in self.datanodes.items()
+                            if i.get("packet_addr")}
             return {"name": name, "mps": [dict(m) for m in vol["mps"]],
                     "dps": [dict(d) for d in vol["dps"]],
-                    "quotas": dict(vol.get("quotas", {}))}
+                    "quotas": dict(vol.get("quotas", {})),
+                    "packet_addrs": packet_addrs}
 
     def _meta_load(self) -> dict[str, int]:
         """Replica count per metanode across all volumes (placement load)."""
@@ -610,7 +619,8 @@ class Master(ReplicatedFsm):
     def rpc_register(self, args, body):
         zone = args.get("zone", "default")
         if args["kind"] == "data":
-            self.register_datanode(args["addr"], zone)
+            self.register_datanode(args["addr"], zone,
+                                   packet_addr=args.get("packet_addr"))
         else:
             self.register_metanode(args["addr"], zone)
         return {}

@@ -97,7 +97,12 @@ class MetaPartition:
         with self._lock:
             result = self.apply(record)
             if self._oplog is not None:
-                self._oplog.write(json.dumps(record) + "\n")
+                # the record carries the apply-id it landed at: replay
+                # after a crash between watermark commit and oplog
+                # truncation skips records the checkpoint already holds
+                # (double-applying appends would garble extent layouts)
+                self._oplog.write(json.dumps(
+                    {"aid": self.apply_id, **record}) + "\n")
                 self._oplog.flush()
                 self._oplog_records += 1
                 if self._oplog_records >= self.SNAPSHOT_EVERY:
@@ -165,6 +170,12 @@ class MetaPartition:
         """Serialize the whole partition state (raft snapshot payload)."""
         with self._lock:
             return json.dumps(self._state_dict()).encode()
+
+    def export_state(self) -> tuple[bytes, int]:
+        """(state bytes, apply_id) captured under ONE lock acquisition,
+        so the manifest id always matches the payload."""
+        with self._lock:
+            return json.dumps(self._state_dict()).encode(), self.apply_id
 
     def restore_state(self, data: bytes) -> None:
         with self._lock:
@@ -298,6 +309,9 @@ class MetaPartition:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
                         break
+                    aid = rec.pop("aid", None)
+                    if aid is not None and aid <= self.apply_id:
+                        continue  # checkpoint already contains this op
                     try:
                         self.apply(rec)
                     except MetaError:
@@ -964,6 +978,5 @@ class MetaNode:
         CRC'd so transit corruption is detected). apply_id comes out of
         the serialized state itself, so it always matches the payload."""
         mp = self._mp_leader(args["pid"])
-        state = mp.state_bytes()
-        return {"crc": zlib.crc32(state),
-                "apply_id": json.loads(state)["apply_id"]}, state
+        state, apply_id = mp.export_state()
+        return {"crc": zlib.crc32(state), "apply_id": apply_id}, state

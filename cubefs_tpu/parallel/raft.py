@@ -234,10 +234,14 @@ class RaftNode:
         self._ticker.start()
         for t in self._repl_threads:
             t.start()
+        if self.peers:
+            HeartbeatMux.get(self.pool).enroll(self)
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.peers:
+            HeartbeatMux.get(self.pool).drop(self)
         for ev in self._repl_events.values():
             ev.set()  # wake replication threads so they exit promptly
         with self._apply_cv:
@@ -277,21 +281,48 @@ class RaftNode:
                 self._run_election()
 
     def _repl_loop(self, peer: str) -> None:
+        """The BULK replication plane: ships log entries/snapshots,
+        paced per HEARTBEAT while there is work. Idle liveness is the
+        HeartbeatMux's job — an idle leader's repl thread blocks on its
+        event, so bulk and heartbeat planes never contend."""
         ev = self._repl_events[peer]
         while not self._stop.is_set():
             with self._lock:
                 leading = self.role == "leader"
-            if not leading:
-                # block with no timeout: woken by _become_leader/stop,
-                # so follower groups cost zero idle wakeups
+                pending = leading and (
+                    self.next_index.get(peer, self._last_index() + 1)
+                    <= self._last_index()
+                )
+            if not leading or not pending:
+                # woken by propose/commit-advance/leadership-change
                 ev.wait()
                 ev.clear()
                 continue
-            # append first (immediate on election or signal), then pace:
-            # a signal mid-wait short-circuits straight into the next one
+            # ship entries, then pace (a signal mid-wait short-circuits)
             self._append_to(peer)
             ev.wait(self.HEARTBEAT)
             ev.clear()
+
+    def heartbeat_args(self) -> list[tuple[str, dict]]:
+        """(peer, empty-AppendEntries args) for every peer this LEADER
+        has no pending entries for — consumed by the HeartbeatMux."""
+        out = []
+        with self._lock:
+            if self.role != "leader" or self._stop.is_set():
+                return out
+            last = self._last_index()
+            for peer in self.peers:
+                ni = self.next_index.get(peer, last + 1)
+                if ni <= self.log_base or ni <= last:
+                    continue  # snapshot/bulk replication owns this peer
+                prev_index = ni - 1
+                prev_term = self._term_at(prev_index) if prev_index else 0
+                out.append((peer, {
+                    "term": self.term, "leader": self.me,
+                    "prev_index": prev_index, "prev_term": prev_term,
+                    "entries": [], "commit": self.commit_index,
+                }))
+        return out
 
     # ---------------- snapshot / compaction ----------------
     def take_snapshot(self) -> None:
@@ -522,6 +553,9 @@ class RaftNode:
             )
         except Exception:
             return
+        self._process_append_reply(peer, args, meta)
+
+    def _process_append_reply(self, peer: str, args: dict, meta: dict) -> None:
         with self._lock:
             if self._stop.is_set():
                 return  # a successor instance owns the FSM now
@@ -547,6 +581,12 @@ class RaftNode:
                 self.next_index[peer] = max(
                     1, hint if hint else self.next_index.get(peer, 2) - 1
                 )
+                # the peer needs entries again: wake its bulk thread (a
+                # parked thread would otherwise never resume and the
+                # heartbeat plane skips pending peers)
+                ev = self._repl_events.get(peer)
+                if ev is not None:
+                    ev.set()
 
     def _advance_commit(self) -> None:
         # caller holds lock; commit = highest index replicated on majority
@@ -666,9 +706,103 @@ class RaftNode:
                     "commit": self.commit_index, "applied": self.last_applied}
 
 
+class HeartbeatMux:
+    """The dedicated multi-raft heartbeat plane (tiglabs raft
+    transport_heartbeat + transport_multi analog): ONE batched RPC per
+    peer node per tick carries empty AppendEntries for every group this
+    process currently leads, so hundreds of partitions cost O(peer
+    nodes) idle heartbeat RPCs instead of O(groups x peers) — and bulk
+    entry replication (the repl threads) can never starve liveness."""
+
+    _BY_POOL: dict[int, "HeartbeatMux"] = {}
+    _BY_POOL_LOCK = threading.Lock()
+
+    @classmethod
+    def get(cls, pool) -> "HeartbeatMux":
+        with cls._BY_POOL_LOCK:
+            mux = cls._BY_POOL.get(id(pool))
+            if mux is None:
+                mux = cls._BY_POOL[id(pool)] = HeartbeatMux(pool)
+            return mux
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._lock = threading.Lock()
+        self.nodes: dict[tuple[str, str], RaftNode] = {}  # (gid, me) -> node
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def enroll(self, node: "RaftNode") -> None:
+        with self._lock:
+            if self._stop.is_set():
+                # raced a final drop(): re-resolve through the registry
+                HeartbeatMux.get(node.pool).enroll(node)
+                return
+            self.nodes[(node.group_id, node.me)] = node
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+    def drop(self, node: "RaftNode") -> None:
+        with self._lock:
+            cur = self.nodes.get((node.group_id, node.me))
+            if cur is node:
+                del self.nodes[(node.group_id, node.me)]
+            if not self.nodes:
+                # last node gone: stop the tick thread and release the
+                # pool reference, or every retired cluster leaks both
+                self._stop.set()
+                with HeartbeatMux._BY_POOL_LOCK:
+                    if HeartbeatMux._BY_POOL.get(id(self.pool)) is self:
+                        del HeartbeatMux._BY_POOL[id(self.pool)]
+
+    def _loop(self) -> None:
+        while not self._stop.wait(RaftNode.HEARTBEAT):
+            with self._lock:
+                nodes = list(self.nodes.values())
+            batches: dict[str, list] = {}  # peer addr -> [(gid, node, args)]
+            for node in nodes:
+                for peer, args in node.heartbeat_args():
+                    batches.setdefault(peer, []).append(
+                        (node.group_id, node, args))
+            for addr, items in batches.items():
+                threading.Thread(target=self._send, args=(addr, items),
+                                 daemon=True).start()
+
+    def _send(self, addr: str, items: list) -> None:
+        try:
+            meta, _ = self.pool.get(addr).call(
+                "raft_hb_batch",
+                {"items": [[gid, args] for gid, _, args in items]},
+                timeout=1.0)
+        except Exception:
+            return
+        replies = dict(map(tuple, meta.get("replies", [])))
+        for gid, node, args in items:
+            reply = replies.get(gid)
+            if reply is not None:
+                node._process_append_reply(addr, args, reply)
+
+
 def register_routes(routes: dict, node: RaftNode) -> None:
     """Mount a raft node's handlers on a service's route table
-    (multi-raft: many nodes share one server)."""
+    (multi-raft: many nodes share one server). Also maintains the
+    table's shared batched-heartbeat endpoint."""
     routes[f"raft_{node.group_id}_vote"] = node.handle_vote
     routes[f"raft_{node.group_id}_append"] = node.handle_append
     routes[f"raft_{node.group_id}_snapshot"] = node.handle_install_snapshot
+    reg = routes.setdefault("__raft_groups__", {})
+    reg[node.group_id] = node
+
+    def hb_batch(args, body, _reg=reg):
+        replies = []
+        for gid, a in args["items"]:
+            member = _reg.get(gid)
+            if member is None:
+                replies.append([gid, {"ok": False, "term": 0}])
+            else:
+                replies.append([gid, member.handle_append(a, b"")])
+        return {"replies": replies}
+
+    routes["raft_hb_batch"] = hb_batch

@@ -78,7 +78,14 @@ def main(argv=None) -> None:
     if args.check:
         print(json.dumps(check(entries), indent=2))
         return
-    print(json.dumps(ensure_mounted(entries), indent=2))
+    results = ensure_mounted(entries)
+    print(json.dumps(results, indent=2), flush=True)
+    if any(r["status"] == "mounted" for r in results):
+        # the FUSE fds live in THIS process: exiting would kill every
+        # mount just reported; block like automount daemons do
+        import threading
+
+        threading.Event().wait()
 
 
 if __name__ == "__main__":

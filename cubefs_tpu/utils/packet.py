@@ -1,0 +1,227 @@
+"""Binary packet protocol: the FS-plane data transport.
+
+Role parity: proto/packet.go:379 — the reference's hot data path speaks
+a fixed 64-byte binary header over persistent TCP connections (magic,
+opcode, CRC, sizes, partition/extent/offset routing fields, request
+id), not HTTP. This is that wire shape, TPU-framework-native:
+
+  offset  field
+  0       magic (0xCF)
+  1       opcode
+  2       flags
+  3       result  (0 ok; else an errno-ish code)
+  4:8     crc32 of the payload (IEEE, little-endian)
+  8:12    payload size
+  12:16   arg size (JSON args for ops that need structured extras)
+  16:24   partition id
+  24:32   extent id
+  32:40   offset
+  40:48   request id
+  48:64   reserved
+
+A frame is header + args + payload. CRC covers the payload, verified on
+both receive directions — corruption is detected at every hop, matching
+the reference's packet CRC discipline.
+
+`PacketServer` dispatches opcodes to handlers; `PacketClient` keeps one
+persistent connection per address (serial request/response per
+connection, pooled by the caller for parallelism).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import zlib
+
+MAGIC = 0xCF
+HEADER = struct.Struct("<BBBBIIIQQQQ16x")
+assert HEADER.size == 64
+
+# opcodes (datanode data plane)
+OP_WRITE = 0x01
+OP_READ = 0x02
+OP_WRITE_REPLICA = 0x03
+OP_FINGERPRINT = 0x04
+OP_ALLOC_EXTENT = 0x05
+OP_PING = 0x7F
+
+RESULT_OK = 0
+
+
+class PacketError(Exception):
+    def __init__(self, result: int, msg: str = ""):
+        super().__init__(f"packet result {result}: {msg}")
+        self.result = result
+
+
+def pack(opcode: int, *, partition: int = 0, extent: int = 0,
+         offset: int = 0, req_id: int = 0, args: dict | None = None,
+         payload: bytes = b"", result: int = RESULT_OK,
+         flags: int = 0) -> bytes:
+    arg_bytes = json.dumps(args).encode() if args else b""
+    hdr = HEADER.pack(MAGIC, opcode, flags, result,
+                      zlib.crc32(payload), len(payload), len(arg_bytes),
+                      partition, extent, offset, req_id)
+    return hdr + arg_bytes + payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_packet(sock: socket.socket) -> tuple[dict, dict, bytes]:
+    """Returns (header fields, args, payload); raises on CRC mismatch."""
+    raw = _recv_exact(sock, HEADER.size)
+    (magic, opcode, flags, result, crc, psize, asize,
+     partition, extent, offset, req_id) = HEADER.unpack(raw)
+    if magic != MAGIC:
+        raise PacketError(0xFF, f"bad magic {magic:#x}")
+    args = json.loads(_recv_exact(sock, asize)) if asize else {}
+    payload = _recv_exact(sock, psize) if psize else b""
+    if zlib.crc32(payload) != crc:
+        raise PacketError(0xFE, "payload crc mismatch")
+    return ({"opcode": opcode, "flags": flags, "result": result,
+             "partition": partition, "extent": extent, "offset": offset,
+             "req_id": req_id}, args, payload)
+
+
+class PacketServer:
+    """Persistent-connection TCP server dispatching opcodes to handlers.
+
+    handler(hdr, args, payload) -> (args_out, payload_out); raising
+    PacketError returns its result code to the client, any other
+    exception returns 0xEF."""
+
+    def __init__(self, handlers: dict, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.handlers = handlers
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.addr = f"{host}:{self._srv.getsockname()[1]}"
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+
+    def start(self) -> "PacketServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    hdr, args, payload = recv_packet(conn)
+                except (ConnectionError, OSError):
+                    return
+                fn = self.handlers.get(hdr["opcode"])
+                if fn is None:
+                    reply = pack(hdr["opcode"], req_id=hdr["req_id"],
+                                 result=0xFD,
+                                 args={"error": f"no opcode {hdr['opcode']:#x}"})
+                else:
+                    try:
+                        args_out, payload_out = fn(hdr, args, payload)
+                        reply = pack(hdr["opcode"], req_id=hdr["req_id"],
+                                     args=args_out, payload=payload_out)
+                    except PacketError as e:
+                        reply = pack(hdr["opcode"], req_id=hdr["req_id"],
+                                     result=e.result,
+                                     args={"error": str(e)})
+                    except Exception as e:  # handler bug: surface, don't die
+                        reply = pack(hdr["opcode"], req_id=hdr["req_id"],
+                                     result=0xEF,
+                                     args={"error": f"{type(e).__name__}: {e}"})
+                try:
+                    conn.sendall(reply)
+                except OSError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class PacketClient:
+    """One persistent connection, serial request/response. Thread-safe;
+    reconnects once on a broken pipe (idempotent ops only — writes carry
+    their own exactly-once semantics at the store layer)."""
+
+    def __init__(self, addr: str, timeout: float = 30.0):
+        self.host, port = addr.rsplit(":", 1)
+        self.port = int(port)
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._req_id = 0
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    def call(self, opcode: int, *, partition: int = 0, extent: int = 0,
+             offset: int = 0, args: dict | None = None,
+             payload: bytes = b"") -> tuple[dict, bytes]:
+        with self._lock:
+            self._req_id += 1
+            req_id = self._req_id
+            frame = pack(opcode, partition=partition, extent=extent,
+                         offset=offset, req_id=req_id, args=args,
+                         payload=payload)
+            for attempt in (0, 1):
+                if self._sock is None:
+                    self._sock = self._connect()
+                try:
+                    self._sock.sendall(frame)
+                    hdr, rargs, rpayload = recv_packet(self._sock)
+                    break
+                except (ConnectionError, OSError):
+                    self.close()
+                    if attempt:
+                        raise
+            if hdr["req_id"] != req_id:
+                self.close()
+                raise PacketError(0xFC, "response req_id mismatch")
+            if hdr["result"] != RESULT_OK:
+                raise PacketError(hdr["result"],
+                                  rargs.get("error", ""))
+            return rargs, rpayload

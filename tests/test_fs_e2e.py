@@ -502,3 +502,38 @@ def test_checkpoint_crash_window_and_missing_segment(tmp_path):
     _os.unlink(_os.path.join(d, seg))
     with pytest.raises(mn.MetaError):
         mn.MetaPartition(3, 1, 1 << 20, data_dir=d)
+
+
+def test_oplog_replay_skips_checkpointed_records(tmp_path):
+    """Crash between the watermark commit and the oplog truncation must
+    not double-apply: records carry their apply-id and replay skips
+    everything the checkpoint already holds."""
+    import json as _json
+    import os as _os
+
+    d = str(tmp_path / "mp")
+    mp = mn.MetaPartition(4, 1, 1 << 20, data_dir=d)
+    ino = mp.alloc_ino()
+    mp.submit({"op": "mk_inode", "ino": ino, "type": mn.FILE, "ts": 1.0})
+    ek = {"dp_id": 1, "extent_id": 1, "ext_offset": 0,
+          "file_offset": 0, "size": 100}
+    mp.submit({"op": "append_extents", "ino": ino, "extents": [ek],
+               "size": 100, "ts": 2.0})
+    pre_truncate_log = open(_os.path.join(d, "oplog.jsonl")).read()
+    mp.snapshot()
+    # simulate the crash window: the watermark committed but the old
+    # oplog survives untruncated
+    with open(_os.path.join(d, "oplog.jsonl"), "w") as f:
+        f.write(pre_truncate_log)
+    clone = mn.MetaPartition(4, 1, 1 << 20, data_dir=d)
+    assert clone.inodes[ino]["extents"] == [ek], \
+        "append must not double-apply on replay"
+    assert clone.inodes[ino]["size"] == 100
+    # records NEWER than the checkpoint still replay
+    ek2 = dict(ek, file_offset=100)
+    rec = {"op": "append_extents", "ino": ino, "extents": [ek2],
+           "size": 200, "ts": 3.0, "aid": clone.apply_id + 50}
+    with open(_os.path.join(d, "oplog.jsonl"), "a") as f:
+        f.write(_json.dumps(rec) + "\n")
+    clone2 = mn.MetaPartition(4, 1, 1 << 20, data_dir=d)
+    assert clone2.inodes[ino]["extents"] == [ek, ek2]
