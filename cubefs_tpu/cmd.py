@@ -101,7 +101,8 @@ def run_role(cfg: dict):
         master.call("register", {"kind": "data", "addr": srv.addr,
                                  "zone": zone, "packet_addr": psrv.addr})
         _heartbeat_loop(lambda: master.call(
-            "heartbeat", {"kind": "data", "addr": srv.addr, "zone": zone}))
+            "heartbeat", {"kind": "data", "addr": srv.addr, "zone": zone,
+                          "packet_addr": psrv.addr}))
         return srv, svc
 
     if role == "objectnode":

@@ -285,6 +285,12 @@ class ExtentClient:
     def __init__(self, vol_view: dict, node_pool):
         self.dps = vol_view["dps"]
         self.nodes = node_pool
+        # binary packet plane per datanode (proto/packet.go transport):
+        # replicas that advertise one serve reads over persistent TCP
+        self.packet_addrs: dict[str, str] = dict(
+            vol_view.get("packet_addrs") or {})
+        self._packet_clients: dict[str, object] = {}
+        self._packet_down: dict[str, float] = {}  # addr -> retry-after ts
         self._rr = 0
         self._lock = threading.Lock()
         # per-inode open extent: ino -> (dp, extent_id, next_offset)
@@ -458,10 +464,7 @@ class ExtentClient:
         for addr in order:
             t0 = time.monotonic()
             try:
-                _, data = self.nodes.get(addr).call(
-                    "read", {"dp_id": dp["dp_id"], "extent_id": eid,
-                             "offset": off, "length": ln},
-                )
+                data = self._read_one(addr, dp["dp_id"], eid, off, ln)
                 if len(data) != ln:
                     # lagging / mid-repair replica: treat like a failure,
                     # a short read silently corrupts the assembled file
@@ -477,6 +480,37 @@ class ExtentClient:
             self._latency[addr] = self._latency.get(addr, dt) * 0.7 + 0.3 * dt
             return data
         raise FsError(5, f"all replicas failed for dp {dp['dp_id']}: {last_err}")
+
+    def _read_one(self, addr: str, dp_id: int, eid: int, off: int,
+                  ln: int) -> bytes:
+        """One replica read: the binary packet plane when the node
+        advertises it (falling back to RPC on transport errors), RPC
+        otherwise."""
+        paddr = self.packet_addrs.get(addr)
+        if paddr and time.monotonic() >= self._packet_down.get(addr, 0.0):
+            from ..utils import packet as pkt
+
+            cli = self._packet_clients.get(addr)
+            if cli is None:
+                # short connect timeout: a blackholed packet port must
+                # not stall reads before the RPC fallback kicks in
+                cli = self._packet_clients[addr] = pkt.PacketClient(
+                    paddr, timeout=2.0)
+            try:
+                _, data = cli.call(pkt.OP_READ, partition=dp_id, extent=eid,
+                                   offset=off, args={"length": ln})
+                return data
+            except pkt.PacketError as e:
+                raise rpc.RpcError(409, f"packet read: {e}") from None
+            except (ConnectionError, OSError):
+                # plane down: remember it and stop paying the connect
+                # cost on every read until the cooldown passes
+                self._packet_down[addr] = time.monotonic() + 30.0
+        _, data = self.nodes.get(addr).call(
+            "read", {"dp_id": dp_id, "extent_id": eid,
+                     "offset": off, "length": ln},
+        )
+        return data
 
 
 class FileSystem:

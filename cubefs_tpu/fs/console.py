@@ -1,8 +1,10 @@
 """Console: web dashboard over the admin APIs.
 
 Role parity: console/ (GraphQL proxy dashboard over master APIs) — here
-a dependency-free HTML status page aggregating master/clustermgr stats,
-volume tables and per-service metric links.
+a dependency-free HTML dashboard + JSON API aggregating master and
+clustermgr state: cluster stats, node topology (zones, liveness,
+decommission, packet planes), volume tables (partitions, capacity,
+usage, quotas), scheduler task switches, and per-service metric links.
 """
 
 from __future__ import annotations
@@ -18,9 +20,11 @@ from ..utils import rpc
 class Console:
     def __init__(self, master_addr: str | None = None,
                  clustermgr_addr: str | None = None,
+                 scheduler_addr: str | None = None,
                  host: str = "127.0.0.1", port: int = 0):
         self.master = master_addr
         self.cm = clustermgr_addr
+        self.scheduler = scheduler_addr
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -30,13 +34,25 @@ class Console:
                 pass
 
             def do_GET(self):
-                if self.path == "/api/state":
-                    body = json.dumps(outer.state()).encode()
+                routes = {
+                    "/api/state": outer.state,
+                    "/api/nodes": outer.nodes,
+                    "/api/volumes": outer.volumes,
+                    "/api/tasks": outer.tasks,
+                }
+                fn = routes.get(self.path)
+                if fn is not None:
+                    try:
+                        body = json.dumps(fn()).encode()
+                        code = 200
+                    except Exception as e:
+                        body = json.dumps({"error": str(e)}).encode()
+                        code = 502
                     ctype = "application/json"
                 else:
                     body = outer.render().encode()
-                    ctype = "text/html; charset=utf-8"
-                self.send_response(200)
+                    code, ctype = 200, "text/html; charset=utf-8"
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -48,32 +64,109 @@ class Console:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
 
+    # ---------------- data panels ----------------
+    def _call(self, addr: str, method: str, args: dict | None = None):
+        return rpc.call(addr, method, args, timeout=5)[0]
+
     def state(self) -> dict:
         out: dict = {}
-        for name, addr in (("master", self.master), ("clustermgr", self.cm)):
+        for name, addr in (("master", self.master), ("clustermgr", self.cm),
+                           ("scheduler", self.scheduler)):
             if not addr:
                 continue
             try:
-                out[name] = {"addr": addr, "stat": rpc.call(addr, "stat", timeout=5)[0]}
+                out[name] = {"addr": addr,
+                             "stat": self._call(addr, "stat")}
             except Exception as e:
                 out[name] = {"addr": addr, "error": str(e)}
         return out
 
-    def render(self) -> str:
-        st = self.state()
-        rows = []
-        for name, info in st.items():
-            detail = json.dumps(info.get("stat") or info.get("error"), indent=1)
-            rows.append(
-                f"<h2>{html.escape(name)} @ {html.escape(info['addr'])}"
-                f" <a href='http://{html.escape(info['addr'])}/metrics'>metrics</a></h2>"
-                f"<pre>{html.escape(detail)}</pre>"
-            )
-        return (
-            "<!doctype html><title>cubefs-tpu console</title>"
-            "<h1>cubefs-tpu cluster</h1>" + "".join(rows)
-            + "<p><a href='/api/state'>JSON</a></p>"
+    def nodes(self) -> dict:
+        if not self.master:
+            return {}
+        return self._call(self.master, "node_list")
+
+    def volumes(self) -> dict:
+        if not self.master:
+            return {}
+        stat = self._call(self.master, "stat")
+        out = {}
+        for name in stat.get("volumes", []):
+            try:
+                view = self._call(self.master, "client_view",
+                                  {"name": name})["volume"]
+                out[name] = {
+                    "mps": len(view["mps"]),
+                    "dps": len(view["dps"]),
+                    "quotas": len(view.get("quotas") or {}),
+                    "packet_nodes": len(view.get("packet_addrs") or {}),
+                }
+            except Exception as e:
+                out[name] = {"error": str(e)}
+        return out
+
+    def tasks(self) -> dict:
+        if not self.scheduler:
+            return {}
+        return self._call(self.scheduler, "task_switch", {"action": "list"})
+
+    # ---------------- HTML ----------------
+    @staticmethod
+    def _table(title: str, headers: list[str], rows: list[list]) -> str:
+        head = "".join(f"<th>{html.escape(h)}</th>" for h in headers)
+        body = "".join(
+            "<tr>" + "".join(f"<td>{html.escape(str(c))}</td>" for c in r)
+            + "</tr>"
+            for r in rows
         )
+        return (f"<h2>{html.escape(title)}</h2>"
+                f"<table border=1 cellpadding=4 cellspacing=0>"
+                f"<tr>{head}</tr>{body}</table>")
+
+    def render(self) -> str:
+        parts = ["<!doctype html><title>cubefs-tpu console</title>"
+                 "<h1>cubefs-tpu cluster</h1>"]
+        st = self.state()
+        for name, info in st.items():
+            detail = json.dumps(info.get("stat") or info.get("error"),
+                                indent=1)
+            parts.append(
+                f"<h2>{html.escape(name)} @ {html.escape(info['addr'])}"
+                f" <a href='http://{html.escape(info['addr'])}/metrics'>"
+                f"metrics</a></h2><pre>{html.escape(detail)}</pre>")
+        try:
+            nodes = self.nodes()
+        except Exception:
+            nodes = {}
+        for kind in ("datanodes", "metanodes"):
+            if nodes.get(kind):
+                parts.append(self._table(
+                    kind, ["addr", "zone", "live", "decommissioned"],
+                    [[a, i["zone"], i["live"], i["decommissioned"]]
+                     for a, i in sorted(nodes[kind].items())]))
+        try:
+            vols = self.volumes()
+        except Exception:
+            vols = {}
+        if vols:
+            parts.append(self._table(
+                "volumes", ["name", "mps", "dps", "quotas", "packet nodes"],
+                [[n, v.get("mps", "?"), v.get("dps", "?"),
+                  v.get("quotas", "?"), v.get("packet_nodes", "?")]
+                 for n, v in sorted(vols.items())]))
+        try:
+            tasks = self.tasks()
+        except Exception:
+            tasks = {}
+        if tasks.get("switches"):
+            parts.append(self._table(
+                "background task switches", ["kind", "enabled"],
+                sorted(tasks["switches"].items())))
+        parts.append("<p>JSON: <a href='/api/state'>state</a> · "
+                     "<a href='/api/nodes'>nodes</a> · "
+                     "<a href='/api/volumes'>volumes</a> · "
+                     "<a href='/api/tasks'>tasks</a></p>")
+        return "".join(parts)
 
     def start(self) -> "Console":
         self._thread.start()

@@ -254,15 +254,20 @@ class Master(ReplicatedFsm):
             info["hb"] = time.time()
             info["zone"] = zone
 
-    def heartbeat(self, addr: str, kind: str, zone: str | None = None) -> None:
+    def heartbeat(self, addr: str, kind: str, zone: str | None = None,
+                  packet_addr: str | None = None) -> None:
         with self._lock:
             reg = self.datanodes if kind == "data" else self.metanodes
             # unknown addr re-registers: a restarted master recovers its
-            # registries from the heartbeat stream
+            # registries from the heartbeat stream — INCLUDING the packet
+            # plane address, or a master restart would silently degrade
+            # every client to HTTP
             info = reg.setdefault(addr, {"addr": addr})
             info["hb"] = time.time()
             if zone or "zone" not in info:
                 info["zone"] = zone or "default"
+            if packet_addr:
+                info["packet_addr"] = packet_addr
 
     def _live(self, reg: dict) -> list[str]:
         now = time.time()
@@ -626,7 +631,8 @@ class Master(ReplicatedFsm):
         return {}
 
     def rpc_heartbeat(self, args, body):
-        self.heartbeat(args["addr"], args["kind"], args.get("zone"))
+        self.heartbeat(args["addr"], args["kind"], args.get("zone"),
+                       packet_addr=args.get("packet_addr"))
         return {}
 
     def rpc_node_list(self, args, body):

@@ -166,16 +166,17 @@ class MetaPartition:
         self.tx_pending = st.get("tx_pending", {})
         self.tx_committed = st.get("tx_committed", {})
 
-    def state_bytes(self) -> bytes:
-        """Serialize the whole partition state (raft snapshot payload)."""
-        with self._lock:
-            return json.dumps(self._state_dict()).encode()
-
     def export_state(self) -> tuple[bytes, int]:
-        """(state bytes, apply_id) captured under ONE lock acquisition,
-        so the manifest id always matches the payload."""
+        """(serialized state, apply_id) captured under ONE lock
+        acquisition, so the manifest id always matches the payload. The
+        single owner of state serialization — raft snapshots
+        (state_bytes) and the export RPC both come through here."""
         with self._lock:
             return json.dumps(self._state_dict()).encode(), self.apply_id
+
+    def state_bytes(self) -> bytes:
+        """Serialize the whole partition state (raft snapshot payload)."""
+        return self.export_state()[0]
 
     def restore_state(self, data: bytes) -> None:
         with self._lock:

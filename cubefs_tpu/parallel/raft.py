@@ -565,9 +565,17 @@ class RaftNode:
             if self.role != "leader":
                 return
             if meta.get("ok"):
-                self.match_index[peer] = args["prev_index"] + len(args["entries"])
-                self.next_index[peer] = self.match_index[peer] + 1
-                self.applied_index[peer] = meta.get("applied", 0)
+                # max() guards: a STALE reply (e.g. an in-flight heartbeat
+                # overtaken by an entry append) must never regress the
+                # peer's progress — a regressed next_index parks the peer
+                # between both planes and it would election-timeout
+                matched = args["prev_index"] + len(args["entries"])
+                self.match_index[peer] = max(
+                    self.match_index.get(peer, 0), matched)
+                self.next_index[peer] = max(
+                    self.next_index.get(peer, 1), self.match_index[peer] + 1)
+                self.applied_index[peer] = max(
+                    self.applied_index.get(peer, 0), meta.get("applied", 0))
                 before = self.commit_index
                 self._advance_commit()
                 if self.commit_index > before:
@@ -731,6 +739,10 @@ class HeartbeatMux:
         self.nodes: dict[tuple[str, str], RaftNode] = {}  # (gid, me) -> node
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # persistent per-address senders (latest-batch slot semantics):
+        # a dead peer blocks only its own sender, and steady state spawns
+        # zero threads per tick
+        self._senders: dict[str, dict] = {}
 
     def enroll(self, node: "RaftNode") -> None:
         with self._lock:
@@ -753,6 +765,8 @@ class HeartbeatMux:
                 # last node gone: stop the tick thread and release the
                 # pool reference, or every retired cluster leaks both
                 self._stop.set()
+                for slot in self._senders.values():
+                    slot["ev"].set()
                 with HeartbeatMux._BY_POOL_LOCK:
                     if HeartbeatMux._BY_POOL.get(id(self.pool)) is self:
                         del HeartbeatMux._BY_POOL[id(self.pool)]
@@ -767,8 +781,26 @@ class HeartbeatMux:
                     batches.setdefault(peer, []).append(
                         (node.group_id, node, args))
             for addr, items in batches.items():
-                threading.Thread(target=self._send, args=(addr, items),
-                                 daemon=True).start()
+                with self._lock:
+                    slot = self._senders.get(addr)
+                    if slot is None:
+                        slot = self._senders[addr] = {
+                            "ev": threading.Event(), "batch": None}
+                        threading.Thread(target=self._sender_loop,
+                                         args=(addr, slot),
+                                         daemon=True).start()
+                slot["batch"] = items  # latest batch wins
+                slot["ev"].set()
+
+    def _sender_loop(self, addr: str, slot: dict) -> None:
+        while not self._stop.is_set():
+            slot["ev"].wait()
+            slot["ev"].clear()
+            if self._stop.is_set():
+                return
+            items = slot["batch"]
+            if items:
+                self._send(addr, items)
 
     def _send(self, addr: str, items: list) -> None:
         try:

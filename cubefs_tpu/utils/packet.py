@@ -189,14 +189,17 @@ class PacketClient:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
     def close(self) -> None:
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                except OSError:
-                    pass
-                self._sock = None
+            self._close_locked()
 
     def call(self, opcode: int, *, partition: int = 0, extent: int = 0,
              offset: int = 0, args: dict | None = None,
@@ -215,11 +218,11 @@ class PacketClient:
                     hdr, rargs, rpayload = recv_packet(self._sock)
                     break
                 except (ConnectionError, OSError):
-                    self.close()
+                    self._close_locked()
                     if attempt:
                         raise
             if hdr["req_id"] != req_id:
-                self.close()
+                self._close_locked()
                 raise PacketError(0xFC, "response req_id mismatch")
             if hdr["result"] != RESULT_OK:
                 raise PacketError(hdr["result"],

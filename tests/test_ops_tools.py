@@ -162,3 +162,31 @@ def test_cli_node_and_tasks_groups(cluster, capsys, tmp_path):
         assert "actions" in out
     finally:
         srv.stop()
+
+
+def test_console_panels(cluster, tmp_path):
+    """Console aggregates nodes/volumes/tasks into JSON panels + HTML."""
+    import urllib.request
+
+    from cubefs_tpu.fs.console import Console
+    from cubefs_tpu.utils import rpc as rpclib
+
+    msrv = rpclib.RpcServer(rpclib.expose(cluster.master),
+                            service="master").start()
+    con = Console(master_addr=msrv.addr).start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(f"http://{con.addr}{path}",
+                                        timeout=10) as r:
+                return r.read()
+
+        nodes = json.loads(get("/api/nodes"))
+        assert len(nodes["datanodes"]) == 4
+        vols = json.loads(get("/api/volumes"))
+        assert vols["opvol"]["mps"] == 2 and vols["opvol"]["dps"] == 3
+        page = get("/").decode()
+        assert "datanodes" in page and "opvol" in page
+        assert "<table" in page
+    finally:
+        con.stop()
+        msrv.stop()
