@@ -1,0 +1,39 @@
+"""Embedded blobstore SDK: the blob plane without an access deployment.
+
+Role parity: blobstore/sdk — applications embed the access-layer logic
+(code-mode selection, split, encode, quorum write, hedged read,
+degraded reconstruct) directly in-process, talking straight to
+clustermgr and blobnodes. `BlobClient` wraps AccessHandler with
+location (de)serialization, so a consumer needs only the clustermgr
+address and a node pool.
+"""
+
+from __future__ import annotations
+
+from ..utils import rpc
+from .access import AccessConfig, AccessHandler
+from .types import Location
+
+
+class BlobClient:
+    """In-process blob put/get/delete (the embedded access client)."""
+
+    def __init__(self, clustermgr, node_pool, cfg: AccessConfig | None = None,
+                 proxy=None):
+        cm_client = (clustermgr if isinstance(clustermgr, rpc.Client)
+                     else rpc.Client(clustermgr))
+        proxy_client = (None if proxy is None else
+                        proxy if isinstance(proxy, rpc.Client)
+                        else rpc.Client(proxy))
+        self._h = AccessHandler(cm_client, node_pool, cfg,
+                                proxy_client=proxy_client)
+
+    def put(self, data: bytes, codemode: int | None = None) -> dict:
+        """Store bytes; returns a JSON-serializable location."""
+        return self._h.put(data, codemode).to_dict()
+
+    def get(self, location: dict) -> bytes:
+        return self._h.get(Location.from_dict(location))
+
+    def delete(self, location: dict) -> None:
+        self._h.delete(Location.from_dict(location))

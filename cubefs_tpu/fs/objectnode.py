@@ -115,10 +115,9 @@ class ObjectNode:
                 self._conf_cache = (bucket, conf)
                 return conf
 
-            def _check(self, action, bucket, key="") -> bool:
-                """Authorization (policy -> ACL -> user grant); replies
-                403 and returns False on denial. A gateway with no
-                authenticator configured skips authorization."""
+            def _allowed(self, action, bucket, key="") -> bool:
+                """Pure authorization decision (policy -> ACL -> user
+                grant); no reply side effects."""
                 if outer.auth is None:
                     return True
                 conf = self._bucket_conf(bucket)
@@ -136,11 +135,14 @@ class ObjectNode:
                                     "BucketCors")):
                     # bucket configuration is owner-only: policy/ACL
                     # cannot grant it away
-                    allowed = grant
-                else:
-                    allowed = s3policy.authorize(
-                        action, bucket, key, self._principal, acl, policy,
-                        grant)
+                    return grant
+                return s3policy.authorize(
+                    action, bucket, key, self._principal, acl, policy,
+                    grant)
+
+            def _check(self, action, bucket, key="") -> bool:
+                """_allowed + a 403 reply on denial."""
+                allowed = self._allowed(action, bucket, key)
                 if not allowed:
                     self._error(403, "AccessDenied", f"{action} denied")
                 return allowed
@@ -305,6 +307,8 @@ class ObjectNode:
                 if key and self._key_reserved(key):
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")
+                if not key and "delete" in query:  # DeleteObjects (batch)
+                    return self._delete_objects(bucket, fs)
                 if not self._check("s3:PutObject", bucket, key):
                     return
                 if "uploads" in query:
@@ -477,11 +481,62 @@ class ObjectNode:
                 self._reply(200, data, ctype="application/octet-stream",
                             headers=self._cors(bucket))
 
+            def _delete_objects(self, bucket, fs):
+                """POST /bucket?delete — batch DeleteObjects: per-key
+                authorization, per-key outcome in one DeleteResult."""
+                import xml.etree.ElementTree as ET
+
+                data = getattr(self, "_stashed_body", b"")
+                try:
+                    root = ET.fromstring(data)
+                except ET.ParseError as e:
+                    return self._error(400, "MalformedXML", str(e))
+                keys = [o.findtext("Key") or ""
+                        for o in root.findall("Object")]
+                if not keys or len(keys) > 1000:  # S3's batch limit
+                    return self._error(400, "MalformedXML",
+                                       "1..1000 Object keys required")
+                deleted, errors = [], []
+                for k in keys:
+                    if not k:
+                        errors.append((k, "UserKeyMustBeSpecified"))
+                        continue
+                    if self._key_reserved(k):
+                        errors.append((k, "AccessDenied"))
+                        continue
+                    if not self._allowed("s3:DeleteObject", bucket, k):
+                        errors.append((k, "AccessDenied"))
+                        continue
+                    try:
+                        fs.unlink("/" + k)
+                        outer._prune_empty_dirs(fs, k)
+                        deleted.append(k)
+                    except FsError as e:
+                        if e.errno == mn.ENOENT:
+                            # S3 treats delete-of-missing as success
+                            deleted.append(k)
+                        else:
+                            errors.append((k, "InternalError"))
+                body = ("<?xml version='1.0'?><DeleteResult>"
+                        + "".join(f"<Deleted><Key>{xs.escape(k)}</Key>"
+                                  f"</Deleted>" for k in deleted)
+                        + "".join(f"<Error><Key>{xs.escape(k)}</Key>"
+                                  f"<Code>{c}</Code></Error>"
+                                  for k, c in errors)
+                        + "</DeleteResult>").encode()
+                self._reply(200, body)
+
             def do_HEAD(self):
                 begun = self._begin()
                 if begun is None:
                     return
                 bucket, key, _ = begun
+                if not key:  # HeadBucket
+                    if self._fs(bucket) is None:
+                        return self._error(404, "NoSuchBucket", bucket)
+                    if not self._check("s3:ListBucket", bucket):
+                        return
+                    return self._reply(200)
                 if not self._check("s3:GetObject", bucket, key):
                     return
                 fs = self._fs(bucket)

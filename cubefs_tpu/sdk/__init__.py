@@ -1,0 +1,9 @@
+"""Typed service clients (the reference's sdk/master, blobstore/api
+analog): every admin/data surface as a concrete Python API over the RPC
+wire, instead of hand-rolled method-name strings at call sites."""
+
+from .clients import (AccessClient, ClusterMgrClient, MasterClient,
+                      SchedulerClient)
+
+__all__ = ["MasterClient", "SchedulerClient", "ClusterMgrClient",
+           "AccessClient"]

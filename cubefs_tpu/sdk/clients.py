@@ -1,0 +1,165 @@
+"""Typed RPC clients for every service surface.
+
+Role parity: sdk/master (admin client, sdk/master/client.go),
+blobstore/api/{access,clustermgr,scheduler} (typed clients per
+service). Each wraps the framework's rpc.Client (in-process or HTTP,
+421-leader-redirect aware) with concrete methods, so consumers — CLI,
+console, tools, other services — never hand-roll method-name strings.
+"""
+
+from __future__ import annotations
+
+from ..utils import rpc
+
+
+class _Base:
+    def __init__(self, target):
+        """target: an address string, an RpcServer, or a live service
+        object (in-process)."""
+        self._c = target if isinstance(target, rpc.Client) else rpc.Client(target)
+
+    def _call(self, method: str, args: dict | None = None,
+              body: bytes = b"", timeout: float = 30.0):
+        return self._c.call(method, args, body, timeout)
+
+
+class MasterClient(_Base):
+    """FS-plane resource manager admin surface (sdk/master analog)."""
+
+    def create_volume(self, name: str, mp_count: int = 3,
+                      dp_count: int = 4) -> dict:
+        return self._call("create_volume", {
+            "name": name, "mp_count": mp_count, "dp_count": dp_count,
+        })[0]["volume"]
+
+    def client_view(self, name: str) -> dict:
+        return self._call("client_view", {"name": name})[0]["volume"]
+
+    def stat(self) -> dict:
+        return self._call("stat")[0]
+
+    def node_list(self) -> dict:
+        return self._call("node_list")[0]
+
+    def decommission_datanode(self, addr: str) -> list:
+        return self._call("decommission_datanode",
+                          {"addr": addr})[0]["actions"]
+
+    def check_replicas(self) -> list:
+        return self._call("check_replicas")[0]["actions"]
+
+    # quotas
+    def set_vol_capacity(self, name: str, capacity: int) -> None:
+        self._call("set_vol_capacity", {"name": name, "capacity": capacity})
+
+    def set_quota(self, name: str, dir_ino: int, max_bytes: int = 0,
+                  max_files: int = 0) -> int:
+        return self._call("set_quota", {
+            "name": name, "dir_ino": dir_ino, "max_bytes": max_bytes,
+            "max_files": max_files})[0]["qid"]
+
+    def delete_quota(self, name: str, qid: int) -> None:
+        self._call("delete_quota", {"name": name, "qid": qid})
+
+    def list_quotas(self, name: str) -> dict:
+        return self._call("list_quotas", {"name": name})[0]["quotas"]
+
+    def enforce_quotas(self) -> dict:
+        return self._call("enforce_quotas")[0]["summary"]
+
+    # meta partitions
+    def split_meta_partition(self, name: str) -> int | None:
+        return self._call("split_meta_partition", {"name": name})[0]["pid"]
+
+    def check_meta_partitions(self) -> list:
+        return self._call("check_meta_partitions")[0]["actions"]
+
+    def register(self, kind: str, addr: str, zone: str = "default",
+                 packet_addr: str | None = None) -> None:
+        args = {"kind": kind, "addr": addr, "zone": zone}
+        if packet_addr:
+            args["packet_addr"] = packet_addr
+        self._call("register", args)
+
+    def heartbeat(self, kind: str, addr: str, zone: str | None = None,
+                  packet_addr: str | None = None) -> None:
+        args = {"kind": kind, "addr": addr}
+        if zone:
+            args["zone"] = zone
+        if packet_addr:
+            args["packet_addr"] = packet_addr
+        self._call("heartbeat", args)
+
+
+class SchedulerClient(_Base):
+    """Background-task brain surface (api/scheduler analog)."""
+
+    def acquire_task(self, worker_id: str) -> dict | None:
+        return self._call("acquire_task",
+                          {"worker_id": worker_id})[0].get("task")
+
+    def renew_task(self, task_id: str, worker_id: str) -> bool:
+        return self._call("renew_task", {
+            "task_id": task_id, "worker_id": worker_id})[0]["ok"]
+
+    def complete_task(self, task_id: str, worker_id: str) -> None:
+        self._call("complete_task", {"task_id": task_id,
+                                     "worker_id": worker_id})
+
+    def fail_task(self, task_id: str, worker_id: str,
+                  error: str = "") -> None:
+        self._call("fail_task", {"task_id": task_id,
+                                 "worker_id": worker_id, "error": error})
+
+    def stats(self) -> dict:
+        return self._call("stats")[0]
+
+    def task_switch(self, action: str = "list",
+                    kind: str | None = None) -> dict:
+        args: dict = {"action": action}
+        if kind:
+            args["kind"] = kind
+        return self._call("task_switch", args)[0]["switches"]
+
+
+class ClusterMgrClient(_Base):
+    """EC-plane metadata center surface (api/clustermgr analog)."""
+
+    def stat(self) -> dict:
+        return self._call("stat")[0]
+
+    def register_disk(self, node_addr: str, path: str) -> int:
+        return self._call("register_disk", {
+            "node_addr": node_addr, "path": path})[0]["disk_id"]
+
+    def alloc_volume(self, codemode: int) -> dict:
+        return self._call("alloc_volume",
+                          {"codemode": codemode})[0]["volume"]
+
+    def get_volume(self, vid: int) -> dict:
+        return self._call("get_volume", {"vid": vid})[0]["volume"]
+
+    def alloc_bids(self, count: int) -> dict:
+        return self._call("alloc_bids", {"count": count})[0]
+
+    def get_service(self, name: str) -> dict:
+        return self._call("get_service", {"name": name})[0]
+
+    def register_service(self, name: str, addr: str) -> None:
+        self._call("register_service", {"name": name, "addr": addr})
+
+
+class AccessClient(_Base):
+    """Blob gateway surface (api/access analog): put/get/delete against
+    a RUNNING access service. For an in-process embedded client with no
+    access deployment, see cubefs_tpu.blob.sdk.BlobClient."""
+
+    def put(self, data: bytes, codemode: int | None = None) -> dict:
+        args = {} if codemode is None else {"codemode": codemode}
+        return self._call("put", args, data)[0]["location"]
+
+    def get(self, location: dict) -> bytes:
+        return self._call("get", {"location": location})[1]
+
+    def delete(self, location: dict) -> None:
+        self._call("delete", {"location": location})

@@ -352,3 +352,51 @@ def test_policy_revocation_visible_on_keepalive_connection(gateway):
         assert r2.status == 403, "revocation must reach open connections"
     finally:
         conn.close()
+
+
+def test_delete_objects_batch(gateway):
+    s3, owner, other, fs = gateway
+    base = f"http://{s3.addr}"
+    for i in range(3):
+        _signed("PUT", f"{base}/bkt/batch/k{i}", owner, b"x")
+    body = (b"<Delete>"
+            b"<Object><Key>batch/k0</Key></Object>"
+            b"<Object><Key>batch/k1</Key></Object>"
+            b"<Object><Key>batch/missing</Key></Object>"
+            b"</Delete>")
+    code, out, _ = _signed("POST", f"{base}/bkt?delete", owner, body)
+    assert code == 200
+    assert out.count(b"<Deleted>") == 3  # missing key deletes are OK per S3
+    assert _signed("GET", f"{base}/bkt/batch/k0", owner)[0] == 404
+    assert _signed("GET", f"{base}/bkt/batch/k2", owner)[0] == 200
+    # an ungranted principal gets per-key AccessDenied, not a batch 403
+    code, out, _ = _signed("POST", f"{base}/bkt?delete", other,
+                           b"<Delete><Object><Key>batch/k2</Key></Object>"
+                           b"</Delete>")
+    assert code == 200 and b"<Error><Key>batch/k2</Key>" in out
+    assert _signed("GET", f"{base}/bkt/batch/k2", owner)[0] == 200
+
+
+def test_head_bucket(gateway):
+    s3, owner, other, fs = gateway
+    base = f"http://{s3.addr}"
+    code, body, _ = _signed("HEAD", f"{base}/bkt", owner)
+    assert code == 200 and body == b""
+    assert _signed("HEAD", f"{base}/nope", owner)[0] == 404
+    assert _anon("HEAD", f"{base}/bkt")[0] == 403  # private bucket
+
+
+def test_presigned_put(gateway):
+    """Presigned PUT: UNSIGNED-PAYLOAD query auth authorizes an upload
+    with no signed headers at all."""
+    s3, owner, other, fs = gateway
+    base = f"http://{s3.addr}"
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    q = s3auth.presign_v4("PUT", "/bkt/uploaded.bin", s3.addr,
+                          owner["access_key"], owner["secret_key"],
+                          amz_date, expires=300)
+    code, _, _ = _anon("PUT", f"{base}/bkt/uploaded.bin?{q}",
+                       payload=b"presigned upload body")
+    assert code == 200
+    code, body, _ = _signed("GET", f"{base}/bkt/uploaded.bin", owner)
+    assert code == 200 and body == b"presigned upload body"

@@ -1,0 +1,90 @@
+"""Typed service clients + the embedded blobstore SDK (reference:
+sdk/master, blobstore/api, blobstore/sdk)."""
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob.blobnode import BlobNode
+from cubefs_tpu.blob.clustermgr import ClusterMgr
+from cubefs_tpu.blob.sdk import BlobClient
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+from cubefs_tpu.sdk import MasterClient, SchedulerClient
+from cubefs_tpu.utils.rpc import NodePool
+
+
+def test_master_client_typed_surface(tmp_path):
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas, datas = [], []
+    for i in range(2):
+        n = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", n)
+        master.register_metanode(f"meta{i}")
+        metas.append(n)
+    for i in range(3):
+        n = DataNode(i, str(tmp_path / f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", n)
+        master.register_datanode(f"data{i}")
+        datas.append(n)
+    mc = MasterClient(master)
+    try:
+        view = mc.create_volume("sdkvol", mp_count=1, dp_count=2)
+        assert len(view["dps"]) == 2
+        assert mc.client_view("sdkvol")["name"] == "sdkvol"
+        assert "sdkvol" in mc.stat()["volumes"]
+        assert len(mc.node_list()["datanodes"]) == 3
+        qid = mc.set_quota("sdkvol", 1, max_bytes=100)
+        assert str(qid) in mc.list_quotas("sdkvol")
+        mc.delete_quota("sdkvol", qid)
+        assert mc.enforce_quotas()["sdkvol"]["used_bytes"] == 0
+        assert mc.check_meta_partitions() == []
+    finally:
+        for m in metas:
+            m.stop()
+        for d in datas:
+            d.stop()
+
+
+def test_scheduler_client_switches():
+    from cubefs_tpu.blob.scheduler import Scheduler
+
+    cm = ClusterMgr(allow_colocated_units=True)
+    sched = Scheduler(cm)
+    sc = SchedulerClient(sched)
+    assert sc.task_switch()["balance"] is True
+    sc.task_switch("disable", "balance")
+    assert sc.task_switch()["balance"] is False
+    assert sc.acquire_task("w1") is None
+    with pytest.raises(Exception):
+        sc.task_switch("disable", "nope")
+
+
+def test_embedded_blob_client_roundtrip(tmp_path, rng):
+    """blobstore/sdk analog: put/get/delete with NO access deployment —
+    the client embeds the whole access pipeline."""
+    from cubefs_tpu.blob.access import AccessConfig
+    from cubefs_tpu.utils import rpc as rpclib
+
+    pool = NodePool()
+    cm = ClusterMgr(allow_colocated_units=True)
+    cm_client = rpclib.Client(cm)
+    for i in range(3):
+        addr = f"bn{i}"
+        bn = BlobNode(node_id=i,
+                      disk_paths=[str(tmp_path / f"bn{i}d{k}")
+                                  for k in range(3)],
+                      cm_client=cm_client, addr=addr)
+        bn.register()
+        bn.send_heartbeat()
+        pool.bind(addr, bn)
+    cli = BlobClient(cm_client, pool, AccessConfig(blob_size=64 << 10))
+    payload = rng.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    loc = cli.put(payload)
+    assert isinstance(loc, dict) and loc["size"] == len(payload)
+    assert cli.get(loc) == payload
+    cli.delete(loc)
+    with pytest.raises(Exception):
+        cli.get(loc)
