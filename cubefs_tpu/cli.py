@@ -78,6 +78,16 @@ def main(argv=None):
     p_mp.add_argument("--master", required=True)
     p_mp.add_argument("--vol", help="volume name (for split)")
 
+    p_user = sub.add_parser("user")
+    p_user.add_argument("action",
+                        choices=["create", "grant", "revoke", "list",
+                                 "delete"])
+    p_user.add_argument("--master", required=True)
+    p_user.add_argument("--user-id")
+    p_user.add_argument("--ak")
+    p_user.add_argument("--vol")
+    p_user.add_argument("--perm", default="rw", choices=["r", "rw"])
+
     p_tasks = sub.add_parser("tasks")
     p_tasks.add_argument("action", choices=["list", "enable", "disable"])
     p_tasks.add_argument("--scheduler", required=True)
@@ -169,6 +179,33 @@ def main(argv=None):
             out = master.call("split_meta_partition", {"name": args.vol})[0]
         else:
             out = master.call("check_meta_partitions", {})[0]
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "user":
+        from .sdk import MasterClient
+
+        mc = MasterClient(args.master)
+        if args.action == "create":
+            if not args.user_id:
+                sys.exit("user create needs --user-id")
+            out = mc.create_user(args.user_id)
+        elif args.action == "grant":
+            if not (args.ak and args.vol):
+                sys.exit("user grant needs --ak and --vol")
+            mc.grant(args.ak, args.vol, args.perm)
+            out = {"granted": f"{args.ak} -> {args.vol} ({args.perm})"}
+        elif args.action == "revoke":
+            if not (args.ak and args.vol):
+                sys.exit("user revoke needs --ak and --vol")
+            mc.revoke(args.ak, args.vol)
+            out = {"revoked": f"{args.ak} -> {args.vol}"}
+        elif args.action == "delete":
+            if not args.ak:
+                sys.exit("user delete needs --ak")
+            mc.delete_user(args.ak)
+            out = {"deleted": args.ak}
+        else:
+            out = mc.list_users()
         print(json.dumps(out, indent=2))
 
     elif args.group == "tasks":

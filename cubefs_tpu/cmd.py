@@ -89,7 +89,8 @@ def run_role(cfg: dict):
         from .fs.datanode import DataNode
 
         # the node learns its own address only after the server binds
-        svc = DataNode(int(cfg.get("node_id", 0)), cfg["data_dir"], "pending", pool)
+        svc = DataNode(int(cfg.get("node_id", 0)), cfg["data_dir"], "pending", pool,
+                       qos=cfg.get("qos"))  # {"read_bps":..., "write_bps":...}
         srv = _serve(rpc.expose(svc), cfg)
         svc.addr = srv.addr
         # the binary packet plane (hot data path) listens beside HTTP
@@ -116,7 +117,13 @@ def run_role(cfg: dict):
             vols[bucket] = FileSystem(view, pool,
                                       master_addr=cfg["master_addr"])
         auth = None
-        if cfg.get("users"):  # [{access_key, secret_key, grants:{vol:perm}}]
+        if cfg.get("users_from_master"):
+            # the master's replicated user table is the identity source
+            from .fs.s3auth import MasterUserStore, S3V4Authenticator
+
+            auth = S3V4Authenticator(MasterUserStore(master),
+                                     dict(cfg.get("vols", {})))
+        elif cfg.get("users"):  # [{access_key, secret_key, grants:{vol:perm}}]
             from .fs.authnode import UserStore
             from .fs.s3auth import S3V4Authenticator
 

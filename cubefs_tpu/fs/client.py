@@ -336,14 +336,9 @@ class ExtentClient:
             seg = min(len(data) - done, self.EXTENT_CAP - ext_off)
             written = 0
             while written < seg:
-                pkt = data[done + written : done + min(written + self.PACKET, seg)]
-                leader.call(
-                    "write",
-                    {"dp_id": dp["dp_id"], "extent_id": eid,
-                     "offset": ext_off + written},
-                    pkt,
-                )
-                written += len(pkt)
+                piece = data[done + written : done + min(written + self.PACKET, seg)]
+                self._leader_write(dp, eid, ext_off + written, piece)
+                written += len(piece)
             extent_keys.append({
                 "dp_id": dp["dp_id"], "extent_id": eid, "ext_offset": ext_off,
                 "file_offset": file_offset + done, "size": seg,
@@ -375,10 +370,7 @@ class ExtentClient:
                 tiny = (dp, eid, 0)
             dp, eid, off = tiny
             self._tiny = (dp, eid, off + len(data))
-        self.nodes.get(dp["leader"]).call(
-            "write", {"dp_id": dp["dp_id"], "extent_id": eid, "offset": off},
-            data,
-        )
+        self._leader_write(dp, eid, off, data)
         meta.append_extents(
             ino,
             [{"dp_id": dp["dp_id"], "extent_id": eid, "ext_offset": off,
@@ -481,6 +473,33 @@ class ExtentClient:
             return data
         raise FsError(5, f"all replicas failed for dp {dp['dp_id']}: {last_err}")
 
+    def _leader_write(self, dp: dict, eid: int, off: int,
+                      data: bytes) -> None:
+        """One write leg to the designated leader: the binary packet
+        plane when advertised (same negative-cache discipline as reads),
+        RPC otherwise. Server-side semantics are identical — both
+        transports enter DataNode.write()."""
+        addr = dp["leader"]
+        paddr = self.packet_addrs.get(addr)
+        if paddr and time.monotonic() >= self._packet_down.get(addr, 0.0):
+            from ..utils import packet as pkt
+
+            cli = self._packet_clients.get(addr)
+            if cli is None:
+                cli = self._packet_clients[addr] = pkt.PacketClient(
+                    paddr, timeout=30.0, connect_timeout=2.0)
+            try:
+                cli.call(pkt.OP_WRITE, partition=dp["dp_id"], extent=eid,
+                         offset=off, payload=data)
+                return
+            except pkt.PacketError as e:
+                raise rpc.RpcError(500, f"packet write: {e}") from None
+            except (ConnectionError, OSError):
+                self._packet_down[addr] = time.monotonic() + 30.0
+        self.nodes.get(addr).call(
+            "write", {"dp_id": dp["dp_id"], "extent_id": eid,
+                      "offset": off}, data)
+
     def _read_one(self, addr: str, dp_id: int, eid: int, off: int,
                   ln: int) -> bytes:
         """One replica read: the binary packet plane when the node
@@ -495,7 +514,7 @@ class ExtentClient:
                 # short connect timeout: a blackholed packet port must
                 # not stall reads before the RPC fallback kicks in
                 cli = self._packet_clients[addr] = pkt.PacketClient(
-                    paddr, timeout=2.0)
+                    paddr, timeout=30.0, connect_timeout=2.0)
             try:
                 _, data = cli.call(pkt.OP_READ, partition=dp_id, extent=eid,
                                    offset=off, args={"length": ln})

@@ -80,11 +80,18 @@ class DataPartition:
 
 
 class DataNode:
-    def __init__(self, node_id: int, root_dir: str, addr: str, node_pool):
+    def __init__(self, node_id: int, root_dir: str, addr: str, node_pool,
+                 qos=None):
+        from ..utils.ratelimit import DiskQos
+
         self.node_id = node_id
         self.root = root_dir
         self.addr = addr
         self.nodes = node_pool  # addr -> rpc client (for chain forward)
+        # client-facing IO shaping (datanode/limit.go): raft applies and
+        # chain replica legs are exempt — throttling consensus/repair
+        # traffic would stall recovery, exactly what QoS must not do
+        self.qos = qos if isinstance(qos, DiskQos) else DiskQos.from_config(qos)
         self.partitions: dict[int, DataPartition] = {}
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = threading.RLock()
@@ -165,6 +172,11 @@ class DataNode:
                           "offset": offset, "hops": hops - 1},
                 data, timeout=30.0)
             return
+        if self.qos is not None:
+            # charge the bucket only where the IO actually happens (the
+            # designated leader); a stale-view forwarder must not burn
+            # its budget on bytes it never writes
+            self.qos.acquire_write(len(data))
         with dp.extent_lock(extent_id):
             if len(dp.peers) > 1 and offset < dp.store.size(extent_id):
                 raft = dp.raft
@@ -244,8 +256,13 @@ class DataNode:
                 last = e
         raise rpc.RpcError(503, f"dp {dp.dp_id} random write failed: {last}")
 
-    def read(self, dp_id: int, extent_id: int, offset: int, length: int) -> bytes:
+    def read(self, dp_id: int, extent_id: int, offset: int, length: int,
+             internal: bool = False) -> bytes:
+        """internal=True (replica repair) bypasses client QoS — throttling
+        recovery is exactly the starvation QoS must not cause."""
         dp = self._dp(dp_id)
+        if self.qos is not None and not internal:
+            self.qos.acquire_read(length)
         return dp.store.read(extent_id, offset, length)
 
     # ---------------- repair (CRC fingerprint diff) ----------------
@@ -268,8 +285,9 @@ class DataNode:
         span = 1 << 20
         for off in range(0, size, span):
             _, chunk = self.nodes.get(src_addr).call(
-                "read", {"dp_id": dp_id, "extent_id": extent_id,
-                         "offset": off, "length": min(span, size - off)},
+                "read_internal", {"dp_id": dp_id, "extent_id": extent_id,
+                                  "offset": off,
+                                  "length": min(span, size - off)},
             )
             dp.store.write(extent_id, off, chunk)
 
@@ -300,6 +318,17 @@ class DataNode:
         self.write(args["dp_id"], args["extent_id"], args["offset"], body,
                    chain=False)
         return {}
+
+    def rpc_read_internal(self, args, body):
+        # repair plane: QoS-exempt (see read())
+        try:
+            data = self.read(args["dp_id"], args["extent_id"],
+                             args["offset"], args["length"], internal=True)
+        except BlockCrcError as e:
+            raise rpc.RpcError(409, str(e)) from None
+        except ExtentError as e:
+            raise rpc.RpcError(500, str(e)) from None
+        return {}, data
 
     def rpc_read(self, args, body):
         try:

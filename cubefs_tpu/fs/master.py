@@ -94,6 +94,9 @@ class Master(ReplicatedFsm):
         # operator drains ARE replicated state: a restart or failover
         # must not re-place partitions on a drained node
         self.decommissioned: set[str] = set()
+        # AK/SK user registry with per-volume grants (master/user.go):
+        # replicated FSM state, served to gateways for authentication
+        self.users: dict[str, dict] = {}  # ak -> {user_id, sk, volumes}
         self._next_pid = 1
         self._next_dp = 1
         self.data_dir = data_dir
@@ -102,12 +105,14 @@ class Master(ReplicatedFsm):
     def _state_dict(self) -> dict:
         return {"volumes": self.volumes,
                 "next": [self._next_pid, self._next_dp],
-                "decommissioned": sorted(self.decommissioned)}
+                "decommissioned": sorted(self.decommissioned),
+                "users": self.users}
 
     def _load_state_dict(self, state: dict) -> None:
         self.volumes = state["volumes"]
         self._next_pid, self._next_dp = state["next"]
         self.decommissioned = set(state.get("decommissioned", []))
+        self.users = state.get("users", {})
 
     def _state_bytes(self) -> bytes:
         with self._lock:
@@ -129,6 +134,105 @@ class Master(ReplicatedFsm):
                              + [m["pid"] + 1 for m in vol["mps"]])
         self._next_dp = max([self._next_dp]
                             + [d["dp_id"] + 1 for d in vol["dps"]])
+
+    # ---------------- users (master/user.go analog) --------------------
+    def _apply_put_user(self, ak: str, user: dict) -> None:
+        self.users[ak] = user
+
+    def _apply_delete_user(self, ak: str) -> None:
+        self.users.pop(ak, None)
+
+    def _apply_set_grant(self, ak: str, volume: str,
+                         perm: str | None) -> None:
+        u = self.users.get(ak)
+        if u is None:
+            return
+        if perm is None:
+            u["volumes"].pop(volume, None)
+        else:
+            u["volumes"][volume] = perm
+
+    def create_user(self, user_id: str) -> dict:
+        import secrets as _secrets
+
+        ak = _secrets.token_hex(8)
+        sk = _secrets.token_hex(16)
+        self._commit({"op": "put_user", "ak": ak, "user": {
+            "user_id": user_id, "sk": sk, "volumes": {}}})
+        return {"user_id": user_id, "access_key": ak, "secret_key": sk}
+
+    def delete_user(self, ak: str) -> None:
+        with self._lock:
+            if ak not in self.users:
+                raise MasterError(f"unknown access key {ak!r}")
+        self._commit({"op": "delete_user", "ak": ak})
+
+    def grant(self, ak: str, volume: str, perm: str = "rw") -> None:
+        if perm not in ("r", "rw"):
+            raise MasterError(f"bad perm {perm!r}")
+        with self._lock:
+            if ak not in self.users:
+                raise MasterError(f"unknown access key {ak!r}")
+        self._commit({"op": "set_grant", "ak": ak, "volume": volume,
+                      "perm": perm})
+
+    def revoke(self, ak: str, volume: str) -> None:
+        self._commit({"op": "set_grant", "ak": ak, "volume": volume,
+                      "perm": None})
+
+    def secret_for(self, ak: str) -> str | None:
+        with self._lock:
+            u = self.users.get(ak)
+            return u["sk"] if u else None
+
+    def allowed(self, ak: str, volume: str, write: bool) -> bool:
+        with self._lock:
+            u = self.users.get(ak)
+            if u is None:
+                return False
+            perm = u["volumes"].get(volume, "")
+            return "w" in perm if write else bool(perm)
+
+    def rpc_create_user(self, args, body):
+        self._leader_gate()
+        return self.create_user(args["user_id"])
+
+    def rpc_delete_user(self, args, body):
+        self._leader_gate()
+        try:
+            self.delete_user(args["ak"])
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+        return {}
+
+    def rpc_grant(self, args, body):
+        self._leader_gate()
+        try:
+            self.grant(args["ak"], args["volume"], args.get("perm", "rw"))
+        except MasterError as e:
+            raise rpc.RpcError(400, str(e)) from None
+        return {}
+
+    def rpc_revoke(self, args, body):
+        self._leader_gate()
+        self.revoke(args["ak"], args["volume"])
+        return {}
+
+    def rpc_list_users(self, args, body):
+        with self._lock:
+            # admin listing: secrets redacted
+            return {"users": {ak: {"user_id": u["user_id"],
+                                   "volumes": dict(u["volumes"])}
+                              for ak, u in self.users.items()}}
+
+    def rpc_user_auth_info(self, args, body):
+        """Gateway authentication lookup: sk + grants for one access
+        key (the objectnode's user-store backend)."""
+        with self._lock:
+            u = self.users.get(args["ak"])
+            if u is None:
+                raise rpc.RpcError(404, f"unknown access key")
+            return {"sk": u["sk"], "volumes": dict(u["volumes"])}
 
     # ---------------- quotas (master_quota_manager.go analog) ----------
     def _apply_set_vol_capacity(self, name: str, capacity: int) -> None:

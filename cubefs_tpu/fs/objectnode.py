@@ -491,8 +491,12 @@ class ObjectNode:
                     root = ET.fromstring(data)
                 except ET.ParseError as e:
                     return self._error(400, "MalformedXML", str(e))
-                keys = [o.findtext("Key") or ""
-                        for o in root.findall("Object")]
+                # AWS SDKs send the namespaced document
+                # (xmlns=http://s3.amazonaws.com/doc/2006-03-06/):
+                # match by local name
+                keys = [o.findtext("{*}Key") or o.findtext("Key") or ""
+                        for o in (root.findall("{*}Object")
+                                  or root.findall("Object"))]
                 if not keys or len(keys) > 1000:  # S3's batch limit
                     return self._error(400, "MalformedXML",
                                        "1..1000 Object keys required")

@@ -12,6 +12,7 @@ import base64
 import calendar
 import hashlib
 import hmac
+import threading
 import time
 import urllib.parse
 
@@ -284,6 +285,58 @@ def verify_v2(method: str, path: str, query: str, headers: dict[str, str],
     if not hmac.compare_digest(expect, sig):
         return False, "signature mismatch"
     return True, ak
+
+
+class MasterUserStore:
+    """UserStore backend served by the master's replicated user table
+    (master/user.go flow: the gateway fetches AK/SK + grants from the
+    resource manager, with a short TTL cache so authentication does not
+    hit the master on every request)."""
+
+    TTL = 30.0
+    MAX_CACHE = 10_000
+
+    def __init__(self, master_client):
+        self._c = master_client
+        self._cache: dict[str, tuple[float, dict | None]] = {}
+        self._lock = threading.Lock()
+
+    def _info(self, ak: str) -> dict | None:
+        from ..utils import rpc as _rpc
+
+        now = time.time()
+        with self._lock:
+            hit = self._cache.get(ak)
+            if hit and now - hit[0] < self.TTL:
+                return hit[1]
+        try:
+            info = self._c.call("user_auth_info", {"ak": ak})[0]
+        except _rpc.RpcError as e:
+            if not (400 <= e.code < 500):
+                # transient master failure: serve the stale cached value
+                # if any, and do NOT cache the outage as "unknown key"
+                return hit[1] if hit else None
+            info = None  # definitive: key does not exist
+        except Exception:
+            return hit[1] if hit else None
+        with self._lock:
+            if len(self._cache) >= self.MAX_CACHE:
+                # unauthenticated key-spraying must not grow this forever
+                for k in list(self._cache)[: self.MAX_CACHE // 2]:
+                    del self._cache[k]
+            self._cache[ak] = (now, info)
+        return info
+
+    def secret_for(self, ak: str) -> str | None:
+        info = self._info(ak)
+        return info["sk"] if info else None
+
+    def allowed(self, ak: str, volume: str, write: bool) -> bool:
+        info = self._info(ak)
+        if info is None:
+            return False
+        perm = info["volumes"].get(volume, "")
+        return "w" in perm if write else bool(perm)
 
 
 class S3V4Authenticator:

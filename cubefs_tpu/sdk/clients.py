@@ -74,6 +74,22 @@ class MasterClient(_Base):
     def check_meta_partitions(self) -> list:
         return self._call("check_meta_partitions")[0]["actions"]
 
+    # users (master/user.go surface)
+    def create_user(self, user_id: str) -> dict:
+        return self._call("create_user", {"user_id": user_id})[0]
+
+    def delete_user(self, ak: str) -> None:
+        self._call("delete_user", {"ak": ak})
+
+    def grant(self, ak: str, volume: str, perm: str = "rw") -> None:
+        self._call("grant", {"ak": ak, "volume": volume, "perm": perm})
+
+    def revoke(self, ak: str, volume: str) -> None:
+        self._call("revoke", {"ak": ak, "volume": volume})
+
+    def list_users(self) -> dict:
+        return self._call("list_users")[0]["users"]
+
     def register(self, kind: str, addr: str, zone: str = "default",
                  packet_addr: str | None = None) -> None:
         args = {"kind": kind, "addr": addr, "zone": zone}

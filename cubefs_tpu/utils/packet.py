@@ -175,17 +175,25 @@ class PacketClient:
     reconnects once on a broken pipe (idempotent ops only — writes carry
     their own exactly-once semantics at the store layer)."""
 
-    def __init__(self, addr: str, timeout: float = 30.0):
+    def __init__(self, addr: str, timeout: float = 30.0,
+                 connect_timeout: float | None = None):
+        """timeout bounds a full request/response round-trip (writes may
+        legitimately block on chain forwarding / raft / QoS shaping);
+        connect_timeout bounds only the TCP connect, so a blackholed
+        port fails fast without shrinking the IO budget."""
         self.host, port = addr.rsplit(":", 1)
         self.port = int(port)
         self.timeout = timeout
+        self.connect_timeout = (connect_timeout if connect_timeout
+                                is not None else timeout)
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._req_id = 0
 
     def _connect(self) -> socket.socket:
         s = socket.create_connection((self.host, self.port),
-                                     timeout=self.timeout)
+                                     timeout=self.connect_timeout)
+        s.settimeout(self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 

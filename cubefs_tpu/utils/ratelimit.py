@@ -1,0 +1,74 @@
+"""Token-bucket rate limiting (disk QoS / client shaping).
+
+Role parity: datanode/limit.go + util/ratelimit — client-facing IO is
+shaped by byte-per-second buckets so background floods cannot starve
+the disk. Blocking acquire with a fairness queue (FIFO via lock order);
+a zero rate means unlimited.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class TokenBucket:
+    """Blocking byte-rate limiter: `acquire(n)` waits until n tokens are
+    available. Burst capacity defaults to one second of rate."""
+
+    def __init__(self, rate_bytes_per_s: float, burst: float | None = None):
+        self.rate = float(rate_bytes_per_s)
+        self.burst = float(burst if burst is not None else rate_bytes_per_s)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def acquire(self, n: int, timeout: float | None = None) -> bool:
+        """Consume n tokens, sleeping as needed. Oversized requests
+        (n > burst) are allowed by letting the balance go negative, so a
+        single large IO is shaped rather than deadlocked."""
+        if self.rate <= 0:
+            return True
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:  # FIFO: waiters shape one another
+            while True:
+                self._refill()
+                if self._tokens >= min(n, self.burst):
+                    self._tokens -= n  # may go negative for n > burst
+                    return True
+                need = (min(n, self.burst) - self._tokens) / self.rate
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    need = min(need, remaining)
+                time.sleep(need)
+
+
+class DiskQos:
+    """Per-disk read/write byte shaping (datanode/limit.go analog)."""
+
+    def __init__(self, read_bps: float = 0, write_bps: float = 0):
+        self.read = TokenBucket(read_bps) if read_bps else None
+        self.write = TokenBucket(write_bps) if write_bps else None
+
+    @classmethod
+    def from_config(cls, cfg: dict | None) -> "DiskQos | None":
+        if not cfg:
+            return None
+        return cls(read_bps=float(cfg.get("read_bps", 0)),
+                   write_bps=float(cfg.get("write_bps", 0)))
+
+    def acquire_read(self, n: int) -> None:
+        if self.read is not None:
+            self.read.acquire(n)
+
+    def acquire_write(self, n: int) -> None:
+        if self.write is not None:
+            self.write.acquire(n)

@@ -198,3 +198,38 @@ def test_leader_killed_mid_overwrite_storm_replicas_identical(trio, rng):
             f"reborn diverged in ranges {diffs[:10]} (of {len(diffs)}); "
             f"fps {fps}; raft {statuses}")
     reborn.stop()
+
+
+def test_disk_qos_shapes_client_io(tmp_path, rng):
+    """datanode/limit.go analog: client reads/writes are byte-rate
+    shaped; replica legs are exempt so repair cannot be starved."""
+    from cubefs_tpu.utils.ratelimit import DiskQos
+
+    pool = NodePool()
+    n = DataNode(0, str(tmp_path / "q"), "q0", pool,
+                 qos=DiskQos(read_bps=200_000, write_bps=200_000))
+    pool.bind("q0", n)
+    n.create_partition(1, ["q0"], "q0")
+    try:
+        pool.get("q0").call("alloc_extent", {"dp_id": 1})
+        payload = rng.integers(0, 256, 150_000, dtype=np.uint8).tobytes()
+        # first write burns the 200KB burst; the next is shaped
+        pool.get("q0").call("write", {"dp_id": 1, "extent_id": 1,
+                                      "offset": 0}, payload)
+        t0 = time.monotonic()
+        pool.get("q0").call("write", {"dp_id": 1, "extent_id": 1,
+                                      "offset": len(payload)}, payload)
+        assert time.monotonic() - t0 > 0.3, "write was not rate-shaped"
+        t0 = time.monotonic()
+        pool.get("q0").call("read", {"dp_id": 1, "extent_id": 1,
+                                     "offset": 0, "length": 150_000})
+        pool.get("q0").call("read", {"dp_id": 1, "extent_id": 1,
+                                     "offset": 0, "length": 150_000})
+        assert time.monotonic() - t0 > 0.3, "read was not rate-shaped"
+        # replica leg bypasses QoS entirely
+        t0 = time.monotonic()
+        pool.get("q0").call("write_replica", {"dp_id": 1, "extent_id": 1,
+                                              "offset": 0}, payload)
+        assert time.monotonic() - t0 < 0.2
+    finally:
+        n.stop()

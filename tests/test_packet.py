@@ -151,8 +151,8 @@ def test_extent_client_reads_over_packet_plane(tmp_path, rng):
         assert fs.read_file("/big.bin") == payload
         assert fs.read_file("/big.bin", offset=1000, length=5000) == \
             payload[1000:6000]
-        # the packet plane was actually used
-        assert fs.data._packet_clients, "reads did not touch the packet plane"
+        # the packet plane was actually used (reads AND writes)
+        assert fs.data._packet_clients, "IO did not touch the packet plane"
         # kill the packet plane: reads fall back to RPC transparently
         for n in datas:
             n._packet_srv.stop()

@@ -359,7 +359,7 @@ def test_delete_objects_batch(gateway):
     base = f"http://{s3.addr}"
     for i in range(3):
         _signed("PUT", f"{base}/bkt/batch/k{i}", owner, b"x")
-    body = (b"<Delete>"
+    body = (b"<Delete xmlns='http://s3.amazonaws.com/doc/2006-03-06/'>"
             b"<Object><Key>batch/k0</Key></Object>"
             b"<Object><Key>batch/k1</Key></Object>"
             b"<Object><Key>batch/missing</Key></Object>"
