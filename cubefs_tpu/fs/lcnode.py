@@ -45,6 +45,38 @@ class LcNode:
     def set_rules(self, rules: list[LifecycleRule]) -> None:
         self.rules = list(rules)
 
+    def load_rules_from_bucket(self) -> int:
+        """Adopt the bucket's S3 LifecycleConfiguration (stored by the
+        gateway as the s3.lifecycle xattr on the volume root) — the
+        master/lifecycle_manager.go -> lcnode task flow, compacted:
+        the executor reads the volume's own config. Returns rule count."""
+        import json
+
+        from . import s3policy
+
+        try:
+            raw = self.fs.getxattr("/", s3policy.XA_LIFECYCLE)
+        except FsError:
+            raw = None
+        if not raw:
+            self.rules = []
+            return 0
+        day = 86400.0
+        rules = []
+        for r in json.loads(raw):
+            rules.append(LifecycleRule(
+                rule_id=r["id"],
+                prefix="/" + r.get("prefix", "").lstrip("/"),
+                expire_after_s=(r["expire_days"] * day
+                                if r.get("expire_days") is not None else None),
+                transition_after_s=(r["transition_days"] * day
+                                    if r.get("transition_days") is not None
+                                    else None),
+                enabled=r.get("status", "Enabled") == "Enabled",
+            ))
+        self.rules = rules
+        return len(rules)
+
     def scan_once(self) -> ScanReport:
         report = ScanReport()
         now = time.time()

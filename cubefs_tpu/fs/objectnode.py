@@ -109,7 +109,7 @@ class ObjectNode:
                         xattr = fs.meta.inode_get(fs.resolve("/"))["xattr"]
                         conf = {k: xattr.get(k) for k in
                                 (s3policy.XA_ACL, s3policy.XA_POLICY,
-                                 s3policy.XA_CORS)}
+                                 s3policy.XA_CORS, s3policy.XA_LIFECYCLE)}
                     except FsError:
                         conf = {}
                 self._conf_cache = (bucket, conf)
@@ -132,7 +132,7 @@ class ObjectNode:
                 write = action not in s3policy.READ_ACTIONS
                 grant = outer.auth.grant_ok(self._principal, bucket, write)
                 if action.endswith(("BucketPolicy", "BucketAcl",
-                                    "BucketCors")):
+                                    "BucketCors", "BucketLifecycle")):
                     # bucket configuration is owner-only: policy/ACL
                     # cannot grant it away
                     return grant
@@ -215,6 +215,16 @@ class ObjectNode:
                     except s3policy.S3ConfigError as e:
                         return self._error(400, "MalformedXML", str(e))
                     outer._bucket_cfg_set(fs, s3policy.XA_CORS,
+                                          json.dumps(rules))
+                    return self._reply(200)
+                if not key and "lifecycle" in query:
+                    if not self._check("s3:PutBucketLifecycle", bucket):
+                        return
+                    try:
+                        rules = s3policy.parse_lifecycle(data)
+                    except s3policy.S3ConfigError as e:
+                        return self._error(400, "MalformedXML", str(e))
+                    outer._bucket_cfg_set(fs, s3policy.XA_LIFECYCLE,
                                           json.dumps(rules))
                     return self._reply(200)
                 if not key:  # CreateBucket
@@ -390,6 +400,16 @@ class ObjectNode:
                         200,
                         (f"<?xml version='1.0'?><CORSConfiguration>{body}"
                          f"</CORSConfiguration>").encode())
+                if not key and "lifecycle" in query:  # GetBucketLifecycle
+                    if not self._check("s3:GetBucketLifecycle", bucket):
+                        return
+                    raw = self._bucket_conf(bucket).get(
+                        s3policy.XA_LIFECYCLE)
+                    if not raw:
+                        return self._error(
+                            404, "NoSuchLifecycleConfiguration", bucket)
+                    return self._reply(
+                        200, s3policy.lifecycle_to_xml(json.loads(raw)))
                 if key and "tagging" in query:  # GetObjectTagging
                     if not self._check("s3:GetObjectTagging", bucket, key):
                         return
@@ -494,9 +514,9 @@ class ObjectNode:
                 # AWS SDKs send the namespaced document
                 # (xmlns=http://s3.amazonaws.com/doc/2006-03-06/):
                 # match by local name
-                keys = [o.findtext("{*}Key") or o.findtext("Key") or ""
-                        for o in (root.findall("{*}Object")
-                                  or root.findall("Object"))]
+                # "{*}name" matches any namespace including none
+                keys = [o.findtext("{*}Key") or ""
+                        for o in root.findall("{*}Object")]
                 if not keys or len(keys) > 1000:  # S3's batch limit
                     return self._error(400, "MalformedXML",
                                        "1..1000 Object keys required")
@@ -572,6 +592,11 @@ class ObjectNode:
                     if not self._check("s3:DeleteBucketPolicy", bucket):
                         return
                     outer._bucket_cfg_set(fs, s3policy.XA_POLICY, None)
+                    return self._reply(204)
+                if not key and "lifecycle" in query:  # DeleteBucketLifecycle
+                    if not self._check("s3:DeleteBucketLifecycle", bucket):
+                        return
+                    outer._bucket_cfg_set(fs, s3policy.XA_LIFECYCLE, None)
                     return self._reply(204)
                 if not key and "cors" in query:  # DeleteBucketCors
                     if not self._check("s3:DeleteBucketCors", bucket):

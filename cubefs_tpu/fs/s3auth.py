@@ -321,8 +321,12 @@ class MasterUserStore:
             return hit[1] if hit else None
         with self._lock:
             if len(self._cache) >= self.MAX_CACHE:
-                # unauthenticated key-spraying must not grow this forever
-                for k in list(self._cache)[: self.MAX_CACHE // 2]:
+                # evict the OLDEST entries by timestamp: key-spraying
+                # inserts fresh garbage, so insertion-order eviction
+                # would throw away the long-lived legitimate keys first
+                stale = sorted(self._cache.items(),
+                               key=lambda kv: kv[1][0])
+                for k, _ in stale[: self.MAX_CACHE // 2]:
                     del self._cache[k]
             self._cache[ak] = (now, info)
         return info

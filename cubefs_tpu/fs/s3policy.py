@@ -27,6 +27,7 @@ XA_ACL = "s3.acl"
 XA_POLICY = "s3.policy"
 XA_CORS = "s3.cors"
 XA_TAGS = "s3.tags"
+XA_LIFECYCLE = "s3.lifecycle"
 
 CANNED_ACLS = ("private", "public-read", "public-read-write",
                "authenticated-read")
@@ -229,3 +230,74 @@ def tagging_to_xml(tags: dict[str, str]) -> bytes:
     )
     return (f"<?xml version='1.0'?><Tagging><TagSet>{body}</TagSet>"
             f"</Tagging>").encode()
+
+
+# ---------------- lifecycle configuration ----------------
+def parse_lifecycle(doc: bytes) -> list[dict]:
+    """<LifecycleConfiguration><Rule><ID/><Filter><Prefix/></Filter>
+    <Status/><Expiration><Days/></Expiration>
+    <Transition><Days/></Transition></Rule>... (namespaced or not)."""
+    try:
+        root = ET.fromstring(doc)
+    except ET.ParseError as e:
+        raise S3ConfigError(f"bad lifecycle XML: {e}") from None
+    def _days(parent, what: str, rule_id: str) -> int:
+        """Days is REQUIRED and >= 1 (AWS rule): a missing or zero value
+        must never silently become expire-everything-now."""
+        raw = parent.findtext("{*}Days")
+        try:
+            days = int(raw)
+        except (TypeError, ValueError):
+            raise S3ConfigError(
+                f"rule {rule_id!r}: {what} needs an integer Days") from None
+        if days < 1:
+            raise S3ConfigError(f"rule {rule_id!r}: Days must be >= 1")
+        return days
+
+    rules = []
+    # "{*}name" matches the element in ANY namespace including none, so
+    # one expression covers AWS-SDK documents and bare XML alike
+    for r in root.findall("{*}Rule"):
+        rule = {
+            "id": r.findtext("{*}ID") or f"rule-{len(rules) + 1}",
+            "status": r.findtext("{*}Status") or "Enabled",
+            "prefix": "",
+            "expire_days": None,
+            "transition_days": None,
+        }
+        flt = r.find("{*}Filter")
+        if flt is not None:
+            rule["prefix"] = flt.findtext("{*}Prefix") or ""
+        exp = r.find("{*}Expiration")
+        if exp is not None:
+            rule["expire_days"] = _days(exp, "Expiration", rule["id"])
+        tr = r.find("{*}Transition")
+        if tr is not None:
+            rule["transition_days"] = _days(tr, "Transition", rule["id"])
+        if rule["expire_days"] is None and rule["transition_days"] is None:
+            raise S3ConfigError(
+                f"rule {rule['id']!r} needs Expiration or Transition")
+        if rule["status"] not in ("Enabled", "Disabled"):
+            raise S3ConfigError(f"bad Status {rule['status']!r}")
+        rules.append(rule)
+    if not rules:
+        raise S3ConfigError("LifecycleConfiguration needs at least one Rule")
+    return rules
+
+
+def lifecycle_to_xml(rules: list[dict]) -> bytes:
+    out = []
+    for r in rules:
+        parts = [f"<ID>{xs.escape(r['id'])}</ID>",
+                 f"<Filter><Prefix>{xs.escape(r['prefix'])}</Prefix></Filter>",
+                 f"<Status>{r['status']}</Status>"]
+        if r.get("expire_days") is not None:
+            parts.append(f"<Expiration><Days>{r['expire_days']}</Days>"
+                         f"</Expiration>")
+        if r.get("transition_days") is not None:
+            parts.append(f"<Transition><Days>{r['transition_days']}</Days>"
+                         f"<StorageClass>EC_COLD</StorageClass>"
+                         f"</Transition>")
+        out.append("<Rule>" + "".join(parts) + "</Rule>")
+    return (f"<?xml version='1.0'?><LifecycleConfiguration>"
+            f"{''.join(out)}</LifecycleConfiguration>").encode()

@@ -225,6 +225,12 @@ class PacketClient:
                     self._sock.sendall(frame)
                     hdr, rargs, rpayload = recv_packet(self._sock)
                     break
+                except socket.timeout:
+                    # the request may be EXECUTING server-side (e.g. a
+                    # QoS-shaped write): resending would duplicate it and
+                    # double the load exactly when the peer is saturated
+                    self._close_locked()
+                    raise
                 except (ConnectionError, OSError):
                     self._close_locked()
                     if attempt:

@@ -32,23 +32,28 @@ class TokenBucket:
     def acquire(self, n: int, timeout: float | None = None) -> bool:
         """Consume n tokens, sleeping as needed. Oversized requests
         (n > burst) are allowed by letting the balance go negative, so a
-        single large IO is shaped rather than deadlocked."""
+        single large IO is shaped rather than deadlocked.
+
+        The reservation happens under the lock but the SLEEP does not:
+        later arrivals see the debt and queue virtually behind it, so a
+        large shaped IO never parks every server thread on the lock,
+        and the timeout is honored at admission time."""
         if self.rate <= 0:
             return True
-        deadline = None if timeout is None else time.monotonic() + timeout
-        with self._lock:  # FIFO: waiters shape one another
-            while True:
-                self._refill()
-                if self._tokens >= min(n, self.burst):
-                    self._tokens -= n  # may go negative for n > burst
-                    return True
-                need = (min(n, self.burst) - self._tokens) / self.rate
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return False
-                    need = min(need, remaining)
-                time.sleep(need)
+        with self._lock:
+            self._refill()
+            need = min(n, self.burst)
+            if self._tokens >= need:
+                self._tokens -= n  # may go negative for n > burst
+                wait = 0.0
+            else:
+                wait = (need - self._tokens) / self.rate
+                if timeout is not None and wait > timeout:
+                    return False  # rejected WITHOUT reserving
+                self._tokens -= n
+        if wait > 0:
+            time.sleep(wait)
+        return True
 
 
 class DiskQos:

@@ -400,3 +400,46 @@ def test_presigned_put(gateway):
     assert code == 200
     code, body, _ = _signed("GET", f"{base}/bkt/uploaded.bin", owner)
     assert code == 200 and body == b"presigned upload body"
+
+
+def test_lifecycle_config_and_lcnode_integration(gateway):
+    """PutBucketLifecycle persists rules the LcNode adopts and enforces:
+    the lifecycle_manager -> lcnode flow through the S3 API."""
+    s3, owner, other, fs = gateway
+    base = f"http://{s3.addr}"
+    # no config yet
+    assert _signed("GET", f"{base}/bkt?lifecycle", owner)[0] == 404
+    doc = (b"<LifecycleConfiguration xmlns="
+           b"'http://s3.amazonaws.com/doc/2006-03-06/'>"
+           b"<Rule><ID>expire-logs</ID>"
+           b"<Filter><Prefix>logs/</Prefix></Filter>"
+           b"<Status>Enabled</Status>"
+           b"<Expiration><Days>1</Days></Expiration></Rule>"
+           b"</LifecycleConfiguration>")
+    assert _signed("PUT", f"{base}/bkt?lifecycle", owner, doc)[0] == 200
+    code, body, _ = _signed("GET", f"{base}/bkt?lifecycle", owner)
+    assert code == 200 and b"expire-logs" in body and b"<Days>1</Days>" in body
+    # non-owner cannot modify bucket config
+    assert _signed("PUT", f"{base}/bkt?lifecycle", other, doc)[0] == 403
+    # malformed rule rejected
+    assert _signed("PUT", f"{base}/bkt?lifecycle", owner,
+                   b"<LifecycleConfiguration><Rule><ID>x</ID>"
+                   b"<Status>Enabled</Status></Rule>"
+                   b"</LifecycleConfiguration>")[0] == 400
+
+    # lcnode adopts the rules and expires an aged object
+    from cubefs_tpu.fs.lcnode import LcNode
+
+    _signed("PUT", f"{base}/bkt/logs/old.log", owner, b"stale")
+    _signed("PUT", f"{base}/bkt/keep/fresh.log", owner, b"fresh")
+    ino = fs.resolve("/logs/old.log")
+    fs.meta.set_attr(ino, mtime=time.time() - 3 * 86400)  # age it
+    lc = LcNode(fs)
+    assert lc.load_rules_from_bucket() == 1
+    report = lc.scan_once()
+    assert report.expired == 1
+    assert _signed("GET", f"{base}/bkt/logs/old.log", owner)[0] == 404
+    assert _signed("GET", f"{base}/bkt/keep/fresh.log", owner)[0] == 200
+    # DeleteBucketLifecycle clears everything
+    assert _signed("DELETE", f"{base}/bkt?lifecycle", owner)[0] == 204
+    assert lc.load_rules_from_bucket() == 0
