@@ -494,6 +494,15 @@ class ExtentClient:
                 return
             except pkt.PacketError as e:
                 raise rpc.RpcError(500, f"packet write: {e}") from None
+            except TimeoutError:
+                # the write may STILL be executing on a saturated peer:
+                # an automatic RPC resend would double its load (and
+                # could land behind a newer same-offset write). Surface
+                # the timeout; the caller owns the retry decision.
+                self._packet_down[addr] = time.monotonic() + 30.0
+                raise rpc.RpcError(
+                    504, f"packet write to {addr} timed out; "
+                         f"possibly still executing") from None
             except (ConnectionError, OSError):
                 self._packet_down[addr] = time.monotonic() + 30.0
         self.nodes.get(addr).call(
@@ -521,6 +530,13 @@ class ExtentClient:
                 return data
             except pkt.PacketError as e:
                 raise rpc.RpcError(409, f"packet read: {e}") from None
+            except TimeoutError:
+                # don't stack a second 30s wait on the same node: count
+                # it as a replica failure so the read fails over to the
+                # NEXT replica immediately
+                self._packet_down[addr] = time.monotonic() + 30.0
+                raise rpc.RpcError(
+                    504, f"packet read from {addr} timed out") from None
             except (ConnectionError, OSError):
                 # plane down: remember it and stop paying the connect
                 # cost on every read until the cooldown passes

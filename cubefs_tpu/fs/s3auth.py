@@ -321,12 +321,13 @@ class MasterUserStore:
             return hit[1] if hit else None
         with self._lock:
             if len(self._cache) >= self.MAX_CACHE:
-                # evict the OLDEST entries by timestamp: key-spraying
-                # inserts fresh garbage, so insertion-order eviction
-                # would throw away the long-lived legitimate keys first
-                stale = sorted(self._cache.items(),
-                               key=lambda kv: kv[1][0])
-                for k, _ in stale[: self.MAX_CACHE // 2]:
+                # evict NEGATIVE (unknown-key) entries first — spray
+                # garbage is negative by definition — then oldest, so an
+                # attacker can never push out legitimate keys
+                victims = sorted(
+                    self._cache.items(),
+                    key=lambda kv: (kv[1][1] is not None, kv[1][0]))
+                for k, _ in victims[: self.MAX_CACHE // 2]:
                     del self._cache[k]
             self._cache[ak] = (now, info)
         return info

@@ -268,6 +268,12 @@ def parse_lifecycle(doc: bytes) -> list[dict]:
         flt = r.find("{*}Filter")
         if flt is not None:
             rule["prefix"] = flt.findtext("{*}Prefix") or ""
+        else:
+            # legacy (pre-Filter) format puts Prefix directly on the
+            # Rule; ignoring it would silently widen the rule to the
+            # WHOLE bucket — the exact expire-everything hazard the
+            # Days validation exists to prevent
+            rule["prefix"] = r.findtext("{*}Prefix") or ""
         exp = r.find("{*}Expiration")
         if exp is not None:
             rule["expire_days"] = _days(exp, "Expiration", rule["id"])
