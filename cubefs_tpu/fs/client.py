@@ -158,15 +158,18 @@ class MetaWrapper:
     # ---- rename (atomic; metanode/transaction.go analog) ----
     def rename_local(self, src_parent: int, src_name: str,
                      dst_parent: int, dst_name: str, ino: int,
-                     victim: int | None = None) -> int | None:
+                     victim: int | None = None,
+                     noreplace: bool = False) -> int | None:
         """Same-partition atomic rename; `victim` is the dst inode the
-        caller validated (re-asserted inside the apply). Returns the
+        caller validated (re-asserted inside the apply); `noreplace`
+        makes an existing target EEXIST atomically. Returns the
         replaced victim inode (or None)."""
         mp = self._mp_for(src_parent)
         res = self._call(mp, "submit", {"record": {
             "op": "rename_local", "src_parent": src_parent,
             "src_name": src_name, "dst_parent": dst_parent,
-            "dst_name": dst_name, "ino": ino, "victim": victim}})
+            "dst_name": dst_name, "ino": ino, "victim": victim,
+            "noreplace": noreplace}})
         return res[0]["result"].get("victim")
 
     def _mp_ref(self, mp: dict) -> dict:
@@ -176,7 +179,8 @@ class MetaWrapper:
     def rename_tx(self, src_parent: int, src_name: str,
                   dst_parent: int, dst_name: str, ino: int,
                   victim: int | None = None,
-                  victim_is_dir: bool = False) -> int | None:
+                  victim_is_dir: bool = False,
+                  noreplace: bool = False) -> int | None:
         """Cross-partition rename as a two-phase transaction. The DST
         partition is the coordinator: it is prepared and committed FIRST,
         so its durable commit decision is what an expired participant
@@ -199,7 +203,8 @@ class MetaWrapper:
             by_pid.setdefault(mp_["pid"], (mp_, []))[1].append(op_)
 
         add_op(dst_mp, {"kind": "link", "parent": dst_parent,
-                        "name": dst_name, "ino": ino, "victim": victim})
+                        "name": dst_name, "ino": ino, "victim": victim,
+                        "noreplace": noreplace})
         add_op(src_mp, {"kind": "rm", "parent": src_parent,
                         "name": src_name, "ino": ino})
         if victim is not None and victim_is_dir:
@@ -705,7 +710,8 @@ class FileSystem:
         self.rename_at(old_parent, old_name, new_parent, new_name)
 
     def rename_at(self, old_parent: int, old_name: str,
-                  new_parent: int, new_name: str) -> None:
+                  new_parent: int, new_name: str,
+                  noreplace: bool = False) -> None:
         """POSIX rename: atomic, replacing an existing target (file over
         file, dir over empty dir). Same-partition renames are ONE fsm
         apply; cross-partition renames run the two-phase transaction —
@@ -716,6 +722,8 @@ class FileSystem:
             victim_ino = self.meta.lookup(new_parent, new_name)
         except FsError:
             victim_ino = None
+        if noreplace and victim_ino is not None:
+            raise FsError(mn.EEXIST, f"{new_name!r} exists (NOREPLACE)")
         if victim_ino == ino:
             return  # same file: POSIX says do nothing
         src = self.meta.inode_get(ino)
@@ -755,11 +763,12 @@ class FileSystem:
             if local_ok:
                 victim = self.meta.rename_local(
                     old_parent, old_name, new_parent, new_name, ino,
-                    victim=victim_ino)
+                    victim=victim_ino, noreplace=noreplace)
             else:
                 victim = self.meta.rename_tx(
                     old_parent, old_name, new_parent, new_name, ino,
-                    victim=victim_ino, victim_is_dir=victim_is_dir)
+                    victim=victim_ino, victim_is_dir=victim_is_dir,
+                    noreplace=noreplace)
         finally:
             if mutex_tx is not None:
                 try:

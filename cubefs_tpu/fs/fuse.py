@@ -35,6 +35,9 @@ FUSE_READLINK, FUSE_SYMLINK = 5, 6
 FUSE_MKDIR, FUSE_UNLINK, FUSE_RMDIR, FUSE_RENAME = 9, 10, 11, 12
 FUSE_OPEN, FUSE_READ, FUSE_WRITE, FUSE_STATFS, FUSE_RELEASE = 14, 15, 16, 17, 18
 FUSE_FSYNC, FUSE_SETXATTR, FUSE_GETXATTR, FUSE_FLUSH = 20, 21, 22, 25
+FUSE_LISTXATTR, FUSE_REMOVEXATTR = 23, 24
+FUSE_RENAME2 = 45
+RENAME_NOREPLACE = 1  # renameat2(2) flag
 FUSE_INIT, FUSE_OPENDIR, FUSE_READDIR, FUSE_RELEASEDIR = 26, 27, 28, 29
 FUSE_ACCESS, FUSE_CREATE = 34, 35
 FUSE_DESTROY = 38
@@ -337,6 +340,40 @@ class FuseMount:
             name, value = rest.split(b"\x00", 1)[0], None
             value = rest[len(name) + 1 : len(name) + 1 + size]
             fs.meta.set_xattr(nodeid, name.decode(), value.decode("utf-8", "replace"))
+            self._reply(unique)
+
+        elif opcode == FUSE_LISTXATTR:
+            size, _pad = struct.unpack_from("<II", body)
+            names = sorted(fs.meta.inode_get(nodeid)["xattr"])
+            raw = b"".join(n.encode() + b"\x00" for n in names)
+            if size == 0:
+                self._reply(unique, struct.pack("<II", len(raw), 0))
+            elif size < len(raw):
+                self._reply_err(unique, errno.ERANGE)
+            else:
+                self._reply(unique, raw)
+
+        elif opcode == FUSE_REMOVEXATTR:
+            name = body.split(b"\x00", 1)[0].decode()
+            if name not in fs.meta.inode_get(nodeid)["xattr"]:
+                self._reply_err(unique, 61)  # ENODATA
+                return
+            fs.meta.set_xattr(nodeid, name, None)
+            self._reply(unique)
+
+        elif opcode == FUSE_RENAME2:
+            newdir, flags, _pad = struct.unpack_from("<QII", body)
+            names = body[16:].split(b"\x00")
+            old_name, new_name = names[0].decode(), names[1].decode()
+            if flags & ~RENAME_NOREPLACE:
+                # EXCHANGE/WHITEOUT are unsupported: rejecting beats a
+                # silent destructive replace where the kernel contract
+                # promises a lossless swap
+                self._reply_err(unique, errno.EINVAL)
+                return
+            # NOREPLACE is enforced atomically inside the rename apply
+            fs.rename_at(nodeid, old_name, newdir, new_name,
+                         noreplace=bool(flags & RENAME_NOREPLACE))
             self._reply(unique)
 
         else:

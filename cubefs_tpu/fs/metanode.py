@@ -452,9 +452,13 @@ class MetaPartition:
                     raise MetaError(ENOENT, f"{op['name']!r} not in {op['parent']}")
                 if op.get("ino") is not None and d[op["name"]] != op["ino"]:
                     raise MetaError(ENOENT, f"{op['name']!r} changed under tx")
-            elif "victim" in op and d.get(op["name"]) != op["victim"]:
-                raise MetaError(
-                    ENOENT, f"target {op['name']!r} changed under tx")
+            elif op["kind"] == "link":
+                if op.get("noreplace") and d.get(op["name"]) is not None:
+                    raise MetaError(
+                        EEXIST, f"{op['name']!r} exists (NOREPLACE)")
+                if "victim" in op and d.get(op["name"]) != op["victim"]:
+                    raise MetaError(
+                        ENOENT, f"target {op['name']!r} changed under tx")
         self.tx_pending[tx_id] = {
             "ops": r["ops"], "ts": now, "coord": r.get("coord"),
             "parts": r.get("parts"),
@@ -518,6 +522,10 @@ class MetaPartition:
         if dd is None:
             raise MetaError(ENOENT, f"parent dir {dp} not here")
         victim = dd.get(dn)
+        if r.get("noreplace") and victim is not None:
+            # RENAME_NOREPLACE: asserted INSIDE the atomic apply, so a
+            # concurrent create can never be silently clobbered
+            raise MetaError(EEXIST, f"{dn!r} exists (NOREPLACE)")
         if "victim" in r and victim != r["victim"]:
             raise MetaError(ENOENT, f"target {dn!r} changed under rename")
         if victim is not None and self.dentries.get(victim):

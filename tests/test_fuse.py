@@ -151,3 +151,56 @@ def test_posix_stress_battery(mounted, rng):
     import subprocess
     subprocess.run(["rm", "-r", f"{mnt}/many"], check=True)
     assert "many" not in os.listdir(mnt)
+
+
+def test_xattr_list_and_remove(mounted):
+    c, mnt = mounted
+    p = os.path.join(mnt, "xf")
+    with open(p, "w") as f:
+        f.write("x")
+    os.setxattr(p, "user.alpha", b"1")
+    os.setxattr(p, "user.beta", b"2")
+    names = set(os.listxattr(p))
+    assert {"user.alpha", "user.beta"} <= names
+    os.removexattr(p, "user.alpha")
+    assert "user.alpha" not in set(os.listxattr(p))
+    with pytest.raises(OSError):
+        os.removexattr(p, "user.alpha")  # ENODATA
+
+
+def test_rename_noreplace(mounted):
+    c, mnt = mounted
+    a, b = os.path.join(mnt, "rnsrc"), os.path.join(mnt, "rndst")
+    for p in (a, b):
+        with open(p, "w") as f:
+            f.write(p)
+    # renameat2(RENAME_NOREPLACE) is not portably exposed by os.*;
+    # drive the syscall directly
+    import ctypes
+
+    libc = ctypes.CDLL(None, use_errno=True)
+    AT_FDCWD = -100
+    rc = libc.renameat2(AT_FDCWD, a.encode(), AT_FDCWD, b.encode(), 1)
+    err = ctypes.get_errno()
+    assert rc == -1 and err == 17, f"RENAME_NOREPLACE: rc={rc} errno={err}"
+    # without the flag the replace succeeds
+    os.replace(a, b)
+    with open(b) as f:
+        assert f.read() == a
+
+
+def test_rename_exchange_rejected(mounted):
+    c, mnt = mounted
+    import ctypes
+
+    a, b = os.path.join(mnt, "exa"), os.path.join(mnt, "exb")
+    for p in (a, b):
+        with open(p, "w") as f:
+            f.write(p)
+    libc = ctypes.CDLL(None, use_errno=True)
+    AT_FDCWD = -100
+    rc = libc.renameat2(AT_FDCWD, a.encode(), AT_FDCWD, b.encode(), 2)
+    err = ctypes.get_errno()
+    assert rc == -1 and err == 22, f"RENAME_EXCHANGE: rc={rc} errno={err}"
+    with open(b) as f:  # b untouched
+        assert f.read() == b

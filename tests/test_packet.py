@@ -164,3 +164,30 @@ def test_extent_client_reads_over_packet_plane(tmp_path, rng):
             m.stop()
         for d in datas:
             d.stop()
+
+
+def test_packet_timeout_is_not_retried(tmp_path):
+    """A recv timeout must NOT resend the frame (the request may still
+    be executing server-side) — it surfaces as TimeoutError after ONE
+    attempt."""
+    import time as _time
+
+    calls = []
+
+    def slow_ping(hdr, args, payload):
+        calls.append(hdr["req_id"])
+        _time.sleep(2.0)
+        return {}, b""
+
+    srv = packet.PacketServer({packet.OP_PING: slow_ping}).start()
+    try:
+        cli = packet.PacketClient(srv.addr, timeout=0.5)
+        t0 = _time.monotonic()
+        with pytest.raises(TimeoutError):
+            cli.call(packet.OP_PING)
+        assert _time.monotonic() - t0 < 1.5, "timeout was not honored"
+        _time.sleep(2.2)  # let the slow handler finish
+        assert len(calls) == 1, f"frame was resent: {calls}"
+        cli.close()
+    finally:
+        srv.stop()

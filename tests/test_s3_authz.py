@@ -443,3 +443,24 @@ def test_lifecycle_config_and_lcnode_integration(gateway):
     # DeleteBucketLifecycle clears everything
     assert _signed("DELETE", f"{base}/bkt?lifecycle", owner)[0] == 204
     assert lc.load_rules_from_bucket() == 0
+
+
+def test_lifecycle_legacy_prefix_and_strict_days(gateway):
+    s3, owner, other, fs = gateway
+    base = f"http://{s3.addr}"
+    # legacy (pre-Filter) Rule-level Prefix is honored, not widened
+    legacy = (b"<LifecycleConfiguration><Rule><ID>old-style</ID>"
+              b"<Prefix>legacy/</Prefix><Status>Enabled</Status>"
+              b"<Expiration><Days>2</Days></Expiration></Rule>"
+              b"</LifecycleConfiguration>")
+    assert _signed("PUT", f"{base}/bkt?lifecycle", owner, legacy)[0] == 200
+    code, body, _ = _signed("GET", f"{base}/bkt?lifecycle", owner)
+    assert code == 200 and b"<Prefix>legacy/</Prefix>" in body
+    # Days is required and >= 1: never expire-everything-now
+    for bad in (b"<Expiration/>", b"<Expiration><Days>0</Days></Expiration>",
+                b"<Expiration><Days>thirty</Days></Expiration>"):
+        doc = (b"<LifecycleConfiguration><Rule><ID>x</ID>"
+               b"<Status>Enabled</Status>" + bad + b"</Rule>"
+               b"</LifecycleConfiguration>")
+        assert _signed("PUT", f"{base}/bkt?lifecycle", owner, doc)[0] == 400
+    _signed("DELETE", f"{base}/bkt?lifecycle", owner)
