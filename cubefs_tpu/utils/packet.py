@@ -139,6 +139,11 @@ class PacketServer:
             while not self._stop.is_set():
                 try:
                     hdr, args, payload = recv_packet(conn)
+                except PacketError:
+                    # corrupt frame (bad magic / CRC): framing may be
+                    # lost, so the only safe move is dropping the
+                    # connection — cleanly, not via a dying thread
+                    return
                 except (ConnectionError, OSError):
                     return
                 fn = self.handlers.get(hdr["opcode"])
