@@ -8,7 +8,8 @@ plane, and blocks. Heartbeat loops run in daemon threads.
 
 Config keys (JSON):
   role:        master | metanode | datanode | objectnode | fuseclient |
-               clustermgr | blobnode | access | proxy | scheduler | codec
+               clustermgr | blobnode | access | proxy | scheduler | codec |
+               fsgateway | console
   listen_host / listen_port: bind address (port 0 = ephemeral)
   master_addr / clustermgr_addr / scheduler_addr: upstreams
   data_dirs / data_dir: storage paths
@@ -221,6 +222,29 @@ def run_role(cfg: dict):
         svc.start()
         routes = {**rpc.expose(svc), **{f"cm_{k}": v for k, v in rpc.expose(cm).items()}}
         return _serve(dict(routes, role=lambda a, b: {"role": "scheduler"}), cfg), svc
+
+    if role == "fsgateway":
+        from .fs.client import FileSystem
+        from .fs.fsgateway import FsGateway
+
+        master = rpc.Client(cfg["master_addr"])
+        view = master.call("client_view", {"name": cfg["vol"]})[0]["volume"]
+        fs = FileSystem(view, pool, master_addr=cfg["master_addr"])
+        svc = FsGateway(fs)
+        srv = _serve(rpc.expose(svc), cfg)
+        print(f"[fsgateway] {cfg['vol']} on {srv.addr}", flush=True)
+        return srv, svc
+
+    if role == "console":
+        from .fs.console import Console
+
+        svc = Console(master_addr=cfg.get("master_addr"),
+                      clustermgr_addr=cfg.get("clustermgr_addr"),
+                      scheduler_addr=cfg.get("scheduler_addr"),
+                      host=cfg.get("listen_host", "127.0.0.1"),
+                      port=int(cfg.get("listen_port", 0))).start()
+        print(f"[console] listening on {svc.addr}", flush=True)
+        return svc, svc
 
     raise SystemExit(f"unknown role {role!r}")
 

@@ -2,7 +2,9 @@
 
 Role parity: docker/docker-compose.yml (3 masters, N metanodes/datanodes,
 objectnodes, monitoring) and blobstore/run_docker.sh — one topology JSON
-spawns every role as a local process, waits for liveness, creates the
+spawns every role as a local process (master, metanodes, datanodes,
+optional blob plane, objectnode, codec sidecar, fsgateway, console),
+waits for liveness, creates the
 initial volume, and writes a state file with all addresses.
 
   python -m cubefs_tpu.deploy.cluster --topo topo.json --workdir /tmp/c1
@@ -103,6 +105,7 @@ class Cluster:
             "dp_count": vol.get("dp_count", 4)})
         self.state["volume"] = vol.get("name", "vol1")
 
+        cm = None
         if t.get("blobnodes"):
             cm = self._spawn("clustermgr", {
                 "allow_colocated_units": t.get("blobnodes", 1) == 1,
@@ -122,6 +125,14 @@ class Cluster:
                 "users": t.get("users", [])})
         if t.get("codec"):
             self._spawn("codec", {})
+        if t.get("fsgateway"):
+            self._spawn("fsgateway", {"master_addr": master,
+                                      "vol": self.state["volume"]})
+        if t.get("console"):
+            console_cfg = {"master_addr": master}
+            if cm is not None:
+                console_cfg["clustermgr_addr"] = cm
+            self._spawn("console", console_cfg)
         with open(os.path.join(self.workdir, "cluster.json"), "w") as f:
             json.dump(self.state, f, indent=2)
         return self.state

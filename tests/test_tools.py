@@ -177,7 +177,8 @@ def test_deploy_cluster_launcher(tmp_path, rng):
     from cubefs_tpu.deploy.cluster import Cluster as DeployCluster
 
     topo = {"metanodes": 1, "datanodes": 2, "replicas": 2,
-            "volume": {"name": "dv", "mp_count": 1, "dp_count": 1}}
+            "volume": {"name": "dv", "mp_count": 1, "dp_count": 1},
+            "fsgateway": True, "console": True}
     c = DeployCluster(topo, str(tmp_path / "work"))
     try:
         state = c.up()
@@ -193,5 +194,29 @@ def test_deploy_cluster_launcher(tmp_path, rng):
         fs.write_file("/compose.bin", payload)
         assert fs.read_file("/compose.bin") == payload
         assert (tmp_path / "work" / "cluster.json").exists()
+        # the launched fsgateway serves the native C ABI POSIX surface
+        import ctypes
+
+        from cubefs_tpu.runtime import build as rt
+
+        gw = state["roles"]["fsgateway"][0]
+        lib = rt.load()
+        host, port = gw.split(":")
+        h = lib.cfs_mount(host.encode(), int(port))
+        assert h, lib.cfs_last_error()
+        buf = ctypes.create_string_buffer(64)
+        fd = lib.cfs_open(h, b"/compose.bin", 0, 0)
+        assert fd >= 0 and lib.cfs_read(h, fd, buf, 64) == 64
+        assert buf.raw[:64] == payload[:64]
+        lib.cfs_close(h, fd)
+        lib.cfs_unmount(h)
+        # the launched console aggregates the cluster
+        import urllib.request
+
+        con = state["roles"]["console"][0]
+        with urllib.request.urlopen(f"http://{con}/api/nodes",
+                                    timeout=10) as r:
+            nodes = json.loads(r.read())
+        assert len(nodes["datanodes"]) == 2
     finally:
         c.down()
