@@ -36,7 +36,11 @@ def factor_mesh(n_devices: int) -> dict[str, int]:
     return dims
 
 
-def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+def make_mesh(
+    n_devices: int | None = None, devices=None, dims: dict[str, int] | None = None
+) -> Mesh:
+    """Build the (dp, tp, sp) mesh; `dims` overrides the default split
+    (e.g. {"dp": 8, "tp": 1, "sp": 1} for a collective-free repair fleet)."""
     devices = devices if devices is not None else jax.devices()
     if n_devices is not None:
         if len(devices) < n_devices:
@@ -45,7 +49,10 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
                 f"{len(devices)} devices exist"
             )
         devices = devices[:n_devices]
-    dims = factor_mesh(len(devices))
+    if dims is None:
+        dims = factor_mesh(len(devices))
+    elif dims["dp"] * dims["tp"] * dims["sp"] != len(devices):
+        raise ValueError(f"mesh dims {dims} != {len(devices)} devices")
     dev_array = np.asarray(devices).reshape(dims["dp"], dims["tp"], dims["sp"])
     return Mesh(dev_array, AXES)
 
