@@ -745,8 +745,17 @@ class FileSystem:
         # s_vfs_rename_mutex)
         dir_move = src["type"] == mn.DIR and old_parent != new_parent
         mutex_tx = self.meta.lock_dir_rename() if dir_move else None
+        # the mutex is a prepared tx auto-released at TX_TTL: if the
+        # ancestry walk below outlived it, a concurrent dir move could
+        # acquire the "held" mutex and both would proceed — so the walk
+        # must finish well inside the TTL or the rename fails EBUSY
+        walk_deadline = (
+            time.time() + mn.MetaPartition.TX_TTL * 0.5 if dir_move else None
+        )
         try:
-            if src["type"] == mn.DIR and self._in_subtree(ino, new_parent):
+            if src["type"] == mn.DIR and self._in_subtree(
+                ino, new_parent, deadline=walk_deadline
+            ):
                 # POSIX: renaming a dir into its own subtree is EINVAL —
                 # it would detach the subtree into an unreachable cycle
                 raise FsError(22, "cannot move a directory into itself")
@@ -783,14 +792,26 @@ class FileSystem:
             self.data.close_stream(victim)
             self.data.release_extents(freed)
 
-    def _in_subtree(self, root_ino: int, target_ino: int) -> bool:
+    def _in_subtree(
+        self, root_ino: int, target_ino: int, deadline: float | None = None
+    ) -> bool:
         """True if target_ino is root_ino or lives anywhere under it
-        (walks DOWN from root — inodes carry no parent pointers)."""
+        (walks DOWN from root — inodes carry no parent pointers).
+
+        `deadline`: abort with EBUSY past it — callers holding the
+        TTL-bounded dir-rename mutex must not let the walk outlive the
+        lock (the cycle-weave protection would silently vanish)."""
         if root_ino == target_ino:
             return True
         queue = [root_ino]
         seen = {root_ino}
         while queue:
+            if deadline is not None and time.time() > deadline:
+                raise FsError(
+                    mn.EBUSY,
+                    "directory tree too large to safely check under the "
+                    "rename mutex; retry",
+                )
             cur = queue.pop()
             try:
                 entries = self.meta.readdir(cur)

@@ -96,6 +96,13 @@ class DataNode:
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = threading.RLock()
         self.broken = False
+        # chain legs that failed mid-append: (dp_id, extent_id) -> peers
+        # whose replica diverged in the appended range. Repaired
+        # immediately in the background (not left to the next fsck /
+        # rebuild sweep — a leader read in that window would serve bytes
+        # the client was told failed).
+        self.pending_repairs: dict[tuple[int, int], set[str]] = {}
+        self._repair_lock = threading.Lock()
         os.makedirs(root_dir, exist_ok=True)
         # reopen partitions found on disk (raft rejoins via its wal once
         # the master re-pushes the peer set through create_partition)
@@ -215,8 +222,46 @@ class DataNode:
         for t in threads:
             t.join()
         if errs:
+            # the leader's local bytes already persisted: until the
+            # failed legs are re-synced the replicas diverge in this
+            # range, so queue an immediate repair instead of waiting for
+            # a periodic fingerprint diff
+            for peer, _ in errs:
+                self._queue_leg_repair(dp.dp_id, extent_id, peer)
             peers = ", ".join(p for p, _ in errs)
             raise rpc.RpcError(500, f"chain write failed on {peers}: {errs[0][1]}")
+
+    def _queue_leg_repair(self, dp_id: int, extent_id: int, peer: str,
+                          attempts: int = 5) -> None:
+        key = (dp_id, extent_id)
+        with self._repair_lock:
+            peers = self.pending_repairs.setdefault(key, set())
+            if peer in peers:
+                return  # a repair thread for this leg is already running
+            peers.add(peer)
+
+        def run():
+            delay = 0.05
+            for _ in range(attempts):
+                try:
+                    self.nodes.get(peer).call(
+                        "sync_extent_from",
+                        {"dp_id": dp_id, "extent_id": extent_id,
+                         "src_addr": self.addr}, timeout=30.0)
+                    with self._repair_lock:
+                        peers_ = self.pending_repairs.get(key)
+                        if peers_ is not None:
+                            peers_.discard(peer)
+                            if not peers_:
+                                del self.pending_repairs[key]
+                    return
+                except Exception:
+                    time.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+            # still pending: left in pending_repairs for fsck / the
+            # master rebuild sweep to observe and finish
+
+        threading.Thread(target=run, daemon=True).start()
 
     def _random_write(self, dp: DataPartition, extent_id: int, offset: int,
                       data: bytes, attempts: int = 4) -> None:

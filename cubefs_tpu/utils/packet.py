@@ -228,7 +228,16 @@ class PacketClient:
                     self._sock = self._connect()
                 try:
                     self._sock.sendall(frame)
-                    hdr, rargs, rpayload = recv_packet(self._sock)
+                    try:
+                        hdr, rargs, rpayload = recv_packet(self._sock)
+                    except PacketError:
+                        # corrupt frame (bad magic/CRC): the stream is
+                        # desynced — an unknown number of frame bytes
+                        # remain unread, so every later call would parse
+                        # misaligned garbage. Drop the connection, same
+                        # discipline as the server side.
+                        self._close_locked()
+                        raise
                     break
                 except socket.timeout:
                     # the request may be EXECUTING server-side (e.g. a

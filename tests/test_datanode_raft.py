@@ -233,3 +233,44 @@ def test_disk_qos_shapes_client_io(tmp_path, rng):
         assert time.monotonic() - t0 < 0.2
     finally:
         n.stop()
+
+
+def test_failed_chain_leg_repairs_immediately(trio, rng):
+    """A follower that drops one chain append diverges from the leader
+    (whose bytes persisted before the fan-out); the leader must queue an
+    immediate re-sync instead of leaving the divergence to the next
+    fsck/rebuild sweep."""
+    pool, nodes, addrs, _ = trio
+    pool.get(addrs[0]).call("alloc_extent", {"dp_id": 1})
+    base = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    pool.get(addrs[0]).call(
+        "write", {"dp_id": 1, "extent_id": 1, "offset": 0}, base)
+
+    victim = nodes[1]
+    orig = victim.rpc_write_replica
+    fail_once = {"armed": True}
+
+    def flaky(args, body):
+        if fail_once["armed"]:
+            fail_once["armed"] = False
+            raise rpc.RpcError(500, "injected: follower leg dropped")
+        return orig(args, body)
+
+    victim.rpc_write_replica = flaky
+    try:
+        with pytest.raises(rpc.RpcError):
+            pool.get(addrs[0]).call(
+                "write", {"dp_id": 1, "extent_id": 1, "offset": len(base)},
+                b"TAIL-BYTES")
+    finally:
+        victim.rpc_write_replica = orig
+    # leader persisted the tail before the failed leg; the queued repair
+    # must converge all replicas without any further client activity
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        fps = _fingerprints(pool, addrs, 1)
+        if len(set(fps.values())) == 1 and not nodes[0].pending_repairs:
+            break
+        time.sleep(0.05)
+    assert len(set(_fingerprints(pool, addrs, 1).values())) == 1
+    assert not nodes[0].pending_repairs

@@ -537,3 +537,37 @@ def test_oplog_replay_skips_checkpointed_records(tmp_path):
         f.write(_json.dumps(rec) + "\n")
     clone2 = mn.MetaPartition(4, 1, 1 << 20, data_dir=d)
     assert clone2.inodes[ino]["extents"] == [ek, ek2]
+
+
+def test_errno_wire_encoding_avoids_reserved_codes():
+    """400+errno encoding must never produce 404 (not-found pass-through)
+    or 421 (leader redirect — its message is parsed as an address, so
+    EISDIR=21 encoded as 421 would be read as a redirect and mask the
+    real failure); those errnos ride the 499 errno= form instead."""
+    for code, msg in ((mn.EISDIR, "is a dir"), (4, "interrupted")):
+        e = mn._rpc_err(mn.MetaError(code, msg))
+        assert e.code == 499 and e.message.startswith(f"errno={code}")
+    assert mn._rpc_err(mn.MetaError(mn.ENOENT, "x")).code == 402
+    assert mn._rpc_err(mn.MetaError(mn.EDQUOT, "q")).code == 499
+
+
+def test_dir_rename_ancestry_walk_bounded_by_mutex_ttl(cluster):
+    """The cycle-weave mutex is TTL-bounded; an ancestry walk that would
+    outlive it must abort the rename with EBUSY rather than continue
+    unprotected (ADVICE r2: a >TTL walk let two dir moves both proceed).
+    The walk receives a deadline derived from TX_TTL at rename time; an
+    expired deadline aborts on the first iteration."""
+    import time as _time
+
+    fs = cluster.fs
+    fs.mkdir("/big")
+    fs.mkdir("/big/sub")
+    fs.mkdir("/dst")
+    root = fs.stat("/big")["ino"]
+    target = fs.stat("/dst")["ino"]
+    with pytest.raises(FsError) as ei:
+        fs._in_subtree(root, target, deadline=_time.time() - 1.0)
+    assert ei.value.errno == mn.EBUSY
+    # and without a deadline the same walk completes normally
+    assert fs._in_subtree(root, fs.stat("/big/sub")["ino"]) is True
+    assert fs._in_subtree(root, target) is False

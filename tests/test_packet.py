@@ -191,3 +191,31 @@ def test_packet_timeout_is_not_retried(tmp_path):
         cli.close()
     finally:
         srv.stop()
+
+
+def test_client_drops_connection_on_corrupt_response():
+    """A response frame that fails to parse leaves unread bytes on the
+    stream; the client must drop the connection (mirroring the server's
+    discipline), not keep reading misaligned bytes forever."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    host, port = lsock.getsockname()
+
+    def bad_server():
+        conn, _ = lsock.accept()
+        conn.recv(packet.HEADER.size + 256)  # swallow the request
+        conn.sendall(b"\xff" * packet.HEADER.size)  # bad-magic "response"
+        # leave the connection open: a non-dropping client would try to
+        # keep using this desynced stream
+
+    t = threading.Thread(target=bad_server, daemon=True)
+    t.start()
+    cli = packet.PacketClient(f"{host}:{port}")
+    try:
+        with pytest.raises(packet.PacketError):
+            cli.call(packet.OP_PING)
+        assert cli._sock is None, "client kept a desynced connection"
+    finally:
+        cli.close()
+        lsock.close()
