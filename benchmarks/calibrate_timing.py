@@ -1,0 +1,106 @@
+"""Timing-fidelity calibration for the axon/TPU relay.
+
+Findings this file exists to encode (measured 2026-07-29 on the live
+relay, TPU v5 lite):
+
+  * ``jax.block_until_ready`` under the relay returns on ENQUEUE, not on
+    device completion — an unchained timing loop reports physically
+    impossible rates (4868 "TFLOP/s" bf16 on a ~197 TFLOP/s chip).
+  * device->host fetches ride the tunnel at single-digit MB/s, so any
+    timing that ends with a bulk ``device_get`` is dominated by the
+    tunnel, not the chip.
+
+The honest measurement is therefore the CHAIN-SLOPE method (shared
+implementation: cubefs_tpu/utils/benchtime.py, also used by bench.py):
+run K dependency-chained iterations, force completion
+by fetching ONE element of the final output, do that for two values of
+K, and report (T(K2)-T(K1))/(K2-K1).  Enqueue lies and the fixed fetch
+cost cancel in the subtraction; what remains is per-iteration device
+execution time.  bench.py uses the same method.
+
+Prints one JSON object.  Not part of the judged bench; this is the
+measurement-integrity artifact backing BENCH_r03.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cubefs_tpu.models import repair
+from cubefs_tpu.ops import rs_kernel
+from cubefs_tpu.utils.benchtime import timed_slope
+
+
+def timed_enqueue_style(fn, x, iters: int = 8) -> float:
+    """The broken bench-style loop, kept to document the discrepancy."""
+    out = fn(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    dev = jax.devices()[0]
+    rng = np.random.default_rng(3)
+    report = {"device": str(dev), "platform": dev.platform}
+
+    # --- roofline 1: bf16 matmul, 4096^3 ------------------------------
+    k = 4096
+    a = jax.device_put(jnp.full((k, k), 0.5, jnp.bfloat16), dev)
+    mm = jax.jit(lambda x: x @ x * 0.000244)  # keep values bounded
+    flops = 2 * k**3
+    report["matmul_tflops_enqueue_style"] = round(
+        flops / timed_enqueue_style(mm, a) / 1e12, 1
+    )
+    report["matmul_tflops_slope"] = round(flops / timed_slope(mm, a, k1=4, k2=68) / 1e12, 1)
+
+    # --- roofline 2: HBM stream (x + 1 over 512 MiB) ------------------
+    big = jax.device_put(jnp.zeros((512 << 20) // 4, jnp.float32), dev)
+    inc = jax.jit(lambda x: x + 1)
+    nbytes = big.size * 4 * 2  # read + write
+    report["hbm_gbs_slope"] = round(nbytes / timed_slope(inc, big, k1=2, k2=34) / 1e9, 1)
+
+    # --- the judged config-3 graph ------------------------------------
+    n, m, S, Br = 12, 4, 4 << 20, 4
+    plan = repair.make_plan(n, m, bad=[1, 7])
+    surv = jax.device_put(rng.integers(0, 256, (Br, n, S), dtype=np.uint8), dev)
+    # self-composable wrapper: tile the reconstructed rows back up to n
+    # pseudo-shards so out feeds in again with a constant graph shape
+    reps = -(-n // len(plan.rows))
+    chain = jax.jit(
+        lambda a: jnp.tile(rs_kernel.gf_matrix_apply(plan.rows, a), (1, reps, 1))[
+            :, :n, :
+        ]
+    )
+    dt = timed_slope(chain, surv, k1=2, k2=34)
+    report["repair_gibs_slope"] = round(Br * n * S / dt / (1 << 30), 2)
+    report["repair_gibs_enqueue_style"] = round(
+        Br
+        * n
+        * S
+        / timed_enqueue_style(lambda a: rs_kernel.gf_matrix_apply(plan.rows, a), surv)
+        / (1 << 30),
+        2,
+    )
+
+    # --- correctness on-chip: bit-identical vs numpy GF golden --------
+    from cubefs_tpu.codec import engine as ec_engine
+
+    small = rng.integers(0, 256, (6, 1 << 16), dtype=np.uint8)
+    golden = ec_engine.get_engine("numpy").encode_parity(small, 3)
+    got = np.asarray(rs_kernel.encode_parity(jax.device_put(small, dev), 3))
+    report["encode_bit_identical_on_tpu"] = bool(np.array_equal(golden, got))
+
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -253,18 +253,22 @@ class MetaWrapper:
     # root-owning partition, so two concurrent dir moves cannot weave a
     # detached cycle past each other's ancestry checks. Held as a
     # prepared tx: a crashed holder is auto-released by TX_TTL expiry.
-    def lock_dir_rename(self, timeout: float = 10.0) -> str:
+    def lock_dir_rename(self, timeout: float = 10.0) -> tuple[str, float]:
+        """Returns (tx_id, ts): ts is the stamp the TTL counts from —
+        holders must derive their work deadline from it, not from the
+        (later) moment the grant RPC returned."""
         mp = self._mp_for(1)
         tx_id = uuid.uuid4().hex
         deadline = time.time() + timeout
         while True:
+            ts = time.time()
             try:
                 self._call(mp, "submit", {"record": {
-                    "op": "tx_prepare", "tx_id": tx_id, "ts": time.time(),
+                    "op": "tx_prepare", "tx_id": tx_id, "ts": ts,
                     "coord": self._mp_ref(mp),
                     "ops": [{"kind": "mutex", "parent": 0,
                              "name": "__dir_rename__"}]}})
-                return tx_id
+                return tx_id, ts
             except FsError as e:
                 if e.errno != 16 or time.time() > deadline:  # EBUSY
                     raise
@@ -744,14 +748,17 @@ class FileSystem:
         # other's checks (the kernel does the same with
         # s_vfs_rename_mutex)
         dir_move = src["type"] == mn.DIR and old_parent != new_parent
-        mutex_tx = self.meta.lock_dir_rename() if dir_move else None
-        # the mutex is a prepared tx auto-released at TX_TTL: if the
-        # ancestry walk below outlived it, a concurrent dir move could
-        # acquire the "held" mutex and both would proceed — so the walk
-        # must finish well inside the TTL or the rename fails EBUSY
-        walk_deadline = (
-            time.time() + mn.MetaPartition.TX_TTL * 0.5 if dir_move else None
-        )
+        mutex_tx, walk_deadline = None, None
+        if dir_move:
+            mutex_tx, lock_ts = self.meta.lock_dir_rename()
+            # the mutex is a prepared tx auto-released at TX_TTL counted
+            # from lock_ts (the stamp inside the tx, NOT the moment the
+            # grant returned): if the ancestry walk below outlived it, a
+            # concurrent dir move could acquire the "held" mutex and both
+            # would proceed. The deadline is checked BEFORE each walk RPC,
+            # so reserve one full RPC deadline (10s) plus slack for an
+            # in-flight call straddling the check.
+            walk_deadline = lock_ts + mn.MetaPartition.TX_TTL - 10.0 - 2.0
         try:
             if src["type"] == mn.DIR and self._in_subtree(
                 ino, new_parent, deadline=walk_deadline
@@ -803,16 +810,22 @@ class FileSystem:
         lock (the cycle-weave protection would silently vanish)."""
         if root_ino == target_ino:
             return True
-        queue = [root_ino]
-        seen = {root_ino}
-        while queue:
+        def check():
+            # called before EVERY walk RPC (readdir and per-child
+            # inode_get), so the worst overshoot past the deadline is the
+            # single in-flight call the caller's margin reserves for
             if deadline is not None and time.time() > deadline:
                 raise FsError(
                     mn.EBUSY,
                     "directory tree too large to safely check under the "
                     "rename mutex; retry",
                 )
+
+        queue = [root_ino]
+        seen = {root_ino}
+        while queue:
             cur = queue.pop()
+            check()
             try:
                 entries = self.meta.readdir(cur)
             except FsError:
@@ -822,6 +835,7 @@ class FileSystem:
                     return True
                 if child not in seen:
                     seen.add(child)
+                    check()
                     try:
                         if self.meta.inode_get(child)["type"] == mn.DIR:
                             queue.append(child)

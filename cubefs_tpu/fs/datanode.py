@@ -101,7 +101,10 @@ class DataNode:
         # immediately in the background (not left to the next fsck /
         # rebuild sweep — a leader read in that window would serve bytes
         # the client was told failed).
-        self.pending_repairs: dict[tuple[int, int], set[str]] = {}
+        # (dp_id, extent_id, peer) -> {"gen": int, "running": bool}; a
+        # repair thread re-syncs until it completes a pass whose gen is
+        # still current, so writes landing mid-repair are never lost
+        self.pending_repairs: dict[tuple[int, int, str], dict] = {}
         self._repair_lock = threading.Lock()
         os.makedirs(root_dir, exist_ok=True)
         # reopen partitions found on disk (raft rejoins via its wal once
@@ -233,33 +236,47 @@ class DataNode:
 
     def _queue_leg_repair(self, dp_id: int, extent_id: int, peer: str,
                           attempts: int = 5) -> None:
-        key = (dp_id, extent_id)
+        key = (dp_id, extent_id, peer)
         with self._repair_lock:
-            peers = self.pending_repairs.setdefault(key, set())
-            if peer in peers:
-                return  # a repair thread for this leg is already running
-            peers.add(peer)
+            st = self.pending_repairs.get(key)
+            if st is not None and st["running"]:
+                # a repair thread is mid-sync; bump the generation so it
+                # re-syncs before declaring the leg clean (a sync started
+                # before this write may have copied pre-write bytes)
+                st["gen"] += 1
+                return
+            gen0 = st["gen"] + 1 if st else 1
+            self.pending_repairs[key] = {"gen": gen0, "running": True}
 
         def run():
-            delay = 0.05
-            for _ in range(attempts):
-                try:
-                    self.nodes.get(peer).call(
-                        "sync_extent_from",
-                        {"dp_id": dp_id, "extent_id": extent_id,
-                         "src_addr": self.addr}, timeout=30.0)
-                    with self._repair_lock:
-                        peers_ = self.pending_repairs.get(key)
-                        if peers_ is not None:
-                            peers_.discard(peer)
-                            if not peers_:
-                                del self.pending_repairs[key]
-                    return
-                except Exception:
-                    time.sleep(delay)
-                    delay = min(delay * 2, 2.0)
-            # still pending: left in pending_repairs for fsck / the
-            # master rebuild sweep to observe and finish
+            while True:
+                with self._repair_lock:
+                    gen = self.pending_repairs[key]["gen"]
+                ok, delay = False, 0.05
+                for _ in range(attempts):
+                    try:
+                        self.nodes.get(peer).call(
+                            "sync_extent_from",
+                            {"dp_id": dp_id, "extent_id": extent_id,
+                             "src_addr": self.addr}, timeout=30.0)
+                        ok = True
+                        break
+                    except Exception:
+                        time.sleep(delay)
+                        delay = min(delay * 2, 2.0)
+                with self._repair_lock:
+                    st = self.pending_repairs[key]
+                    if ok and st["gen"] == gen:
+                        del self.pending_repairs[key]
+                        return
+                    if not ok and st["gen"] == gen:
+                        # attempts exhausted (peer likely down): stop the
+                        # thread but keep the entry visible (rpc_stat) and
+                        # restartable — the next failed chain leg, or the
+                        # master's rebuild sweep, re-arms a fresh thread
+                        st["running"] = False
+                        return
+                    # gen advanced while we were syncing/failing: go again
 
         threading.Thread(target=run, daemon=True).start()
 
@@ -401,7 +418,14 @@ class DataNode:
         return {}
 
     def rpc_stat(self, args, body):
-        return {"node_id": self.node_id, "partitions": sorted(self.partitions)}
+        with self._repair_lock:
+            pending = [
+                {"dp_id": dp, "extent_id": ext, "peer": peer,
+                 "running": st["running"]}
+                for (dp, ext, peer), st in self.pending_repairs.items()
+            ]
+        return {"node_id": self.node_id, "partitions": sorted(self.partitions),
+                "pending_repairs": pending}
 
     # ---------------- binary packet plane (proto/packet.go analog) -----
     # The HOT data path speaks the 64-byte-header binary protocol over

@@ -274,3 +274,127 @@ def test_failed_chain_leg_repairs_immediately(trio, rng):
         time.sleep(0.05)
     assert len(set(_fingerprints(pool, addrs, 1).values())) == 1
     assert not nodes[0].pending_repairs
+
+def test_write_racing_inflight_repair_is_not_lost(trio, rng):
+    """A chain-leg failure that lands while a repair for the same leg is
+    mid-sync must trigger a re-sync: the in-flight sync may have copied
+    pre-write bytes, so completing it does not make the leg clean."""
+    pool, nodes, addrs, _ = trio
+    pool.get(addrs[0]).call("alloc_extent", {"dp_id": 1})
+    base = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    pool.get(addrs[0]).call(
+        "write", {"dp_id": 1, "extent_id": 1, "offset": 0}, base)
+
+    victim = nodes[1]
+    fail_left = {"n": 2}
+    orig_write = victim.rpc_write_replica
+
+    def flaky(args, body):
+        if fail_left["n"] > 0:
+            fail_left["n"] -= 1
+            raise rpc.RpcError(500, "injected: follower leg dropped")
+        return orig_write(args, body)
+
+    synced, release = threading.Event(), threading.Event()
+    first_sync = {"armed": True}
+    orig_sync = victim.rpc_sync_extent_from
+
+    def gated(args, body):
+        out = orig_sync(args, body)  # real sync happens BEFORE the gate:
+        if first_sync["armed"]:      # it has copied pre-W2 bytes
+            first_sync["armed"] = False
+            synced.set()
+            assert release.wait(10)
+        return out
+
+    victim.rpc_write_replica = flaky
+    victim.rpc_sync_extent_from = gated
+    try:
+        with pytest.raises(rpc.RpcError):
+            pool.get(addrs[0]).call(
+                "write", {"dp_id": 1, "extent_id": 1, "offset": len(base)},
+                b"W1-BYTES")
+        assert synced.wait(10), "repair thread never synced"
+        # repair for this leg is mid-flight (gated); a second write now
+        # fails the same leg -> its bytes are newer than the sync copy
+        with pytest.raises(rpc.RpcError):
+            pool.get(addrs[0]).call(
+                "write",
+                {"dp_id": 1, "extent_id": 1, "offset": len(base) + 8},
+                b"W2-BYTES")
+        release.set()
+    finally:
+        victim.rpc_write_replica = orig_write
+        victim.rpc_sync_extent_from = orig_sync
+        release.set()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        fps = _fingerprints(pool, addrs, 1)
+        if len(set(fps.values())) == 1 and not nodes[0].pending_repairs:
+            break
+        time.sleep(0.05)
+    assert len(set(_fingerprints(pool, addrs, 1).values())) == 1, \
+        "W2 bytes lost on the repaired leg"
+    assert not nodes[0].pending_repairs
+
+
+def test_exhausted_repair_stays_visible_and_restartable(trio, rng):
+    """When a repair thread exhausts its attempts (peer down), the entry
+    must stay visible (rpc_stat) with running=False, and a later enqueue
+    for the same leg must arm a fresh thread that converges."""
+    pool, nodes, addrs, _ = trio
+    pool.get(addrs[0]).call("alloc_extent", {"dp_id": 1})
+    base = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    pool.get(addrs[0]).call(
+        "write", {"dp_id": 1, "extent_id": 1, "offset": 0}, base)
+
+    victim = nodes[1]
+    orig_write = victim.rpc_write_replica
+    orig_sync = victim.rpc_sync_extent_from
+    down = {"on": True}
+
+    def dead_write(args, body):
+        if down["on"]:
+            raise rpc.RpcError(500, "injected: peer down")
+        return orig_write(args, body)
+
+    def dead_sync(args, body):
+        if down["on"]:
+            raise rpc.RpcError(500, "injected: peer down")
+        return orig_sync(args, body)
+
+    victim.rpc_write_replica = dead_write
+    victim.rpc_sync_extent_from = dead_sync
+    try:
+        with pytest.raises(rpc.RpcError):
+            pool.get(addrs[0]).call(
+                "write", {"dp_id": 1, "extent_id": 1, "offset": len(base)},
+                b"TAIL")
+        key = (1, 1, addrs[1])
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            with nodes[0]._repair_lock:
+                st = nodes[0].pending_repairs.get(key)
+            if st is not None and not st["running"]:
+                break
+            time.sleep(0.1)
+        assert st is not None and not st["running"], \
+            "exhausted repair entry vanished (or never gave up)"
+        stat, _ = pool.get(addrs[0]).call("stat", {})
+        assert {"dp_id": 1, "extent_id": 1, "peer": addrs[1],
+                "running": False} in stat["pending_repairs"]
+        # peer revives; re-arming the same leg must start a new thread
+        down["on"] = False
+        nodes[0]._queue_leg_repair(1, 1, addrs[1])
+    finally:
+        victim.rpc_write_replica = orig_write
+        victim.rpc_sync_extent_from = orig_sync
+        down["on"] = False
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        fps = _fingerprints(pool, addrs, 1)
+        if len(set(fps.values())) == 1 and not nodes[0].pending_repairs:
+            break
+        time.sleep(0.05)
+    assert len(set(_fingerprints(pool, addrs, 1).values())) == 1
+    assert not nodes[0].pending_repairs
