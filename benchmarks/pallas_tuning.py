@@ -1,0 +1,214 @@
+"""On-chip tuning experiments for the fused GF(2) Pallas kernel.
+
+The shipped kernel (cubefs_tpu/ops/pallas_gf.py) measured ~17 GiB/s on
+the judged RS(12+4)-reconstruct shape while the HBM roofline for a truly
+fused kernel (read payload + write parity only) is ~300+ GiB/s at the
+~434 GB/s streaming rate the chip sustains. Variants probed here, each a
+hypothesis about where the time goes:
+
+  base          — shipped kernel as-is (byte-major bit interleave)
+  bitmajor      — unpack to (8, N, T)->reshape(8N, T) [plane-major, no
+                  per-byte interleave] with the coefficient matrix
+                  permuted to match; packs from plane-major rows too
+  bitmajor-u8   — same, but shifts/masks on uint8 (no int32 blowup)
+  flatgrid      — bitmajor + batch folded into the pallas grid instead
+                  of vmap (one pallas_call, 2D grid)
+
+each x tile sizes. Prints one JSON line per (variant, tile) with slope-
+timed GiB/s on the judged shape (Br=4, RS(12+4), 2 missing, 4MiB).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cubefs_tpu.models import repair
+from cubefs_tpu.ops import bitlin, pallas_gf
+from cubefs_tpu.utils.benchtime import timed_slope
+
+N, M, S, BR = 12, 4, 4 << 20, 4
+
+
+def bitmajor_perm(n_bytes: int) -> np.ndarray:
+    """Permutation mapping byte-major bit index (b*8+k) -> bit-major
+    position (k*n_bytes+b)."""
+    idx = np.arange(8 * n_bytes)
+    b, k = idx // 8, idx % 8
+    return k * n_bytes + b
+
+
+def w_to_bitmajor(w: np.ndarray, rows_bytes: int, cols_bytes: int) -> np.ndarray:
+    """Permute a (8R, 8C) byte-major GF(2) matrix so it consumes
+    bit-major inputs and produces bit-major outputs."""
+    rp = bitmajor_perm(rows_bytes)
+    cp = bitmajor_perm(cols_bytes)
+    out = np.zeros_like(w)
+    out[rp[:, None], cp[None, :]] = w
+    return out
+
+
+def _kernel_bitmajor(use_u8: bool, w_ref, x_ref, o_ref):
+    x = x_ref[:]  # (N, T) uint8
+    n, t = x.shape
+    if use_u8:
+        planes = [((x >> k) & 1).astype(jnp.int8) for k in range(8)]
+    else:
+        xi = x.astype(jnp.int32)
+        planes = [((xi >> k) & 1).astype(jnp.int8) for k in range(8)]
+    bits = jnp.concatenate(planes, axis=0)  # (8N, T) plane-major
+    y = jax.lax.dot_general(
+        w_ref[:], bits, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (8M, T) plane-major rows
+    y = y & 1
+    m8, _ = y.shape
+    r = m8 // 8
+    acc = y[0:r, :]
+    for k in range(1, 8):
+        acc = acc | (y[k * r : (k + 1) * r, :] << k)
+    o_ref[:] = acc.astype(jnp.uint8)
+
+
+@functools.lru_cache(maxsize=None)
+def bitmajor_fn(coeff_bytes: bytes, rows: int, cols: int, tile: int,
+                use_u8: bool):
+    coeff = np.frombuffer(coeff_bytes, dtype=np.uint8).reshape(rows, cols)
+    w = bitlin.gf_matrix_to_bits(coeff)
+    wb = jnp.asarray(w_to_bitmajor(w, rows, cols), dtype=jnp.int8)
+
+    @jax.jit
+    def apply(shards):
+        n, s = shards.shape
+        return pl.pallas_call(
+            functools.partial(_kernel_bitmajor, use_u8),
+            out_shape=jax.ShapeDtypeStruct((rows, s), jnp.uint8),
+            grid=(s // tile,),
+            in_specs=[
+                pl.BlockSpec((8 * rows, 8 * cols), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((n, tile), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((rows, tile), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel",)),
+        )(wb, shards)
+
+    return apply
+
+
+@functools.lru_cache(maxsize=None)
+def flatgrid_fn(coeff_bytes: bytes, rows: int, cols: int, tile: int):
+    coeff = np.frombuffer(coeff_bytes, dtype=np.uint8).reshape(rows, cols)
+    w = bitlin.gf_matrix_to_bits(coeff)
+    wb = jnp.asarray(w_to_bitmajor(w, rows, cols), dtype=jnp.int8)
+
+    @jax.jit
+    def apply(shards):  # (B, N, S)
+        b, n, s = shards.shape
+        return pl.pallas_call(
+            functools.partial(_kernel_bitmajor, True),
+            out_shape=jax.ShapeDtypeStruct((b, rows, s), jnp.uint8),
+            grid=(b, s // tile),
+            in_specs=[
+                pl.BlockSpec((8 * rows, 8 * cols), lambda i, j: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, n, tile), lambda i, j: (i, 0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, rows, tile), lambda i, j: (i, 0, j),
+                                   memory_space=pltpu.VMEM),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+        )(wb, shards)
+
+    return apply
+
+
+def main():
+    rng = np.random.default_rng(5)
+    plan = repair.make_plan(N, M, bad=[1, 7])
+    rows = plan.rows
+    coeff = np.ascontiguousarray(rows, dtype=np.uint8)
+    r, c = coeff.shape
+    surv = jax.device_put(
+        rng.integers(0, 256, (BR, N, S), dtype=np.uint8), jax.devices()[0]
+    )
+    reps = -(-N // r)
+
+    # correctness golden (small shape) for every variant first
+    small = rng.integers(0, 256, (2, N, 1 << 15), dtype=np.uint8)
+    from cubefs_tpu.ops import gf256
+    want = np.stack([gf256.gf_matmul(coeff, s) for s in small])
+
+    def check(apply2d, name):
+        got = np.asarray(jax.vmap(apply2d)(jax.device_put(small)))
+        okay = np.array_equal(got, want)
+        if not okay:
+            print(f"{name}: WRONG OUTPUT", file=sys.stderr)
+        return okay
+
+    def bench(chain):
+        dt = timed_slope(chain, surv, k1=1, k2=9, repeats=2)
+        return BR * N * S / dt / (1 << 30)
+
+    results = []
+    for tile in (8192, 16384, 32768, 65536, 131072):
+        # base (shipped)
+        try:
+            chain = jax.jit(lambda a, _t=tile: jnp.tile(
+                pallas_gf.gf_matrix_apply_pallas(rows, a, tile=_t),
+                (1, reps, 1))[:, :N, :])
+            results.append({"variant": "base", "tile": tile,
+                            "gibs": round(bench(chain), 2)})
+        except Exception as e:
+            results.append({"variant": "base", "tile": tile,
+                            "error": str(e)[:120]})
+        # bitmajor int32 / uint8
+        for u8 in (False, True):
+            name = "bitmajor-u8" if u8 else "bitmajor"
+            try:
+                fn2d = bitmajor_fn(coeff.tobytes(), r, c, tile, u8)
+                if tile == 8192 and not check(fn2d, name):
+                    results.append({"variant": name, "tile": tile,
+                                    "error": "wrong output"})
+                    continue
+                chain = jax.jit(lambda a, _f=fn2d: jnp.tile(
+                    jax.vmap(_f)(a), (1, reps, 1))[:, :N, :])
+                results.append({"variant": name, "tile": tile,
+                                "gibs": round(bench(chain), 2)})
+            except Exception as e:
+                results.append({"variant": name, "tile": tile,
+                                "error": str(e)[:120]})
+        # flatgrid
+        try:
+            fn3d = flatgrid_fn(coeff.tobytes(), r, c, tile)
+            got = np.asarray(fn3d(jax.device_put(small)))
+            if not np.array_equal(got, want):
+                results.append({"variant": "flatgrid", "tile": tile,
+                                "error": "wrong output"})
+            else:
+                chain = jax.jit(lambda a, _f=fn3d: jnp.tile(
+                    _f(a), (1, reps, 1))[:, :N, :])
+                results.append({"variant": "flatgrid", "tile": tile,
+                                "gibs": round(bench(chain), 2)})
+        except Exception as e:
+            results.append({"variant": "flatgrid", "tile": tile,
+                            "error": str(e)[:120]})
+        print(json.dumps(results[-4:]), flush=True)
+
+    best = max((x for x in results if "gibs" in x), key=lambda x: x["gibs"])
+    print("BEST:", json.dumps(best))
+
+
+if __name__ == "__main__":
+    main()

@@ -54,18 +54,16 @@ def make_plan(n_data: int, n_parity: int, bad: list[int]) -> RepairPlan:
 
 @functools.lru_cache(maxsize=None)
 def _repair_fn(plan: RepairPlan, chunk_len: int):
-    rec_bits = bitlin.gf_matrix_to_bits(plan.rows)
+    rec_rows = plan.rows
     # Integrity leg: the extra survivors beyond the first n_data are an
     # independent linear view of the same data — reconstruct them from the
     # first n_data and compare with what was actually read. (A check that
     # only re-derives shards already inside the solving set would be a
     # tautology: the derivation functional collapses to the identity.)
     extras = plan.present[plan.n_data :]
-    extra_bits = (
-        bitlin.gf_matrix_to_bits(
-            rs_kernel.reconstruct_rows(
-                plan.n_data, plan.n_total, list(plan.present), list(extras)
-            )
+    extra_rows = (
+        rs_kernel.reconstruct_rows(
+            plan.n_data, plan.n_total, list(plan.present), list(extras)
         )
         if extras
         else None
@@ -82,9 +80,10 @@ def _repair_fn(plan: RepairPlan, chunk_len: int):
         (vacuously True when no extra shards were read).
         """
         solve = surviving[:, : plan.n_data, :]
-        recovered = rs_kernel.gf_apply_bits(jnp.asarray(rec_bits), solve)
-        if extra_bits is not None:
-            re_extra = rs_kernel.gf_apply_bits(jnp.asarray(extra_bits), solve)
+        # gf_matrix_apply dispatches to the fused Pallas kernel on TPU
+        recovered = rs_kernel.gf_matrix_apply(rec_rows, solve)
+        if extra_rows is not None:
+            re_extra = rs_kernel.gf_matrix_apply(extra_rows, solve)
             ok = jnp.all(
                 re_extra == surviving[:, plan.n_data :, :], axis=(-1, -2)
             )

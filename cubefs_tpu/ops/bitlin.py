@@ -38,6 +38,27 @@ def gf_matrix_to_bits(m: np.ndarray) -> np.ndarray:
     return out
 
 
+def bitmajor_perm(n_bytes: int) -> np.ndarray:
+    """Permutation mapping byte-major bit index (b*8+k) to bit-major
+    (plane-major) position (k*n_bytes+b). Plane-major is the layout the
+    TPU kernel prefers: unpacking to (8, N, T)->(8N, T) concatenates
+    whole planes instead of interleaving bits per byte (measured 4x
+    faster in Mosaic than the byte-major interleave)."""
+    idx = np.arange(8 * n_bytes)
+    b, k = idx // 8, idx % 8
+    return k * n_bytes + b
+
+
+def w_to_bitmajor(w: np.ndarray, rows_bytes: int, cols_bytes: int) -> np.ndarray:
+    """Permute an (8R, 8C) byte-major GF(2) matrix so it consumes
+    plane-major inputs and produces plane-major outputs."""
+    rp = bitmajor_perm(rows_bytes)
+    cp = bitmajor_perm(cols_bytes)
+    out = np.zeros_like(w)
+    out[rp[:, None], cp[None, :]] = w
+    return out
+
+
 def unpack_bits_np(x: np.ndarray) -> np.ndarray:
     """(..., B, S) uint8 -> (..., 8B, S) int8 bit planes (numpy golden)."""
     bits = (x[..., :, None, :] >> np.arange(8)[None, :, None]) & 1

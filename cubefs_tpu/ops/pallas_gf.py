@@ -31,37 +31,50 @@ from . import bitlin
 # for C input shards and R output rows: at T=32KiB and RS(12+4) repair
 # (C=12, R<=6) that is ~8 MiB — comfortably inside a v5e core's ~16 MiB
 # VMEM while amortizing grid overhead far better than tiny tiles.
-# bench.py autotunes over TILE_CANDIDATES on real hardware.
+# bench.py autotunes over TILE_CANDIDATES on real hardware — and MUST
+# verify bit-identity per tile first (verify_tile below): Mosaic was
+# observed to MISCOMPILE this kernel at tile >= 65536 (silent wrong
+# parity), so an unvalidated autotune can "win" with garbage output.
 DEFAULT_TILE = 32768
 TILE_CANDIDATES = (8192, 16384, 32768)
 
 
 def _kernel(w_ref, x_ref, o_ref):
+    # Plane-major (bit-major) layout throughout: bits row k*N+b = bit k
+    # of byte-row b. The per-byte interleave (row b*8+k) forces Mosaic
+    # into sublane shuffles that dominated the kernel (17 -> 58 GiB/s on
+    # the judged shape when switched); the coefficient matrix is
+    # permuted to match at trace time (bitlin.w_to_bitmajor), so the
+    # math is unchanged.
     x = x_ref[:].astype(jnp.int32)  # (N, T) bytes
     n, t = x.shape
-    # unpack LSB-first, byte-major rows: row b*8+k = bit k of byte-row b
-    planes = jnp.stack([(x >> k) & 1 for k in range(8)], axis=1)  # (N, 8, T)
-    bits = planes.reshape(n * 8, t).astype(jnp.int8)
-    w = w_ref[:]  # (8M, 8N) int8 0/1
+    planes = [((x >> k) & 1).astype(jnp.int8) for k in range(8)]
+    bits = jnp.concatenate(planes, axis=0)  # (8N, T) plane-major
+    w = w_ref[:]  # (8M, 8N) int8 0/1, plane-major both sides
     y = jax.lax.dot_general(
         w, bits, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
-    )  # (8M, T)
+    )  # (8M, T) plane-major rows
     y = y & 1
-    m8 = y.shape[0]
-    packed = y.reshape(m8 // 8, 8, t)
-    weights = (1 << jnp.arange(8, dtype=jnp.int32))[None, :, None]
-    o_ref[:] = (packed * weights).sum(axis=1).astype(jnp.uint8)
+    r = y.shape[0] // 8
+    acc = y[0:r, :]
+    for k in range(1, 8):
+        acc = acc | (y[k * r : (k + 1) * r, :] << k)
+    o_ref[:] = acc.astype(jnp.uint8)
 
 
 @functools.lru_cache(maxsize=None)
 def _apply_fn(coeff_bytes: bytes, rows: int, cols: int, tile: int,
               interpret: bool):
     coeff = np.frombuffer(coeff_bytes, dtype=np.uint8).reshape(rows, cols)
-    w = jnp.asarray(bitlin.gf_matrix_to_bits(coeff), dtype=jnp.int8)
+    # keep numpy in the closure: converting here would capture a tracer
+    # when the first call happens inside an outer jit trace (the cached
+    # closure would then leak it into later traces)
+    w_np = bitlin.w_to_bitmajor(bitlin.gf_matrix_to_bits(coeff), rows, cols)
 
     @jax.jit
     def apply(shards: jax.Array) -> jax.Array:
         """(N, S) uint8 -> (R, S) uint8; S must be a tile multiple."""
+        w = jnp.asarray(w_np, dtype=jnp.int8)
         n, s = shards.shape
         grid = (s // tile,)
         kwargs = {}
@@ -119,6 +132,34 @@ def gf_matrix_apply_pallas(coeff: np.ndarray, shards, tile: int = DEFAULT_TILE,
     outs = jax.vmap(fn)(flat)
     out = outs.reshape(*lead, coeff.shape[0], s + pad)
     return out[..., :s] if pad else out
+
+
+def verify_tile(coeff: np.ndarray, tile: int, seed: int = 0) -> bool:
+    """On-device bit-identity gate for one tile size: runs the fused
+    kernel on one random tile and compares (on device) against the jnp
+    bit-matmul path. MUST pass before an autotuner (or the production
+    dispatch in rs_kernel) may use this tile — Mosaic has miscompiled
+    large tiles silently.
+
+    The golden deliberately bypasses rs_kernel.gf_matrix_apply: that
+    entry point dispatches back to THIS kernel on TPU, which would make
+    the gate a tautology (Pallas compared against itself)."""
+    import jax.numpy as _jnp
+
+    from . import rs_kernel
+
+    coeff = np.ascontiguousarray(coeff, dtype=np.uint8)
+    rng = np.random.default_rng(seed)
+    # the gate may fire lazily from inside an outer jit trace (first
+    # dispatch for a matrix); ensure_compile_time_eval keeps this
+    # concrete computation out of that trace
+    with jax.ensure_compile_time_eval():
+        x = jnp.asarray(
+            rng.integers(0, 256, (coeff.shape[1], tile), dtype=np.uint8))
+        got = gf_matrix_apply_pallas(coeff, x, tile=tile)
+        want = rs_kernel._matrix_apply_fn(
+            coeff.tobytes(), coeff.shape[0], coeff.shape[1])(x)
+        return bool(jax.device_get(_jnp.array_equal(got, want)))
 
 
 class PallasEngine:

@@ -19,6 +19,7 @@ reference byte-for-byte.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +28,55 @@ import numpy as np
 from . import bitlin, gf256
 
 _BITS = (1 << np.arange(8)).astype(np.int32)
+
+
+def _use_pallas() -> bool:
+    """On real TPU the fused plane-major Pallas kernel is ~2.5x the jnp
+    bit-matmul (no 8x bit tensor in HBM); CUBEFS_NO_PALLAS=1 forces the
+    jnp path (debugging / A-B measurement)."""
+    if os.environ.get("CUBEFS_NO_PALLAS"):
+        return False
+    from . import pallas_gf
+
+    return pallas_gf.on_tpu()
+
+
+def _pallas_profitable(s: int) -> bool:
+    """Pallas pads S up to a tile multiple: only dispatch when the pad
+    waste is bounded (exact multiple, or >=4 tiles so waste <= 25%) —
+    small/tiny-extent shards stay on the jnp path, which is exact in S."""
+    from . import pallas_gf
+
+    tile = pallas_gf.DEFAULT_TILE
+    return s % tile == 0 or s >= 4 * tile
+
+
+@functools.lru_cache(maxsize=None)
+def _pallas_verified(coeff_bytes: bytes, rows: int, cols: int) -> bool:
+    """Once-per-process bit-identity gate for the production dispatch:
+    the fused kernel must match the jnp path on-device for this exact
+    coefficient matrix at DEFAULT_TILE before it may serve real data.
+    Mosaic has silently miscompiled this kernel at some tile sizes —
+    unlike repair (whose extras integrity leg fails loudly), encode has
+    no downstream check, so wrong parity would only surface at
+    reconstruct time, after the data shards are gone."""
+    import sys
+
+    from . import pallas_gf
+
+    coeff = np.frombuffer(coeff_bytes, dtype=np.uint8).reshape(rows, cols)
+    try:
+        ok = pallas_gf.verify_tile(coeff, pallas_gf.DEFAULT_TILE)
+    except Exception as e:
+        print(f"rs_kernel: pallas gate errored ({e}); using jnp path",
+              file=sys.stderr)
+        return False
+    if not ok:
+        print(
+            "rs_kernel: pallas kernel MISCOMPILES for this matrix at "
+            f"tile={pallas_gf.DEFAULT_TILE}; using jnp path",
+            file=sys.stderr)
+    return ok
 
 
 def unpack_bits(x: jax.Array) -> jax.Array:
@@ -88,7 +138,15 @@ def _encode_fn(n: int, m: int):
 
 def encode_parity(data: jax.Array, n_parity: int) -> jax.Array:
     """data: (..., N, S) uint8 -> parity (..., M, S) uint8."""
-    return _encode_fn(int(data.shape[-2]), n_parity)(data)
+    n = int(data.shape[-2])
+    if _use_pallas() and _pallas_profitable(int(data.shape[-1])):
+        coeff = np.ascontiguousarray(
+            gf256.parity_matrix(n, n_parity), dtype=np.uint8)
+        if _pallas_verified(coeff.tobytes(), coeff.shape[0], coeff.shape[1]):
+            from . import pallas_gf
+
+            return pallas_gf.gf_matrix_apply_pallas(coeff, data)
+    return _encode_fn(n, n_parity)(data)
 
 
 @functools.lru_cache(maxsize=None)
@@ -112,6 +170,14 @@ def gf_matrix_apply(coeff: np.ndarray, shards: jax.Array) -> jax.Array:
     compiles once and is cached.
     """
     coeff = np.ascontiguousarray(coeff, dtype=np.uint8)
+    if (
+        _use_pallas()
+        and _pallas_profitable(int(shards.shape[-1]))
+        and _pallas_verified(coeff.tobytes(), coeff.shape[0], coeff.shape[1])
+    ):
+        from . import pallas_gf
+
+        return pallas_gf.gf_matrix_apply_pallas(coeff, shards)
     fn = _matrix_apply_fn(coeff.tobytes(), coeff.shape[0], coeff.shape[1])
     return fn(shards)
 
