@@ -32,27 +32,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from cubefs_tpu.models import repair
 from cubefs_tpu.ops import bitlin, pallas_gf
+from cubefs_tpu.ops.bitlin import bitmajor_perm, w_to_bitmajor
 from cubefs_tpu.utils.benchtime import timed_slope
 
 N, M, S, BR = 12, 4, 4 << 20, 4
-
-
-def bitmajor_perm(n_bytes: int) -> np.ndarray:
-    """Permutation mapping byte-major bit index (b*8+k) -> bit-major
-    position (k*n_bytes+b)."""
-    idx = np.arange(8 * n_bytes)
-    b, k = idx // 8, idx % 8
-    return k * n_bytes + b
-
-
-def w_to_bitmajor(w: np.ndarray, rows_bytes: int, cols_bytes: int) -> np.ndarray:
-    """Permute a (8R, 8C) byte-major GF(2) matrix so it consumes
-    bit-major inputs and produces bit-major outputs."""
-    rp = bitmajor_perm(rows_bytes)
-    cp = bitmajor_perm(cols_bytes)
-    out = np.zeros_like(w)
-    out[rp[:, None], cp[None, :]] = w
-    return out
 
 
 def _kernel_bitmajor(use_u8: bool, w_ref, x_ref, o_ref):
@@ -145,16 +128,21 @@ def main():
     )
     reps = -(-N // r)
 
-    # correctness golden (small shape) for every variant first
-    small = rng.integers(0, 256, (2, N, 1 << 15), dtype=np.uint8)
+    # correctness golden PER TILE (two grid steps of the tile being
+    # tested — a fixed-size golden smaller than the tile never executes
+    # the kernel and silently skips validation)
     from cubefs_tpu.ops import gf256
-    want = np.stack([gf256.gf_matmul(coeff, s) for s in small])
 
-    def check(apply2d, name):
+    def golden(tile):
+        small = rng.integers(0, 256, (2, N, 2 * tile), dtype=np.uint8)
+        return small, np.stack([gf256.gf_matmul(coeff, s) for s in small])
+
+    def check(apply2d, name, tile):
+        small, want = golden(tile)
         got = np.asarray(jax.vmap(apply2d)(jax.device_put(small)))
         okay = np.array_equal(got, want)
         if not okay:
-            print(f"{name}: WRONG OUTPUT", file=sys.stderr)
+            print(f"{name} tile={tile}: WRONG OUTPUT", file=sys.stderr)
         return okay
 
     def bench(chain):
@@ -178,7 +166,7 @@ def main():
             name = "bitmajor-u8" if u8 else "bitmajor"
             try:
                 fn2d = bitmajor_fn(coeff.tobytes(), r, c, tile, u8)
-                if tile == 8192 and not check(fn2d, name):
+                if not check(fn2d, name, tile):
                     results.append({"variant": name, "tile": tile,
                                     "error": "wrong output"})
                     continue
@@ -192,6 +180,7 @@ def main():
         # flatgrid
         try:
             fn3d = flatgrid_fn(coeff.tobytes(), r, c, tile)
+            small, want = golden(tile)
             got = np.asarray(fn3d(jax.device_put(small)))
             if not np.array_equal(got, want):
                 results.append({"variant": "flatgrid", "tile": tile,

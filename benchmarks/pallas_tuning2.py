@@ -31,7 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 from cubefs_tpu.models import repair
 from cubefs_tpu.ops import bitlin, gf256
 from cubefs_tpu.utils.benchtime import timed_slope
-from benchmarks.pallas_tuning import w_to_bitmajor
+from cubefs_tpu.ops.bitlin import w_to_bitmajor
 
 N, M, S, BR = 12, 4, 4 << 20, 4
 
@@ -153,8 +153,12 @@ def main():
         rng.integers(0, 256, (BR, N, S), dtype=np.uint8), dev)
     reps = -(-N // r)
 
-    small = rng.integers(0, 256, (2, N, 1 << 15), dtype=np.uint8)
-    want = np.stack([gf256.gf_matmul(coeff, s) for s in small])
+    def golden(tile):
+        # golden sized to the tile under test: a fixed 32KiB golden is
+        # SMALLER than the 64/128KiB tiles (grid=0, kernel never runs),
+        # which silently skipped validation for 2/3 of the sweep
+        small = rng.integers(0, 256, (2, N, 2 * tile), dtype=np.uint8)
+        return small, np.stack([gf256.gf_matmul(coeff, s) for s in small])
 
     cases = [
         ("bm-loop", "loop", None, False),
@@ -170,6 +174,7 @@ def main():
             try:
                 fn = make_fn(coeff.tobytes(), r, c, tile, mode, probe, flat)
                 if probe is None:
+                    small, want = golden(tile)
                     got = np.asarray(fn(jax.device_put(small)))
                     if not np.array_equal(got, want):
                         results.append({"v": name, "tile": tile,

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from ..ops import bitlin, crc32_kernel, rs_kernel
+from ..ops import crc32_kernel, rs_kernel
 from ..parallel import sharded_codec
 
 

