@@ -84,6 +84,12 @@ def run_role(cfg: dict):
                                  "zone": zone})
         _heartbeat_loop(lambda: master.call(
             "heartbeat", {"kind": "meta", "addr": srv.addr, "zone": zone}))
+
+        def _dp_view():
+            meta, _ = master.call("dp_view", {})
+            return {int(k): v for k, v in meta["dps"].items()}
+
+        svc.set_dp_view(_dp_view)  # enables the deferred-deletion scan
         return srv, svc
 
     if role == "datanode":

@@ -117,9 +117,6 @@ class CachingExtentClient:
     def close_stream(self, ino: int) -> None:
         self.inner.close_stream(ino)
 
-    def release_extents(self, eks) -> None:
-        self.inner.release_extents(eks)
-
     def _dp_by_id(self, dp_id):
         return self.inner._dp_by_id(dp_id)
 

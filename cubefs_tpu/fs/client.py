@@ -107,7 +107,8 @@ class MetaWrapper:
 
     def inode_delete(self, ino: int) -> list:
         mp = self._mp_for(ino)
-        res = self._call(mp, "submit", {"record": {"op": "rm_inode", "ino": ino}})
+        res = self._call(mp, "submit", {"record": {
+            "op": "rm_inode", "ino": ino, "ts": time.time()}})
         return res[0]["result"].get("extents", [])
 
     def dentry_create(self, parent: int, name: str, ino: int) -> None:
@@ -133,6 +134,29 @@ class MetaWrapper:
         mp = self._mp_for(parent)
         return self._call(mp, "dentry_count", {"parent": parent})[0]["count"]
 
+    def freelist_all(self) -> dict[str, dict]:
+        """Pending deferred deletions across all partitions (fsck input:
+        these extents are freed-but-not-yet-deleted, not orphans)."""
+        out: dict[str, dict] = {}
+        for mp in self.mps:
+            try:
+                fl = self._call(mp, "freelist", {})[0]["freelist"]
+            except (FsError, rpc.RpcError):
+                continue
+            for k, v in fl.items():
+                out[f"{mp['pid']}:{k}"] = v
+        return out
+
+    def list_inos(self) -> set[int]:
+        """Every inode id the partitions hold (fsck's orphan-inode pass)."""
+        inos: set[int] = set()
+        for mp in self.mps:
+            try:
+                inos.update(self._call(mp, "list_inos", {})[0]["inos"])
+            except (FsError, rpc.RpcError):
+                pass
+        return inos
+
     def append_extents(self, ino: int, extents: list[dict], size: int) -> None:
         mp = self._mp_for(ino)
         self._call(mp, "submit", {"record": {
@@ -152,7 +176,7 @@ class MetaWrapper:
     def truncate(self, ino: int, size: int = 0) -> list:
         mp = self._mp_for(ino)
         res = self._call(mp, "submit", {"record": {
-            "op": "truncate", "ino": ino, "size": size}})
+            "op": "truncate", "ino": ino, "size": size, "ts": time.time()}})
         return res[0]["result"].get("extents", [])
 
     # ---- rename (atomic; metanode/transaction.go analog) ----
@@ -417,31 +441,6 @@ class ExtentClient:
             out[lo - offset : hi - offset] = data
         return bytes(out)
 
-    def release_extents(self, extent_keys: list[dict]) -> None:
-        """Best-effort GC of data extents freed by unlink/truncate: delete
-        each unique extent on every replica of its dp (extents are owned
-        by a single inode's stream, so key removal implies reclaim)."""
-        seen: set[tuple[int, int]] = set()
-        for ek in extent_keys:
-            if ek.get("tiny"):
-                continue  # shared extent: other files live there
-            key = (ek["dp_id"], ek["extent_id"])
-            if key in seen:
-                continue
-            seen.add(key)
-            try:
-                dp = self._dp_by_id(ek["dp_id"])
-            except FsError:
-                continue
-            for addr in dp["replicas"]:
-                try:
-                    self.nodes.get(addr).call(
-                        "delete_extent",
-                        {"dp_id": dp["dp_id"], "extent_id": ek["extent_id"]},
-                    )
-                except rpc.RpcError:
-                    pass  # node down: scrubber reclaims later
-
     def _read_replicated(self, dp: dict, eid: int, off: int, ln: int) -> bytes:
         """Read from the historically-fastest replica first (k-faster
         selector role: an EWMA of per-address latency orders candidates;
@@ -660,9 +659,10 @@ class FileSystem:
         inode = self.meta.inode_get(ino)
         off = inode["size"] if append else 0
         if not append and inode["size"]:
-            freed = self.meta.truncate(ino, 0)
+            self.meta.truncate(ino, 0)
             self.data.close_stream(ino)
-            self.data.release_extents(freed)
+            # freed extents ride the metanode freelist: the server's
+            # free scan deletes them (deferred deletion, crash-safe)
         self.data.write(self.meta, ino, off, data)
         return ino
 
@@ -678,9 +678,9 @@ class FileSystem:
 
     def truncate_file(self, path: str, size: int) -> None:
         ino = self.resolve(path)
-        freed = self.meta.truncate(ino, size)
+        self.meta.truncate(ino, size)
         self.data.close_stream(ino)
-        self.data.release_extents(freed)
+        # freed extents are reclaimed server-side via the freelist
 
     def read_file(self, path: str, offset: int = 0, length: int | None = None) -> bytes:
         inode = self.meta.inode_get(self.resolve(path))
@@ -704,9 +704,12 @@ class FileSystem:
         if inode["type"] == mn.DIR and self.meta.dentry_count(ino) > 0:
             raise FsError(mn.ENOTEMPTY, f"{path} not empty")
         self.meta.dentry_delete(parent, name)
-        freed = self.meta.inode_delete(ino)
+        # rm_inode moves the extents onto the partition's replicated
+        # freelist; the metanode's background scan deletes them from the
+        # datanodes — a client crash ANYWHERE in this sequence leaks at
+        # most an orphan inode, which fsck reclaims (never raw extents)
+        self.meta.inode_delete(ino)
         self.data.close_stream(ino)
-        self.data.release_extents(freed)
 
     def rename(self, old: str, new: str) -> None:
         old_parent, old_name = self._parent_of(old)
@@ -792,12 +795,11 @@ class FileSystem:
                 except (FsError, rpc.RpcError):
                     pass  # TX_TTL expiry releases a stranded lock
         if victim is not None:
-            # replaced target: drop its inode + storage (post-commit
-            # cleanup; a crash here leaves an unreferenced inode for
-            # fsck, never a dangling dentry)
-            freed = self.meta.inode_delete(victim)
+            # replaced target: drop its inode (post-commit cleanup; a
+            # crash here leaves an unreferenced inode for fsck, never a
+            # dangling dentry). Extents ride the server-side freelist.
+            self.meta.inode_delete(victim)
             self.data.close_stream(victim)
-            self.data.release_extents(freed)
 
     def _in_subtree(
         self, root_ino: int, target_ino: int, deadline: float | None = None

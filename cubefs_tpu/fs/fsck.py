@@ -25,11 +25,16 @@ class FsckReport:
     replica_mismatches: list = field(default_factory=list)  # (path, ek, fps)
     orphan_dentries: list = field(default_factory=list)  # (parent_path, name)
     orphan_extents: list = field(default_factory=list)  # (dp_id, extent_id)
+    orphan_inodes: list = field(default_factory=list)  # ino (no dentry)
+    pending_free: int = 0  # freelist entries awaiting the deletion scan
+    reclaimed_extents: int = 0
+    reclaimed_inodes: int = 0
 
     @property
     def clean(self) -> bool:
         return not (self.dangling_extents or self.replica_mismatches
-                    or self.orphan_dentries or self.orphan_extents)
+                    or self.orphan_dentries or self.orphan_extents
+                    or self.orphan_inodes)
 
     def summary(self) -> dict:
         return {
@@ -39,21 +44,97 @@ class FsckReport:
             "replica_mismatches": len(self.replica_mismatches),
             "orphan_dentries": len(self.orphan_dentries),
             "orphan_extents": len(self.orphan_extents),
+            "orphan_inodes": len(self.orphan_inodes),
+            "pending_free": self.pending_free,
+            "reclaimed_extents": self.reclaimed_extents,
+            "reclaimed_inodes": self.reclaimed_inodes,
             "clean": self.clean,
         }
 
 
-def fsck(fs: FileSystem, node_pool, check_orphans: bool = True) -> FsckReport:
+def fsck(fs: FileSystem, node_pool, check_orphans: bool = True,
+         reclaim: bool = False, orphan_grace: float = 3600.0) -> FsckReport:
+    """Meta-tree coherence plus the meta<->data reachability pass:
+    datanode extents referenced by no inode AND no freelist entry are
+    orphans (a leak the deferred-deletion design makes impossible for
+    crashes after unlink, but disk swaps / partial rebuilds can still
+    manufacture). `reclaim` deletes orphan extents from datanodes and
+    funnels orphan inodes through rm_inode (whose extents then ride the
+    freelist, so reclaim never races the free scan)."""
     report = FsckReport()
     referenced: set[tuple[int, int]] = set()
-    _walk(fs, node_pool, "/", mn.ROOT_INO, report, referenced)
+    seen_inos: set[int] = set()
+    _walk(fs, node_pool, "/", mn.ROOT_INO, report, referenced, seen_inos)
+    # freed-but-not-yet-deleted extents are NOT orphans: the metanode
+    # free scan owns them
+    pending = fs.meta.freelist_all()
+    report.pending_free = len(pending)
+    for ent in pending.values():
+        for ek in ent["extents"]:
+            referenced.add((ek["dp_id"], ek["extent_id"]))
+    _find_orphan_inodes(fs, seen_inos, referenced, report)
     if check_orphans:
         _find_orphan_extents(fs, node_pool, referenced, report)
+    if reclaim:
+        _reclaim(fs, node_pool, report, orphan_grace)
     return report
 
 
+def _find_orphan_inodes(fs, seen_inos, referenced,
+                        report: FsckReport) -> None:
+    """Inodes no dentry reaches (e.g. a client that crashed between
+    dentry_delete and inode_delete). Their extents are still accounted
+    to them — marked referenced here so the extent pass doesn't call
+    them orphans — but the space only comes back when rm_inode moves
+    them to the freelist (reclaim does that)."""
+    for ino in sorted(fs.meta.list_inos()):
+        if ino != mn.ROOT_INO and ino not in seen_inos:
+            report.orphan_inodes.append(ino)
+            try:
+                for ek in fs.meta.inode_get(ino)["extents"]:
+                    referenced.add((ek["dp_id"], ek["extent_id"]))
+            except FsError:
+                pass
+
+
+def _reclaim(fs, pool, report: FsckReport, orphan_grace: float) -> None:
+    import time as _time
+
+    now = _time.time()
+    for ino in report.orphan_inodes:
+        try:
+            inode = fs.meta.inode_get(ino)
+            # grace window: a client mid-create (mk_inode committed,
+            # dentry_create not yet issued) looks exactly like an orphan;
+            # only reclaim inodes old enough that no live create can
+            # still be racing us
+            if now - inode.get("ctime", 0.0) < orphan_grace:
+                continue
+            fs.meta.inode_delete(ino)  # extents -> freelist -> free scan
+            report.reclaimed_inodes += 1
+        except FsError:
+            pass
+    for dp_id, eid in report.orphan_extents:
+        try:
+            dp = fs.data._dp_by_id(dp_id)
+        except FsError:
+            continue
+        ok = True
+        for addr in dp["replicas"]:
+            try:
+                pool.get(addr).call(
+                    "delete_extent", {"dp_id": dp_id, "extent_id": eid})
+            except rpc.RpcError:
+                ok = False
+        if ok:
+            report.reclaimed_extents += 1
+
+
 def _walk(fs, pool, path, ino, report: FsckReport,
-          referenced: set[tuple[int, int]]) -> None:
+          referenced: set[tuple[int, int]],
+          seen_inos: set[int] | None = None) -> None:
+    if seen_inos is not None:
+        seen_inos.add(ino)
     try:
         entries = fs.meta.readdir(ino)
     except FsError:
@@ -66,8 +147,10 @@ def _walk(fs, pool, path, ino, report: FsckReport,
         except FsError:
             report.orphan_dentries.append((path, name))
             continue
+        if seen_inos is not None:
+            seen_inos.add(child)
         if inode["type"] == mn.DIR:
-            _walk(fs, pool, cpath, child, report, referenced)
+            _walk(fs, pool, cpath, child, report, referenced, seen_inos)
             continue
         report.files += 1
         for ek in inode["extents"]:

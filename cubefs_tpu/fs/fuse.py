@@ -195,9 +195,9 @@ class FuseMount:
             attrs = {}
             if valid & (1 << 3):  # FATTR_SIZE
                 if size == 0:
-                    freed = fs.meta.truncate(nodeid, 0)
+                    fs.meta.truncate(nodeid, 0)
                     fs.data.close_stream(nodeid)
-                    fs.data.release_extents(freed)
+                    # freed extents ride the metanode freelist
                 else:
                     attrs["size"] = size
             if valid & (1 << 0):  # FATTR_MODE
@@ -305,9 +305,8 @@ class FuseMount:
             if opcode == FUSE_RMDIR and fs.meta.dentry_count(ino) > 0:
                 raise FsError(mn.ENOTEMPTY, "directory not empty")
             fs.meta.dentry_delete(nodeid, name)
-            freed = fs.meta.inode_delete(ino)
+            fs.meta.inode_delete(ino)  # extents ride the freelist
             fs.data.close_stream(ino)
-            fs.data.release_extents(freed)
             self._reply(unique)
 
         elif opcode == FUSE_RENAME:

@@ -126,9 +126,9 @@ class LcNode:
         if loc is not None:
             self.fs.meta.set_xattr(inode["ino"], "cold.location",
                                    __import__("json").dumps(loc.to_dict()))
-            freed = self.fs.meta.truncate(inode["ino"], 0)
+            self.fs.meta.truncate(inode["ino"], 0)
             self.fs.meta.set_attr(inode["ino"], size=len(data))
-            self.fs.data.release_extents(freed)
+            # hot extents ride the metanode freelist (deferred deletion)
             report.transitioned += 1
 
     def read_through(self, path: str) -> bytes:

@@ -774,6 +774,19 @@ class Master(ReplicatedFsm):
         except MasterError as e:
             raise rpc.RpcError(404, str(e)) from None
 
+    def rpc_dp_view(self, args, body):
+        """All data partitions across volumes, keyed by dp_id — the
+        metanode free scan resolves freed extents' replicas from this
+        (metanode deletes extents server-side, partition_free_list.go)."""
+        self._leader_gate()
+        with self._lock:
+            dps = {}
+            for v in self.volumes.values():
+                for dp in v["dps"]:
+                    dps[str(dp["dp_id"])] = {
+                        "dp_id": dp["dp_id"], "replicas": dp["replicas"]}
+            return {"dps": dps}
+
     def rpc_check_replicas(self, args, body):
         # a deposed leader must not run datanode-mutating rebuilds
         self._leader_gate()

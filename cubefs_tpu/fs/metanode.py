@@ -15,6 +15,7 @@ import json
 import os
 import threading
 import time
+import uuid
 import zlib
 
 from ..utils import rpc
@@ -74,6 +75,13 @@ class MetaPartition:
         # decisions stay queryable so participants can roll forward
         self.tx_pending: dict[str, dict] = {}  # tx_id -> {ops, ts, coord}
         self.tx_committed: dict[str, dict] = {}  # tx_id -> {victims, ts}
+        # deferred-deletion free list (partition_free_list.go analog):
+        # unlink/truncate move freed extent keys HERE (replicated FSM
+        # state) instead of trusting the client to delete them; the
+        # metanode's background scan deletes them from datanodes and
+        # retires entries via the free_done op. A client crash right
+        # after unlink can no longer leak datanode space.
+        self.freelist: dict[str, dict] = {}  # key -> {extents, ts}
         self.apply_id = 0
         self._next_ino = start
         self._dirty: set[str] = set(self._SEGMENTS)
@@ -160,6 +168,7 @@ class MetaPartition:
             "dentries": {str(k): v for k, v in self.dentries.items()},
             "tx_pending": self.tx_pending,
             "tx_committed": self.tx_committed,
+            "freelist": self.freelist,
         }
 
     def _load_state_dict(self, st: dict) -> None:
@@ -169,6 +178,7 @@ class MetaPartition:
         self.dentries = {int(k): v for k, v in st["dentries"].items()}
         self.tx_pending = st.get("tx_pending", {})
         self.tx_committed = st.get("tx_committed", {})
+        self.freelist = st.get("freelist", {})
 
     def export_state(self) -> tuple[bytes, int]:
         """(serialized state, apply_id) captured under ONE lock
@@ -196,17 +206,18 @@ class MetaPartition:
     # fires every SNAPSHOT_EVERY records, so per-op cost is amortized
     # O(1) instead of O(partition) on every external snapshot call.
     SNAPSHOT_EVERY = 4096
-    _SEGMENTS = ("inodes", "dentries", "tx")
+    _SEGMENTS = ("inodes", "dentries", "tx", "freelist")
     _DIRTY_MAP = {
         "mk_inode": {"inodes", "dentries"},
-        "rm_inode": {"inodes", "dentries"},
+        "rm_inode": {"inodes", "dentries", "freelist"},
         "mk_dentry": {"dentries"},
         "rm_dentry": {"dentries"},
         "rename_local": {"dentries"},
         "append_extents": {"inodes"},
         "set_attr": {"inodes"},
         "set_xattr": {"inodes"},
-        "truncate": {"inodes"},
+        "truncate": {"inodes", "freelist"},
+        "free_done": {"freelist"},
         "tx_prepare": {"tx"},
         "tx_abort": {"tx"},
         "tx_finish": {"tx"},
@@ -219,6 +230,8 @@ class MetaPartition:
                     "next_ino": self._next_ino}
         if name == "dentries":
             return {"dentries": {str(k): v for k, v in self.dentries.items()}}
+        if name == "freelist":
+            return {"freelist": self.freelist}
         return {"tx_pending": self.tx_pending,
                 "tx_committed": self.tx_committed}
 
@@ -355,7 +368,14 @@ class MetaPartition:
         ino = r["ino"]
         inode = self.inodes.pop(ino, None)
         self.dentries.pop(ino, None)
-        return {"extents": inode["extents"] if inode else []}
+        exts = inode["extents"] if inode else []
+        deferred = [ek for ek in exts if not ek.get("tiny")]
+        if deferred:
+            # server-side deferred deletion: the background free scan
+            # (MetaNode._free_scan) owns reclaiming these from datanodes
+            self.freelist[str(ino)] = {
+                "extents": deferred, "ts": r.get("ts", 0.0)}
+        return {"extents": exts, "deferred": bool(deferred)}
 
     def _apply_mk_dentry(self, r: dict) -> dict:
         parent, name = r["parent"], r["name"]
@@ -594,6 +614,7 @@ class MetaPartition:
         if size == 0:
             old = inode["extents"]
             inode["extents"] = []
+            self._defer_free(r["ino"], old, r.get("ts", 0.0))
             return {"extents": old}
         # shrink: drop keys entirely past the new EOF (freed for GC) and
         # clip a straddling key's mapped length — reads in [size, later
@@ -611,7 +632,26 @@ class MetaPartition:
             else:
                 kept.append(ek)
         inode["extents"] = kept
+        self._defer_free(r["ino"], freed, r.get("ts", 0.0))
         return {"extents": freed}
+
+    def _defer_free(self, ino: int, extents: list, ts: float) -> None:
+        """Queue non-tiny freed extents for the background deletion scan
+        (tiny extents are shared across files, never reclaimed here).
+        Keyed by apply_id so repeated truncates of one inode never
+        collide; apply_id is part of the FSM, so replicas agree."""
+        deferred = [ek for ek in extents if not ek.get("tiny")]
+        if deferred:
+            self.freelist[f"{ino}:t{self.apply_id}"] = {
+                "extents": deferred, "ts": ts}
+
+    def _apply_free_done(self, r: dict) -> dict:
+        self.freelist.pop(r["key"], None)
+        return {}
+
+    def freelist_entries(self) -> list[tuple[str, dict]]:
+        with self._lock:
+            return [(k, dict(v)) for k, v in self.freelist.items()]
 
     # ---------------- reads (no apply) ----------------
     def inode_get(self, ino: int) -> dict:
@@ -703,6 +743,7 @@ class MetaNode:
         self.pool = node_pool
         self.partitions: dict[int, MetaPartition] = {}
         self.rafts: dict[int, object] = {}  # pid -> RaftNode
+        self.dp_view_fn = None  # set_dp_view: enables the free scan
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -897,6 +938,71 @@ class MetaNode:
                 self._push_committed_txs()
             except Exception:
                 pass
+            try:
+                self._free_scan()
+            except Exception:
+                pass
+
+    # ---------------- deferred extent deletion (the free scan) ----------
+    # partition_free_list.go analog: the leader of each partition walks
+    # its freelist, deletes the extents from every replica of their data
+    # partitions, and retires the entry through the commit door (so all
+    # replicas drop it). Failures leave the entry for the next sweep —
+    # that IS the retry policy; a datanode that stays down parks the
+    # entry until the master rebuilds/decommissions the partition.
+    def set_dp_view(self, fn) -> None:
+        """fn: () -> {dp_id: {"dp_id", "replicas": [...]}}. Deployments
+        wire this to the master's client_view; tests inject a direct
+        map. Without it the scan is inert (standalone metanodes)."""
+        self.dp_view_fn = fn
+
+    def _free_scan(self) -> None:
+        view = None
+        for pid in list(self.partitions):
+            mp = self.partitions.get(pid)
+            if mp is None:
+                continue
+            node = self.rafts.get(pid)
+            if node is not None and node.status()["role"] != "leader":
+                continue
+            entries = mp.freelist_entries()
+            if not entries:
+                continue
+            if view is None:
+                fn = getattr(self, "dp_view_fn", None)
+                if fn is None:
+                    return
+                view = fn() or {}
+            for key, ent in entries:
+                if self._stop.is_set():
+                    return
+                done = True
+                seen: set[tuple[int, int]] = set()
+                for ek in ent["extents"]:
+                    ekey = (ek["dp_id"], ek["extent_id"])
+                    if ekey in seen:
+                        continue
+                    seen.add(ekey)
+                    dp = view.get(ek["dp_id"])
+                    if dp is None:
+                        done = False  # dp not in view (rebuild in flight)
+                        continue
+                    for addr in dp["replicas"]:
+                        try:
+                            self.pool.get(addr).call(
+                                "delete_extent",
+                                {"dp_id": ek["dp_id"],
+                                 "extent_id": ek["extent_id"]},
+                                timeout=10.0)
+                        except Exception:
+                            done = False  # replica down: retry next sweep
+                if done:
+                    try:
+                        self._submit_local(
+                            pid, {"op": "free_done", "key": key,
+                                  "op_id": uuid.uuid4().hex})
+                    except Exception:
+                        pass  # resubmitted next sweep (idempotent pop)
 
     # ---------------- RPC surface ----------------
     def rpc_create_partition(self, args, body):
@@ -955,6 +1061,20 @@ class MetaNode:
 
     def rpc_usage_report(self, args, body):
         return self._mp_leader(args["pid"]).usage_report()
+
+    def rpc_freelist(self, args, body):
+        """Pending deferred deletions (fsck reads this so
+        freed-but-not-yet-deleted extents don't count as orphans)."""
+        mp = self._mp_leader(args["pid"])
+        with mp._lock:
+            return {"freelist": {k: v for k, v in mp.freelist.items()}}
+
+    def rpc_list_inos(self, args, body):
+        """All inode ids held by the partition (fsck's orphan-inode pass
+        compares these against the dentry-reachable set)."""
+        mp = self._mp_leader(args["pid"])
+        with mp._lock:
+            return {"inos": sorted(mp.inodes)}
 
     def rpc_mp_fill(self, args, body):
         mp = self._mp_leader(args["pid"])

@@ -1,0 +1,148 @@
+"""Server-side deferred deletion (partition_free_list.go analog) and the
+fsck meta<->data reachability pass.
+
+The round-2 design deleted freed extents from the CLIENT, best-effort: a
+client crash between dentry removal and extent delete permanently leaked
+datanode space. Now unlink/truncate move freed extent keys onto the
+partition's replicated freelist and the metanode's background scan
+deletes them — the client can die at any point without leaking extents,
+and fsck reclaims the one thing a crash can still strand (an orphan
+inode)."""
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.fs import metanode as mn
+from cubefs_tpu.fs.fsck import fsck
+from cubefs_tpu.fs.metanode import MetaPartition
+
+from tests.test_fs_e2e import FsCluster
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = FsCluster(tmp_path)
+    yield c
+    c.stop()
+
+
+def _extent_gone(cluster, ek) -> bool:
+    dp = next(d for d in cluster.view["dps"] if d["dp_id"] == ek["dp_id"])
+    return all(
+        ek["extent_id"] not in cluster.data_node(a)
+        .partitions[dp["dp_id"]].store.list_extents()
+        for a in dp["replicas"]
+    )
+
+
+def test_client_crash_after_unlink_reclaims_space(cluster, rng):
+    """The round-2 leak: client removes the dentry and inode then dies
+    before deleting extents. With the freelist, the metanode free scan
+    reclaims the space with NO further client involvement."""
+    fs = cluster.fs
+    payload = rng.integers(0, 256, 120_000, dtype=np.uint8).tobytes()
+    fs.write_file("/doomed.bin", payload)
+    ino = fs.resolve("/doomed.bin")
+    eks = fs.meta.inode_get(ino)["extents"]
+    assert eks
+    # crashed-client unlink: ONLY the meta ops land (no close_stream, no
+    # client-side extent deletes — the client is gone)
+    fs.meta.dentry_delete(mn.ROOT_INO, "doomed.bin")
+    fs.meta.inode_delete(ino)
+    assert fs.meta.freelist_all(), "extents must be queued, not dropped"
+    cluster.run_free_scan()
+    assert not fs.meta.freelist_all()
+    for ek in eks:
+        assert _extent_gone(cluster, ek)
+
+
+def test_crash_between_dentry_and_inode_delete(cluster, rng):
+    """Client dies after dentry_delete, before inode_delete: the inode
+    (with its extents) is stranded. fsck's orphan-inode pass finds it;
+    reclaim funnels it through rm_inode -> freelist -> free scan."""
+    fs = cluster.fs
+    fs.write_file("/half.bin",
+                  rng.integers(0, 256, 90_000, dtype=np.uint8).tobytes())
+    ino = fs.resolve("/half.bin")
+    eks = fs.meta.inode_get(ino)["extents"]
+    fs.meta.dentry_delete(mn.ROOT_INO, "half.bin")  # ...client dies here
+    rep = fsck(fs, cluster.pool)
+    assert rep.orphan_inodes == [ino]
+    assert not rep.orphan_extents, "accounted extents are not orphans"
+    rep2 = fsck(fs, cluster.pool, reclaim=True, orphan_grace=0.0)
+    assert rep2.reclaimed_inodes == 1
+    cluster.run_free_scan()
+    for ek in eks:
+        assert _extent_gone(cluster, ek)
+    assert fsck(fs, cluster.pool).clean
+
+
+def test_truncate_defers_freed_extents(cluster, rng):
+    fs = cluster.fs
+    fs.write_file("/t.bin",
+                  rng.integers(0, 256, 100_000, dtype=np.uint8).tobytes())
+    eks = fs.meta.inode_get(fs.resolve("/t.bin"))["extents"]
+    fs.truncate_file("/t.bin", 0)
+    assert fs.meta.freelist_all()
+    cluster.run_free_scan()
+    assert not fs.meta.freelist_all()
+    for ek in eks:
+        assert _extent_gone(cluster, ek)
+    assert fs.read_file("/t.bin") == b""
+
+
+def test_pending_freelist_is_not_an_orphan(cluster, rng):
+    """Between unlink and the free scan, fsck must treat the queued
+    extents as accounted (pending_free), not as orphan leaks."""
+    fs = cluster.fs
+    fs.write_file("/p.bin",
+                  rng.integers(0, 256, 80_000, dtype=np.uint8).tobytes())
+    fs.unlink("/p.bin")
+    rep = fsck(fs, cluster.pool)
+    assert rep.pending_free >= 1
+    assert not rep.orphan_extents
+    assert rep.clean
+
+
+def test_free_scan_retries_while_replica_down(cluster, rng):
+    """A datanode that fails deletes parks the entry (the retry policy
+    is the next sweep); once it recovers the entry drains."""
+    fs = cluster.fs
+    fs.write_file("/r.bin",
+                  rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes())
+    ek = fs.meta.inode_get(fs.resolve("/r.bin"))["extents"][0]
+    dp = next(d for d in cluster.view["dps"] if d["dp_id"] == ek["dp_id"])
+    victim = cluster.data_node(dp["replicas"][0])
+    orig = victim.rpc_delete_extent
+    victim.rpc_delete_extent = lambda a, b: (_ for _ in ()).throw(
+        __import__("cubefs_tpu.utils.rpc", fromlist=["RpcError"]).RpcError(
+            500, "injected: disk down"))
+    try:
+        fs.unlink("/r.bin")
+        cluster.run_free_scan()
+        assert fs.meta.freelist_all(), "entry must survive a failed sweep"
+    finally:
+        victim.rpc_delete_extent = orig
+    cluster.run_free_scan()
+    assert not fs.meta.freelist_all()
+    assert _extent_gone(cluster, ek)
+
+
+def test_freelist_survives_restart(tmp_path):
+    """The freelist is FSM state: a standalone partition checkpoint +
+    reload must preserve queued entries (a metanode restart cannot
+    forget space it owes the datanodes)."""
+    d = str(tmp_path / "mp")
+    mp = MetaPartition(7, 1, 1000, d)
+    ino = mp.apply({"op": "mk_inode", "ino": 42, "type": mn.FILE})["ino"]
+    mp.apply({"op": "append_extents", "ino": 42, "size": 10,
+              "extents": [{"dp_id": 3, "extent_id": 9, "file_offset": 0,
+                           "ext_offset": 0, "size": 10}]})
+    mp.apply({"op": "rm_inode", "ino": 42, "ts": 123.0})
+    assert "42" in mp.freelist
+    mp.snapshot()
+    mp2 = MetaPartition(7, 1, 1000, d)
+    assert mp2.freelist.get("42", {}).get("extents"), \
+        "freelist lost across checkpoint reload"
+    mp2.apply({"op": "free_done", "key": "42"})
+    assert not mp2.freelist

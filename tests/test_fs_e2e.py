@@ -36,6 +36,15 @@ class FsCluster:
             self.datas.append(node)
         self.view = self.master.create_volume("vol1", mp_count=2, dp_count=3)
         self.fs = FileSystem(self.view, self.pool)
+        dpmap = {d["dp_id"]: d for d in self.view["dps"]}
+        for m in self.metas:
+            m.set_dp_view(lambda _dp=dpmap: _dp)
+
+    def run_free_scan(self) -> None:
+        """Drive the deferred-deletion scan synchronously (tests don't
+        wait out the background TX_SCAN_INTERVAL cadence)."""
+        for m in self.metas:
+            m._free_scan()
 
     def data_node(self, addr: str) -> DataNode:
         return self.datas[int(addr.removeprefix("data"))]
@@ -210,9 +219,16 @@ def test_unlink_reclaims_extents(cluster, rng):
     node = cluster.data_node(dp["replicas"][0])
     assert node.partitions[dp["dp_id"]].store.size(ek["extent_id"]) > 0
     fs.unlink("/gc.bin")
+    # deferred deletion: unlink only moved the extents to the metanode
+    # freelist; the server-side free scan reclaims them
+    for addr in dp["replicas"]:
+        n = cluster.data_node(addr)
+        assert ek["extent_id"] in n.partitions[dp["dp_id"]].store.list_extents()
+    cluster.run_free_scan()
     for addr in dp["replicas"]:
         n = cluster.data_node(addr)
         assert ek["extent_id"] not in n.partitions[dp["dp_id"]].store.list_extents()
+    assert not cluster.fs.meta.freelist_all()
 
 
 def test_concurrent_creates_unique_inodes(cluster):
