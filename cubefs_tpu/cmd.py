@@ -78,12 +78,17 @@ def run_role(cfg: dict):
                        node_pool=pool)
         srv = _serve(svc, cfg)  # live routing: per-partition raft handlers
         svc.addr = srv.addr
+        # the binary meta plane (manager_op.go analog) listens beside HTTP
+        psrv = svc.serve_packets(host=cfg.get("listen_host", "127.0.0.1"),
+                                 port=int(cfg.get("packet_port", 0)))
+        print(f"[metanode] packet plane on {psrv.addr}", flush=True)
         master = rpc.Client(cfg["master_addr"])
         zone = cfg.get("zone", "default")
         master.call("register", {"kind": "meta", "addr": srv.addr,
-                                 "zone": zone})
+                                 "zone": zone, "packet_addr": psrv.addr})
         _heartbeat_loop(lambda: master.call(
-            "heartbeat", {"kind": "meta", "addr": srv.addr, "zone": zone}))
+            "heartbeat", {"kind": "meta", "addr": srv.addr, "zone": zone,
+                          "packet_addr": psrv.addr}))
 
         def _dp_view():
             meta, _ = master.call("dp_view", {})
@@ -98,7 +103,7 @@ def run_role(cfg: dict):
         # the node learns its own address only after the server binds
         svc = DataNode(int(cfg.get("node_id", 0)), cfg["data_dir"], "pending", pool,
                        qos=cfg.get("qos"))  # {"read_bps":..., "write_bps":...}
-        srv = _serve(rpc.expose(svc), cfg)
+        srv = _serve(svc, cfg)  # live routing: per-dp raft handlers
         svc.addr = srv.addr
         # the binary packet plane (hot data path) listens beside HTTP
         psrv = svc.serve_packets(host=cfg.get("listen_host", "127.0.0.1"),

@@ -14,6 +14,7 @@ import time
 
 import uuid
 
+from ..utils import packet as pkt
 from ..utils import rpc
 from . import metanode as mn
 
@@ -22,6 +23,16 @@ class FsError(Exception):
     def __init__(self, errno_: int, msg: str):
         super().__init__(msg)
         self.errno = errno_
+
+
+# meta ops served on the binary packet plane (manager_op.go analog);
+# everything else stays on HTTP
+_META_PACKET_OPS = {"lookup": pkt.OP_META_LOOKUP,
+                    "inode_get": pkt.OP_META_INODE_GET,
+                    "readdir": pkt.OP_META_READDIR,
+                    "submit": pkt.OP_META_SUBMIT,
+                    "dentry_count": pkt.OP_META_DENTRY_COUNT,
+                    "alloc_ino": pkt.OP_META_ALLOC_INO}
 
 
 
@@ -34,6 +45,14 @@ class MetaWrapper:
         self.nodes = node_pool
         self._rr = 0
         self._lock = threading.Lock()
+        # binary meta plane (manager_op.go): metanodes that advertise a
+        # packet address serve the hot ops over persistent TCP; HTTP
+        # stays as the per-address fallback (same negative-cache
+        # discipline as the data path)
+        self.packet_addrs: dict[str, str] = dict(
+            vol_view.get("meta_packet_addrs") or {})
+        self._packet_clients: dict[str, object] = {}
+        self._packet_down: dict[str, float] = {}  # addr -> retry-after ts
 
     def _mp_for(self, ino: int) -> dict:
         for mp in self.mps:
@@ -44,16 +63,24 @@ class MetaWrapper:
     REDIRECT = 421  # metanode "not leader" status
 
     def _call(self, mp: dict, method: str, args: dict):
-        """Call the partition via the shared replica/redirect loop
-        (rpc.call_replicas). Mutations ("submit") carry a unique op_id
-        so a retry after a lost response is exactly-once; metanode 4xx
-        codes map back to errnos."""
+        """Call the partition via the shared replica/redirect loop.
+        Mutations ("submit") carry a unique op_id so a retry after a
+        lost response is exactly-once; metanode 4xx codes map back to
+        errnos. Hot ops ride the binary packet plane when advertised."""
         addrs = list(mp.get("addrs") or [mp["addr"]])
         payload = {"pid": mp["pid"], **args}
         if method == "submit":
             payload["record"] = dict(payload["record"])
             payload["record"].setdefault("op_id", uuid.uuid4().hex)
         try:
+            if self.packet_addrs and method in _META_PACKET_OPS:
+                # same replica/redirect loop, per-address call swapped
+                # for the packet transport (with per-address HTTP
+                # fallback inside _packet_one)
+                return rpc.call_replicas(
+                    self.nodes, addrs, method, payload, deadline=10.0,
+                    call_fn=lambda a: (self._packet_one(a, method, payload),
+                                       b""))
             return rpc.call_replicas(self.nodes, addrs, method, payload,
                                      deadline=10.0)
         except rpc.RpcError as e:
@@ -63,6 +90,31 @@ class MetaWrapper:
             if 400 <= e.code < 500 and e.code not in (404, self.REDIRECT):
                 raise FsError(e.code - 400, e.message) from None
             raise
+
+    def _packet_one(self, addr: str, method: str, payload: dict) -> dict:
+        """One meta call to one node: packet plane if advertised and not
+        negative-cached, HTTP otherwise. Packet rpc-status errors are
+        re-raised as RpcError so BOTH transports share one redirect /
+        errno semantics."""
+        paddr = self.packet_addrs.get(addr)
+        if paddr and time.monotonic() >= self._packet_down.get(addr, 0.0):
+            cli = self._packet_clients.get(addr)
+            if cli is None:
+                cli = self._packet_clients[addr] = pkt.PacketClient(
+                    paddr, timeout=10.0, connect_timeout=2.0)
+            try:
+                rargs, _ = cli.call(_META_PACKET_OPS[method], args=payload)
+                return rargs
+            except pkt.PacketError as e:
+                if e.code is not None:
+                    raise rpc.RpcError(e.code, e.message) from None
+                # protocol-level failure (crc, desync): distrust the
+                # plane for a while, fall through to HTTP
+                self._packet_down[addr] = time.monotonic() + 30.0
+            except (ConnectionError, OSError, TimeoutError):
+                self._packet_down[addr] = time.monotonic() + 30.0
+        meta, _ = self.nodes.get(addr).call(method, payload)
+        return meta
 
     # ---- inode/dentry API (reference sdk/meta/api.go shapes) ----
     def inode_create(self, typ: str, mode: int = 0o644, target=None,

@@ -281,16 +281,22 @@ class DataNode:
         threading.Thread(target=run, daemon=True).start()
 
     def _random_write(self, dp: DataPartition, extent_id: int, offset: int,
-                      data: bytes, attempts: int = 4) -> None:
+                      data: bytes, deadline: float = 8.0) -> None:
         """Commit an overwrite through the dp raft group, forwarding to
         the current raft leader if this replica isn't it (ApplyRandomWrite
-        analog: one total order for overwrites across leader changes)."""
+        analog: one total order for overwrites across leader changes).
+
+        Retries are deadline-bounded, not count-bounded: an election
+        under write-storm load can outlast any fixed small retry count
+        (seen on the deployed real-socket cluster), and failing a write
+        because the group took 1-2s to elect is wrong."""
         from ..parallel.raft import NotLeaderError
 
         entry = {"op": "random_write", "extent_id": extent_id,
                  "offset": offset, "data": base64.b64encode(data).decode()}
         last: Exception | None = None
-        for _ in range(attempts):
+        end = time.monotonic() + deadline
+        while time.monotonic() < end:
             try:
                 # wait_all: readers may hit ANY replica right after the
                 # ack (k-faster selection), so the overwrite must be
@@ -407,7 +413,10 @@ class DataNode:
         return {"size": size, "crc": crc}
 
     def rpc_list_extents(self, args, body):
-        return {"extents": self._dp(args["dp_id"]).store.list_extents()}
+        store = self._dp(args["dp_id"]).store
+        eids = store.list_extents()
+        return {"extents": eids,
+                "ages": {str(e): store.extent_age(e) for e in eids}}
 
     def rpc_delete_extent(self, args, body):
         self._dp(args["dp_id"]).store.delete(args["extent_id"])
@@ -416,6 +425,13 @@ class DataNode:
     def rpc_sync_extent_from(self, args, body):
         self.sync_extent_from(args["dp_id"], args["extent_id"], args["src_addr"])
         return {}
+
+    def rpc_dp_raft_status(self, args, body):
+        """Raft role/leader/term of one dp's overwrite group (ops/debug
+        surface; the CLI's datapartition status path)."""
+        dp = self._dp(args["dp_id"])
+        st = dp.raft.status() if dp.raft is not None else None
+        return {"status": st}
 
     def rpc_stat(self, args, body):
         with self._repair_lock:

@@ -16,7 +16,9 @@ of handing the C engine a freed handle.
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
+import time
 import zlib
 
 import numpy as np
@@ -120,6 +122,15 @@ class ExtentStore:
             if name.startswith("e_") and name.endswith(".data"):
                 out.append(int(name[2:-5], 16))
         return sorted(out)
+
+    def extent_age(self, extent_id: int) -> float:
+        """Seconds since the extent's data file was last written (orphan
+        reclaim uses this as the in-flight-write grace signal)."""
+        path = os.path.join(self.directory, f"e_{extent_id:016x}.data")
+        try:
+            return max(0.0, time.time() - os.stat(path).st_mtime)
+        except OSError:
+            return 0.0  # unknown: treat as brand new (never reclaim)
 
     def delete(self, extent_id: int) -> None:
         with self._lock:

@@ -25,6 +25,7 @@ class FsckReport:
     replica_mismatches: list = field(default_factory=list)  # (path, ek, fps)
     orphan_dentries: list = field(default_factory=list)  # (parent_path, name)
     orphan_extents: list = field(default_factory=list)  # (dp_id, extent_id)
+    orphan_extent_ages: dict = field(default_factory=dict)  # (dp,eid)->sec
     orphan_inodes: list = field(default_factory=list)  # ino (no dentry)
     pending_free: int = 0  # freelist entries awaiting the deletion scan
     reclaimed_extents: int = 0
@@ -93,7 +94,7 @@ def _find_orphan_inodes(fs, seen_inos, referenced,
             try:
                 for ek in fs.meta.inode_get(ino)["extents"]:
                     referenced.add((ek["dp_id"], ek["extent_id"]))
-            except FsError:
+            except (FsError, rpc.RpcError, OSError):
                 pass
 
 
@@ -112,9 +113,15 @@ def _reclaim(fs, pool, report: FsckReport, orphan_grace: float) -> None:
                 continue
             fs.meta.inode_delete(ino)  # extents -> freelist -> free scan
             report.reclaimed_inodes += 1
-        except FsError:
+        except (FsError, rpc.RpcError, OSError):
             pass
     for dp_id, eid in report.orphan_extents:
+        # same grace discipline as orphan inodes: an extent a client just
+        # wrote but has not yet committed to the metanode (append_extents
+        # in flight) looks exactly like an orphan — only reclaim extents
+        # old enough that no live write can still be racing us
+        if report.orphan_extent_ages.get((dp_id, eid), 0.0) < orphan_grace:
+            continue
         try:
             dp = fs.data._dp_by_id(dp_id)
         except FsError:
@@ -124,7 +131,7 @@ def _reclaim(fs, pool, report: FsckReport, orphan_grace: float) -> None:
             try:
                 pool.get(addr).call(
                     "delete_extent", {"dp_id": dp_id, "extent_id": eid})
-            except rpc.RpcError:
+            except (rpc.RpcError, OSError):
                 ok = False
         if ok:
             report.reclaimed_extents += 1
@@ -168,7 +175,7 @@ def _walk(fs, pool, path, ino, report: FsckReport,
                         {"dp_id": ek["dp_id"], "extent_id": ek["extent_id"]},
                     )
                     fps[addr] = (meta["size"], meta["crc"])
-                except rpc.RpcError as e:
+                except (rpc.RpcError, OSError) as e:
                     fps[addr] = ("unreachable", str(e)[:40])
             values = {v for v in fps.values() if v[0] != "unreachable"}
             if not values:
@@ -188,11 +195,14 @@ def _find_orphan_extents(fs, pool, referenced, report: FsckReport) -> None:
         for addr in dp["replicas"]:
             try:
                 meta, _ = pool.get(addr).call("list_extents", {"dp_id": dp["dp_id"]})
-            except rpc.RpcError:
+            except (rpc.RpcError, OSError):
                 continue
+            ages = meta.get("ages", {})
             for eid in meta["extents"]:
                 if (dp["dp_id"], eid) not in referenced:
                     key = (dp["dp_id"], eid)
                     if key not in report.orphan_extents:
                         report.orphan_extents.append(key)
+                        report.orphan_extent_ages[key] = ages.get(
+                            str(eid), 0.0)
             break

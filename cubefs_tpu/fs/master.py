@@ -352,11 +352,14 @@ class Master(ReplicatedFsm):
             if packet_addr:
                 info["packet_addr"] = packet_addr
 
-    def register_metanode(self, addr: str, zone: str = "default") -> None:
+    def register_metanode(self, addr: str, zone: str = "default",
+                          packet_addr: str | None = None) -> None:
         with self._lock:
             info = self.metanodes.setdefault(addr, {"addr": addr})
             info["hb"] = time.time()
             info["zone"] = zone
+            if packet_addr:
+                info["packet_addr"] = packet_addr
 
     def heartbeat(self, addr: str, kind: str, zone: str | None = None,
                   packet_addr: str | None = None) -> None:
@@ -553,10 +556,14 @@ class Master(ReplicatedFsm):
             packet_addrs = {a: i["packet_addr"]
                             for a, i in self.datanodes.items()
                             if i.get("packet_addr")}
+            meta_packet_addrs = {a: i["packet_addr"]
+                                 for a, i in self.metanodes.items()
+                                 if i.get("packet_addr")}
             return {"name": name, "mps": [dict(m) for m in vol["mps"]],
                     "dps": [dict(d) for d in vol["dps"]],
                     "quotas": dict(vol.get("quotas", {})),
-                    "packet_addrs": packet_addrs}
+                    "packet_addrs": packet_addrs,
+                    "meta_packet_addrs": meta_packet_addrs}
 
     def _meta_load(self) -> dict[str, int]:
         """Replica count per metanode across all volumes (placement load)."""
@@ -731,7 +738,8 @@ class Master(ReplicatedFsm):
             self.register_datanode(args["addr"], zone,
                                    packet_addr=args.get("packet_addr"))
         else:
-            self.register_metanode(args["addr"], zone)
+            self.register_metanode(args["addr"], zone,
+                                   packet_addr=args.get("packet_addr"))
         return {}
 
     def rpc_heartbeat(self, args, body):

@@ -106,6 +106,46 @@ def _inprocess_fs(workdir: str, n_data: int = 3, n_meta: int = 2):
     return FileSystem(view, pool), metas
 
 
+def deployed_ab(workdir: str, files: int = 300, threads: int = 8) -> dict:
+    """Launch the real-socket deploy cluster and run the mdtest shapes
+    twice: meta ops over HTTP only vs over the binary packet plane
+    (manager_op.go parity). The in-process NodePool default cannot show
+    this — its 'RPC' is a function call — so the transport A/B only
+    means something against live listeners."""
+    from ..deploy.cluster import Cluster as DeployCluster
+    from ..fs.client import FileSystem
+    from ..utils import rpc
+    from ..utils.rpc import NodePool
+
+    topo = {"metanodes": 2, "datanodes": 3, "replicas": 2,
+            "volume": {"name": "bench", "mp_count": 2, "dp_count": 3}}
+    c = DeployCluster(topo, workdir)
+    out: dict = {}
+    try:
+        state = c.up()
+        master = state["roles"]["master"][0]
+        view = rpc.call(master, "client_view", {"name": "bench"})[0]["volume"]
+        # warmup: per-dp rafts elect after boot; don't time the storm
+        # against elections
+        warm = FileSystem(view, NodePool())
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                warm.write_file("/warmup", b"x" * 100)
+                warm.unlink("/warmup")
+                break
+            except Exception:
+                time.sleep(0.5)
+        http_view = {**view, "meta_packet_addrs": {}}
+        out["meta_http"] = run(FileSystem(http_view, NodePool()),
+                               files=files, io_mb=4, threads=threads)
+        out["meta_packet"] = run(FileSystem(view, NodePool()),
+                                 files=files, io_mb=4, threads=threads)
+    finally:
+        c.down()
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="cubefs-tpu-fs-bench")
     ap.add_argument("--master")
@@ -113,8 +153,15 @@ def main(argv=None):
     ap.add_argument("--files", type=int, default=200)
     ap.add_argument("--io-mb", type=int, default=16)
     ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--deploy", action="store_true",
+                    help="real-socket cluster; A/B meta HTTP vs packet")
     args = ap.parse_args(argv)
     metas = []
+    if args.deploy:
+        workdir = tempfile.mkdtemp(prefix="cubefs-bench-deploy-")
+        print(json.dumps(deployed_ab(workdir, files=args.files,
+                                     threads=args.threads)))
+        return
     if args.master:
         from ..fs.client import FileSystem
         from ..utils import rpc

@@ -48,13 +48,29 @@ OP_FINGERPRINT = 0x04
 OP_ALLOC_EXTENT = 0x05
 OP_PING = 0x7F
 
+# opcodes (metanode meta plane — manager_op.go analog: meta ops ride the
+# same 64-byte binary protocol as data ops, not HTTP)
+OP_META_LOOKUP = 0x20
+OP_META_INODE_GET = 0x21
+OP_META_READDIR = 0x22
+OP_META_SUBMIT = 0x23
+OP_META_DENTRY_COUNT = 0x24
+OP_META_ALLOC_INO = 0x25
+
 RESULT_OK = 0
+RESULT_RPC = 0xE1  # structured rpc error: code+message ride the args
 
 
 class PacketError(Exception):
-    def __init__(self, result: int, msg: str = ""):
+    """`code` carries a full rpc status (421 redirect, 499 errno=...)
+    across the wire — the 1-byte header result field can't; handlers
+    raise with code set and the server forwards it in the reply args."""
+
+    def __init__(self, result: int, msg: str = "", code: int | None = None):
         super().__init__(f"packet result {result}: {msg}")
         self.result = result
+        self.code = code
+        self.message = msg
 
 
 def pack(opcode: int, *, partition: int = 0, extent: int = 0,
@@ -157,9 +173,11 @@ class PacketServer:
                         reply = pack(hdr["opcode"], req_id=hdr["req_id"],
                                      args=args_out, payload=payload_out)
                     except PacketError as e:
+                        err_args = {"error": e.message or str(e)}
+                        if e.code is not None:
+                            err_args["code"] = e.code
                         reply = pack(hdr["opcode"], req_id=hdr["req_id"],
-                                     result=e.result,
-                                     args={"error": str(e)})
+                                     result=e.result, args=err_args)
                     except Exception as e:  # handler bug: surface, don't die
                         reply = pack(hdr["opcode"], req_id=hdr["req_id"],
                                      result=0xEF,
@@ -253,6 +271,6 @@ class PacketClient:
                 self._close_locked()
                 raise PacketError(0xFC, "response req_id mismatch")
             if hdr["result"] != RESULT_OK:
-                raise PacketError(hdr["result"],
-                                  rargs.get("error", ""))
+                raise PacketError(hdr["result"], rargs.get("error", ""),
+                                  code=rargs.get("code"))
             return rargs, rpayload

@@ -300,13 +300,19 @@ class Client:
 def call_replicas(pool: NodePool, addrs: list[str], method: str,
                   args: dict | None = None, body: bytes = b"",
                   timeout: float = 30.0,
-                  deadline: float = 10.0) -> tuple[dict, bytes]:
+                  deadline: float = 10.0,
+                  call_fn=None) -> tuple[dict, bytes]:
     """Call one member of a replica set, following 421 leader redirects
     (with election backoff) and failing over across replicas on
     transport errors / 5xx / 404. The ONE redirect-following loop shared
-    by the meta SDK and the metanode tx scanner — raises the last error
-    if no replica answers."""
+    by the meta SDK (both transports — `call_fn` swaps the per-address
+    call, e.g. the binary packet plane) and the metanode tx scanner —
+    raises the last error if no replica answers."""
     import time as _t
+
+    if call_fn is None:
+        def call_fn(addr):
+            return pool.get(addr).call(method, args, body, timeout)
 
     last: Exception | None = None
     tried: set[str] = set()
@@ -317,7 +323,7 @@ def call_replicas(pool: NodePool, addrs: list[str], method: str,
         if addr in tried:
             continue
         try:
-            return pool.get(addr).call(method, args, body, timeout)
+            return call_fn(addr)
         except RpcError as e:
             if e.code == Client.REDIRECT:
                 leader = e.message.removeprefix("leader=").strip()

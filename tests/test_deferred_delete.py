@@ -146,3 +146,22 @@ def test_freelist_survives_restart(tmp_path):
         "freelist lost across checkpoint reload"
     mp2.apply({"op": "free_done", "key": "42"})
     assert not mp2.freelist
+
+
+def test_orphan_extent_reclaim_respects_grace(cluster, rng):
+    """A just-written uncommitted extent looks like an orphan (client
+    mid-write, append_extents not yet submitted): reclaim must skip it
+    inside the grace window and delete it once old enough."""
+    dp = cluster.view["dps"][0]
+    leader = cluster.data_node(dp["leader"])
+    eid = leader.partitions[dp["dp_id"]].alloc_extent()
+    leader.write(dp["dp_id"], eid, 0, b"uncommitted write", chain=False)
+    rep = fsck(cluster.fs, cluster.pool, reclaim=True)  # default grace
+    assert (dp["dp_id"], eid) in rep.orphan_extents
+    assert rep.reclaimed_extents == 0, "grace window must protect it"
+    store = leader.partitions[dp["dp_id"]].store
+    assert eid in store.list_extents()
+    rep2 = fsck(cluster.fs, cluster.pool, reclaim=True, orphan_grace=0.0)
+    assert rep2.reclaimed_extents >= 1
+    assert eid not in store.list_extents()
+    assert fsck(cluster.fs, cluster.pool).clean

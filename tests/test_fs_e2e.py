@@ -21,12 +21,17 @@ class FsCluster:
         self.master = Master(self.pool)
         self.pool.bind("master", self.master)
         self.metas, self.datas = [], []
+        self.meta_packet_srvs = []
         for i in range(n_meta):
             addr = f"meta{i}"
             node = MetaNode(i, data_dir=str(tmp_path / f"meta{i}"),
                             addr=addr, node_pool=self.pool)
             self.pool.bind(addr, node)
-            self.master.register_metanode(addr)
+            # the binary meta plane listens on real TCP beside the
+            # in-process routes, so every e2e test exercises it
+            psrv = node.serve_packets()
+            self.meta_packet_srvs.append(psrv)
+            self.master.register_metanode(addr, packet_addr=psrv.addr)
             self.metas.append(node)
         for i in range(n_data):
             addr = f"data{i}"
@@ -50,6 +55,8 @@ class FsCluster:
         return self.datas[int(addr.removeprefix("data"))]
 
     def stop(self):
+        for s in self.meta_packet_srvs:
+            s.stop()
         for m in self.metas:
             m.stop()
         for d in self.datas:
@@ -274,8 +281,10 @@ def test_metanode_leader_failover(tmp_path, rng):
             leader_addr = node.addr
             leader_node = node
     assert leader_addr is not None
-    # kill it: stop rafts and unbind (simulates process death)
+    # kill it: stop rafts, packet listener, and unbind (process death
+    # takes BOTH transports down)
     leader_node.stop()
+    c.meta_packet_srvs[c.metas.index(leader_node)].stop()
     c.pool.bind(leader_addr, _DeadNode())
     deadline = time.time() + 8
     last = None
@@ -587,3 +596,42 @@ def test_dir_rename_ancestry_walk_bounded_by_mutex_ttl(cluster):
     # and without a deadline the same walk completes normally
     assert fs._in_subtree(root, fs.stat("/big/sub")["ino"]) is True
     assert fs._in_subtree(root, target) is False
+
+def test_meta_ops_ride_packet_plane(cluster):
+    """With meta packet addrs in the view, the hot meta ops go over the
+    binary plane (manager_op.go parity): the HTTP route must see NO
+    lookup/readdir traffic."""
+    fs = cluster.fs
+    assert fs.meta.packet_addrs, "view must advertise meta packet addrs"
+    http_hits = {"n": 0}
+    for m in cluster.metas:
+        orig = m.rpc_lookup
+
+        def spy(args, body, _orig=orig):
+            http_hits["n"] += 1
+            return _orig(args, body)
+
+        m.rpc_lookup = spy
+    fs.mkdir("/pk")
+    fs.write_file("/pk/f", b"packet me")
+    assert fs.read_file("/pk/f") == b"packet me"
+    assert fs.stat("/pk/f")["size"] == 9
+    assert "f" in fs.readdir("/pk")
+    assert http_hits["n"] == 0, "lookup leaked onto the HTTP route"
+
+
+def test_meta_packet_failover_to_http(cluster):
+    """Killing the packet listeners must degrade meta ops to HTTP
+    transparently (same negative-cache fallback as the data path)."""
+    fs = cluster.fs
+    fs.mkdir("/fo")
+    fs.write_file("/fo/a", b"x")
+    for s in cluster.meta_packet_srvs:
+        s.stop()
+    # existing persistent connections die; new ops must still succeed
+    for cli in fs.meta._packet_clients.values():
+        cli.close()
+    fs.write_file("/fo/b", b"y")
+    assert fs.read_file("/fo/b") == b"y"
+    assert set(fs.readdir("/fo")) == {"a", "b"}
+    assert fs.meta._packet_down, "failover must negative-cache the plane"
