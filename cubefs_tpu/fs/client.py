@@ -736,6 +736,10 @@ class FileSystem:
 
     def read_file(self, path: str, offset: int = 0, length: int | None = None) -> bytes:
         inode = self.meta.inode_get(self.resolve(path))
+        if inode["type"] == mn.DIR:
+            # read(2) of a directory is EISDIR — which also exercises the
+            # 499 errno= wire form (421 is a reserved transport code)
+            raise FsError(mn.EISDIR, f"{path} is a directory")
         if length is None:
             length = max(0, inode["size"] - offset)
         else:

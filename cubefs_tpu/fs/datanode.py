@@ -415,8 +415,11 @@ class DataNode:
     def rpc_list_extents(self, args, body):
         store = self._dp(args["dp_id"]).store
         eids = store.list_extents()
-        return {"extents": eids,
-                "ages": {str(e): store.extent_age(e) for e in eids}}
+        out = {"extents": eids}
+        if args.get("want_ages"):
+            # one stat(2) per extent — only fsck's orphan pass needs it
+            out["ages"] = {str(e): store.extent_age(e) for e in eids}
+        return out
 
     def rpc_delete_extent(self, args, body):
         self._dp(args["dp_id"]).store.delete(args["extent_id"])

@@ -194,7 +194,8 @@ def _find_orphan_extents(fs, pool, referenced, report: FsckReport) -> None:
         seen_dps.add(dp["dp_id"])
         for addr in dp["replicas"]:
             try:
-                meta, _ = pool.get(addr).call("list_extents", {"dp_id": dp["dp_id"]})
+                meta, _ = pool.get(addr).call(
+                    "list_extents", {"dp_id": dp["dp_id"], "want_ages": True})
             except (rpc.RpcError, OSError):
                 continue
             ages = meta.get("ages", {})

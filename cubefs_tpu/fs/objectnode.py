@@ -288,8 +288,11 @@ class ObjectNode:
                         return
                     try:
                         data = sfs.read_file("/" + sk)
-                    except FsError:
-                        return self._error(404, "NoSuchKey", sk)
+                    except FsError as e:
+                        if e.errno == 21:  # EISDIR: folder-marker copy
+                            data = b""
+                        else:
+                            return self._error(404, "NoSuchKey", sk)
                 try:
                     outer._put_object(fs, key, data)
                 except FsError as e:
@@ -496,7 +499,11 @@ class ObjectNode:
                             headers={"Content-Range":
                                      f"bytes {lo}-{hi}/{size}"})
                     data = fs.read_file("/" + key)
-                except FsError:
+                except FsError as e:
+                    if e.errno == 21:  # EISDIR: folder-marker key GET
+                        return self._reply(200, b"",
+                                           ctype="application/octet-stream",
+                                           headers=self._cors(bucket))
                     return self._error(404, "NoSuchKey", key)
                 self._reply(200, data, ctype="application/octet-stream",
                             headers=self._cors(bucket))

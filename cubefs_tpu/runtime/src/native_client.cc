@@ -35,8 +35,28 @@ namespace {
 
 thread_local std::string g_nc_err;
 thread_local std::string g_nc_meta;  // last response's X-Rpc-Resp JSON
+thread_local int g_nc_errno = 0;     // POSIX errno of the last failure
 
 void nc_set_err(const std::string& e) { g_nc_err = e; }
+
+// Decode the gateway's errno-on-the-wire scheme (fsgateway._err /
+// metanode._rpc_err): HTTP 400+errno for small errnos, except 404 and
+// 421 which are reserved transport codes; 499 carries "errno=NN: ..."
+// in the error message for large/colliding errnos. Everything else
+// (transport failure, 5xx) is EIO.
+int status_to_errno(int status) {
+  if (status >= 401 && status <= 498 && status != 404 && status != 421)
+    return status - 400;
+  if (status == 499) {
+    size_t p = g_nc_meta.find("errno=");
+    if (p != std::string::npos) return atoi(g_nc_meta.c_str() + p + 6);
+  }
+  return EIO;
+}
+
+// negative-errno return for the POSIX surface (libsdk.go returns
+// -errno throughout; so do we)
+int nc_fail() { return -(g_nc_errno ? g_nc_errno : EIO); }
 
 int dial(const char* host, int port) {
   addrinfo hints{}, *res = nullptr;
@@ -74,8 +94,12 @@ bool send_all(int fd, const void* buf, size_t n) {
 int http_post(const char* host, int port, const std::string& path,
               const std::string& args_json, const uint8_t* body,
               size_t body_len, std::vector<uint8_t>* resp) {
+  g_nc_errno = 0;
   int fd = dial(host, port);
-  if (fd < 0) return -1;
+  if (fd < 0) {
+    g_nc_errno = EIO;
+    return -1;
+  }
   // heap-built header: args_json (e.g. a multi-slice location) can be
   // arbitrarily long; a fixed buffer would truncate and over-send
   std::string head = "POST /" + path + " HTTP/1.1\r\nHost: " + host +
@@ -85,6 +109,7 @@ int http_post(const char* host, int port, const std::string& path,
   if (!send_all(fd, head.data(), head.size()) ||
       (body_len && !send_all(fd, body, body_len))) {
     nc_set_err("send failed");
+    g_nc_errno = EIO;
     close(fd);
     return -1;
   }
@@ -96,6 +121,7 @@ int http_post(const char* host, int port, const std::string& path,
   size_t hdr_end = raw.find("\r\n\r\n");
   if (hdr_end == std::string::npos) {
     nc_set_err("malformed http response");
+    g_nc_errno = EIO;
     return -1;
   }
   int status = 0;
@@ -110,8 +136,10 @@ int http_post(const char* host, int port, const std::string& path,
   if (resp) {
     resp->assign(raw.begin() + hdr_end + 4, raw.end());
   }
-  if (status != 200) nc_set_err("http status " + std::to_string(status) +
-                                ": " + g_nc_meta);
+  if (status != 200) {
+    nc_set_err("http status " + std::to_string(status) + ": " + g_nc_meta);
+    g_nc_errno = status_to_errno(status);
+  }
   return status;
 }
 
@@ -152,6 +180,7 @@ struct CfsClient {
 constexpr int kO_WRONLY = 01;
 constexpr int kO_RDWR = 02;
 constexpr int kO_CREAT = 0100;
+constexpr int kO_EXCL = 0200;
 constexpr int kO_TRUNC = 01000;
 constexpr int kO_APPEND = 02000;
 
@@ -177,6 +206,10 @@ extern "C" {
 
 const char* cfs_last_error() { return g_nc_err.c_str(); }
 const char* cfs_last_meta() { return g_nc_meta.c_str(); }
+// POSIX errno of this thread's last failed call (0 after success); the
+// cfs_* POSIX surface also returns it as a negative result, matching
+// the reference libsdk's -errno contract (libsdk.go:289-840)
+int cfs_last_errno() { return g_nc_errno; }
 
 // ---------------- POSIX file surface (libsdk.go:289-840 analog) ------
 
@@ -204,12 +237,18 @@ int cfs_open(void* h, const char* path, int flags, int mode) {
                    &resp);
   uint64_t size = 0;
   if (st == 200 && resp.size() >= 8) {
+    if ((flags & kO_CREAT) && (flags & kO_EXCL)) {
+      // atomic create-if-absent contract: the file exists, so fail
+      nc_set_err("O_EXCL: file exists");
+      g_nc_errno = EEXIST;
+      return nc_fail();
+    }
     memcpy(&size, resp.data(), 8);
     if (flags & kO_TRUNC) {
       if (fs_call(c, "fs_truncate",
                   "{\"path\": \"" + p + "\", \"size\": 0}", nullptr, 0,
                   nullptr) != 200)
-        return -1;
+        return nc_fail();
       size = 0;
     }
   } else if (flags & kO_CREAT) {
@@ -217,6 +256,11 @@ int cfs_open(void* h, const char* path, int flags, int mode) {
     snprintf(args, sizeof args, "{\"path\": \"%s\", \"mode\": %d}",
              p.c_str(), mode);
     int cst = fs_call(c, "fs_create", args, nullptr, 0, nullptr);
+    if (cst == 417 && (flags & kO_EXCL)) {
+      // lost the create race while O_EXCL was set: must fail
+      g_nc_errno = EEXIST;
+      return nc_fail();
+    }
     if (cst == 417) {
       // lost the create race (EEXIST): O_CREAT without O_EXCL must open
       // the existing file, honoring O_TRUNC
@@ -224,16 +268,16 @@ int cfs_open(void* h, const char* path, int flags, int mode) {
         if (fs_call(c, "fs_truncate",
                     "{\"path\": \"" + p + "\", \"size\": 0}", nullptr,
                     0, nullptr) != 200)
-          return -1;
+          return nc_fail();
       } else if (fs_call(c, "fs_stat", "{\"path\": \"" + p + "\"}",
                          nullptr, 0, &resp) == 200 && resp.size() >= 8) {
         memcpy(&size, resp.data(), 8);
       }
     } else if (cst != 200) {
-      return -1;
+      return nc_fail();
     }
   } else {
-    return -1;  // ENOENT; detail in cfs_last_error()
+    return nc_fail();  // -ENOENT; detail in cfs_last_error()
   }
   std::lock_guard<std::mutex> g(c->mu);
   int fd = c->next_fd++;
@@ -248,7 +292,11 @@ int cfs_open(void* h, const char* path, int flags, int mode) {
 int cfs_close(void* h, int fd) {
   CfsClient* c = (CfsClient*)h;
   std::lock_guard<std::mutex> g(c->mu);
-  return c->fds.erase(fd) ? 0 : -1;
+  if (!c->fds.erase(fd)) {
+    g_nc_errno = EBADF;
+    return nc_fail();
+  }
+  return 0;
 }
 
 int64_t cfs_pread(void* h, int fd, void* buf, uint64_t n, uint64_t off) {
@@ -259,7 +307,8 @@ int64_t cfs_pread(void* h, int fd, void* buf, uint64_t n, uint64_t off) {
     auto it = c->fds.find(fd);
     if (it == c->fds.end()) {
       nc_set_err("bad fd");
-      return -1;
+      g_nc_errno = EBADF;
+      return nc_fail();
     }
     path = it->second.path;
   }
@@ -269,10 +318,12 @@ int64_t cfs_pread(void* h, int fd, void* buf, uint64_t n, uint64_t off) {
            json_escape(path.c_str()).c_str(), (unsigned long long)off,
            (unsigned long long)n);
   std::vector<uint8_t> resp;
-  if (fs_call(c, "fs_read", args, nullptr, 0, &resp) != 200) return -1;
+  if (fs_call(c, "fs_read", args, nullptr, 0, &resp) != 200)
+    return nc_fail();
   if (resp.size() > n) {
     nc_set_err("gateway returned more than requested");
-    return -1;
+    g_nc_errno = EIO;
+    return nc_fail();
   }
   memcpy(buf, resp.data(), resp.size());
   return (int64_t)resp.size();
@@ -286,7 +337,8 @@ int64_t cfs_read(void* h, int fd, void* buf, uint64_t n) {
     auto it = c->fds.find(fd);
     if (it == c->fds.end()) {
       nc_set_err("bad fd");
-      return -1;
+      g_nc_errno = EBADF;
+      return nc_fail();
     }
     off = it->second.offset;
   }
@@ -308,7 +360,8 @@ int64_t cfs_pwrite(void* h, int fd, const void* buf, uint64_t n,
     auto it = c->fds.find(fd);
     if (it == c->fds.end()) {
       nc_set_err("bad fd");
-      return -1;
+      g_nc_errno = EBADF;
+      return nc_fail();
     }
     path = it->second.path;
   }
@@ -316,7 +369,7 @@ int64_t cfs_pwrite(void* h, int fd, const void* buf, uint64_t n,
   snprintf(args, sizeof args, "{\"path\": \"%s\", \"offset\": %llu}",
            json_escape(path.c_str()).c_str(), (unsigned long long)off);
   if (fs_call(c, "fs_write", args, (const uint8_t*)buf, n, nullptr) != 200)
-    return -1;
+    return nc_fail();
   return (int64_t)n;
 }
 
@@ -330,7 +383,8 @@ int64_t cfs_write(void* h, int fd, const void* buf, uint64_t n) {
     auto it = c->fds.find(fd);
     if (it == c->fds.end()) {
       nc_set_err("bad fd");
-      return -1;
+      g_nc_errno = EBADF;
+      return nc_fail();
     }
     off = it->second.offset;
     append = it->second.append;
@@ -345,7 +399,7 @@ int64_t cfs_write(void* h, int fd, const void* buf, uint64_t n) {
                 "{\"path\": \"" + json_escape(path.c_str()) + "\"}",
                 nullptr, 0, &resp) != 200 || resp.size() < 8) {
       nc_set_err("O_APPEND size probe failed: " + g_nc_err);
-      return -1;
+      return nc_fail();
     }
     memcpy(&off, resp.data(), 8);
   }
@@ -367,7 +421,8 @@ int64_t cfs_lseek(void* h, int fd, int64_t off, int whence) {
     auto it = c->fds.find(fd);
     if (it == c->fds.end()) {
       nc_set_err("bad fd");
-      return -1;
+      g_nc_errno = EBADF;
+      return nc_fail();
     }
     path = it->second.path;
   }
@@ -376,19 +431,23 @@ int64_t cfs_lseek(void* h, int fd, int64_t off, int whence) {
     if (fs_call(c, "fs_stat",
                 "{\"path\": \"" + json_escape(path.c_str()) + "\"}",
                 nullptr, 0, &resp) != 200 || resp.size() < 8)
-      return -1;
+      return nc_fail();
     memcpy(&size, resp.data(), 8);
   }
   std::lock_guard<std::mutex> g(c->mu);
   auto it = c->fds.find(fd);
-  if (it == c->fds.end()) return -1;
+  if (it == c->fds.end()) {
+    g_nc_errno = EBADF;
+    return nc_fail();
+  }
   int64_t base = whence == 0 ? 0
                  : whence == 1 ? (int64_t)it->second.offset
                                : (int64_t)size;
   int64_t pos = base + off;
   if (pos < 0) {
     nc_set_err("negative seek");
-    return -1;
+    g_nc_errno = EINVAL;
+    return nc_fail();
   }
   it->second.offset = (uint64_t)pos;
   return pos;
@@ -403,7 +462,7 @@ int cfs_stat_path(void* h, const char* path, uint64_t* size, uint32_t* mode,
   if (fs_call(c, "fs_stat",
               "{\"path\": \"" + json_escape(path) + "\"}", nullptr, 0,
               &resp) != 200 || resp.size() < 24)
-    return -1;
+    return nc_fail();
   if (size) memcpy(size, resp.data(), 8);
   if (mode) memcpy(mode, resp.data() + 8, 4);
   if (type) memcpy(type, resp.data() + 12, 4);
@@ -424,7 +483,7 @@ int cfs_mkdirs(void* h, const char* path) {
       int st = fs_call(c, "fs_mkdir",
                        "{\"path\": \"" + json_escape(acc.c_str()) + "\"}",
                        nullptr, 0, nullptr);
-      if (st != 200 && st != 417) return -1;  // 417 = EEXIST: fine
+      if (st != 200 && st != 417) return nc_fail();  // 417 = EEXIST: fine
     }
     i = j;
   }
@@ -438,10 +497,11 @@ int64_t cfs_readdir(void* h, const char* path, char* out, uint64_t cap) {
   if (fs_call(c, "fs_readdir",
               "{\"path\": \"" + json_escape(path) + "\"}", nullptr, 0,
               &resp) != 200)
-    return -1;
+    return nc_fail();
   if (resp.size() + 1 > cap) {
     nc_set_err("readdir buffer too small");
-    return -2;
+    g_nc_errno = ERANGE;
+    return nc_fail();
   }
   memcpy(out, resp.data(), resp.size());
   out[resp.size()] = 0;
@@ -456,7 +516,7 @@ int cfs_unlink(void* h, const char* path) {
                  "{\"path\": \"" + json_escape(path) + "\"}", nullptr, 0,
                  nullptr) == 200
              ? 0
-             : -1;
+             : nc_fail();
 }
 
 int cfs_rmdir(void* h, const char* path) { return cfs_unlink(h, path); }
@@ -468,7 +528,7 @@ int cfs_rename(void* h, const char* oldp, const char* newp) {
                      json_escape(newp) + "\"}",
                  nullptr, 0, nullptr) == 200
              ? 0
-             : -1;
+             : nc_fail();
 }
 
 int cfs_truncate(void* h, const char* path, uint64_t size) {
@@ -476,7 +536,8 @@ int cfs_truncate(void* h, const char* path, uint64_t size) {
   char args[4352];
   snprintf(args, sizeof args, "{\"path\": \"%s\", \"size\": %llu}",
            json_escape(path).c_str(), (unsigned long long)size);
-  return fs_call(c, "fs_truncate", args, nullptr, 0, nullptr) == 200 ? 0 : -1;
+  return fs_call(c, "fs_truncate", args, nullptr, 0, nullptr) == 200 ? 0
+                                                                      : nc_fail();
 }
 
 int cfs_flush(void* h, int fd) {

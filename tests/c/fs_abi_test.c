@@ -27,11 +27,19 @@ extern int cfs_unlink(void* h, const char* path);
 extern int cfs_rename(void* h, const char* o, const char* n);
 extern int cfs_truncate(void* h, const char* path, uint64_t size);
 extern const char* cfs_last_error(void);
+extern int cfs_last_errno(void);
 
 #define O_WRONLY 01
 #define O_CREAT 0100
+#define O_EXCL 0200
 #define O_TRUNC 01000
 #define O_APPEND 02000
+
+/* POSIX errnos the ABI contract promises as -errno returns */
+#define E_NOENT 2
+#define E_EEXIST 17
+#define E_EISDIR 21
+#define E_NOTEMPTY 39
 
 #define CHECK(cond, msg)                                          \
   do {                                                            \
@@ -93,6 +101,29 @@ int main(int argc, char** argv) {
   CHECK(cfs_stat_path(h, "/c/abi/deep/file.bin", &size, &mode, &type,
                       &mtime) == 0 && size == strlen(msg) + 1 + 5,
         "append-size");
+
+  /* -errno fidelity (libsdk.go returns -errno throughout; so do we) */
+  CHECK(cfs_open(h, "/c/abi/deep/absent.bin", 0, 0) == -E_NOENT,
+        "open-enoent");
+  CHECK(cfs_last_errno() == E_NOENT, "last-errno-enoent");
+  CHECK(cfs_open(h, "/c/abi/deep/file.bin", O_WRONLY | O_CREAT | O_EXCL,
+                 0644) == -E_EEXIST, "open-excl-eexist");
+  CHECK(cfs_last_errno() == E_EEXIST, "last-errno-eexist");
+  /* O_EXCL on a genuinely new path still works */
+  fd = cfs_open(h, "/c/abi/deep/excl.bin", O_WRONLY | O_CREAT | O_EXCL,
+                0644);
+  CHECK(fd >= 0, "open-excl-new");
+  CHECK(cfs_close(h, fd) == 0, "close-excl");
+  CHECK(cfs_unlink(h, "/c/abi/deep/excl.bin") == 0, "unlink-excl");
+  CHECK(cfs_unlink(h, "/c/abi/deep") == -E_NOTEMPTY, "rmdir-enotempty");
+  /* reading a directory is EISDIR — decoded from the 499 errno= wire
+   * form (421 is a reserved transport code, so EISDIR can't ride
+   * 400+errno) */
+  fd = cfs_open(h, "/c/abi/deep", 0, 0);
+  CHECK(fd >= 0, "open-dir");
+  CHECK(cfs_read(h, fd, buf, 4) == -E_EISDIR, "read-dir-eisdir");
+  CHECK(cfs_close(h, fd) == 0, "close-dir");
+  CHECK(cfs_close(h, 9999) == -9, "close-ebadf"); /* EBADF */
 
   /* readdir + rename + truncate + unlink */
   char names[256] = {0};

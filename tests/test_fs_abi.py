@@ -91,7 +91,8 @@ def test_open_semantics(gateway):
     h = lib.cfs_mount(host, port)
     try:
         # O_CREAT off + missing file -> error
-        assert lib.cfs_open(h, b"/nope", 0, 0) == -1
+        assert lib.cfs_open(h, b"/nope", 0, 0) == -2  # -ENOENT
+        assert lib.cfs_last_errno() == 2
         fs.write_file("/t", b"0123456789")
         # O_TRUNC empties
         fd = lib.cfs_open(h, b"/t", O_WRONLY | O_TRUNC, 0)
