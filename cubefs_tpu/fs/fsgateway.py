@@ -24,12 +24,7 @@ TYPE_CODES = {mn.FILE: 0, mn.DIR: 1, mn.SYMLINK: 2}
 
 
 def _err(e: FsError) -> rpc.RpcError:
-    # same collision-safe encoding as metanode._rpc_err: 404 (not-found
-    # pass-through) and 421 (leader redirect) are reserved transport
-    # codes, so EINTR=4 / EISDIR=21 must ride the 499 errno= form
-    if e.errno < 99 and 400 + e.errno not in (404, 421):
-        return rpc.RpcError(400 + e.errno, str(e))
-    return rpc.RpcError(499, f"errno={e.errno}: {e}")
+    return rpc.errno_error(e.errno, str(e))
 
 
 class FsGateway:

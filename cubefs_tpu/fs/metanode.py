@@ -45,16 +45,7 @@ EDQUOT = 122
 
 
 def _rpc_err(e: "MetaError") -> "rpc.RpcError":
-    """Encode a metanode errno for the wire: 400+errno for small errnos
-    (back-compat), or 499 with an errno= prefix for errnos >= 100 (e.g.
-    EDQUOT=122 must not collide with 5xx failover semantics) and for the
-    errnos whose 400+errno would collide with reserved HTTP codes — 404
-    (not-found pass-through) and 421 (leader redirect, whose message is
-    parsed as an address; EISDIR=21 encoded as 421 would be read as a
-    redirect and mask the real failure)."""
-    if e.code < 99 and 400 + e.code not in (404, 421):
-        return rpc.RpcError(400 + e.code, str(e))
-    return rpc.RpcError(499, f"errno={e.code}: {e}")
+    return rpc.errno_error(e.code, str(e))
 
 
 class MetaPartition:

@@ -289,7 +289,7 @@ class ObjectNode:
                     try:
                         data = sfs.read_file("/" + sk)
                     except FsError as e:
-                        if e.errno == 21:  # EISDIR: folder-marker copy
+                        if e.errno == mn.EISDIR:  # folder-marker copy
                             data = b""
                         else:
                             return self._error(404, "NoSuchKey", sk)
@@ -500,7 +500,7 @@ class ObjectNode:
                                      f"bytes {lo}-{hi}/{size}"})
                     data = fs.read_file("/" + key)
                 except FsError as e:
-                    if e.errno == 21:  # EISDIR: folder-marker key GET
+                    if e.errno == mn.EISDIR:  # folder-marker key GET
                         return self._reply(200, b"",
                                            ctype="application/octet-stream",
                                            headers=self._cors(bucket))

@@ -221,6 +221,13 @@ class CachedReader:
         self.hits = 0
         self.misses = 0
 
+    def _flash_client(self, addr: str):
+        # NodePool.get already caches one Client per addr and stays
+        # current across rebinds; FlashClient is a stateless wrapper
+        from ..sdk.clients import FlashClient
+
+        return FlashClient(self.nodes.get(addr))
+
     @staticmethod
     def _key(dp_id: int, extent_id: int, block: int) -> str:
         return f"{dp_id}/{extent_id}/{block}"
@@ -233,7 +240,7 @@ class CachedReader:
         key = self._key(dp["dp_id"], extent_id, block)
         for addr in self.fgm.group_for(key):
             try:
-                _, data = self.nodes.get(addr).call("cache_get", {"key": key})
+                data = self._flash_client(addr).cache_get(key)
                 if len(data) >= length:  # stale short entry -> refetch
                     self.hits += 1
                     cache_ops.inc(result="hit")
@@ -247,7 +254,7 @@ class CachedReader:
         )
         for addr in self.fgm.group_for(key):
             try:
-                self.nodes.get(addr).call("cache_put", {"key": key}, data)
+                self._flash_client(addr).cache_put(key, data)
                 break
             except rpc.RpcError:
                 continue

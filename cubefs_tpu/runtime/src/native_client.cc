@@ -296,6 +296,7 @@ int cfs_close(void* h, int fd) {
     g_nc_errno = EBADF;
     return nc_fail();
   }
+  g_nc_errno = 0;  // success with no HTTP round-trip: clear stale errno
   return 0;
 }
 
@@ -450,6 +451,7 @@ int64_t cfs_lseek(void* h, int fd, int64_t off, int whence) {
     return nc_fail();
   }
   it->second.offset = (uint64_t)pos;
+  g_nc_errno = 0;  // SEEK_SET/CUR succeed locally: clear stale errno
   return pos;
 }
 
@@ -543,6 +545,7 @@ int cfs_truncate(void* h, const char* path, uint64_t size) {
 int cfs_flush(void* h, int fd) {
   (void)h;
   (void)fd;
+  g_nc_errno = 0;
   return 0;  // writes are synchronous through the gateway
 }
 

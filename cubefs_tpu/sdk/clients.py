@@ -165,6 +165,71 @@ class ClusterMgrClient(_Base):
         self._call("register_service", {"name": name, "addr": addr})
 
 
+class AuthClient(_Base):
+    """Ticket service surface (sdk/auth/api.go analog): key
+    registration and ticket issue against a running authnode role. The
+    proof is computed client-side from the registered key, so the
+    secret never travels on the ticket path."""
+
+    def register(self, id_: str) -> bytes:
+        import base64
+
+        return base64.b64decode(self._call("register", {"id": id_})[0]["key"])
+
+    def get_ticket(self, client_id: str, service_id: str,
+                   client_key: bytes) -> dict:
+        from ..fs.authnode import AuthNode
+
+        proof = AuthNode.client_proof(client_id, service_id, client_key)
+        return self._call("get_ticket", {
+            "client_id": client_id, "service_id": service_id,
+            "proof": proof})[0]
+
+    # AK/SK user registry surface (UserStore role)
+    def create_user(self, user_id: str) -> dict:
+        return self._call("create_user", {"user_id": user_id})[0]
+
+    def grant(self, ak: str, volume: str, perm: str = "rw") -> None:
+        self._call("grant", {"ak": ak, "volume": volume, "perm": perm})
+
+    def secret_for(self, ak: str) -> str | None:
+        return self._call("secret_for", {"ak": ak})[0]["sk"]
+
+
+class FlashClient(_Base):
+    """Remote-cache engine surface (sdk/remotecache analog): one
+    flashnode's cache ops."""
+
+    def cache_get(self, key: str) -> bytes:
+        return self._call("cache_get", {"key": key})[1]
+
+    def cache_put(self, key: str, data: bytes) -> None:
+        self._call("cache_put", {"key": key}, data)
+
+    def stats(self) -> dict:
+        return self._call("stats")[0]
+
+
+class FlashGroupClient(_Base):
+    """FlashGroupManager admin surface (flashgroupmanager role)."""
+
+    def register_group(self, group_id: int, addrs: list[str]) -> None:
+        self._call("register_group", {"group_id": group_id, "addrs": addrs})
+
+    def remove_group(self, group_id: int) -> None:
+        self._call("remove_group", {"group_id": group_id})
+
+    def set_group_status(self, group_id: int, status: str) -> None:
+        self._call("set_group_status", {"group_id": group_id,
+                                        "status": status})
+
+    def flashnode_heartbeat(self, addr: str) -> None:
+        self._call("flashnode_heartbeat", {"addr": addr})
+
+    def ring(self) -> dict:
+        return self._call("ring")[0]
+
+
 class AccessClient(_Base):
     """Blob gateway surface (api/access analog): put/get/delete against
     a RUNNING access service. For an in-process embedded client with no

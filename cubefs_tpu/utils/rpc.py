@@ -31,6 +31,19 @@ class ServiceUnavailable(RpcError):
     pass
 
 
+def errno_error(errno_: int, msg: str) -> RpcError:
+    """THE errno-on-the-wire encoding, shared by every plane that maps
+    POSIX errnos onto RPC statuses: 400+errno for small errnos, except
+    that 404 (not-found pass-through) and 421 (leader redirect, whose
+    message is parsed as an address) are reserved transport codes — those
+    and errnos >= 100 (EDQUOT=122 must not collide with 5xx failover
+    semantics) ride 499 with an "errno=NN: " message prefix. Decoders:
+    fs/client.py MetaWrapper._call and native_client.cc status_to_errno."""
+    if errno_ < 99 and 400 + errno_ not in (404, 421):
+        return RpcError(400 + errno_, msg)
+    return RpcError(499, f"errno={errno_}: {msg}")
+
+
 def expose(obj) -> dict:
     """Collect rpc_* methods from a service object into a route table."""
     return {

@@ -173,3 +173,53 @@ def test_master_user_store_and_gateway_auth(tmp_path, rng):
             m.stop()
         for d in datas:
             d.stop()
+
+
+def test_auth_client_typed_surface(tmp_path):
+    """AuthClient against a live authnode role: key registration,
+    proof-based ticket issue, service-side verification (sdk/auth/api.go
+    analog), plus the AK/SK user-registry leg."""
+    from cubefs_tpu.fs.authnode import AuthNode, UserStore
+    from cubefs_tpu.sdk import AuthClient
+
+    node = AuthNode(data_dir=str(tmp_path / "auth"))
+    ac = AuthClient(node)
+    ckey = ac.register("client-a")
+    skey = ac.register("svc-meta")
+    out = ac.get_ticket("client-a", "svc-meta", ckey)
+    claims = AuthNode.verify_ticket(out["ticket"], skey, "svc-meta")
+    assert claims["client"] == "client-a"
+    # a wrong key yields a rejected proof -> 403
+    import pytest as _pytest
+
+    from cubefs_tpu.utils import rpc as rpclib
+
+    with _pytest.raises(rpclib.RpcError):
+        ac.get_ticket("client-a", "svc-meta", b"\x00" * 32)
+
+    users = AuthClient(UserStore())
+    cred = users.create_user("bob")
+    users.grant(cred["access_key"], "vol1")
+    assert users.secret_for(cred["access_key"]) == cred["secret_key"]
+    assert users.secret_for("nope") is None
+
+
+def test_flash_clients_typed_surface():
+    """FlashClient/FlashGroupClient drive a flashnode + group manager
+    (sdk/remotecache analog)."""
+    from cubefs_tpu.fs.remotecache import FlashGroupManager, FlashNode
+    from cubefs_tpu.sdk import FlashClient, FlashGroupClient
+
+    fc = FlashClient(FlashNode(capacity_bytes=10_000))
+    fc.cache_put("k1", b"payload")
+    assert fc.cache_get("k1") == b"payload"
+    assert fc.stats()["items"] == 1
+
+    fgc = FlashGroupClient(FlashGroupManager())
+    fgc.register_group(1, ["fn-a"])
+    fgc.register_group(2, ["fn-b"])
+    ring = fgc.ring()
+    assert set(ring["groups"]) == {"1", "2"}
+    fgc.set_group_status(2, "inactive")
+    fgc.remove_group(2)
+    assert set(fgc.ring()["groups"]) == {"1"}
