@@ -28,8 +28,18 @@ from .client import FileSystem, FsError
 class ObjectNode:
     def __init__(self, volumes: dict[str, FileSystem], host="127.0.0.1", port=0,
                  authenticator=None):
+        from . import s3ext
+
         self.volumes = dict(volumes)
         self.auth = authenticator
+        # STS issuer: ONE instance shared with the authenticator, so
+        # tokens issued here validate on later requests (sts.go role) —
+        # an authenticator constructed with its own (e.g. multi-gateway
+        # shared-key) Sts wins
+        self.sts = getattr(authenticator, "sts", None) or s3ext.Sts()
+        if authenticator is not None and getattr(
+                authenticator, "sts", None) is None:
+            authenticator.sts = self.sts
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -81,11 +91,21 @@ class ObjectNode:
                 already sent. Sets self._principal (None = anonymous)."""
                 # the handler object lives for a whole keep-alive
                 # connection: bucket config must be re-read per REQUEST
-                # or an ACL/policy revocation never reaches it
+                # or an ACL/policy revocation never reaches it (same for
+                # the temp-credential flag)
                 self._conf_cache = None
+                self._via_token = False
                 if outer.auth is None:
+                    from . import s3ext
+
                     n = int(self.headers.get("Content-Length") or 0)
                     self._stashed_body = self.rfile.read(n) if n else b""
+                    if (self.headers.get("x-amz-content-sha256")
+                            == s3ext.STREAMING_PAYLOAD):
+                        # no keys to verify the chain against: strip the
+                        # aws-chunked framing so the payload lands intact
+                        self._stashed_body = s3ext.strip_aws_chunked(
+                            self._stashed_body)
                     self._principal = None
                     return self._split()
                 ok, who, reason = outer.auth.authenticate(self)
@@ -309,14 +329,21 @@ class ObjectNode:
 
             def do_POST(self):
                 # multipart lifecycle: InitiateMultipartUpload (?uploads)
-                # and CompleteMultipartUpload (?uploadId=...)
+                # and CompleteMultipartUpload (?uploadId=...), plus the
+                # STS action surface (POST /) and browser POST policy
+                # uploads (multipart/form-data to the bucket)
                 begun = self._begin()
                 if begun is None:
                     return
                 bucket, key, query = begun
+                if not bucket:
+                    return self._sts_action()
                 fs = self._fs(bucket)
                 if fs is None:
                     return self._error(404, "NoSuchBucket", bucket)
+                ctype = self.headers.get("Content-Type", "")
+                if not key and ctype.startswith("multipart/form-data"):
+                    return self._post_policy_upload(bucket, fs, ctype)
                 if key and self._key_reserved(key):
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")
@@ -556,6 +583,97 @@ class ObjectNode:
                                   for k, c in errors)
                         + "</DeleteResult>").encode()
                 self._reply(200, body)
+
+            def _sts_action(self):
+                """POST / with Action=AssumeRole|GetSessionToken: issue
+                temporary credentials for the AUTHENTICATED caller
+                (sts.go role). Anonymous or policy-denied callers get
+                nothing."""
+                if outer.auth is not None and self._principal is None:
+                    return self._error(403, "AccessDenied",
+                                       "STS requires signed credentials")
+                if getattr(self, "_via_token", False):
+                    # temp creds must not mint fresh tokens, or a leaked
+                    # short-lived credential chains itself past expiry
+                    return self._error(403, "AccessDenied",
+                                       "cannot call STS with temporary "
+                                       "credentials")
+                form = urllib.parse.parse_qs(
+                    self._stashed_body.decode("utf-8", "replace"))
+                action = (form.get("Action") or [""])[0]
+                if action not in ("AssumeRole", "GetSessionToken"):
+                    return self._error(400, "InvalidAction",
+                                       action or "missing Action")
+                try:
+                    duration = int((form.get("DurationSeconds")
+                                    or ["3600"])[0])
+                except ValueError:
+                    return self._error(400, "InvalidRequest",
+                                       "malformed DurationSeconds")
+                cred = outer.sts.issue(self._principal or "anonymous",
+                                       duration)
+                import time as _time
+
+                exp_iso = _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         _time.gmtime(cred["expiration"]))
+                body = (
+                    f"<?xml version='1.0'?><{action}Response>"
+                    f"<{action}Result><Credentials>"
+                    f"<AccessKeyId>{cred['access_key']}</AccessKeyId>"
+                    f"<SecretAccessKey>{cred['secret_key']}</SecretAccessKey>"
+                    f"<SessionToken>{cred['session_token']}</SessionToken>"
+                    f"<Expiration>{exp_iso}</Expiration>"
+                    f"</Credentials></{action}Result>"
+                    f"</{action}Response>"
+                ).encode()
+                self._reply(200, body)
+
+            def _post_policy_upload(self, bucket, fs, ctype):
+                """Browser form upload (post_policy.go): authorization is
+                the policy document's signature, not the Authorization
+                header — verify it, honor its conditions, store `file`
+                under `key`."""
+                from . import s3ext
+
+                fields = s3ext.parse_multipart(self._stashed_body, ctype)
+                if "key" not in fields or "file" not in fields:
+                    return self._error(400, "InvalidRequest",
+                                       "form needs key and file fields")
+                key = fields["key"].decode("utf-8", "replace").replace(
+                    "${filename}", "upload")
+                if self._key_reserved(key):
+                    return self._error(403, "AccessDenied",
+                                       ".multipart is a reserved namespace")
+                if outer.auth is not None:
+                    ok, who = s3ext.verify_post_policy(
+                        fields, outer.auth.users.secret_for,
+                        implicit={"bucket": bucket})
+                    if not ok:
+                        return self._error(403, "AccessDenied", who)
+                    if not outer.auth.grant_ok(who, bucket, write=True):
+                        return self._error(403, "AccessDenied",
+                                           "no write grant for bucket")
+                try:
+                    outer._put_object(fs, key, fields["file"])
+                except FsError as e:
+                    if e.errno in (mn.ENOSPC, mn.EDQUOT):
+                        return self._error(507, "QuotaExceeded", str(e))
+                    return self._error(500, "InternalError", str(e))
+                status = 204
+                raw = fields.get("success_action_status")
+                if raw in (b"200", b"201", b"204"):
+                    status = int(raw)
+                etag = hashlib.md5(fields["file"]).hexdigest()
+                body = b""
+                if status == 201:
+                    body = (
+                        f"<?xml version='1.0'?><PostResponse>"
+                        f"<Bucket>{bucket}</Bucket><Key>{xs.escape(key)}"
+                        f"</Key><ETag>\"{etag}\"</ETag></PostResponse>"
+                    ).encode()
+                self._reply(status, body,
+                            headers={"ETag": f'"{etag}"',
+                                     **self._cors(bucket)})
 
             def do_HEAD(self):
                 begun = self._begin()

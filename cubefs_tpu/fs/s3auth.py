@@ -52,25 +52,45 @@ def canonical_request(method: str, path: str, query: str,
     ])
 
 
-def verify_v4(method: str, path: str, query: str, headers: dict[str, str],
-              payload: bytes, secret_for,
-              now: float | None = None) -> tuple[bool, str]:
-    """Returns (ok, access_key_or_reason). headers keys must be
-    lower-cased. secret_for(ak) -> sk | None."""
+def parse_v4_auth(headers: dict[str, str]) -> dict | None:
+    """Split an AWS4-HMAC-SHA256 Authorization header into its parts
+    (Credential fields, SignedHeaders, Signature) — shared by header
+    verification and the streaming-chunk seed extraction."""
     auth = headers.get("authorization", "")
     if not auth.startswith("AWS4-HMAC-SHA256 "):
-        return False, "missing AWS4-HMAC-SHA256 authorization"
+        return None
     parts = {}
     for item in auth[len("AWS4-HMAC-SHA256 "):].split(","):
         k, _, v = item.strip().partition("=")
         parts[k] = v
     try:
-        cred = parts["Credential"]
-        signed_headers = parts["SignedHeaders"].split(";")
-        signature = parts["Signature"]
-        ak, date, region, service, scope_term = cred.split("/", 4)
+        ak, date, region, service, _term = parts["Credential"].split("/", 4)
+        return {
+            "ak": ak, "date": date, "region": region, "service": service,
+            "signed_headers": parts["SignedHeaders"].split(";"),
+            "signature": parts["Signature"],
+        }
     except (KeyError, ValueError):
-        return False, "malformed authorization header"
+        return None
+
+
+def verify_v4(method: str, path: str, query: str, headers: dict[str, str],
+              payload: bytes, secret_for,
+              now: float | None = None,
+              payload_override: str | None = None) -> tuple[bool, str]:
+    """Returns (ok, access_key_or_reason). headers keys must be
+    lower-cased. secret_for(ak) -> sk | None. payload_override replaces
+    the body-hash check with a literal canonical payload hash — used for
+    STREAMING-AWS4-HMAC-SHA256-PAYLOAD where the body is authenticated
+    by the per-chunk signature chain instead (the CALLER must then run
+    that chain check)."""
+    parsed = parse_v4_auth(headers)
+    if parsed is None:
+        return False, "missing/malformed AWS4-HMAC-SHA256 authorization"
+    ak, date, region, service = (parsed["ak"], parsed["date"],
+                                 parsed["region"], parsed["service"])
+    signed_headers = parsed["signed_headers"]
+    signature = parsed["signature"]
     sk = secret_for(ak)
     if sk is None:
         return False, f"unknown access key {ak}"
@@ -88,7 +108,9 @@ def verify_v4(method: str, path: str, query: str, headers: dict[str, str],
     skew = abs((time.time() if now is None else now) - req_time)
     if skew > MAX_CLOCK_SKEW:
         return False, "request time too skewed (replay window exceeded)"
-    if "x-amz-content-sha256" in signed_headers:
+    if payload_override is not None:
+        payload_hash = payload_override
+    elif "x-amz-content-sha256" in signed_headers:
         payload_hash = headers.get("x-amz-content-sha256", "")
         if (payload_hash != "UNSIGNED-PAYLOAD"
                 and hashlib.sha256(payload).hexdigest() != payload_hash):
@@ -114,12 +136,15 @@ def verify_v4(method: str, path: str, query: str, headers: dict[str, str],
 
 def sign_v4(method: str, path: str, query: str, headers: dict[str, str],
             payload: bytes, ak: str, sk: str, amz_date: str,
-            region: str = "us-east-1", service: str = "s3") -> str:
+            region: str = "us-east-1", service: str = "s3",
+            payload_override: str | None = None) -> str:
     """Client-side signer (for tests and the CLI): returns the
     Authorization header value. headers must already include host and
-    x-amz-date (lower-case keys)."""
+    x-amz-date (lower-case keys). payload_override stands in for the
+    body hash (streaming-signed PUTs sign the literal
+    STREAMING-AWS4-HMAC-SHA256-PAYLOAD marker)."""
     date = amz_date[:8]
-    payload_hash = hashlib.sha256(payload).hexdigest()
+    payload_hash = payload_override or hashlib.sha256(payload).hexdigest()
     headers = dict(headers)
     headers.setdefault("x-amz-content-sha256", payload_hash)
     signed_headers = sorted(headers)
@@ -353,14 +378,18 @@ class S3V4Authenticator:
     authorization layer (ACL/policy) to judge. `__call__` keeps the
     legacy boolean authn+grant contract."""
 
-    def __init__(self, user_store, bucket_volume: dict[str, str] | None = None):
+    def __init__(self, user_store, bucket_volume: dict[str, str] | None = None,
+                 sts=None):
         self.users = user_store
         self.bucket_volume = bucket_volume or {}
+        self.sts = sts  # s3ext.Sts issuer for temporary credentials
 
     def authenticate(self, handler) -> tuple[bool, str | None, str]:
         """Returns (ok, principal, reason). ok=False means credentials
         were presented but are INVALID (reject 403); principal None with
         ok=True means anonymous."""
+        from . import s3ext
+
         n = int(handler.headers.get("Content-Length") or 0)
         # read + stash the body so the verb handler can reuse it
         body = handler.rfile.read(n) if n else b""
@@ -368,9 +397,60 @@ class S3V4Authenticator:
         parsed = urllib.parse.urlsplit(handler.path)
         headers = {k.lower(): v for k, v in handler.headers.items()}
         auth_hdr = headers.get("authorization", "")
+
+        # parse the Authorization header ONCE; every V4 leg below (token
+        # check, header verification, chunk-seed extraction) reuses it
+        v4 = (parse_v4_auth(headers)
+              if auth_hdr.startswith("AWS4-HMAC-SHA256 ") else None)
+
+        # temporary credentials: the session token resolves to a derived
+        # temp secret; the principal is the PARENT key (grants follow it)
+        secret_for = self.users.secret_for
+        principal_map = None
+        token = headers.get("x-amz-security-token")
+        if token is not None and v4 is not None:
+            if self.sts is None:
+                return False, None, "session tokens not enabled"
+            claims = self.sts.resolve(token)
+            if claims is None:
+                return False, None, "invalid/expired session token"
+            if "x-amz-security-token" not in v4["signed_headers"]:
+                # an unsigned token header proves nothing: reject
+                return False, None, "x-amz-security-token must be signed"
+            tak, tsk = claims["tak"], claims["sk"]
+            secret_for = lambda ak: tsk if ak == tak else None  # noqa: E731
+            principal_map = {tak: claims["pak"]}
+            handler._via_token = True  # STS endpoint refuses chaining
+
         if auth_hdr.startswith("AWS4-HMAC-SHA256 "):
-            ok, who = verify_v4(handler.command, parsed.path, parsed.query,
-                                headers, body, self.users.secret_for)
+            streaming = (headers.get("x-amz-content-sha256")
+                         == s3ext.STREAMING_PAYLOAD)
+            ok, who = verify_v4(
+                handler.command, parsed.path, parsed.query, headers, body,
+                secret_for,
+                payload_override=s3ext.STREAMING_PAYLOAD if streaming else None)
+            if ok and streaming:
+                # header signature only covers the headers; the body is
+                # authenticated chunk-by-chunk against the seed signature
+                want = headers.get("x-amz-decoded-content-length")
+                try:
+                    want_n = None if want is None else int(want)
+                except ValueError:
+                    return False, None, "malformed x-amz-decoded-content-length"
+                sk = secret_for(v4["ak"])
+                key = signing_key(sk, v4["date"], v4["region"], v4["service"])
+                scope = (f"{v4['date']}/{v4['region']}/{v4['service']}"
+                         f"/aws4_request")
+                cok, out = s3ext.verify_aws_chunked(
+                    body, v4["signature"], key,
+                    headers.get("x-amz-date", ""), scope)
+                if not cok:
+                    return False, None, str(out)
+                if want_n is not None and want_n != len(out):
+                    return False, None, "decoded length mismatch"
+                handler._stashed_body = out
+            if ok and principal_map is not None:
+                who = principal_map.get(who, who)
             return (ok, who if ok else None, "" if ok else who)
         if auth_hdr.startswith("AWS "):
             ok, who = verify_v2(handler.command, parsed.path, parsed.query,

@@ -159,11 +159,16 @@ _UNPACK_BUDGET_BYTES = 512 << 20
 def _crc_block_fn(block_len: int, chunk_len: int, micro: int):
     if block_len % chunk_len:
         raise ValueError(f"block_len {block_len} % chunk_len {chunk_len} != 0")
-    const_bits = jnp.asarray(_state_bits(crc32_zeros(block_len)), dtype=jnp.int32)
+    # numpy on purpose: this closure is functools.cache'd, so a
+    # jnp.asarray here could be a TRACER if the first call happens
+    # inside an outer jit trace — memoized, it poisons every later call
+    # (UnexpectedTracerError). A numpy constant is lifted into whatever
+    # trace is active at call time instead.
+    const_bits = _state_bits(crc32_zeros(block_len)).astype(np.int32)
 
     def one(blocks: jax.Array) -> jax.Array:
         linear = linear_crc_bits(blocks, chunk_len)
-        return pack_crc_bits(linear ^ const_bits[None, :])
+        return pack_crc_bits(linear ^ jnp.asarray(const_bits)[None, :])
 
     @jax.jit
     def crc(blocks: jax.Array) -> jax.Array:

@@ -464,3 +464,277 @@ def test_lifecycle_legacy_prefix_and_strict_days(gateway):
                b"</LifecycleConfiguration>")
         assert _signed("PUT", f"{base}/bkt?lifecycle", owner, doc)[0] == 400
     _signed("DELETE", f"{base}/bkt?lifecycle", owner)
+
+
+# ---------------- interop edges: streaming sig, POST policy, STS -------
+
+def _streaming_put(url, cred, payload, chunk=8192, tamper=False):
+    """Real-SDK-shaped streaming-signed PUT: header sig over the
+    STREAMING marker, body in aws-chunked framing with a chunk-signature
+    chain seeded by the header signature."""
+    from cubefs_tpu.fs import s3ext
+
+    parsed = urllib.parse.urlsplit(url)
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amz_date[:8]
+    headers = {
+        "host": parsed.netloc,
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": s3ext.STREAMING_PAYLOAD,
+        "x-amz-decoded-content-length": str(len(payload)),
+        "content-encoding": "aws-chunked",
+    }
+    auth = s3auth.sign_v4("PUT", parsed.path, parsed.query, headers,
+                          b"", cred["access_key"], cred["secret_key"],
+                          amz_date,
+                          payload_override=s3ext.STREAMING_PAYLOAD)
+    seed = auth.rpartition("Signature=")[2]
+    key = s3auth.signing_key(cred["secret_key"], date, "us-east-1", "s3")
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    body = s3ext.build_aws_chunked(payload, chunk, seed, key, amz_date,
+                                   scope)
+    if tamper:
+        flip = body.find(b"\r\n") + 4  # inside the first chunk's data
+        body = body[:flip] + bytes([body[flip] ^ 0xFF]) + body[flip + 1:]
+    req = urllib.request.Request(url, data=body, method="PUT")
+    for k, v in headers.items():
+        if k != "host":
+            req.add_header(k, v)
+    req.add_header("Authorization", auth)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_streaming_chunked_put_roundtrip(gateway):
+    """aws-chunked STREAMING-AWS4-HMAC-SHA256-PAYLOAD PUT: the framing
+    is decoded, the chunk chain verified, and the DECODED payload stored
+    (auth_signature_chunk.go)."""
+    s3, owner, _, _ = gateway
+    payload = bytes(range(256)) * 150  # 38400 B, several chunks
+    st, _ = _streaming_put(f"http://{s3.addr}/bkt/stream.bin", owner,
+                           payload, chunk=8192)
+    assert st == 200
+    st, body, _ = _signed("GET", f"http://{s3.addr}/bkt/stream.bin", owner)
+    assert st == 200 and body == payload
+
+
+def test_streaming_chunked_tamper_rejected(gateway):
+    """A flipped byte inside a signed chunk breaks the chain -> 403,
+    nothing stored."""
+    s3, owner, _, _ = gateway
+    st, _ = _streaming_put(f"http://{s3.addr}/bkt/evil.bin", owner,
+                           b"A" * 20000, tamper=True)
+    assert st == 403
+    st, _, _ = _signed("GET", f"http://{s3.addr}/bkt/evil.bin", owner)
+    assert st == 404
+
+
+def _post_policy_form(bucket, key_prefix, filename, content, cred,
+                      conditions_extra=None, expires_in=300,
+                      success_status=None, sk_override=None):
+    import base64 as b64
+
+    from cubefs_tpu.fs import s3auth as sa
+
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amz_date[:8]
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    credential = f"{cred['access_key']}/{scope}"
+    policy = {
+        "expiration": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() + expires_in)),
+        "conditions": [
+            {"bucket": bucket},
+            ["starts-with", "$key", key_prefix],
+            {"x-amz-credential": credential},
+            {"x-amz-algorithm": "AWS4-HMAC-SHA256"},
+            {"x-amz-date": amz_date},
+            *(conditions_extra or []),
+        ],
+    }
+    policy_b64 = b64.b64encode(json.dumps(policy).encode()).decode()
+    import hmac as _hmac
+
+    key = sa.signing_key(sk_override or cred["secret_key"], date,
+                         "us-east-1", "s3")
+    sig = _hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+    fields = [
+        ("key", filename), ("policy", policy_b64),
+        ("x-amz-algorithm", "AWS4-HMAC-SHA256"),
+        ("x-amz-credential", credential), ("x-amz-date", amz_date),
+        ("x-amz-signature", sig),
+    ]
+    if success_status:
+        fields.append(("success_action_status", success_status))
+    boundary = "----testboundary42"
+    out = bytearray()
+    for name, value in fields:
+        out.extend(f"--{boundary}\r\nContent-Disposition: form-data; "
+                   f"name=\"{name}\"\r\n\r\n{value}\r\n".encode())
+    out.extend(f"--{boundary}\r\nContent-Disposition: form-data; "
+               f"name=\"file\"; filename=\"f\"\r\n"
+               f"Content-Type: application/octet-stream\r\n\r\n".encode())
+    out.extend(content)
+    out.extend(f"\r\n--{boundary}--\r\n".encode())
+    return bytes(out), f"multipart/form-data; boundary={boundary}"
+
+
+def test_post_policy_upload(gateway):
+    """Browser form upload: policy signature authorizes the write
+    (post_policy.go); the object lands under the form's key."""
+    s3, owner, _, _ = gateway
+    body, ctype = _post_policy_form(
+        "bkt", "uploads/", "uploads/browser.bin", b"form-bytes", owner,
+        conditions_extra=[["content-length-range", 1, 1024]],
+        success_status="201")
+    req = urllib.request.Request(f"http://{s3.addr}/bkt", data=body,
+                                 method="POST")
+    req.add_header("Content-Type", ctype)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 201
+        assert b"<PostResponse>" in r.read()
+    st, got, _ = _signed("GET", f"http://{s3.addr}/bkt/uploads/browser.bin",
+                         owner)
+    assert st == 200 and got == b"form-bytes"
+
+
+def test_post_policy_violations_rejected(gateway):
+    """Key outside the policy prefix, oversize file, or a forged
+    signature each fail with 403 and store nothing."""
+    s3, owner, other, _ = gateway
+    cases = []
+    # key violates starts-with
+    cases.append(_post_policy_form("bkt", "uploads/", "escape.bin",
+                                   b"x", owner))
+    # content-length-range violated
+    cases.append(_post_policy_form(
+        "bkt", "uploads/", "uploads/big.bin", b"y" * 64, owner,
+        conditions_extra=[["content-length-range", 1, 8]]))
+    # signed with the wrong secret
+    cases.append(_post_policy_form("bkt", "uploads/", "uploads/forged.bin",
+                                   b"z", owner, sk_override="not-the-key"))
+    # signer authenticated but has no grant on the bucket
+    cases.append(_post_policy_form("bkt", "uploads/", "uploads/nogrant.bin",
+                                   b"w", other))
+    for body, ctype in cases:
+        req = urllib.request.Request(f"http://{s3.addr}/bkt", data=body,
+                                     method="POST")
+        req.add_header("Content-Type", ctype)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert False, f"expected 403, got {r.status}"
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+
+
+def test_sts_assume_role_and_temp_credentials(gateway):
+    """STS flow: an authenticated caller gets temporary credentials; a
+    request signed with them (token header signed too) carries the
+    PARENT's grants; a tampered token is rejected (sts.go)."""
+    s3, owner, _, _ = gateway
+    form = urllib.parse.urlencode({"Action": "AssumeRole",
+                                   "DurationSeconds": "3600"}).encode()
+    st, body, _ = _signed("POST", f"http://{s3.addr}/", owner, form)
+    assert st == 200, body
+    text = body.decode()
+
+    def field(tag):
+        return text.split(f"<{tag}>")[1].split(f"</{tag}>")[0]
+
+    temp = {"access_key": field("AccessKeyId"),
+            "secret_key": field("SecretAccessKey")}
+    token = field("SessionToken")
+    # temp creds + signed token header: write allowed via parent grants
+    st, _, _ = _signed("PUT", f"http://{s3.addr}/bkt/via-sts.bin", temp,
+                       b"sts-bytes",
+                       headers_extra={"x-amz-security-token": token})
+    assert st == 200
+    st, got, _ = _signed("GET", f"http://{s3.addr}/bkt/via-sts.bin", owner)
+    assert st == 200 and got == b"sts-bytes"
+    # tampered token -> 403
+    bad = token[:-8] + ("AAAAAAAA" if token[-8:] != "AAAAAAAA"
+                        else "BBBBBBBB")
+    st, _, _ = _signed("PUT", f"http://{s3.addr}/bkt/evil2.bin", temp,
+                       b"no", headers_extra={"x-amz-security-token": bad})
+    assert st == 403
+    # temp creds WITHOUT the token header are unknown keys -> 403
+    st, _, _ = _signed("PUT", f"http://{s3.addr}/bkt/evil3.bin", temp, b"no")
+    assert st == 403
+
+
+def test_sts_requires_authentication_and_expiry(gateway):
+    """Anonymous STS requests are refused; expired tokens stop
+    resolving."""
+    s3, owner, _, _ = gateway
+    form = urllib.parse.urlencode({"Action": "AssumeRole"}).encode()
+    st, _, _ = _anon("POST", f"http://{s3.addr}/", form)
+    assert st == 403
+    from cubefs_tpu.fs.s3ext import Sts
+
+    sts = Sts()
+    cred = sts.issue("parent", duration=1000, now=1000.0)
+    assert sts.resolve(cred["session_token"], now=1500.0) is not None
+    assert sts.resolve(cred["session_token"], now=10_000.0) is None
+
+
+def test_post_policy_preserves_trailing_newlines(gateway):
+    """Multipart parsing must strip only framing CRLF, never the
+    payload's own trailing newline bytes."""
+    s3, owner, _, _ = gateway
+    content = b"line one\nline two\r\n"
+    body, ctype = _post_policy_form("bkt", "nl/", "nl/keep.txt", content,
+                                    owner)
+    req = urllib.request.Request(f"http://{s3.addr}/bkt", data=body,
+                                 method="POST")
+    req.add_header("Content-Type", ctype)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    st, got, _ = _signed("GET", f"http://{s3.addr}/bkt/nl/keep.txt", owner)
+    assert st == 200 and got == content
+
+
+def test_sts_refuses_chaining_and_bad_length_header(gateway):
+    """Temp creds cannot mint fresh tokens (expiry would be
+    unenforceable); a malformed x-amz-decoded-content-length is a clean
+    403, not a dropped connection."""
+    s3, owner, _, _ = gateway
+    form = urllib.parse.urlencode({"Action": "GetSessionToken"}).encode()
+    st, body, _ = _signed("POST", f"http://{s3.addr}/", owner, form)
+    assert st == 200
+    text = body.decode()
+
+    def field(tag):
+        return text.split(f"<{tag}>")[1].split(f"</{tag}>")[0]
+
+    temp = {"access_key": field("AccessKeyId"),
+            "secret_key": field("SecretAccessKey")}
+    token = field("SessionToken")
+    st, _, _ = _signed("POST", f"http://{s3.addr}/", temp, form,
+                       headers_extra={"x-amz-security-token": token})
+    assert st == 403  # chaining refused
+    # malformed decoded-content-length on a streaming PUT
+    from cubefs_tpu.fs import s3ext
+
+    parsed = urllib.parse.urlsplit(f"http://{s3.addr}/bkt/x.bin")
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    headers = {"host": parsed.netloc, "x-amz-date": amz_date,
+               "x-amz-content-sha256": s3ext.STREAMING_PAYLOAD,
+               "x-amz-decoded-content-length": "not-a-number"}
+    auth = s3auth.sign_v4("PUT", parsed.path, "", headers, b"",
+                          owner["access_key"], owner["secret_key"],
+                          amz_date, payload_override=s3ext.STREAMING_PAYLOAD)
+    req = urllib.request.Request(f"http://{s3.addr}/bkt/x.bin",
+                                 data=b"0;chunk-signature=ab\r\n\r\n",
+                                 method="PUT")
+    for k, v in headers.items():
+        if k != "host":
+            req.add_header(k, v)
+    req.add_header("Authorization", auth)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert False, f"expected 403, got {r.status}"
+    except urllib.error.HTTPError as e:
+        assert e.code == 403
