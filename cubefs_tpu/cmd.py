@@ -69,6 +69,7 @@ def run_role(cfg: dict):
                      allow_single_node=bool(cfg.get("allow_single_node", False)),
                      data_dir=cfg.get("data_dir"),
                      me=cfg.get("me"), peers=cfg.get("peers"))
+        svc.start_quota_sweeper(float(cfg.get("quota_sweep_interval", 30.0)))
         return _serve(svc, cfg), svc
 
     if role == "metanode":

@@ -639,8 +639,14 @@ class ObjectNode:
                 if "key" not in fields or "file" not in fields:
                     return self._error(400, "InvalidRequest",
                                        "form needs key and file fields")
+                # S3 substitutes ${filename} with the file part's
+                # client-supplied name BEFORE evaluating conditions, so
+                # an eq/starts-with on $key sees the final key
+                filename = fields.get(".filename.file", b"upload").decode(
+                    "utf-8", "replace") or "upload"
                 key = fields["key"].decode("utf-8", "replace").replace(
-                    "${filename}", "upload")
+                    "${filename}", filename.rsplit("/", 1)[-1])
+                fields = {**fields, "key": key.encode()}
                 if self._key_reserved(key):
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")

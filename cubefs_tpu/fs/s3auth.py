@@ -452,6 +452,12 @@ class S3V4Authenticator:
             if ok and principal_map is not None:
                 who = principal_map.get(who, who)
             return (ok, who if ok else None, "" if ok else who)
+        if headers.get("x-amz-content-sha256") == s3ext.STREAMING_PAYLOAD:
+            # aws-chunked bodies are only defined for SigV4 header auth
+            # (the chunk chain needs a seed signature); admitting a V2 /
+            # presigned / anonymous streaming PUT would store the raw
+            # framing — chunk headers and signatures — as object bytes
+            return False, None, "streaming payload requires SigV4 header auth"
         if auth_hdr.startswith("AWS "):
             ok, who = verify_v2(handler.command, parsed.path, parsed.query,
                                 headers, self.users.secret_for)

@@ -147,7 +147,7 @@ def parse_multipart(body: bytes, content_type: str) -> dict[str, bytes]:
         if part.endswith(b"\r\n"):
             part = part[:-2]
         head, _, value = part.partition(b"\r\n\r\n")
-        name = None
+        name = filename = None
         for line in head.split(b"\r\n"):
             lo = line.decode("latin1")
             if lo.lower().startswith("content-disposition:"):
@@ -155,8 +155,14 @@ def parse_multipart(body: bytes, content_type: str) -> dict[str, bytes]:
                     item = item.strip()
                     if item.startswith("name="):
                         name = item[5:].strip('"')
+                    elif item.startswith("filename="):
+                        filename = item[9:].strip('"')
         if name:
             fields[name] = value
+            if filename is not None:
+                # reserved dotted key: S3's ${filename} substitution
+                # needs the upload part's client-supplied filename
+                fields[f".filename.{name}"] = filename.encode()
     return fields
 
 
@@ -209,32 +215,38 @@ def verify_post_policy(fields: dict[str, bytes], secret_for,
         return False, "policy has no valid expiration"
     if (time.time() if now is None else now) > exp:
         return False, "policy expired"
-    for cond in policy.get("conditions", []):
-        if isinstance(cond, dict):
-            items = [("eq", k, v) for k, v in cond.items()]
-        elif isinstance(cond, list) and len(cond) == 3:
-            items = [tuple(cond)]
-        else:
-            return False, f"malformed condition {cond!r}"
-        for op, k, v in items:
-            if op == "content-length-range":
-                n = len(fields.get("file", b""))
-                if not (int(k) <= n <= int(v)):
-                    return False, "content-length-range violated"
-                continue
-            name = str(k).lstrip("$").lower()
-            if implicit and name in implicit:
-                got = implicit[name]
+    try:
+        for cond in policy.get("conditions", []):
+            if isinstance(cond, dict):
+                items = [("eq", k, v) for k, v in cond.items()]
+            elif isinstance(cond, list) and len(cond) == 3:
+                items = [tuple(cond)]
             else:
-                got = fields.get(name, b"").decode("utf-8", "replace")
-            if op == "eq":
-                if got != v:
-                    return False, f"condition eq failed for {name}"
-            elif op == "starts-with":
-                if not got.startswith(v):
-                    return False, f"condition starts-with failed for {name}"
-            else:
-                return False, f"unsupported condition op {op!r}"
+                return False, f"malformed condition {cond!r}"
+            for op, k, v in items:
+                if op == "content-length-range":
+                    n = len(fields.get("file", b""))
+                    if not (int(k) <= n <= int(v)):
+                        return False, "content-length-range violated"
+                    continue
+                name = str(k).lstrip("$").lower()
+                if implicit and name in implicit:
+                    got = implicit[name]
+                else:
+                    got = fields.get(name, b"").decode("utf-8", "replace")
+                if op == "eq":
+                    if got != v:
+                        return False, f"condition eq failed for {name}"
+                elif op == "starts-with":
+                    if not got.startswith(v):
+                        return False, f"condition starts-with failed for {name}"
+                else:
+                    return False, f"unsupported condition op {op!r}"
+    except (TypeError, ValueError):
+        # a correctly-signed but malformed policy (non-numeric range
+        # bounds, non-string values) is a REJECTION, not a crashed
+        # handler thread
+        return False, "malformed condition value"
     return True, ak
 
 

@@ -390,7 +390,7 @@ class RaftNode:
         def ask(peer):
             nonlocal votes
             try:
-                meta, _ = self.pool.get(peer).call(
+                meta, _ = self.pool.get_direct(peer).call(
                     f"raft_{self.group_id}_vote",
                     {"term": term, "candidate": self.me,
                      "last_index": last_index, "last_term": last_term},
@@ -442,8 +442,17 @@ class RaftNode:
         self.term = max(self.term, term)
         self.role = "follower"
         self.voted_for = None
+        self.leader = None  # stale self/old-leader would misroute redirects
         self._persist_meta()
-        self._last_heard = time.monotonic()
+        # do NOT reset the election timer here (Raft §5.2: only a GRANTED
+        # vote or a valid AppendEntries resets it — both callers set
+        # _last_heard themselves on those paths). Resetting on every
+        # higher-term RequestVote lets a log-behind candidate that can
+        # never win (§5.4.1 restriction) suppress this node's own
+        # election forever: a two-node livelock where the node with the
+        # committed log stays follower while the empty-log peer
+        # term-ratchets — observed over the HTTP transport, where a
+        # heartbeat gap is long enough for the empty peer to campaign.
         self._election_due = self._rand_timeout()
 
     # ---------------- replication ----------------
@@ -532,7 +541,7 @@ class RaftNode:
                 }
         try:
             if snapshot_args is not None:
-                meta, _ = self.pool.get(peer).call(
+                meta, _ = self.pool.get_direct(peer).call(
                     f"raft_{self.group_id}_snapshot", snapshot_args, timeout=5.0
                 )
                 with self._lock:
@@ -548,7 +557,7 @@ class RaftNode:
                             snapshot_args["index"])
                         self._apply_cv.notify_all()
                 return
-            meta, _ = self.pool.get(peer).call(
+            meta, _ = self.pool.get_direct(peer).call(
                 f"raft_{self.group_id}_append", args, timeout=1.0
             )
         except Exception:
@@ -804,7 +813,7 @@ class HeartbeatMux:
 
     def _send(self, addr: str, items: list) -> None:
         try:
-            meta, _ = self.pool.get(addr).call(
+            meta, _ = self.pool.get_direct(addr).call(
                 "raft_hb_batch",
                 {"items": [[gid, args] for gid, _, args in items]},
                 timeout=1.0)

@@ -236,17 +236,36 @@ class NodePool:
 
     def __init__(self):
         self._clients: dict[str, "Client"] = {}
+        self._direct: dict[str, "Client"] = {}
         self._lock = threading.Lock()
 
     def bind(self, addr: str, target) -> None:
         with self._lock:
             self._clients[addr] = Client(target)
+            self._direct.pop(addr, None)
 
     def get(self, addr: str) -> "Client":
         with self._lock:
             if addr not in self._clients:
                 self._clients[addr] = Client(addr)  # HTTP
             return self._clients[addr]
+
+    def get_direct(self, addr: str) -> "Client":
+        """Client that never follows leader redirects — REQUIRED for
+        point-to-point protocols (raft vote/append/heartbeat). The
+        default client's learned-leader cache is per address and shared
+        with the SDKs, so a 421 learned from a data/meta op would
+        silently reroute raft messages addressed to a follower back to
+        the leader — the leader then receives its own heartbeat, sees a
+        'peer' claiming leadership at its own term, and steps down (a
+        livelock observed on multi-group HTTP topologies)."""
+        with self._lock:
+            c = self._clients.get(addr)
+            if c is not None and c._target is not None:
+                return c  # in-process: no redirect cache exists
+            if addr not in self._direct:
+                self._direct[addr] = Client(addr, follow_redirects=False)
+            return self._direct[addr]
 
 
 class Client:
@@ -256,9 +275,10 @@ class Client:
     is the test fixture analog of the reference's mocktest servers.
     """
 
-    def __init__(self, target):
+    def __init__(self, target, follow_redirects: bool = True):
         self._target = None
         self._addr = None
+        self._follow = follow_redirects
         if isinstance(target, str):
             self._addr = target
         elif isinstance(target, RpcServer):
@@ -283,6 +303,10 @@ class Client:
                 # is a 500, never a raw exception leaking into (and
                 # killing) the caller's thread
                 raise RpcError(500, f"{type(e).__name__}: {e}") from e
+        if not self._follow:
+            # point-to-point mode: the message is for THIS address, a
+            # 421 is a response, not a routing instruction
+            return call(self._addr, method, args, body, timeout)
         # leader redirects (421 with "leader=<addr>") are followed
         # transparently and the learned leader is preferred afterwards,
         # so a clustermgr failover never strands access/blobnode clients
@@ -345,6 +369,16 @@ def call_replicas(pool: NodePool, addrs: list[str], method: str,
                 elif not leader:  # election in progress: retry shortly
                     _t.sleep(0.05)
                     queue.append(addr)
+                last = e
+                continue
+            if e.code == 503 and "leader unresolved" in e.message:
+                # a fresh/failed-over raft group mid-election: the node
+                # is ALIVE, just leaderless — wait it out within the
+                # deadline instead of declaring the replica dead (a new
+                # 2-replica partition would otherwise 503 its first
+                # client ops for the whole election)
+                _t.sleep(0.1)
+                queue.append(addr)
                 last = e
                 continue
             if isinstance(e, ServiceUnavailable) or e.code >= 500 or e.code == 404:

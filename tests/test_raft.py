@@ -31,8 +31,7 @@ class FlakyPool(NodePool):
         super().__init__()
         self.down: set[str] = set()
 
-    def get(self, addr):
-        client = super().get(addr)
+    def _wrap(self, addr, client):
         outer = self
 
         class Wrapped:
@@ -43,6 +42,14 @@ class FlakyPool(NodePool):
                 return client.call(method, args, body, timeout)
 
         return Wrapped()
+
+    def get(self, addr):
+        return self._wrap(addr, super().get(addr))
+
+    def get_direct(self, addr):
+        # raft's point-to-point transport rides get_direct: partitions
+        # must blackhole it too
+        return self._wrap(addr, super().get_direct(addr))
 
 
 def make_cluster(n=3, tmp=None, pool=None):
@@ -353,3 +360,84 @@ def test_wal_torn_tail_dropped(tmp_path):
         assert state3 == [{"i": i} for i in range(6)]
     finally:
         m3.node.stop()
+
+
+def test_direct_client_never_follows_leader_redirects():
+    """Raft transport rides NodePool.get_direct: a 421 must surface as
+    an error, never reroute the message — the shared default client's
+    learned-leader cache once hijacked raft appends addressed to a
+    follower back to the leader (self-heartbeat -> spurious step-down
+    livelock on HTTP topologies)."""
+    from cubefs_tpu.utils import rpc
+
+    class Svc:
+        def rpc_ping(self, args, body):
+            raise rpc.RpcError(421, "leader=127.0.0.1:1")
+
+    srv = rpc.RpcServer(Svc(), service="t").start()
+    try:
+        pool = NodePool()
+        direct = pool.get_direct(srv.addr)
+        with pytest.raises(rpc.RpcError) as ei:
+            direct.call("ping", timeout=5.0)
+        assert ei.value.code == 421  # surfaced, not followed
+        # poisoning the default client's leader cache must not affect
+        # the direct client (separate cache, separate instance)
+        default = pool.get(srv.addr)
+        default._leader = "127.0.0.1:1"
+        assert pool.get_direct(srv.addr) is direct
+    finally:
+        srv.stop()
+
+
+def test_http_raft_survives_poisoned_sdk_leader_cache():
+    """End-to-end regression for the livelock: a 2-node raft over REAL
+    HTTP where the SDK client for the follower has 'learned' the leader
+    address. Replication must still commit (raft traffic bypasses the
+    redirect cache)."""
+    from cubefs_tpu.utils import rpc
+
+    pool = NodePool()
+    applied_a, applied_b = [], []
+    routes_a, routes_b = {}, {}
+
+    class SvcA:
+        extra_routes = routes_a
+
+    class SvcB:
+        extra_routes = routes_b
+
+    srv_a = rpc.RpcServer(SvcA(), service="a").start()
+    srv_b = rpc.RpcServer(SvcB(), service="b").start()
+    members = [srv_a.addr, srv_b.addr]
+    node_a = raft.RaftNode("g9", srv_a.addr, members, applied_a.append, pool)
+    node_b = raft.RaftNode("g9", srv_b.addr, members, applied_b.append, pool)
+    raft.register_routes(routes_a, node_a)
+    raft.register_routes(routes_b, node_b)
+    node_a.start()
+    node_b.start()
+    try:
+        deadline = time.time() + 10
+        leader = None
+        while time.time() < deadline and leader is None:
+            for n in (node_a, node_b):
+                if n.status()["role"] == "leader":
+                    leader = n
+            time.sleep(0.05)
+        assert leader is not None, "no leader elected over HTTP"
+        follower_addr = (srv_b.addr if leader is node_a else srv_a.addr)
+        # the poison: an SDK-style 421 learned earlier on this address
+        pool.get(follower_addr)._leader = leader.me
+        for i in range(3):
+            leader.propose({"seq": i})
+        follower_applied = applied_b if leader is node_a else applied_a
+        deadline = time.time() + 5
+        while time.time() < deadline and len(follower_applied) < 3:
+            time.sleep(0.05)
+        assert [e.get("seq") for e in follower_applied
+                if "seq" in e] == [0, 1, 2]
+    finally:
+        node_a.stop()
+        node_b.stop()
+        srv_a.stop()
+        srv_b.stop()

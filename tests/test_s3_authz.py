@@ -738,3 +738,51 @@ def test_sts_refuses_chaining_and_bad_length_header(gateway):
             assert False, f"expected 403, got {r.status}"
     except urllib.error.HTTPError as e:
         assert e.code == 403
+
+
+def test_streaming_marker_requires_sigv4(gateway):
+    """Anonymous (or V2) requests carrying the streaming content-sha256
+    marker are rejected outright — nothing else can verify the chunk
+    chain, and admitting them would store the framing as object bytes."""
+    s3, owner, _, _ = gateway
+    from cubefs_tpu.fs import s3ext
+
+    st, _, _ = _anon("PUT", f"http://{s3.addr}/bkt/anon-stream.bin",
+                     b"5;chunk-signature=ab\r\nhello\r\n"
+                     b"0;chunk-signature=cd\r\n\r\n",
+                     headers={"x-amz-content-sha256":
+                              s3ext.STREAMING_PAYLOAD})
+    assert st == 403
+    st, _, _ = _signed("GET", f"http://{s3.addr}/bkt/anon-stream.bin", owner)
+    assert st == 404  # nothing stored
+
+
+def test_post_policy_filename_substitution(gateway):
+    """${filename} is replaced with the upload part's client filename
+    BEFORE conditions are evaluated (S3 semantics), and a malformed
+    condition in a correctly-signed policy is a 403, not a dropped
+    connection."""
+    s3, owner, _, _ = gateway
+    body, ctype = _post_policy_form(
+        "bkt", "docs/", "docs/${filename}", b"pdf-bytes", owner,
+        conditions_extra=[["eq", "$key", "docs/f"]])
+    # _post_policy_form sends filename="f" on the file part
+    req = urllib.request.Request(f"http://{s3.addr}/bkt", data=body,
+                                 method="POST")
+    req.add_header("Content-Type", ctype)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 204
+    st, got, _ = _signed("GET", f"http://{s3.addr}/bkt/docs/f", owner)
+    assert st == 200 and got == b"pdf-bytes"
+    # malformed content-length-range bounds: clean 403
+    body, ctype = _post_policy_form(
+        "bkt", "docs/", "docs/bad.bin", b"x", owner,
+        conditions_extra=[["content-length-range", "not", "numeric"]])
+    req = urllib.request.Request(f"http://{s3.addr}/bkt", data=body,
+                                 method="POST")
+    req.add_header("Content-Type", ctype)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert False, f"expected 403, got {r.status}"
+    except urllib.error.HTTPError as e:
+        assert e.code == 403
