@@ -304,6 +304,19 @@ class ClusterMgr(ReplicatedFsm):
         self.set_disk_status(args["disk_id"], args["status"])
         return {}
 
+    def rpc_list_disks(self, args, body):
+        with self._lock:
+            return {"disks": {str(k): v.to_dict()
+                              for k, v in self.disks.items()}}
+
+    def rpc_list_volumes(self, args, body):
+        with self._lock:
+            vols = self.volumes
+            status = args.get("status")
+            return {"volumes": {
+                str(k): v.to_dict() for k, v in vols.items()
+                if status is None or v.status == status}}
+
     def rpc_update_volume_unit(self, args, body):
         self.update_volume_unit(args["vid"], args["index"], args["disk_id"],
                                 args["chunk_id"], args["node_addr"])

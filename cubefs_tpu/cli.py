@@ -64,9 +64,20 @@ def main(argv=None):
     p_fs.add_argument("--vol", required=True)
 
     p_blob = sub.add_parser("blob")
-    p_blob.add_argument("action", choices=["put", "get", "delete", "stat"])
+    p_blob.add_argument("action",
+                        choices=["put", "get", "delete", "stat",
+                                 "vols", "disks", "disk-status",
+                                 "chunks", "compact"])
     p_blob.add_argument("args", nargs="*")
-    p_blob.add_argument("--access", required=True)
+    p_blob.add_argument("--access", help="access addr (put/get/delete/stat)")
+    p_blob.add_argument("--clustermgr",
+                        help="clustermgr addr (vols/disks/disk-status)")
+    p_blob.add_argument("--blobnode", help="blobnode addr (chunks/compact)")
+    p_blob.add_argument("--disk-id", type=int)
+    p_blob.add_argument("--chunk-id", type=int)
+    p_blob.add_argument("--status", type=int,
+                        help="disk status code (disk-status) or volume "
+                             "status filter (vols)")
 
     p_node = sub.add_parser("node")
     p_node.add_argument("action", choices=["list", "decommission"])
@@ -89,9 +100,35 @@ def main(argv=None):
     p_user.add_argument("--perm", default="rw", choices=["r", "rw"])
 
     p_tasks = sub.add_parser("tasks")
-    p_tasks.add_argument("action", choices=["list", "enable", "disable"])
+    p_tasks.add_argument("action",
+                         choices=["list", "enable", "disable", "stats"])
     p_tasks.add_argument("--scheduler", required=True)
     p_tasks.add_argument("--kind", help="task kind (for enable/disable)")
+
+    p_dp = sub.add_parser("dp")
+    p_dp.add_argument("action", choices=["view", "check", "raft-status"])
+    p_dp.add_argument("--master", help="master addr (view/check)")
+    p_dp.add_argument("--datanode", help="datanode addr (raft-status)")
+    p_dp.add_argument("--vol", help="volume name (view)")
+    p_dp.add_argument("--dp-id", type=int, help="partition id (raft-status)")
+
+    p_flash = sub.add_parser("flash")
+    p_flash.add_argument("action",
+                         choices=["ring", "register-group", "remove-group",
+                                  "set-status", "stats"])
+    p_flash.add_argument("--fgm", help="flashgroupmanager addr")
+    p_flash.add_argument("--flashnode", help="flashnode addr (stats)")
+    p_flash.add_argument("--group-id", type=int)
+    p_flash.add_argument("--addrs", help="comma-separated flashnode addrs")
+    p_flash.add_argument("--status", help="group status (set-status)")
+
+    p_auth = sub.add_parser("auth")
+    p_auth.add_argument("action", choices=["register", "ticket"])
+    p_auth.add_argument("--authnode", required=True)
+    p_auth.add_argument("--id", help="client/service id (register)")
+    p_auth.add_argument("--client-id")
+    p_auth.add_argument("--service-id")
+    p_auth.add_argument("--key", help="b64 client key (ticket)")
 
     args = ap.parse_args(argv)
     from .utils import rpc
@@ -210,14 +247,84 @@ def main(argv=None):
 
     elif args.group == "tasks":
         sched = rpc.Client(args.scheduler)
-        if args.action in ("enable", "disable") and not args.kind:
-            sys.exit(f"tasks {args.action} needs --kind")
-        out = sched.call("task_switch", {"action": args.action,
-                                         "kind": args.kind})[0]
+        if args.action == "stats":
+            out = sched.call("stats", {})[0]
+        else:
+            if args.action in ("enable", "disable") and not args.kind:
+                sys.exit(f"tasks {args.action} needs --kind")
+            out = sched.call("task_switch", {"action": args.action,
+                                             "kind": args.kind})[0]
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "dp":
+        if args.action == "raft-status":
+            if not args.datanode or args.dp_id is None:
+                sys.exit("dp raft-status needs --datanode and --dp-id")
+            out = rpc.call(args.datanode, "dp_raft_status",
+                           {"dp_id": args.dp_id})[0]
+        elif args.action == "view":
+            if not (args.master and args.vol):
+                sys.exit("dp view needs --master and --vol")
+            out = rpc.Client(args.master).call(
+                "dp_view", {"name": args.vol})[0]
+        else:  # check
+            if not args.master:
+                sys.exit("dp check needs --master")
+            out = rpc.Client(args.master).call("check_replicas", {})[0]
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "flash":
+        from .sdk import FlashClient, FlashGroupClient
+
+        if args.action == "stats":
+            if not args.flashnode:
+                sys.exit("flash stats needs --flashnode")
+            out = FlashClient(args.flashnode).stats()
+        else:
+            if not args.fgm:
+                sys.exit(f"flash {args.action} needs --fgm")
+            fgc = FlashGroupClient(args.fgm)
+            if args.action == "ring":
+                out = fgc.ring()
+            elif args.action == "register-group":
+                if args.group_id is None or not args.addrs:
+                    sys.exit("needs --group-id and --addrs")
+                fgc.register_group(args.group_id, args.addrs.split(","))
+                out = {"registered": args.group_id}
+            elif args.action == "remove-group":
+                if args.group_id is None:
+                    sys.exit("needs --group-id")
+                fgc.remove_group(args.group_id)
+                out = {"removed": args.group_id}
+            else:  # set-status
+                if args.group_id is None or not args.status:
+                    sys.exit("needs --group-id and --status")
+                fgc.set_group_status(args.group_id, args.status)
+                out = {"group": args.group_id, "status": args.status}
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "auth":
+        import base64
+
+        from .sdk import AuthClient
+
+        ac = AuthClient(args.authnode)
+        if args.action == "register":
+            if not args.id:
+                sys.exit("auth register needs --id")
+            out = {"id": args.id,
+                   "key": base64.b64encode(ac.register(args.id)).decode()}
+        else:  # ticket
+            if not (args.client_id and args.service_id and args.key):
+                sys.exit("auth ticket needs --client-id --service-id --key")
+            out = ac.get_ticket(args.client_id, args.service_id,
+                                base64.b64decode(args.key))
         print(json.dumps(out, indent=2))
 
     elif args.group == "blob":
         a = args.args
+        if args.action in ("put", "get", "delete", "stat") and not args.access:
+            sys.exit(f"blob {args.action} needs --access")
         if args.action == "put":
             data = open(a[0], "rb").read()
             meta, _ = rpc.call(args.access, "put", {}, data)
@@ -232,6 +339,31 @@ def main(argv=None):
             rpc.call(args.access, "delete", {"location": loc})
         elif args.action == "stat":
             print(json.dumps(rpc.call(args.access, "stat")[0], indent=2))
+        elif args.action in ("vols", "disks", "disk-status"):
+            if not args.clustermgr:
+                sys.exit(f"blob {args.action} needs --clustermgr")
+            cm_client = rpc.Client(args.clustermgr)
+            if args.action == "vols":
+                q = {} if args.status is None else {"status": args.status}
+                out = cm_client.call("list_volumes", q)[0]
+            elif args.action == "disks":
+                out = cm_client.call("list_disks", {})[0]
+            else:  # disk-status (offline/online a blob disk)
+                if args.disk_id is None or args.status is None:
+                    sys.exit("blob disk-status needs --disk-id and --status")
+                cm_client.call("set_disk_status", {
+                    "disk_id": args.disk_id, "status": args.status})
+                out = {"disk_id": args.disk_id, "status": args.status}
+            print(json.dumps(out, indent=2))
+        elif args.action in ("chunks", "compact"):
+            if not (args.blobnode and args.disk_id is not None
+                    and args.chunk_id is not None):
+                sys.exit(f"blob {args.action} needs --blobnode --disk-id "
+                         f"--chunk-id")
+            method = "list_chunk" if args.action == "chunks" else "compact_chunk"
+            out = rpc.call(args.blobnode, method, {
+                "disk_id": args.disk_id, "chunk_id": args.chunk_id})[0]
+            print(json.dumps(out, indent=2))
 
 
 if __name__ == "__main__":

@@ -825,13 +825,19 @@ class Master(ReplicatedFsm):
             raise rpc.RpcError(404, str(e)) from None
 
     def rpc_dp_view(self, args, body):
-        """All data partitions across volumes, keyed by dp_id — the
-        metanode free scan resolves freed extents' replicas from this
-        (metanode deletes extents server-side, partition_free_list.go)."""
+        """Data partitions keyed by dp_id — all volumes by default, or
+        one volume when args carries "name" (the CLI's dp view). The
+        metanode free scan resolves freed extents' replicas from the
+        unfiltered view (server-side deletes, partition_free_list.go)."""
         self._leader_gate()
+        name = args.get("name")
         with self._lock:
+            if name is not None and name not in self.volumes:
+                raise rpc.RpcError(404, f"no volume {name!r}")
+            vols = ([self.volumes[name]] if name is not None
+                    else self.volumes.values())
             dps = {}
-            for v in self.volumes.values():
+            for v in vols:
                 for dp in v["dps"]:
                     dps[str(dp["dp_id"])] = {
                         "dp_id": dp["dp_id"], "replicas": dp["replicas"]}

@@ -190,3 +190,106 @@ def test_console_panels(cluster, tmp_path):
     finally:
         con.stop()
         msrv.stop()
+
+
+def test_cli_dp_flash_auth_groups(cluster, capsys):
+    """The r2-VERDICT ops-depth pass: dp view/check/raft-status, flash
+    group admin, authnode ops — every surface reachable from cli.py."""
+    from cubefs_tpu import cli
+    from cubefs_tpu.fs.authnode import AuthNode
+    from cubefs_tpu.fs.remotecache import FlashGroupManager, FlashNode
+    from cubefs_tpu.utils import rpc as rpclib
+
+    msrv = rpclib.RpcServer(rpclib.expose(cluster.master),
+                            service="master").start()
+    dsrv = rpclib.RpcServer(cluster.datas[0], service="data0").start()
+    fgm_srv = rpclib.RpcServer(FlashGroupManager(), service="fgm").start()
+    fn_srv = rpclib.RpcServer(FlashNode(), service="fn").start()
+    auth_srv = rpclib.RpcServer(AuthNode(), service="auth").start()
+    try:
+        cli.main(["dp", "view", "--master", msrv.addr, "--vol", "opvol"])
+        out = json.loads(capsys.readouterr().out)
+        assert len(out["dps"]) == 3
+        # the view is per-volume: a second volume's dps must not leak in
+        cluster.master.create_volume("othervol", mp_count=1, dp_count=2)
+        cli.main(["dp", "view", "--master", msrv.addr, "--vol", "opvol"])
+        assert len(json.loads(capsys.readouterr().out)["dps"]) == 3
+        with pytest.raises(rpclib.RpcError):
+            rpclib.call(msrv.addr, "dp_view", {"name": "nope"})
+        cli.main(["dp", "check", "--master", msrv.addr])
+        assert "actions" in json.loads(capsys.readouterr().out)
+        dp_id = cluster.view["dps"][0]["dp_id"]
+        cli.main(["dp", "raft-status", "--datanode", dsrv.addr,
+                  "--dp-id", str(dp_id)])
+        assert "role" in json.loads(capsys.readouterr().out)["status"]
+
+        cli.main(["flash", "register-group", "--fgm", fgm_srv.addr,
+                  "--group-id", "1", "--addrs", "fn-a,fn-b"])
+        capsys.readouterr()
+        cli.main(["flash", "ring", "--fgm", fgm_srv.addr])
+        assert "1" in json.loads(capsys.readouterr().out)["groups"]
+        cli.main(["flash", "stats", "--flashnode", fn_srv.addr])
+        assert "items" in json.loads(capsys.readouterr().out)
+
+        cli.main(["auth", "register", "--authnode", auth_srv.addr,
+                  "--id", "cli-client"])
+        ckey = json.loads(capsys.readouterr().out)["key"]
+        cli.main(["auth", "register", "--authnode", auth_srv.addr,
+                  "--id", "svc"])
+        capsys.readouterr()
+        cli.main(["auth", "ticket", "--authnode", auth_srv.addr,
+                  "--client-id", "cli-client", "--service-id", "svc",
+                  "--key", ckey])
+        assert "ticket" in json.loads(capsys.readouterr().out)
+    finally:
+        for s in (msrv, dsrv, fgm_srv, fn_srv, auth_srv):
+            s.stop()
+
+
+def test_cli_blob_ops_groups(tmp_path, capsys, rng):
+    """blob vols/disks/disk-status/chunks/compact: the clustermgr- and
+    blobnode-side ops surface (reference: blobstore/cli grumble shell)."""
+    from cubefs_tpu import cli
+    from cubefs_tpu.blob.blobnode import BlobNode
+    from cubefs_tpu.blob.clustermgr import ClusterMgr
+    from cubefs_tpu.utils import rpc as rpclib
+
+    cm = ClusterMgr()
+    bn = BlobNode(1, [], addr="bn")
+    cm_srv = rpclib.RpcServer(cm, service="cm").start()
+    bn_srv = rpclib.RpcServer(bn, service="bn").start()
+    try:
+        disk_ids = []
+        for i in range(6):  # EC3P3 stripes across 6 distinct disks
+            did = cm.register_disk(bn_srv.addr, str(tmp_path / f"bn{i}"))
+            bn.attach_local(did, str(tmp_path / f"bn{i}"))
+            disk_ids.append(did)
+        disk_id = disk_ids[0]
+        vol = cm.alloc_volume(11)  # EC3P3
+        cli.main(["blob", "vols", "--clustermgr", cm_srv.addr])
+        vols = json.loads(capsys.readouterr().out)["volumes"]
+        assert str(vol.vid) in vols
+        cli.main(["blob", "disks", "--clustermgr", cm_srv.addr])
+        disks = json.loads(capsys.readouterr().out)["disks"]
+        assert str(disk_id) in disks
+        cli.main(["blob", "disk-status", "--clustermgr", cm_srv.addr,
+                  "--disk-id", str(disk_id), "--status", "2"])
+        capsys.readouterr()
+        assert cm.disks[disk_id].status == 2
+
+        # put a shard so the chunk listing has content
+        unit = vol.units[0]
+        payload = rng.integers(0, 256, 1024, dtype=np.uint8).tobytes()
+        bn.put_shard(unit.disk_id, unit.chunk_id, bid=7, data=payload)
+        cli.main(["blob", "chunks", "--blobnode", bn_srv.addr,
+                  "--disk-id", str(unit.disk_id),
+                  "--chunk-id", str(unit.chunk_id)])
+        shards = json.loads(capsys.readouterr().out)["shards"]
+        assert any(s[0] == 7 for s in shards)
+        cli.main(["blob", "compact", "--blobnode", bn_srv.addr,
+                  "--disk-id", str(unit.disk_id),
+                  "--chunk-id", str(unit.chunk_id)])
+        assert "reclaimed" in json.loads(capsys.readouterr().out)
+    finally:
+        cm_srv.stop()
+        bn_srv.stop()
