@@ -108,9 +108,12 @@ class ClusterMgr(ReplicatedFsm):
                         self.disks[d].chunk_count = chunk_counts[str(d)]
 
     def set_disk_status(self, disk_id: int, status: int) -> None:
+        # validate BEFORE the commit: a nonsense status in the replicated
+        # FSM strands the disk (neither allocatable nor repairable)
+        status = int(DiskStatus(status))
         with self._propose_lock:
             self._commit({"op": "set_disk_status", "disk_id": disk_id,
-                          "status": int(status)})
+                          "status": status})
 
     def _apply_set_disk_status(self, disk_id: int, status: int) -> None:
         self.disks[disk_id].status = int(status)
@@ -305,11 +308,13 @@ class ClusterMgr(ReplicatedFsm):
         return {}
 
     def rpc_list_disks(self, args, body):
+        self._leader_gate()  # replicated mode: no stale follower reads
         with self._lock:
             return {"disks": {str(k): v.to_dict()
                               for k, v in self.disks.items()}}
 
     def rpc_list_volumes(self, args, body):
+        self._leader_gate()
         with self._lock:
             vols = self.volumes
             status = args.get("status")

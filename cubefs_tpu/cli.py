@@ -246,14 +246,15 @@ def main(argv=None):
         print(json.dumps(out, indent=2))
 
     elif args.group == "tasks":
-        sched = rpc.Client(args.scheduler)
+        from .sdk import SchedulerClient
+
+        sched = SchedulerClient(args.scheduler)
         if args.action == "stats":
-            out = sched.call("stats", {})[0]
+            out = sched.stats()
         else:
             if args.action in ("enable", "disable") and not args.kind:
                 sys.exit(f"tasks {args.action} needs --kind")
-            out = sched.call("task_switch", {"action": args.action,
-                                             "kind": args.kind})[0]
+            out = sched.task_switch(args.action, args.kind)
         print(json.dumps(out, indent=2))
 
     elif args.group == "dp":
@@ -270,7 +271,9 @@ def main(argv=None):
         else:  # check
             if not args.master:
                 sys.exit("dp check needs --master")
-            out = rpc.Client(args.master).call("check_replicas", {})[0]
+            from .sdk import MasterClient
+
+            out = {"actions": MasterClient(args.master).check_replicas()}
         print(json.dumps(out, indent=2))
 
     elif args.group == "flash":

@@ -1,0 +1,151 @@
+package io.cubefs.tpu;
+
+import com.sun.jna.Native;
+import com.sun.jna.Pointer;
+
+/**
+ * High-level POSIX-style client over {@link CfsLibrary}.
+ *
+ * Role parity: io.cubefs.fs.CfsMount in the reference java/ SDK — same
+ * flag constants and method shapes; this framework's native boundary is
+ * the FsGateway daemon (host:port) instead of an embedded Go runtime,
+ * so the constructor takes the gateway address rather than a config
+ * map. All int-returning calls keep the C ABI's -errno convention.
+ */
+public class CfsMount implements AutoCloseable {
+    // Open flags (Linux values, the ABI contract)
+    public static final int O_RDONLY = 0;
+    public static final int O_WRONLY = 1;
+    public static final int O_RDWR = 2;
+    public static final int O_CREAT = 0100;
+    public static final int O_EXCL = 0200;
+    public static final int O_TRUNC = 01000;
+    public static final int O_APPEND = 02000;
+
+    // Whence
+    public static final int SEEK_SET = 0;
+    public static final int SEEK_CUR = 1;
+    public static final int SEEK_END = 2;
+
+    // Stat type codes (the gateway's fixed-layout stat record)
+    public static final int TYPE_FILE = 0;
+    public static final int TYPE_DIR = 1;
+    public static final int TYPE_SYMLINK = 2;
+
+    public static final int SUCCESS = 0;
+
+    private final CfsLibrary libcfs;
+    private final Pointer handle;
+
+    public CfsMount(String host, int port) {
+        this(host, port, "cubefs_rt");
+    }
+
+    public CfsMount(String host, int port, String libraryName) {
+        libcfs = Native.load(libraryName, CfsLibrary.class);
+        handle = libcfs.cfs_mount(host, port);
+        if (handle == null) {
+            throw new IllegalStateException(
+                "cfs_mount failed: " + libcfs.cfs_last_error());
+        }
+    }
+
+    public int open(String path, int flags, int mode) {
+        return libcfs.cfs_open(handle, path, flags, mode);
+    }
+
+    public int close(int fd) {
+        return libcfs.cfs_close(handle, fd);
+    }
+
+    public long read(int fd, byte[] buf) {
+        return libcfs.cfs_read(handle, fd, buf, buf.length);
+    }
+
+    public long pread(int fd, byte[] buf, long offset) {
+        return libcfs.cfs_pread(handle, fd, buf, buf.length, offset);
+    }
+
+    public long write(int fd, byte[] buf) {
+        return libcfs.cfs_write(handle, fd, buf, buf.length);
+    }
+
+    public long pwrite(int fd, byte[] buf, long offset) {
+        return libcfs.cfs_pwrite(handle, fd, buf, buf.length, offset);
+    }
+
+    public long lseek(int fd, long offset, int whence) {
+        return libcfs.cfs_lseek(handle, fd, offset, whence);
+    }
+
+    /** out[0]=size, out[1]=mtime seconds; returns type code or -errno. */
+    public int stat(String path, long[] out) {
+        long[] size = new long[1];
+        int[] mode = new int[1];
+        int[] type = new int[1];
+        long[] mtime = new long[1];
+        int rc = libcfs.cfs_stat_path(handle, path, size, mode, type, mtime);
+        if (rc != 0) {
+            return rc;
+        }
+        if (out != null && out.length >= 2) {
+            out[0] = size[0];
+            out[1] = mtime[0];
+        }
+        return type[0];
+    }
+
+    public int mkdirs(String path) {
+        return libcfs.cfs_mkdirs(handle, path);
+    }
+
+    /** Returns entry names, or null on failure (errno via lastErrno). */
+    public String[] readdir(String path) {
+        byte[] out = new byte[1 << 20];
+        long n = libcfs.cfs_readdir(handle, path, out, out.length);
+        if (n < 0) {
+            return null;
+        }
+        if (n == 0) {
+            return new String[0];
+        }
+        int end = 0;
+        while (end < out.length && out[end] != 0) {
+            end++;
+        }
+        return new String(out, 0, end).split("\n");
+    }
+
+    public int unlink(String path) {
+        return libcfs.cfs_unlink(handle, path);
+    }
+
+    public int rmdir(String path) {
+        return libcfs.cfs_rmdir(handle, path);
+    }
+
+    public int rename(String from, String to) {
+        return libcfs.cfs_rename(handle, from, to);
+    }
+
+    public int truncate(String path, long size) {
+        return libcfs.cfs_truncate(handle, path, size);
+    }
+
+    public int flush(int fd) {
+        return libcfs.cfs_flush(handle, fd);
+    }
+
+    public String lastError() {
+        return libcfs.cfs_last_error();
+    }
+
+    public int lastErrno() {
+        return libcfs.cfs_last_errno();
+    }
+
+    @Override
+    public void close() {
+        libcfs.cfs_unmount(handle);
+    }
+}

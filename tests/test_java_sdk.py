@@ -1,0 +1,103 @@
+"""Java SDK binding consistency: the JNA interface in java/ must match
+the C ABI it binds (runtime/src/native_client.cc) symbol-for-symbol and
+arity-for-arity — so the binding cannot drift even though the jar build
+is gated on a JDK that this image does not ship (reference: java/
+CfsLibrary.java over client/libsdk exports)."""
+
+import ctypes
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+
+from cubefs_tpu.runtime import build as rt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JAVA_IFACE = os.path.join(REPO, "java", "src", "main", "java", "io",
+                          "cubefs", "tpu", "CfsLibrary.java")
+JAVA_MOUNT = os.path.join(REPO, "java", "src", "main", "java", "io",
+                          "cubefs", "tpu", "CfsMount.java")
+NATIVE_SRC = os.path.join(REPO, "cubefs_tpu", "runtime", "src",
+                          "native_client.cc")
+
+
+def _java_methods() -> dict[str, int]:
+    """name -> parameter count for every method in CfsLibrary.java."""
+    src = open(JAVA_IFACE).read()
+    out = {}
+    for m in re.finditer(
+            r"^\s*(?:[\w\[\]]+)\s+(cfs_\w+)\s*\(([^)]*)\)\s*;",
+            src, re.MULTILINE | re.DOTALL):
+        name, params = m.group(1), m.group(2).strip()
+        out[name] = 0 if not params else len(params.split(","))
+    return out
+
+
+def _c_exports() -> dict[str, int]:
+    """name -> parameter count for every extern-C cfs_* export."""
+    src = open(NATIVE_SRC).read()
+    out = {}
+    for m in re.finditer(
+            r"^[ \t]*[\w \t\*]+?\b(cfs_\w+)\s*\(([^)]*)\)\s*\{",
+            src, re.MULTILINE | re.DOTALL):
+        name, params = m.group(1), m.group(2).strip()
+        if params in ("", "void"):
+            out[name] = 0
+        else:
+            out[name] = len(params.split(","))
+    return out
+
+
+def test_java_binding_matches_c_abi():
+    java = _java_methods()
+    c = _c_exports()
+    assert java, "no methods parsed from CfsLibrary.java"
+    missing = sorted(set(java) - set(c))
+    assert not missing, f"Java binds symbols the C ABI lacks: {missing}"
+    arity = {n: (java[n], c[n]) for n in java if java[n] != c[n]}
+    assert not arity, f"parameter-count mismatches (java, c): {arity}"
+    # the POSIX core must be fully bound, not a token subset
+    for required in ("cfs_mount", "cfs_open", "cfs_read", "cfs_write",
+                     "cfs_pread", "cfs_pwrite", "cfs_lseek",
+                     "cfs_stat_path", "cfs_mkdirs", "cfs_readdir",
+                     "cfs_unlink", "cfs_rename", "cfs_truncate",
+                     "cfs_last_errno"):
+        assert required in java, f"{required} not bound in CfsLibrary.java"
+
+
+def test_bound_symbols_exported_by_built_library():
+    lib = ctypes.CDLL(rt.build())
+    for name in _java_methods():
+        assert hasattr(lib, name), f"{name} missing from libcubefs_rt.so"
+
+
+def test_mount_wrapper_references_only_bound_methods():
+    """CfsMount may only call methods CfsLibrary declares."""
+    java = _java_methods()
+    src = open(JAVA_MOUNT).read()
+    used = set(re.findall(r"libcfs\.(cfs_\w+)\s*\(", src))
+    unbound = sorted(used - set(java))
+    assert not unbound, f"CfsMount calls unbound methods: {unbound}"
+
+
+@pytest.mark.skipif(shutil.which("javac") is None,
+                    reason="no JDK in this image (build is gated)")
+def test_java_sources_compile(tmp_path):
+    """When a JDK exists, the sources must at least parse/compile
+    against a stub JNA (full JNA not vendored)."""
+    stub = tmp_path / "com" / "sun" / "jna"
+    stub.mkdir(parents=True)
+    (stub / "Library.java").write_text(
+        "package com.sun.jna; public interface Library {}")
+    (stub / "Pointer.java").write_text(
+        "package com.sun.jna; public class Pointer {}")
+    (stub / "Native.java").write_text(
+        "package com.sun.jna; public class Native {"
+        " public static <T> T load(String n, Class<T> c) { return null; } }")
+    out = subprocess.run(
+        ["javac", "-cp", str(tmp_path), "-d", str(tmp_path),
+         JAVA_IFACE, JAVA_MOUNT],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
