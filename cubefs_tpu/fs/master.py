@@ -232,7 +232,8 @@ class Master(ReplicatedFsm):
             u = self.users.get(args["ak"])
             if u is None:
                 raise rpc.RpcError(404, f"unknown access key")
-            return {"sk": u["sk"], "volumes": dict(u["volumes"])}
+            return {"sk": u["sk"], "user_id": u.get("user_id", ""),
+                    "volumes": dict(u["volumes"])}
 
     # ---------------- quotas (master_quota_manager.go analog) ----------
     def _apply_set_vol_capacity(self, name: str, capacity: int) -> None:

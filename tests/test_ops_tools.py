@@ -293,3 +293,113 @@ def test_cli_blob_ops_groups(tmp_path, capsys, rng):
     finally:
         cm_srv.stop()
         bn_srv.stop()
+
+
+def test_console_graphql_admin_surface(cluster):
+    """The authenticated management surface (gapi_user.go +
+    console/service role): AK/SK login -> session token -> GraphQL
+    queries and mutations against the master; bad creds/tokens are
+    403s, never silent fall-through."""
+    import urllib.error
+    import urllib.request
+
+    from cubefs_tpu.fs.console import Console
+    from cubefs_tpu.utils import rpc as rpclib
+
+    msrv = rpclib.RpcServer(rpclib.expose(cluster.master),
+                            service="master").start()
+    con = Console(master_addr=msrv.addr).start()
+    try:
+        def post(path, obj, token=None):
+            req = urllib.request.Request(
+                f"http://{con.addr}{path}",
+                data=json.dumps(obj).encode(), method="POST",
+                headers={"Content-Type": "application/json",
+                         **({"X-Console-Token": token} if token else {})})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        cred = cluster.master.create_user("admin")
+        st, out = post("/api/login", {"access_key": cred["access_key"],
+                                      "secret_key": cred["secret_key"]})
+        assert st == 200
+        token = out["token"]
+        # token format is fixed-width MAC, never delimiter-split: every
+        # login must verify (the old b"|"-join failed ~12% of the time
+        # when the raw digest contained 0x7c)
+        for _ in range(30):
+            st2, out2 = post("/api/login",
+                             {"access_key": cred["access_key"],
+                              "secret_key": cred["secret_key"]})
+            assert st2 == 200
+            st2, _ = post("/api/graphql", {"query": "query { users }"},
+                          token=out2["token"])
+            assert st2 == 200
+        # wrong secret and garbage token are rejected
+        st, _ = post("/api/login", {"access_key": cred["access_key"],
+                                    "secret_key": "nope"})
+        assert st == 403
+        st, _ = post("/api/graphql", {"query": "query { users }"},
+                     token="AAAA")
+        assert st == 403
+        st, _ = post("/api/graphql", {"query": "query { users }"})
+        assert st == 403  # no token at all
+
+        # mutations: createUser -> grant -> visible in users query
+        st, out = post("/api/graphql", {
+            "query": 'mutation { createUser(userId: "bob") '
+                     '{ access_key secret_key } }'}, token=token)
+        assert st == 200, out
+        bob = out["data"]["createUser"]
+        assert set(bob) == {"access_key", "secret_key"}  # selection filter
+        st, out = post("/api/graphql", {
+            "query": f'mutation {{ grant(ak: "{bob["access_key"]}", '
+                     f'volume: "opvol", perm: "rw") {{ ok }} }}'},
+            token=token)
+        assert st == 200 and out["data"]["grant"]["ok"]
+        st, out = post("/api/graphql", {"query": "query { users }"},
+                       token=token)
+        assert bob["access_key"] in out["data"]["users"]
+        assert out["data"]["users"][bob["access_key"]]["volumes"] == {
+            "opvol": "rw"}
+
+        # volume ops with variables
+        st, out = post("/api/graphql", {
+            "query": "mutation { createVolume(name: $n, mpCount: 1, "
+                     "dpCount: 2) { name } }",
+            "variables": {"n": "gqlvol"}}, token=token)
+        assert st == 200, out
+        assert out["data"]["createVolume"]["name"] == "gqlvol"
+        # undefined variable is rejected up front, not forwarded as None
+        st, out = post("/api/graphql", {
+            "query": "mutation { createVolume(name: $typo) { name } }",
+            "variables": {"n": "x"}}, token=token)
+        assert st == 200 and "errors" in out
+        st, out = post("/api/graphql", {
+            "query": 'mutation { setVolCapacity(name: "gqlvol", '
+                     'capacity: 4096) { ok } }'}, token=token)
+        assert st == 200 and out["data"]["setVolCapacity"]["ok"]
+        assert cluster.master.volumes["gqlvol"]["capacity"] == 4096
+
+        # unknown field -> GraphQL-style errors array, not a 5xx
+        st, out = post("/api/graphql", {"query": "query { nope }"},
+                       token=token)
+        assert st == 200 and "errors" in out
+        # a NON-admin session can query but not mutate (gapi admin gate)
+        st, out = post("/api/login", {"access_key": bob["access_key"],
+                                      "secret_key": bob["secret_key"]})
+        assert st == 200
+        bob_token = out["token"]
+        st, out = post("/api/graphql", {"query": "query { volumes }"},
+                       token=bob_token)
+        assert st == 200 and "gqlvol" in out["data"]["volumes"]
+        st, out = post("/api/graphql", {
+            "query": f'mutation {{ deleteUser(ak: "{cred["access_key"]}")'
+                     f' {{ ok }} }}'}, token=bob_token)
+        assert st == 403
+    finally:
+        con.stop()
+        msrv.stop()
