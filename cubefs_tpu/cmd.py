@@ -148,9 +148,20 @@ def run_role(cfg: dict):
                     "volumes": dict(u.get("grants", {})),
                 }
             auth = S3V4Authenticator(store, dict(cfg.get("vols", {})))
+        sinks = []
+        if cfg.get("audit_webhook_url"):
+            from .fs.s3audit import WebhookAuditSink
+
+            sinks.append(WebhookAuditSink(cfg["audit_webhook_url"]))
+        if cfg.get("audit_queue_dir"):
+            from .blob.mq import MessageQueue
+            from .fs.s3audit import QueueAuditSink
+
+            sinks.append(QueueAuditSink(
+                MessageQueue(cfg["audit_queue_dir"], topic="s3audit")))
         node = ObjectNode(vols, host=cfg.get("listen_host", "127.0.0.1"),
                           port=int(cfg.get("listen_port", 0)),
-                          authenticator=auth).start()
+                          authenticator=auth, audit_sinks=sinks).start()
         print(f"[objectnode] S3 on {node.addr}", flush=True)
         return node, node
 

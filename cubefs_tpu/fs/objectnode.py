@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 import urllib.parse
 import xml.sax.saxutils as xs
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -27,11 +28,14 @@ from .client import FileSystem, FsError
 
 class ObjectNode:
     def __init__(self, volumes: dict[str, FileSystem], host="127.0.0.1", port=0,
-                 authenticator=None):
+                 authenticator=None, audit_sinks=None):
         from . import s3ext
 
         self.volumes = dict(volumes)
         self.auth = authenticator
+        # access-audit fan-out (audit_webhook.go / audit_kafka.go role):
+        # every reply emits one event to each sink, fire-and-forget
+        self.audit_sinks = list(audit_sinks or [])
         # STS issuer: ONE instance shared with the authenticator, so
         # tokens issued here validate on later requests (sts.go role) —
         # an authenticator constructed with its own (e.g. multi-gateway
@@ -65,8 +69,29 @@ class ObjectNode:
                 # on it would expose/corrupt other clients' uploads
                 return key.split("/", 1)[0] == ".multipart"
 
+            def _audit(self, code: int, bytes_out: int) -> None:
+                if not outer.audit_sinks:
+                    return
+                # emitted BEFORE the response write: a client hangup must
+                # not suppress the audit trail of a committed mutation
+                bucket, key = getattr(self, "_route", None) or \
+                    self._split()[:2]
+                event = {
+                    "ts": round(time.time(), 3),
+                    "method": self.command, "bucket": bucket,
+                    "key": key, "code": code,
+                    "principal": getattr(self, "_principal", None),
+                    "bytes_out": bytes_out,
+                    "bytes_in": len(getattr(self, "_stashed_body",
+                                            b"") or b""),
+                    "remote": self.client_address[0],
+                }
+                for sink in outer.audit_sinks:
+                    sink.emit(event)
+
             def _reply(self, code, body=b"", ctype="application/xml",
                        headers=None):
+                self._audit(code, len(body))
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -91,10 +116,14 @@ class ObjectNode:
                 already sent. Sets self._principal (None = anonymous)."""
                 # the handler object lives for a whole keep-alive
                 # connection: bucket config must be re-read per REQUEST
-                # or an ACL/policy revocation never reaches it (same for
-                # the temp-credential flag)
+                # or an ACL/policy revocation never reaches it — and the
+                # audit fields (principal, body, route) must never leak
+                # from the previous request into this one's events
                 self._conf_cache = None
                 self._via_token = False
+                self._principal = None
+                self._stashed_body = b""
+                self._route = self._split()[:2]
                 if outer.auth is None:
                     from . import s3ext
 
@@ -180,7 +209,10 @@ class ObjectNode:
             def do_OPTIONS(self):
                 # CORS preflight
                 self._conf_cache = None
+                self._principal = None
+                self._stashed_body = b""
                 bucket, key, _ = self._split()
+                self._route = (bucket, key)
                 origin = self.headers.get("Origin", "")
                 method = self.headers.get("Access-Control-Request-Method", "")
                 fs = self._fs(bucket)
@@ -706,6 +738,7 @@ class ObjectNode:
                     return self._error(404, "NoSuchKey", key)
                 # HEAD: standard Content-Length describes what GET would
                 # return; no body follows (RFC 9110)
+                self._audit(200, 0)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Content-Length", str(st["size"]))
@@ -909,3 +942,8 @@ class ObjectNode:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        for sink in self.audit_sinks:
+            try:
+                sink.close()  # flush buffered audit events
+            except Exception:
+                pass

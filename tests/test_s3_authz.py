@@ -786,3 +786,83 @@ def test_post_policy_filename_substitution(gateway):
             assert False, f"expected 403, got {r.status}"
     except urllib.error.HTTPError as e:
         assert e.code == 403
+
+
+def test_s3_audit_sinks(tmp_path):
+    """Every S3 reply fans an audit event to the configured sinks:
+    webhook (batched async POST, audit_webhook.go) and durable queue
+    (audit_kafka.go analog); a dead webhook never blocks requests."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from cubefs_tpu.blob.access import NodePool as _Pool
+    from cubefs_tpu.blob.mq import MessageQueue
+    from cubefs_tpu.fs.client import FileSystem as _FS
+    from cubefs_tpu.fs.datanode import DataNode as _DN
+    from cubefs_tpu.fs.master import Master as _Master
+    from cubefs_tpu.fs.metanode import MetaNode as _MN
+    from cubefs_tpu.fs.s3audit import QueueAuditSink, WebhookAuditSink
+
+    received = []
+
+    class Hook(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            received.extend(json.loads(self.rfile.read(n)))
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    hook = ThreadingHTTPServer(("127.0.0.1", 0), Hook)
+    hook.daemon_threads = True
+    threading.Thread(target=hook.serve_forever, daemon=True).start()
+    hook_url = f"http://127.0.0.1:{hook.server_address[1]}/audit"
+
+    pool = _Pool()
+    master = _Master(pool)
+    pool.bind("master", master)
+    for i in range(2):
+        n = _MN(i, addr=f"am{i}", node_pool=pool)
+        pool.bind(f"am{i}", n)
+        master.register_metanode(f"am{i}")
+    for i in range(3):
+        d = _DN(i, str(tmp_path / f"ad{i}"), f"ad{i}", pool)
+        pool.bind(f"ad{i}", d)
+        master.register_datanode(f"ad{i}")
+    fs = _FS(master.create_volume("audvol", mp_count=1, dp_count=2), pool)
+    mq = MessageQueue(str(tmp_path / "mq"), topic="s3audit")
+    s3 = ObjectNode({"bkt": fs},
+                    audit_sinks=[WebhookAuditSink(hook_url),
+                                 QueueAuditSink(mq)]).start()
+    try:
+        st, _, _ = _anon("PUT", f"http://{s3.addr}/bkt/a.txt", b"payload")
+        assert st == 200
+        st, _, _ = _anon("GET", f"http://{s3.addr}/bkt/a.txt")
+        assert st == 200
+        st, _, _ = _anon("GET", f"http://{s3.addr}/bkt/missing")
+        assert st == 404
+        st, _, _ = _anon("HEAD", f"http://{s3.addr}/bkt/a.txt")
+        assert st == 200  # success HEAD must be audited too
+        # queue sink is synchronous-durable: 4 events with full fields
+        events = [m for _, m in mq.poll(100)]
+        assert len(events) == 4
+        assert (events[3]["method"], events[3]["code"]) == ("HEAD", 200)
+        put_ev = events[0]
+        assert (put_ev["method"], put_ev["bucket"], put_ev["key"],
+                put_ev["code"]) == ("PUT", "bkt", "a.txt", 200)
+        assert put_ev["bytes_in"] == len(b"payload")
+        assert events[2]["code"] == 404
+        # webhook sink delivers asynchronously
+        deadline = time.time() + 5
+        while time.time() < deadline and len(received) < 4:
+            time.sleep(0.05)
+        assert len(received) == 4
+        # a DEAD webhook must not block or fail requests
+        hook.shutdown()
+        st, _, _ = _anon("GET", f"http://{s3.addr}/bkt/a.txt")
+        assert st == 200
+    finally:
+        s3.stop()
