@@ -54,12 +54,39 @@ class MessageQueue:
             end = min(self._offset + max_n, len(self._mem))
             return [(i, self._mem[i]) for i in range(self._offset, end)]
 
+    # acked prefix kept before compaction kicks in: bounds memory AND
+    # restart-replay cost for high-volume topics (per-request S3 audit)
+    COMPACT_THRESHOLD = 4096
+
     def ack(self, offset: int) -> None:
         with self._lock:
             self._offset = max(self._offset, offset + 1)
-            if self._offset_path:
+            if self._offset >= self.COMPACT_THRESHOLD:
+                self._compact_locked()
+            elif self._offset_path:
                 with open(self._offset_path, "w") as f:
                     f.write(str(self._offset))
+
+    def _compact_locked(self) -> None:
+        """Drop the acked prefix from memory and the log (tmp + replace,
+        then offset reset — a crash between steps replays at-least-once,
+        never loses unacked messages)."""
+        self._mem = self._mem[self._offset:]
+        self._offset = 0
+        if self._log is not None:
+            log_path = self._log.name
+            self._log.close()
+            tmp = log_path + ".tmp"
+            with open(tmp, "w") as f:
+                for msg in self._mem:
+                    f.write(json.dumps(msg) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, log_path)
+            self._log = open(log_path, "a")
+        if self._offset_path:
+            with open(self._offset_path, "w") as f:
+                f.write("0")
 
     def backlog(self) -> int:
         with self._lock:

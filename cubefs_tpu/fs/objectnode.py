@@ -91,7 +91,10 @@ class ObjectNode:
 
             def _reply(self, code, body=b"", ctype="application/xml",
                        headers=None):
-                self._audit(code, len(body))
+                # HEAD never writes the body: audit the bytes actually
+                # sent, or egress accounting over-counts every HEAD error
+                self._audit(code,
+                            0 if self.command == "HEAD" else len(body))
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))

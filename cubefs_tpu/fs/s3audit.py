@@ -62,14 +62,17 @@ class WebhookAuditSink:
                     batch.append(self._q.get_nowait())
                 except queue.Empty:
                     break
-            try:
-                req = urllib.request.Request(
-                    self.url, data=json.dumps(batch).encode(),
-                    headers={"Content-Type": "application/json"},
-                    method="POST")
-                urllib.request.urlopen(req, timeout=self.timeout).close()
-            except Exception:
-                audit_dropped.inc(sink="webhook", value=len(batch))
+            self._post(batch)
+
+    def _post(self, batch: list[dict]) -> None:
+        try:
+            req = urllib.request.Request(
+                self.url, data=json.dumps(batch).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            urllib.request.urlopen(req, timeout=self.timeout).close()
+        except Exception:
+            audit_dropped.inc(sink="webhook", value=len(batch))
 
     def close(self) -> None:
         """Graceful shutdown: flush buffered events (one final batch
@@ -84,15 +87,7 @@ class WebhookAuditSink:
             except queue.Empty:
                 break
         for i in range(0, len(pending), self.batch_size):
-            batch = pending[i:i + self.batch_size]
-            try:
-                req = urllib.request.Request(
-                    self.url, data=json.dumps(batch).encode(),
-                    headers={"Content-Type": "application/json"},
-                    method="POST")
-                urllib.request.urlopen(req, timeout=self.timeout).close()
-            except Exception:
-                audit_dropped.inc(sink="webhook", value=len(batch))
+            self._post(pending[i:i + self.batch_size])
 
 
 class QueueAuditSink:

@@ -28,6 +28,8 @@
 #include <unistd.h>
 #include <sys/stat.h>
 
+#include "bufpool.h"
+
 namespace {
 
 // ---------------- CRC32 (IEEE reflected), slicing-by-8 ----------------
@@ -404,16 +406,21 @@ int64_t cs_compact_chunk(void* h, uint64_t chunk_id) {
     return fail("compact hdr write", -1);
   uint64_t new_end = 0;
   std::map<uint64_t, ShardLoc> new_shards;
-  std::vector<uint8_t> buf;
+  // ONE pooled scratch for the whole pass, sized to the largest shard —
+  // per-iteration allocation (pooled or not) would be pure churn, and
+  // shards can exceed the pool's largest class
+  uint64_t max_size = 0;
+  for (auto& kv : c->shards)
+    max_size = std::max(max_size, (uint64_t)kv.second.size);
+  PoolBuf buf(max_size ? max_size : 1);
   for (auto& kv : c->shards) {
     const ShardLoc& loc = kv.second;
-    buf.resize(loc.size);
-    if (pread(c->data_fd, buf.data(), loc.size, (off_t)loc.offset) !=
+    if (pread(c->data_fd, buf.data, loc.size, (off_t)loc.offset) !=
         (ssize_t)loc.size)
       return fail("compact pread", -1);
-    if (crc32_ieee(0, buf.data(), loc.size) != loc.crc)
+    if (crc32_ieee(0, buf.data, loc.size) != loc.crc)
       return fail("compact crc mismatch (refusing to carry corruption)", -2);
-    if (pwrite(dfd, buf.data(), loc.size, (off_t)new_end) != (ssize_t)loc.size)
+    if (pwrite(dfd, buf.data, loc.size, (off_t)new_end) != (ssize_t)loc.size)
       return fail("compact pwrite", -1);
     IdxRec rec{kv.first, new_end, loc.size, loc.crc, 0, 0};
     rec.rec_crc = crc32_ieee(0, (const uint8_t*)&rec, sizeof rec - 4);

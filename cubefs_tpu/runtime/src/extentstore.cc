@@ -24,6 +24,8 @@
 #include <unistd.h>
 #include <sys/stat.h>
 
+#include "bufpool.h"
+
 namespace {
 
 constexpr uint64_t kBlockSize = 128 * 1024;  // util.BlockSize parity
@@ -146,16 +148,16 @@ bool persist_crc(EStore* s, Extent* e) {
 bool recrc_block(EStore* s, Extent* e, uint64_t b) {
   uint64_t off = b * kBlockSize;
   uint64_t len = std::min(kBlockSize, e->size - off);
-  std::vector<uint8_t> buf(len);
-  ssize_t rd = pread(e->data_fd, buf.data(), len, (off_t)off);
+  PoolBuf buf(len);  // pooled scratch: no per-recrc malloc churn
+  ssize_t rd = pread(e->data_fd, buf.data, len, (off_t)off);
   if (rd < 0) {
     es_set_err(s, "pread for recrc");
     return false;
   }
   if ((uint64_t)rd < len) {  // sparse tail: treat missing as zeros
-    memset(buf.data() + rd, 0, len - rd);
+    memset(buf.data + rd, 0, len - rd);
   }
-  e->block_crc[b] = crc32_ieee(0, buf.data(), len);
+  e->block_crc[b] = crc32_ieee(0, buf.data, len);
   return true;
 }
 
@@ -244,17 +246,17 @@ int64_t es_read(void* h, uint64_t extent_id, uint64_t off, uint8_t* buf,
   if ((uint64_t)rd < len) memset(buf + rd, 0, len - rd);
   // verify every touched block (read its full span from disk)
   uint64_t b0 = off / kBlockSize, b1 = (off + len - 1) / kBlockSize;
-  std::vector<uint8_t> tmp(kBlockSize);
+  PoolBuf tmp(kBlockSize);  // pooled: this runs on EVERY verified read
   for (uint64_t b = b0; b <= b1; b++) {
     uint64_t boff = b * kBlockSize;
     uint64_t blen = std::min(kBlockSize, e->size - boff);
-    ssize_t r2 = pread(e->data_fd, tmp.data(), blen, (off_t)boff);
+    ssize_t r2 = pread(e->data_fd, tmp.data, blen, (off_t)boff);
     if (r2 < 0) {
       es_set_err(s, "pread verify");
       return -1;
     }
-    if ((uint64_t)r2 < blen) memset(tmp.data() + r2, 0, blen - r2);
-    if (crc32_ieee(0, tmp.data(), blen) != e->block_crc[b]) {
+    if ((uint64_t)r2 < blen) memset(tmp.data + r2, 0, blen - r2);
+    if (crc32_ieee(0, tmp.data, blen) != e->block_crc[b]) {
       es_set_err(s, "block crc mismatch");
       return -2;
     }

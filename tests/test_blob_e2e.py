@@ -450,3 +450,26 @@ def test_repeated_failures_park_the_task(cluster, rng):
     task = next(iter(cluster.sched.tasks.values()))
     assert task["state"] == "parked"  # no infinite hot retry
     assert cluster.worker.run_once() is False  # nothing left to lease
+
+
+def test_mq_compacts_acked_prefix(tmp_path):
+    """High-volume topics (per-request S3 audit) must not grow without
+    bound: acking past the threshold trims memory AND the on-disk log,
+    and a restart replays only unacked messages."""
+    from cubefs_tpu.blob.mq import MessageQueue
+
+    mq = MessageQueue(str(tmp_path / "q"), topic="t")
+    mq.COMPACT_THRESHOLD = 100
+    for i in range(250):
+        mq.put({"i": i})
+    got = [m["i"] for _, m in mq.poll(120)]
+    assert got == list(range(120))
+    mq.ack(119)  # past threshold: compaction fires
+    assert mq.backlog() == 130
+    assert len(mq._mem) == 130  # acked prefix dropped from memory
+    # unacked tail intact, offsets renumbered
+    assert [m["i"] for _, m in mq.poll(5)] == [120, 121, 122, 123, 124]
+    # restart replays only the compacted log
+    mq2 = MessageQueue(str(tmp_path / "q"), topic="t")
+    assert mq2.backlog() == 130
+    assert [m["i"] for _, m in mq2.poll(3)] == [120, 121, 122]

@@ -161,3 +161,46 @@ def test_stale_generation_files_swept_at_open(tmp_path, rng):
         assert cs.get_shard(5, 2)[0] == b"keep"
         assert not os.path.exists(legacy)
         assert not os.path.exists(stray)
+
+
+def test_native_buffer_pool():
+    """The tcmalloc/resourcepool role: size-classed slab pool with
+    stats + release-free-memory ops surface (bufpool.cc)."""
+    import ctypes
+    import json as _json
+
+    from cubefs_tpu.runtime import build as rt
+
+    lib = ctypes.CDLL(rt.build())
+    lib.bp_alloc.restype = ctypes.c_void_p
+    lib.bp_alloc.argtypes = [ctypes.c_size_t]
+    lib.bp_free.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.bp_release_free_memory.restype = ctypes.c_size_t
+    lib.bp_stats_json.restype = ctypes.c_size_t
+    lib.bp_stats_json.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+
+    lib.bp_release_free_memory()  # clean slate across test ordering
+    # miss -> free -> hit on the same class
+    p1 = lib.bp_alloc(100_000)  # 128 KiB class
+    assert p1
+    lib.bp_free(p1, 100_000)
+    p2 = lib.bp_alloc(120_000)  # same class: must be a cache hit
+    assert p2 == p1
+    lib.bp_free(p2, 120_000)
+
+    out = ctypes.create_string_buffer(8192)
+    n = lib.bp_stats_json(out, 8192)
+    stats = _json.loads(out.value[:n])
+    cls = next(c for c in stats["classes"] if c["size"] == 128 * 1024)
+    assert cls["hits"] >= 1 and cls["cached"] >= 1
+    assert stats["held_bytes"] >= 128 * 1024
+
+    released = lib.bp_release_free_memory()
+    assert released >= 128 * 1024
+    n = lib.bp_stats_json(out, 8192)
+    assert _json.loads(out.value[:n])["held_bytes"] == 0
+
+    # oversize requests fall through to the system allocator
+    big = lib.bp_alloc(32 << 20)
+    assert big
+    lib.bp_free(big, 32 << 20)
