@@ -3,8 +3,9 @@ analog): every admin/data surface as a concrete Python API over the RPC
 wire, instead of hand-rolled method-name strings at call sites."""
 
 from .clients import (AccessClient, AuthClient, ClusterMgrClient,
-                      FlashClient, FlashGroupClient, MasterClient,
-                      SchedulerClient)
+                      ConsoleClient, FlashClient, FlashGroupClient,
+                      MasterClient, SchedulerClient)
 
 __all__ = ["MasterClient", "SchedulerClient", "ClusterMgrClient",
-           "AccessClient", "AuthClient", "FlashClient", "FlashGroupClient"]
+           "AccessClient", "AuthClient", "FlashClient", "FlashGroupClient",
+           "ConsoleClient"]

@@ -230,6 +230,64 @@ class FlashGroupClient(_Base):
         return self._call("ring")[0]
 
 
+class ConsoleClient:
+    """Console management surface (sdk/graphql analog): AK/SK login +
+    GraphQL queries/mutations over plain HTTP (the console is not an
+    RpcServer — it speaks browser-shaped JSON)."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self._token: str | None = None
+
+    def _post(self, path: str, obj: dict) -> dict:
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{self.addr}{path}", data=_json.dumps(obj).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     **({"X-Console-Token": self._token}
+                        if self._token else {})})
+        try:
+            with urllib.request.urlopen(req, timeout=15) as r:
+                return _json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            body = _json.loads(e.read() or b"{}")
+            raise rpc.RpcError(e.code, body.get("error", str(e))) from None
+
+    def login(self, access_key: str, secret_key: str) -> None:
+        self._token = self._post("/api/login", {
+            "access_key": access_key, "secret_key": secret_key})["token"]
+
+    def graphql(self, query: str, variables: dict | None = None):
+        out = self._post("/api/graphql", {"query": query,
+                                          "variables": variables or {}})
+        if "errors" in out:
+            raise rpc.RpcError(400, "; ".join(out["errors"]))
+        return out["data"]
+
+    # convenience wrappers over the mutation/query fields
+    def users(self) -> dict:
+        return self.graphql("query { users }")["users"]
+
+    def create_user(self, user_id: str) -> dict:
+        return self.graphql("mutation { createUser(userId: $u) "
+                            "{ access_key secret_key user_id } }",
+                            {"u": user_id})["createUser"]
+
+    def grant(self, ak: str, volume: str, perm: str = "rw") -> None:
+        self.graphql("mutation { grant(ak: $a, volume: $v, perm: $p) "
+                     "{ ok } }", {"a": ak, "v": volume, "p": perm})
+
+    def create_volume(self, name: str, mp_count: int = 3,
+                      dp_count: int = 4) -> dict:
+        return self.graphql(
+            "mutation { createVolume(name: $n, mpCount: $m, dpCount: $d) }",
+            {"n": name, "m": mp_count, "d": dp_count})["createVolume"]
+
+
 class AccessClient(_Base):
     """Blob gateway surface (api/access analog): put/get/delete against
     a RUNNING access service. For an in-process embedded client with no

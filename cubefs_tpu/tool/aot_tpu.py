@@ -169,6 +169,20 @@ def compile_judged_graphs(out_dir: Path | None = None) -> list[dict]:
             out_dir,
         )
     )
+    # config 4, fused pallas CRC linear stage, every tile candidate
+    from cubefs_tpu.ops import pallas_crc
+
+    for tb in pallas_crc.TILE_CANDIDATES:
+        records.append(
+            _compile_one(
+                f"crc32_pallas_10k_128kib_tb{tb}",
+                lambda a, tb=tb: pallas_crc.crc32_blocks_pallas(
+                    a, chunk_len=1024, tile_blocks=tb, interpret=False
+                ),
+                [arg((10_000, 128 << 10))],
+                out_dir,
+            )
+        )
     # config 5: fused repair_step (reconstruct + verify + CRC) graph
     records.append(
         _compile_one(

@@ -454,22 +454,38 @@ def test_repeated_failures_park_the_task(cluster, rng):
 
 def test_mq_compacts_acked_prefix(tmp_path):
     """High-volume topics (per-request S3 audit) must not grow without
-    bound: acking past the threshold trims memory AND the on-disk log,
-    and a restart replays only unacked messages."""
+    bound: acking past the threshold trims memory AND the on-disk log.
+    Offsets are ABSOLUTE: consumers holding pre-compaction offsets keep
+    acking safely (the renumbering design destroyed unacked messages
+    when an ack crossed the threshold mid-batch), and a crash between
+    the log rewrite and anything else replays at-least-once."""
     from cubefs_tpu.blob.mq import MessageQueue
 
     mq = MessageQueue(str(tmp_path / "q"), topic="t")
     mq.COMPACT_THRESHOLD = 100
     for i in range(250):
         mq.put({"i": i})
-    got = [m["i"] for _, m in mq.poll(120)]
-    assert got == list(range(120))
-    mq.ack(119)  # past threshold: compaction fires
-    assert mq.backlog() == 130
-    assert len(mq._mem) == 130  # acked prefix dropped from memory
-    # unacked tail intact, offsets renumbered
-    assert [m["i"] for _, m in mq.poll(5)] == [120, 121, 122, 123, 124]
-    # restart replays only the compacted log
+    # the scheduler's consume pattern: poll a batch, ack per message —
+    # compaction fires MID-BATCH and must not invalidate held offsets
+    batch1 = mq.poll(64)
+    batch2 = mq.poll(130)[64:130]  # offsets 64..129, held before acks
+    for off, _ in batch1:
+        mq.ack(off)
+    for off, _ in batch2:
+        mq.ack(off)  # crosses the threshold mid-way
+    assert mq.backlog() == 250 - 130
+    assert [m["i"] for _, m in mq.poll(5)] == [130, 131, 132, 133, 134]
+    assert len(mq._mem) < 250  # acked prefix actually dropped
+
+    # restart replays ONLY unacked messages, with absolute offsets
     mq2 = MessageQueue(str(tmp_path / "q"), topic="t")
-    assert mq2.backlog() == 130
-    assert [m["i"] for _, m in mq2.poll(3)] == [120, 121, 122]
+    assert mq2.backlog() == 120
+    assert [m["i"] for _, m in mq2.poll(3)] == [130, 131, 132]
+
+    # crash window: a restart that lost the offset-file write but kept
+    # the compacted log must not lose messages (base header bounds it)
+    import os
+    os.unlink(str(tmp_path / "q" / "t.offset"))
+    mq3 = MessageQueue(str(tmp_path / "q"), topic="t")
+    got = [m["i"] for _, m in mq3.poll(500)]
+    assert got[0] <= 130 and got[-1] == 249  # replay, never loss

@@ -99,3 +99,51 @@ def test_crc_blocks_micro_nondivisor_batch(rng, monkeypatch):
     got = np.asarray(crc32_kernel.crc32_blocks(blocks, chunk_len=64))
     expect = np.array([zlib.crc32(b.tobytes()) for b in blocks], dtype=np.uint32)
     assert np.array_equal(got, expect)
+
+
+def test_pallas_crc_bit_identical_to_zlib():
+    """The fused Pallas CRC linear stage (interpret mode off-TPU):
+    zlib-identical across chunk geometries, padding, and the
+    non-divisor chunk_len fit (ops/pallas_crc.py)."""
+    import zlib
+
+    from cubefs_tpu.ops import pallas_crc
+
+    rng = np.random.default_rng(13)
+    for b, block_len, chunk in ((5, 4096, 1024), (3, 8192, 512),
+                                (2, 131072, 1024), (4, 5000, 1024),
+                                (1, 1024, 1024)):
+        blocks = rng.integers(0, 256, (b, block_len), dtype=np.uint8)
+        got = np.asarray(pallas_crc.crc32_blocks_pallas(
+            blocks, chunk_len=chunk, tile_blocks=8))
+        want = np.array([zlib.crc32(r.tobytes()) for r in blocks],
+                        dtype=np.uint32)
+        assert np.array_equal(got, want), (b, block_len, chunk)
+
+
+def test_pallas_crc_matches_jnp_path_inside_jit():
+    """Pallas and jnp CRC agree when called inside an outer jit (the
+    bench chain shape), including the tracer-safety of the cached
+    fold/parts closures."""
+    import jax
+    import jax.numpy as jnp
+
+    from cubefs_tpu.ops import crc32_kernel, pallas_crc
+
+    rng = np.random.default_rng(17)
+    blocks = rng.integers(0, 256, (6, 16384), dtype=np.uint8)
+    f_pl = jax.jit(lambda a: pallas_crc.crc32_blocks_pallas(
+        a, chunk_len=1024, tile_blocks=8))
+    f_np = jax.jit(lambda a: crc32_kernel.crc32_blocks(a, chunk_len=1024))
+    a = jnp.asarray(blocks)
+    assert np.array_equal(np.asarray(f_pl(a)), np.asarray(f_np(a)))
+    # second fresh trace reuses the caches without tracer leaks
+    f_pl2 = jax.jit(lambda a: pallas_crc.crc32_blocks_pallas(
+        a, chunk_len=1024, tile_blocks=8))
+    assert np.array_equal(np.asarray(f_pl2(a)), np.asarray(f_np(a)))
+
+
+def test_pallas_crc_verify_tile_interpret():
+    from cubefs_tpu.ops import pallas_crc
+
+    assert pallas_crc.verify_tile(8192, 1024, 8)

@@ -223,3 +223,50 @@ def test_flash_clients_typed_surface():
     fgc.set_group_status(2, "inactive")
     fgc.remove_group(2)
     assert set(fgc.ring()["groups"]) == {"1"}
+
+
+def test_console_client_typed_surface(tmp_path):
+    """ConsoleClient (sdk/graphql analog) drives login + GraphQL admin
+    over the console's real HTTP surface."""
+    from cubefs_tpu.fs.console import Console
+    from cubefs_tpu.sdk import ConsoleClient
+    from cubefs_tpu.utils import rpc as rpclib
+
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas, datas = [], []
+    for i in range(2):
+        n = MetaNode(i, addr=f"cm{i}", node_pool=pool)
+        pool.bind(f"cm{i}", n)
+        master.register_metanode(f"cm{i}")
+        metas.append(n)
+    for i in range(3):
+        d = DataNode(i, str(tmp_path / f"cd{i}"), f"cd{i}", pool)
+        pool.bind(f"cd{i}", d)
+        master.register_datanode(f"cd{i}")
+        datas.append(d)
+    msrv = rpclib.RpcServer(rpclib.expose(master), service="master").start()
+    con = Console(master_addr=msrv.addr).start()
+    try:
+        root = master.create_user("root")
+        cc = ConsoleClient(con.addr)
+        # mutations before login are rejected
+        with pytest.raises(rpclib.RpcError):
+            cc.users()
+        cc.login(root["access_key"], root["secret_key"])
+        bob = cc.create_user("bob")
+        vol = cc.create_volume("ccvol", mp_count=1, dp_count=2)
+        assert vol["name"] == "ccvol"
+        cc.grant(bob["access_key"], "ccvol")
+        assert cc.users()[bob["access_key"]]["volumes"] == {"ccvol": "rw"}
+        # graphql errors surface as typed exceptions
+        with pytest.raises(rpclib.RpcError):
+            cc.graphql("query { bogusField }")
+    finally:
+        con.stop()
+        msrv.stop()
+        for m in metas:
+            m.stop()
+        for d in datas:
+            d.stop()
