@@ -91,9 +91,18 @@ class RaftNode:
         self._results: dict[int, tuple[object, BaseException | None]] = {}
         self._wal = None
         self._wal_unclean = False
+        # group-commit state: records are WRITTEN+flushed under the node
+        # lock, fsync'd OUTSIDE it by _wal_sync (concurrent acks share
+        # one disk flush). _wal_mu guards the handle vs rewrite swaps.
+        self._wal_mu = threading.Lock()
+        self._sync_cv = threading.Condition()
+        self._sync_active = False
+        self._wal_written = 0  # abs idx written+flushed
+        self._wal_synced = 0   # abs idx fsync'd
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._load()
+            self._wal_written = self._wal_synced = self._last_index()
             self._wal = open(self._wal_path(), "a")
             if self._wal_unclean:
                 # the file held garbage/skipped records beyond the loaded
@@ -166,21 +175,57 @@ class RaftNode:
         if self._wal is None:
             return
         if rewrote:
-            self._wal.close()
-            lines = [
-                json.dumps({"idx": self.log_base + i + 1, **rec})
-                for i, rec in enumerate(self.log)
-            ]
-            self._write_atomic(
-                self._wal_path(), "".join(ln + "\n" for ln in lines)
-            )
-            self._wal = open(self._wal_path(), "a")
+            with self._wal_mu:  # vs a concurrent group fsync
+                self._wal.close()
+                lines = [
+                    json.dumps({"idx": self.log_base + i + 1, **rec})
+                    for i, rec in enumerate(self.log)
+                ]
+                self._write_atomic(
+                    self._wal_path(), "".join(ln + "\n" for ln in lines)
+                )
+                self._wal = open(self._wal_path(), "a")
+            with self._sync_cv:
+                self._wal_written = self._last_index()
+                self._wal_synced = self._wal_written  # replace+fsync'd
         else:
             base = self._last_index() - len(appended)
             for i, rec in enumerate(appended):
                 self._wal.write(json.dumps({"idx": base + i + 1, **rec}) + "\n")
             self._wal.flush()
-            os.fsync(self._wal.fileno())
+            # fsync is DEFERRED to _wal_sync, called by the proposer /
+            # append handler outside the node lock before acknowledging:
+            # concurrent callers share one group fsync instead of
+            # serializing a disk flush each under the lock
+            with self._sync_cv:
+                self._wal_written = self._last_index()
+
+    def _wal_sync(self, through: int) -> None:
+        """Group commit: block until WAL records through absolute index
+        `through` are fsync'd. The first caller becomes the syncer; the
+        rest wait on its flush — N concurrent acks cost ONE fsync. Never
+        called under the node lock."""
+        if self._wal is None:
+            return
+        while True:
+            with self._sync_cv:
+                if through <= self._wal_synced:
+                    return
+                if self._sync_active:
+                    self._sync_cv.wait(timeout=1.0)
+                    continue
+                self._sync_active = True
+                target = self._wal_written
+            try:
+                with self._wal_mu:
+                    wal = self._wal
+                    if wal is not None:
+                        os.fsync(wal.fileno())
+            finally:
+                with self._sync_cv:
+                    self._sync_active = False
+                    self._wal_synced = max(self._wal_synced, target)
+                    self._sync_cv.notify_all()
 
     def _persist_snapshot(self, data: bytes) -> None:
         if not self.data_dir:
@@ -433,6 +478,8 @@ class RaftNode:
             rec = {"term": self.term, "entry": dict(self.NOOP)}
             self.log.append(rec)
             self._persist_entries([rec], rewrote=False)
+            noop_idx = self._last_index()
+        self._wal_sync(noop_idx)
         for ev in self._repl_events.values():
             ev.set()  # wake blocked follower-mode repl threads
         self._broadcast_append()
@@ -480,6 +527,9 @@ class RaftNode:
             index = self._last_index()
             self._waiting[index] = self.term
             self._persist_entries([rec], rewrote=False)
+        # leader durability precedes replication/commit: group fsync
+        # outside the lock so concurrent proposers share it
+        self._wal_sync(index)
         self._broadcast_append()
         deadline = time.monotonic() + timeout
         with self._apply_cv:
@@ -708,13 +758,21 @@ class RaftNode:
                 else:
                     self.log.append(rec)
                     appended.append(rec)
+            sync_through = 0
             if appended or rewrote:
                 self._persist_entries(appended, rewrote)
+                sync_through = self._last_index()
             if args["commit"] > self.commit_index:
                 self.commit_index = min(args["commit"], self._last_index())
                 self._apply_committed()
-            return {"ok": True, "term": self.term,
-                    "applied": self.last_applied}
+            result = {"ok": True, "term": self.term,
+                      "applied": self.last_applied}
+        if sync_through:
+            # the ok-ack is a durability promise to the leader: wait for
+            # the (shared) group fsync outside the lock, so concurrent
+            # append batches don't serialize disk flushes
+            self._wal_sync(sync_through)
+        return result
 
     def status(self) -> dict:
         with self._lock:
