@@ -196,26 +196,45 @@ def test_packet_timeout_is_not_retried(tmp_path):
 def test_client_drops_connection_on_corrupt_response():
     """A response frame that fails to parse leaves unread bytes on the
     stream; the client must drop the connection (mirroring the server's
-    discipline), not keep reading misaligned bytes forever."""
+    discipline), not keep reading misaligned bytes forever. Observed
+    over a real socket: after the corrupt reply the next call must ride
+    a FRESH connection — the desynced one is never checked back into
+    the pool — and must succeed end-to-end."""
     lsock = socket.socket()
     lsock.bind(("127.0.0.1", 0))
-    lsock.listen(1)
+    lsock.listen(4)
     host, port = lsock.getsockname()
+    accepted = []
 
-    def bad_server():
-        conn, _ = lsock.accept()
-        conn.recv(packet.HEADER.size + 256)  # swallow the request
-        conn.sendall(b"\xff" * packet.HEADER.size)  # bad-magic "response"
-        # leave the connection open: a non-dropping client would try to
-        # keep using this desynced stream
+    def server():
+        # conn 1: swallow the request, answer with garbage, keep it OPEN
+        # (a non-dropping client would reuse this desynced stream and
+        # hang or misparse on its next call)
+        c1, _ = lsock.accept()
+        accepted.append(c1)
+        c1.recv(packet.HEADER.size + 256)
+        c1.sendall(b"\xff" * packet.HEADER.size)  # bad-magic "response"
+        # conn 2: behave like a real server for exactly one request
+        c2, _ = lsock.accept()
+        accepted.append(c2)
+        hdr, _, _ = packet.recv_packet(c2)
+        c2.sendall(packet.pack(hdr["opcode"], req_id=hdr["req_id"]))
 
-    t = threading.Thread(target=bad_server, daemon=True)
+    t = threading.Thread(target=server, daemon=True)
     t.start()
-    cli = packet.PacketClient(f"{host}:{port}")
+    # short timeout: a regressed client that reuses the desynced conn
+    # blocks on it — fail in 2s, not the default 30
+    cli = packet.PacketClient(f"{host}:{port}", timeout=2.0)
     try:
         with pytest.raises(packet.PacketError):
             cli.call(packet.OP_PING)
-        assert cli._sock is None, "client kept a desynced connection"
+        # the pool must not hand the desynced socket to the next call
+        cli.call(packet.OP_PING)
+        t.join(5.0)
+        assert not t.is_alive(), "server never saw the second connection"
+        assert len(accepted) == 2, "second call reused the desynced conn"
     finally:
         cli.close()
         lsock.close()
+        for c in accepted:
+            c.close()

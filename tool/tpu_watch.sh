@@ -1,0 +1,39 @@
+#!/bin/bash
+# TPU relay watcher: the axon tunnel wedges unpredictably (the TCP port
+# accepts while backend init hangs), so probe with a hard timeout and —
+# the moment the relay is alive — run the full judged bench and capture
+# the JSON line into artifacts/ with provenance. bench.py's official
+# end-of-round run falls back to the newest captured artifact when the
+# relay is dead (see bench.py), so this loop is what guarantees the
+# official record carries a TPU number.
+#
+# Usage: tool/tpu_watch.sh [round_tag]   (default r04)
+set -u
+cd "$(dirname "$0")/.."
+TAG="${1:-r04}"
+ART="artifacts/BENCH_tpu_${TAG}_early.json"
+while true; do
+  if timeout 90 python -c "import jax; assert jax.devices()" 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) relay alive; running bench" >&2
+    out=$(PYTHONUNBUFFERED=1 timeout 2400 python bench.py 2>/tmp/tpu_watch_bench.err)
+    line=$(printf '%s\n' "$out" | grep -m1 '"metric"')
+    # a line carrying "provenance" is bench.py's own artifact fallback
+    # (relay wedged mid-run), not a fresh on-chip measurement
+    if [ -n "$line" ] && printf '%s' "$line" | grep -q '"platform": "tpu"' \
+        && ! printf '%s' "$line" | grep -q '"provenance"'; then
+      cur=$(printf '%s' "$line" | python -c 'import json,sys; print(json.load(sys.stdin)["value"])')
+      printf '%s\n' "$line" > "artifacts/BENCH_tpu_${TAG}_$(date -u +%H%M%S).json"
+      # LATEST capture wins: the canonical artifact must reflect the
+      # code as it is now — keeping a max would cherry-pick and mask
+      # regressions (timestamped copies above preserve the history)
+      printf '%s\n' "$line" > "$ART"
+      echo "$(date -u +%FT%TZ) captured value=$cur -> $ART" >&2
+    else
+      echo "$(date -u +%FT%TZ) bench ran but no tpu line (err tail):" >&2
+      tail -3 /tmp/tpu_watch_bench.err >&2
+    fi
+  else
+    echo "$(date -u +%FT%TZ) relay wedged/dead" >&2
+  fi
+  sleep 600
+done
