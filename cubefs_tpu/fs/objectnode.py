@@ -23,6 +23,7 @@ import json
 
 from . import metanode as mn
 from . import s3policy
+from . import s3version
 from .client import FileSystem, FsError
 
 
@@ -65,9 +66,16 @@ class ObjectNode:
                 return outer.volumes.get(bucket)
 
             def _key_reserved(self, key: str) -> bool:
-                # the multipart staging area is internal: direct key ops
-                # on it would expose/corrupt other clients' uploads
-                return key.split("/", 1)[0] == ".multipart"
+                # the multipart staging and version archive areas are
+                # internal: direct key ops on them would expose/corrupt
+                # other clients' uploads / version history
+                return key.split("/", 1)[0] in (".multipart",
+                                                s3version.VDIR)
+
+            def _bypass_governance(self) -> bool:
+                return (self.headers.get(
+                    "x-amz-bypass-governance-retention", "")
+                    .lower() == "true")
 
             def _audit(self, code: int, bytes_out: int) -> None:
                 if not outer.audit_sinks:
@@ -184,7 +192,9 @@ class ObjectNode:
                 write = action not in s3policy.READ_ACTIONS
                 grant = outer.auth.grant_ok(self._principal, bucket, write)
                 if action.endswith(("BucketPolicy", "BucketAcl",
-                                    "BucketCors", "BucketLifecycle")):
+                                    "BucketCors", "BucketLifecycle",
+                                    "BucketVersioning",
+                                    "ObjectLockConfiguration")):
                     # bucket configuration is owner-only: policy/ACL
                     # cannot grant it away
                     return grant
@@ -282,6 +292,58 @@ class ObjectNode:
                     outer._bucket_cfg_set(fs, s3policy.XA_LIFECYCLE,
                                           json.dumps(rules))
                     return self._reply(200)
+                if not key and "versioning" in query:  # PutBucketVersioning
+                    if not self._check("s3:PutBucketVersioning", bucket):
+                        return
+                    try:
+                        status = outer._parse_versioning_xml(data)
+                        s3version.VersionStore(fs).set_status(status)
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    return self._reply(200)
+                if not key and "object-lock" in query:  # PutObjectLockConfiguration
+                    if not self._check("s3:PutBucketObjectLockConfiguration",
+                                       bucket):
+                        return
+                    try:
+                        conf = outer._parse_objlock_xml(data)
+                        s3version.VersionStore(fs).set_lock_config(conf)
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    return self._reply(200)
+                if key and "retention" in query:  # PutObjectRetention
+                    if self._key_reserved(key):
+                        return self._error(403, "AccessDenied",
+                                           "reserved namespace")
+                    if not self._check("s3:PutObjectRetention", bucket, key):
+                        return
+                    vid = query.get("versionId", [None])[0]
+                    try:
+                        mode, until = outer._parse_retention_xml(data)
+                        s3version.VersionStore(fs).set_retention(
+                            key, vid, mode, until,
+                            self._bypass_governance())
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    except FsError:
+                        return self._error(404, "NoSuchKey", key)
+                    return self._reply(200)
+                if key and "legal-hold" in query:  # PutObjectLegalHold
+                    if self._key_reserved(key):
+                        return self._error(403, "AccessDenied",
+                                           "reserved namespace")
+                    if not self._check("s3:PutObjectLegalHold", bucket, key):
+                        return
+                    vid = query.get("versionId", [None])[0]
+                    try:
+                        on = outer._parse_legal_hold_xml(data)
+                        s3version.VersionStore(fs).set_legal_hold(
+                            key, vid, on)
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    except FsError:
+                        return self._error(404, "NoSuchKey", key)
+                    return self._reply(200)
                 if not key:  # CreateBucket
                     if not self._check("s3:CreateBucket", bucket):
                         return
@@ -348,18 +410,23 @@ class ObjectNode:
                             data = b""
                         else:
                             return self._error(404, "NoSuchKey", sk)
+                etag = hashlib.md5(data).hexdigest()
                 try:
-                    outer._put_object(fs, key, data)
+                    vid = outer._put_object_versioned(
+                        fs, key, data, etag, self._bypass_governance())
+                except s3version.S3VersionError as e:
+                    return self._error(e.http, e.code, str(e))
                 except FsError as e:
                     if e.errno in (mn.ENOSPC, mn.EDQUOT):
                         return self._error(507, "QuotaExceeded", str(e))
                     return self._error(500, "InternalError", str(e))
-                etag = hashlib.md5(data).hexdigest()
+                vid_hdr = {"x-amz-version-id": vid} if vid else {}
                 if is_copy:
                     body = (f"<?xml version='1.0'?><CopyObjectResult>"
                             f"<ETag>\"{etag}\"</ETag></CopyObjectResult>").encode()
-                    return self._reply(200, body)
+                    return self._reply(200, body, headers=vid_hdr)
                 self._reply(200, headers={"ETag": f'"{etag}"',
+                                          **vid_hdr,
                                           **self._cors(bucket)})
 
             def do_POST(self):
@@ -403,6 +470,10 @@ class ObjectNode:
                         etag = outer._complete_multipart(
                             fs, key, query["uploadId"][0]
                         )
+                    except s3version.S3VersionError as e:
+                        # e.g. Locked: completing onto a retained null
+                        # version must 403, not drop the connection
+                        return self._error(e.http, e.code, str(e))
                     except FsError as e:
                         return self._error(404, "NoSuchUpload", str(e))
                     body = (
@@ -475,6 +546,67 @@ class ObjectNode:
                             404, "NoSuchLifecycleConfiguration", bucket)
                     return self._reply(
                         200, s3policy.lifecycle_to_xml(json.loads(raw)))
+                if not key and "versioning" in query:  # GetBucketVersioning
+                    if not self._check("s3:GetBucketVersioning", bucket):
+                        return
+                    st = s3version.VersionStore(fs).status()
+                    inner = f"<Status>{st}</Status>" if st else ""
+                    return self._reply(
+                        200,
+                        (f"<?xml version='1.0'?><VersioningConfiguration>"
+                         f"{inner}</VersioningConfiguration>").encode())
+                if not key and "object-lock" in query:  # GetObjectLockConfiguration
+                    if not self._check("s3:GetBucketObjectLockConfiguration",
+                                       bucket):
+                        return
+                    conf = s3version.VersionStore(fs).lock_config()
+                    if conf is None:
+                        return self._error(
+                            404, "ObjectLockConfigurationNotFoundError",
+                            bucket)
+                    return self._reply(200, outer._objlock_to_xml(conf))
+                if not key and "versions" in query:  # ListObjectVersions
+                    if not self._check("s3:ListBucketVersions", bucket):
+                        return
+                    return outer._list_versions_reply(self, bucket, fs,
+                                                      query)
+                if key and "retention" in query:  # GetObjectRetention
+                    if not self._check("s3:GetObjectRetention", bucket, key):
+                        return
+                    vid = query.get("versionId", [None])[0]
+                    try:
+                        ret = s3version.VersionStore(fs).get_retention(
+                            key, vid)
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    except FsError:
+                        return self._error(404, "NoSuchKey", key)
+                    if ret is None:
+                        return self._error(
+                            404, "NoSuchObjectLockConfiguration", key)
+                    return self._reply(
+                        200,
+                        (f"<?xml version='1.0'?><Retention>"
+                         f"<Mode>{ret['mode']}</Mode>"
+                         f"<RetainUntilDate>"
+                         f"{s3version.iso8601(ret['until'])}"
+                         f"</RetainUntilDate></Retention>").encode())
+                if key and "legal-hold" in query:  # GetObjectLegalHold
+                    if not self._check("s3:GetObjectLegalHold", bucket, key):
+                        return
+                    vid = query.get("versionId", [None])[0]
+                    try:
+                        on = s3version.VersionStore(fs).get_legal_hold(
+                            key, vid)
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    except FsError:
+                        return self._error(404, "NoSuchKey", key)
+                    return self._reply(
+                        200,
+                        (f"<?xml version='1.0'?><LegalHold><Status>"
+                         f"{'ON' if on else 'OFF'}</Status>"
+                         f"</LegalHold>").encode())
                 if key and "tagging" in query:  # GetObjectTagging
                     if not self._check("s3:GetObjectTagging", bucket, key):
                         return
@@ -526,6 +658,17 @@ class ObjectNode:
                     return self._reply(200, body)
                 if not self._check("s3:GetObject", bucket, key):
                     return
+                vid_q = query.get("versionId", [""])[0]
+                if vid_q:  # GetObject of a specific version
+                    try:
+                        data, vmeta = s3version.VersionStore(
+                            fs).read_version(key, vid_q)
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    return self._reply(
+                        200, data, ctype="application/octet-stream",
+                        headers={"x-amz-version-id": vmeta["vid"],
+                                 **self._cors(bucket)})
                 rng_hdr = self.headers.get("Range", "")
                 span = None
                 if rng_hdr.startswith("bytes=") and "," not in rng_hdr:
@@ -566,6 +709,14 @@ class ObjectNode:
                         return self._reply(200, b"",
                                            ctype="application/octet-stream",
                                            headers=self._cors(bucket))
+                    if s3version.VersionStore(fs).latest_is_marker(key):
+                        # the newest version is a delete marker: 404
+                        # that SAYS so, per the S3 API
+                        return self._reply(
+                            404,
+                            b"<?xml version='1.0'?><Error>"
+                            b"<Code>NoSuchKey</Code></Error>",
+                            headers={"x-amz-delete-marker": "true"})
                     return self._error(404, "NoSuchKey", key)
                 self._reply(200, data, ctype="application/octet-stream",
                             headers=self._cors(bucket))
@@ -590,6 +741,8 @@ class ObjectNode:
                     return self._error(400, "MalformedXML",
                                        "1..1000 Object keys required")
                 deleted, errors = [], []
+                vs = s3version.VersionStore(fs)
+                versioned = bool(vs.status())
                 for k in keys:
                     if not k:
                         errors.append((k, "UserKeyMustBeSpecified"))
@@ -601,9 +754,15 @@ class ObjectNode:
                         errors.append((k, "AccessDenied"))
                         continue
                     try:
-                        fs.unlink("/" + k)
+                        if versioned:
+                            # versioned bucket: batch delete adds markers
+                            vs.delete(k)
+                        else:
+                            fs.unlink("/" + k)
                         outer._prune_empty_dirs(fs, k)
                         deleted.append(k)
+                    except s3version.S3VersionError:
+                        errors.append((k, "AccessDenied"))
                     except FsError as e:
                         if e.errno == mn.ENOENT:
                             # S3 treats delete-of-missing as success
@@ -720,7 +879,7 @@ class ObjectNode:
                 begun = self._begin()
                 if begun is None:
                     return
-                bucket, key, _ = begun
+                bucket, key, query = begun
                 if not key:  # HeadBucket
                     if self._fs(bucket) is None:
                         return self._error(404, "NoSuchBucket", bucket)
@@ -735,16 +894,37 @@ class ObjectNode:
                 if self._key_reserved(key):
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")
-                try:
-                    st = fs.stat("/" + key)
-                except FsError:
-                    return self._error(404, "NoSuchKey", key)
+                vid_q = query.get("versionId", [""])[0]
+                vid_hdr = None
+                if vid_q:
+                    try:
+                        vmeta = s3version.VersionStore(fs).find(key, vid_q)
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    if vmeta["dm"]:
+                        return self._error(405, "MethodNotAllowed",
+                                           "version is a delete marker")
+                    st = {"size": vmeta["size"]}
+                    vid_hdr = vmeta["vid"]
+                else:
+                    try:
+                        st = fs.stat("/" + key)
+                    except FsError:
+                        if s3version.VersionStore(fs).latest_is_marker(key):
+                            return self._reply(
+                                404,
+                                b"<?xml version='1.0'?><Error>"
+                                b"<Code>NoSuchKey</Code></Error>",
+                                headers={"x-amz-delete-marker": "true"})
+                        return self._error(404, "NoSuchKey", key)
                 # HEAD: standard Content-Length describes what GET would
                 # return; no body follows (RFC 9110)
                 self._audit(200, 0)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Content-Length", str(st["size"]))
+                if vid_hdr:
+                    self.send_header("x-amz-version-id", vid_hdr)
                 self.end_headers()
 
             def do_DELETE(self):
@@ -788,6 +968,32 @@ class ObjectNode:
                     return self._reply(204)
                 if not self._check("s3:DeleteObject", bucket, key):
                     return
+                vs = s3version.VersionStore(fs)
+                vid_q = query.get("versionId", [""])[0]
+                if vid_q:  # permanent delete of ONE version
+                    try:
+                        was_marker = vs.delete_version(
+                            key, vid_q, self._bypass_governance())
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    except FsError as e:
+                        return self._error(500, "InternalError", str(e))
+                    outer._prune_empty_dirs(fs, key)
+                    hdrs = {"x-amz-version-id": vid_q}
+                    if was_marker:
+                        hdrs["x-amz-delete-marker"] = "true"
+                    return self._reply(204, headers=hdrs)
+                if vs.status():  # versioned delete: add a marker
+                    try:
+                        marker_vid = vs.delete(key)
+                    except s3version.S3VersionError as e:
+                        return self._error(e.http, e.code, str(e))
+                    except FsError as e:
+                        return self._error(500, "InternalError", str(e))
+                    outer._prune_empty_dirs(fs, key)
+                    return self._reply(204, headers={
+                        "x-amz-delete-marker": "true",
+                        "x-amz-version-id": marker_vid})
                 try:
                     fs.unlink("/" + key)
                     outer._prune_empty_dirs(fs, key)
@@ -844,9 +1050,11 @@ class ObjectNode:
                               f"{initiated_for!r}, not {key!r}")
         parts = sorted(fs.readdir(staging))
         body = b"".join(fs.read_file(f"{staging}/{p}") for p in parts)
-        self._put_object(fs, key, body)
+        etag = _h.md5(body).hexdigest()
+        # versioned buckets version multipart completions too
+        self._put_object_versioned(fs, key, body, etag, bypass=False)
         self._abort_multipart(fs, upload_id)  # clear staging
-        return _h.md5(body).hexdigest()
+        return etag
 
     def _abort_multipart(self, fs: FileSystem, upload_id: str) -> None:
         staging = f"/.multipart/{upload_id}"
@@ -856,6 +1064,138 @@ class ObjectNode:
             fs.unlink(staging)
         except FsError:
             pass
+
+    # ---- versioning glue (s3version.py owns the semantics) ----
+    def _put_object_versioned(self, fs: FileSystem, key: str, data: bytes,
+                              etag: str, bypass: bool) -> str | None:
+        """PutObject through the version store when the bucket versions;
+        returns the new version id (None on unversioned buckets)."""
+        vs = s3version.VersionStore(fs)
+        if not vs.status():
+            self._put_object(fs, key, data)
+            return None
+        return vs.put(key, lambda: self._put_object(fs, key, data),
+                      etag, bypass_governance=bypass)
+
+    @staticmethod
+    def _xml_root(data: bytes):
+        import xml.etree.ElementTree as ET
+
+        try:
+            return ET.fromstring(data)
+        except ET.ParseError as e:
+            raise s3version.S3VersionError(400, "MalformedXML", str(e))
+
+    def _parse_versioning_xml(self, data: bytes) -> str:
+        root = self._xml_root(data)
+        status = root.findtext("{*}Status") or ""
+        if status not in ("Enabled", "Suspended"):
+            raise s3version.S3VersionError(
+                400, "MalformedXML", f"bad Status {status!r}")
+        return status
+
+    def _parse_objlock_xml(self, data: bytes) -> dict:
+        root = self._xml_root(data)
+        if (root.findtext("{*}ObjectLockEnabled") or "") != "Enabled":
+            raise s3version.S3VersionError(
+                400, "MalformedXML", "ObjectLockEnabled must be Enabled")
+        conf: dict = {"enabled": True, "default": None}
+        ret = root.find("{*}Rule/{*}DefaultRetention")
+        if ret is not None:
+            mode = ret.findtext("{*}Mode") or ""
+            if mode not in ("GOVERNANCE", "COMPLIANCE"):
+                raise s3version.S3VersionError(
+                    400, "MalformedXML", f"bad retention Mode {mode!r}")
+            days = ret.findtext("{*}Days")
+            years = ret.findtext("{*}Years")
+            if bool(days) == bool(years):  # exactly one, like AWS
+                raise s3version.S3VersionError(
+                    400, "MalformedXML",
+                    "DefaultRetention needs Days XOR Years")
+            conf["default"] = {"mode": mode,
+                               "days": int(days) if days else 0,
+                               "years": int(years) if years else 0}
+        return conf
+
+    @staticmethod
+    def _objlock_to_xml(conf: dict) -> bytes:
+        rule = ""
+        d = conf.get("default")
+        if d:
+            span = (f"<Days>{d['days']}</Days>" if d.get("days")
+                    else f"<Years>{d['years']}</Years>")
+            rule = (f"<Rule><DefaultRetention><Mode>{d['mode']}</Mode>"
+                    f"{span}</DefaultRetention></Rule>")
+        return (f"<?xml version='1.0'?><ObjectLockConfiguration>"
+                f"<ObjectLockEnabled>Enabled</ObjectLockEnabled>{rule}"
+                f"</ObjectLockConfiguration>").encode()
+
+    def _parse_retention_xml(self, data: bytes) -> tuple[str, float]:
+        root = self._xml_root(data)
+        mode = root.findtext("{*}Mode") or ""
+        raw = root.findtext("{*}RetainUntilDate") or ""
+        try:
+            until = s3version.parse_iso8601(raw)
+        except ValueError:
+            raise s3version.S3VersionError(
+                400, "MalformedXML", f"bad RetainUntilDate {raw!r}")
+        return mode, until
+
+    def _parse_legal_hold_xml(self, data: bytes) -> bool:
+        status = self._xml_root(data).findtext("{*}Status") or ""
+        if status not in ("ON", "OFF"):
+            raise s3version.S3VersionError(
+                400, "MalformedXML", f"bad LegalHold Status {status!r}")
+        return status == "ON"
+
+    def _list_versions_reply(self, handler, bucket: str, fs: FileSystem,
+                             query: dict) -> None:
+        prefix = query.get("prefix", [""])[0]
+        try:
+            max_keys = int(query.get("max-keys", ["1000"])[0])
+        except ValueError:
+            return handler._error(400, "InvalidArgument",
+                                  "max-keys must be an integer")
+        if max_keys < 1:
+            return handler._error(400, "InvalidArgument",
+                                  "max-keys must be positive")
+        key_marker = query.get("key-marker", [""])[0]
+        vid_marker = query.get("version-id-marker", [""])[0]
+        vs = s3version.VersionStore(fs)
+        page, truncated, nk, nv = vs.list_versions(
+            lambda p: self._list_objects(fs, p), prefix, max_keys,
+            key_marker, vid_marker)
+        parts = []
+        for e in page:
+            latest = "true" if e["is_latest"] else "false"
+            lm = s3version.iso8601(e["vts"] / 1e9)
+            if e["dm"]:
+                parts.append(
+                    f"<DeleteMarker><Key>{xs.escape(e['key'])}</Key>"
+                    f"<VersionId>{e['vid']}</VersionId>"
+                    f"<IsLatest>{latest}</IsLatest>"
+                    f"<LastModified>{lm}</LastModified></DeleteMarker>")
+            else:
+                parts.append(
+                    f"<Version><Key>{xs.escape(e['key'])}</Key>"
+                    f"<VersionId>{e['vid']}</VersionId>"
+                    f"<IsLatest>{latest}</IsLatest>"
+                    f"<LastModified>{lm}</LastModified>"
+                    f"<Size>{e['size']}</Size>"
+                    f"<ETag>\"{e['etag']}\"</ETag></Version>")
+        markers = ""
+        if truncated:
+            markers = (f"<NextKeyMarker>{xs.escape(nk)}</NextKeyMarker>"
+                       f"<NextVersionIdMarker>{nv}"
+                       f"</NextVersionIdMarker>")
+        body = (
+            f"<?xml version='1.0'?><ListVersionsResult>"
+            f"<Name>{bucket}</Name><Prefix>{xs.escape(prefix)}</Prefix>"
+            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+            f"{markers}{''.join(parts)}</ListVersionsResult>"
+        ).encode()
+        handler._reply(200, body)
 
     # ---- key <-> path adaptation ----
     def _put_object(self, fs: FileSystem, key: str, data: bytes) -> None:
@@ -875,8 +1215,8 @@ class ObjectNode:
 
         def walk(path: str, keybase: str):
             for name, ino in sorted(fs.readdir(path or "/").items()):
-                if not path and name == ".multipart":
-                    continue  # staging area is not object namespace
+                if not path and name in (".multipart", s3version.VDIR):
+                    continue  # internal areas are not object namespace
                 inode = fs.meta.inode_get(ino)
                 k = f"{keybase}{name}"
                 if inode["type"] == mn.DIR:

@@ -34,9 +34,12 @@ CANNED_ACLS = ("private", "public-read", "public-read-write",
 
 # HEAD authorizes as s3:GetObject, matching AWS (there is no separate
 # HeadObject permission)
-READ_ACTIONS = {"s3:GetObject", "s3:ListBucket", "s3:GetObjectTagging"}
+READ_ACTIONS = {"s3:GetObject", "s3:ListBucket", "s3:GetObjectTagging",
+                "s3:GetBucketVersioning", "s3:ListBucketVersions",
+                "s3:GetObjectRetention", "s3:GetObjectLegalHold"}
 WRITE_ACTIONS = {"s3:PutObject", "s3:DeleteObject", "s3:PutObjectTagging",
-                 "s3:DeleteObjectTagging"}
+                 "s3:DeleteObjectTagging", "s3:PutObjectRetention",
+                 "s3:PutObjectLegalHold"}
 
 
 class S3ConfigError(Exception):

@@ -1,0 +1,309 @@
+"""S3 bucket versioning + object lock (objectnode/router.go:244-312,
+objectnode/object_lock.go parity) — driven over real HTTP sockets."""
+
+import urllib.request
+
+import pytest
+
+from cubefs_tpu.fs.objectnode import ObjectNode
+
+from test_gateways import _req, fscluster  # noqa: F401  (fixture)
+
+
+def _reqh(method, url, data=None, headers=None):
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+ENABLE = (b"<VersioningConfiguration><Status>Enabled</Status>"
+          b"</VersioningConfiguration>")
+SUSPEND = (b"<VersioningConfiguration><Status>Suspended</Status>"
+           b"</VersioningConfiguration>")
+
+
+@pytest.fixture
+def vbucket(fscluster):  # noqa: F811
+    s3 = ObjectNode({"vb": fscluster}).start()
+    yield f"http://{s3.addr}", s3
+    s3.stop()
+
+
+def _enable(base):
+    code, _, _ = _req("PUT", f"{base}/vb?versioning", ENABLE)
+    assert code == 200
+
+
+def test_versioning_config_roundtrip(vbucket):
+    base, _ = vbucket
+    code, body, _ = _req("GET", f"{base}/vb?versioning")
+    assert code == 200 and b"<Status>" not in body  # never configured
+    _enable(base)
+    code, body, _ = _req("GET", f"{base}/vb?versioning")
+    assert code == 200 and b"<Status>Enabled</Status>" in body
+    code, _, _ = _req("PUT", f"{base}/vb?versioning", SUSPEND)
+    assert code == 200
+    code, body, _ = _req("GET", f"{base}/vb?versioning")
+    assert b"<Status>Suspended</Status>" in body
+
+
+def test_versioned_put_get_and_list(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    code, _, h1 = _req("PUT", f"{base}/vb/doc.txt", b"one")
+    assert code == 200
+    v1 = h1["x-amz-version-id"]
+    code, _, h2 = _req("PUT", f"{base}/vb/doc.txt", b"two")
+    v2 = h2["x-amz-version-id"]
+    assert v1 != v2
+    # plain GET serves the newest version
+    code, body, _ = _req("GET", f"{base}/vb/doc.txt")
+    assert code == 200 and body == b"two"
+    # GET of the archived version by id
+    code, body, h = _req("GET", f"{base}/vb/doc.txt?versionId={v1}")
+    assert code == 200 and body == b"one"
+    assert h["x-amz-version-id"] == v1
+    code, body, _ = _req("GET", f"{base}/vb/doc.txt?versionId=deadbeef")
+    assert code == 404 and b"NoSuchVersion" in body
+    # ListObjectVersions: both versions, newest flagged latest
+    code, listing, _ = _req("GET", f"{base}/vb?versions")
+    assert code == 200
+    text = listing.decode()
+    assert text.count("<Version>") == 2
+    i2, i1 = text.index(v2), text.index(v1)
+    assert i2 < i1, "versions must list newest first"
+    assert "<IsLatest>true</IsLatest>" in text.split(v1)[0]
+
+
+def test_delete_marker_lifecycle(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    _, _, h1 = _req("PUT", f"{base}/vb/k", b"data1")
+    v1 = h1["x-amz-version-id"]
+    # versioned DELETE: adds a marker, destroys nothing
+    code, _, dh = _req("DELETE", f"{base}/vb/k")
+    assert code == 204 and dh["x-amz-delete-marker"] == "true"
+    marker = dh["x-amz-version-id"]
+    # plain GET now 404s and SAYS it's a marker
+    code, _, gh = _req("GET", f"{base}/vb/k")
+    assert code == 404 and gh.get("x-amz-delete-marker") == "true"
+    code, _, _ = _req("HEAD", f"{base}/vb/k")
+    assert code == 404
+    # the old version is still fully readable
+    code, body, _ = _req("GET", f"{base}/vb/k?versionId={v1}")
+    assert code == 200 and body == b"data1"
+    # GET of the marker itself is 405
+    code, _, _ = _req("GET", f"{base}/vb/k?versionId={marker}")
+    assert code == 405
+    # listing shows the marker as latest
+    code, listing, _ = _req("GET", f"{base}/vb?versions")
+    text = listing.decode()
+    assert "<DeleteMarker>" in text and marker in text
+    # deleting the MARKER resurrects the object
+    code, _, dh2 = _req("DELETE", f"{base}/vb/k?versionId={marker}")
+    assert code == 204 and dh2.get("x-amz-delete-marker") == "true"
+    code, body, _ = _req("GET", f"{base}/vb/k")
+    assert code == 200 and body == b"data1"
+
+
+def test_delete_version_promotes_previous(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    _req("PUT", f"{base}/vb/p", b"v1")
+    _, _, h2 = _req("PUT", f"{base}/vb/p", b"v2")
+    v2 = h2["x-amz-version-id"]
+    # permanently delete the CURRENT version: previous takes over
+    code, _, _ = _req("DELETE", f"{base}/vb/p?versionId={v2}")
+    assert code == 204
+    code, body, _ = _req("GET", f"{base}/vb/p")
+    assert code == 200 and body == b"v1"
+    code, _, _ = _req("GET", f"{base}/vb/p?versionId={v2}")
+    assert code == 404
+
+
+def test_suspended_writes_null_version(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    _, _, h1 = _req("PUT", f"{base}/vb/s", b"kept")
+    v1 = h1["x-amz-version-id"]
+    _req("PUT", f"{base}/vb?versioning", SUSPEND)
+    _, _, h2 = _req("PUT", f"{base}/vb/s", b"null-a")
+    assert h2["x-amz-version-id"] == "null"
+    _, _, _ = _req("PUT", f"{base}/vb/s", b"null-b")
+    # the null version is REPLACED, not stacked; the Enabled-era
+    # version survives
+    code, listing, _ = _req("GET", f"{base}/vb?versions")
+    text = listing.decode()
+    assert text.count("<Version>") == 2
+    assert v1 in text and text.count("null") >= 1
+    code, body, _ = _req("GET", f"{base}/vb/s?versionId={v1}")
+    assert body == b"kept"
+    code, body, _ = _req("GET", f"{base}/vb/s?versionId=null")
+    assert body == b"null-b"
+
+
+def test_batch_delete_adds_markers(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    _, _, h = _req("PUT", f"{base}/vb/bd", b"x")
+    v1 = h["x-amz-version-id"]
+    doc = (b"<Delete><Object><Key>bd</Key></Object></Delete>")
+    code, body, _ = _req("POST", f"{base}/vb?delete", doc)
+    assert code == 200 and b"<Deleted><Key>bd</Key>" in body
+    code, _, gh = _req("GET", f"{base}/vb/bd")
+    assert code == 404 and gh.get("x-amz-delete-marker") == "true"
+    code, body, _ = _req("GET", f"{base}/vb/bd?versionId={v1}")
+    assert code == 200 and body == b"x"
+
+
+def test_object_lock_requires_versioning(vbucket):
+    base, _ = vbucket
+    lock = (b"<ObjectLockConfiguration><ObjectLockEnabled>Enabled"
+            b"</ObjectLockEnabled></ObjectLockConfiguration>")
+    code, body, _ = _req("PUT", f"{base}/vb?object-lock", lock)
+    assert code == 409 and b"InvalidBucketState" in body
+    _enable(base)
+    code, _, _ = _req("PUT", f"{base}/vb?object-lock", lock)
+    assert code == 200
+    # versioning can never be suspended once locked
+    code, body, _ = _req("PUT", f"{base}/vb?versioning", SUSPEND)
+    assert code == 409
+
+
+def test_default_retention_blocks_version_delete(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    lock = (b"<ObjectLockConfiguration><ObjectLockEnabled>Enabled"
+            b"</ObjectLockEnabled><Rule><DefaultRetention>"
+            b"<Mode>GOVERNANCE</Mode><Days>1</Days></DefaultRetention>"
+            b"</Rule></ObjectLockConfiguration>")
+    code, _, _ = _req("PUT", f"{base}/vb?object-lock", lock)
+    assert code == 200
+    code, body, _ = _req("GET", f"{base}/vb?object-lock")
+    assert code == 200 and b"<Days>1</Days>" in body
+    _, _, h = _req("PUT", f"{base}/vb/locked", b"precious")
+    v1 = h["x-amz-version-id"]
+    # retention landed on the new version from the bucket default
+    code, body, _ = _req("GET", f"{base}/vb/locked?retention")
+    assert code == 200 and b"GOVERNANCE" in body
+    # unversioned delete (marker) is always allowed
+    code, _, _ = _req("DELETE", f"{base}/vb/locked")
+    assert code == 204
+    # permanent version delete is NOT
+    code, body, _ = _req("DELETE", f"{base}/vb/locked?versionId={v1}")
+    assert code == 403 and b"AccessDenied" in body
+    # ... unless governance is explicitly bypassed
+    code, _, _ = _reqh(
+        "DELETE", f"{base}/vb/locked?versionId={v1}",
+        headers={"x-amz-bypass-governance-retention": "true"})
+    assert code == 204
+
+
+LOCK_ON = (b"<ObjectLockConfiguration><ObjectLockEnabled>Enabled"
+           b"</ObjectLockEnabled></ObjectLockConfiguration>")
+
+
+def test_retention_requires_lock_config(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    _req("PUT", f"{base}/vb/r", b"x")
+    ret = (b"<Retention><Mode>COMPLIANCE</Mode>"
+           b"<RetainUntilDate>2199-01-01T00:00:00Z</RetainUntilDate>"
+           b"</Retention>")
+    # without object lock nothing would ENFORCE this: refuse it
+    code, body, _ = _req("PUT", f"{base}/vb/r?retention", ret)
+    assert code == 400 and b"InvalidRequest" in body
+    code, _, _ = _req("PUT", f"{base}/vb/r?legal-hold",
+                      b"<LegalHold><Status>ON</Status></LegalHold>")
+    assert code == 400
+
+
+def test_versioned_delete_of_dir_key_is_not_subtree_archive(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    _req("PUT", f"{base}/vb/dir/child.txt", b"kid")
+    # DELETE of the bare prefix must not swallow the subtree
+    code, _, _ = _req("DELETE", f"{base}/vb/dir")
+    assert code == 204  # marker for the (nonexistent) object "dir"
+    code, body, _ = _req("GET", f"{base}/vb/dir/child.txt")
+    assert code == 200 and body == b"kid"
+
+
+def test_compliance_retention_is_absolute(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    assert _req("PUT", f"{base}/vb?object-lock", LOCK_ON)[0] == 200
+    _, _, h = _req("PUT", f"{base}/vb/c", b"evidence")
+    v1 = h["x-amz-version-id"]
+    ret = (b"<Retention><Mode>COMPLIANCE</Mode>"
+           b"<RetainUntilDate>2199-01-01T00:00:00Z</RetainUntilDate>"
+           b"</Retention>")
+    code, _, _ = _req("PUT", f"{base}/vb/c?retention", ret)
+    assert code == 200
+    # bypass does NOT beat compliance mode
+    code, body, _ = _reqh(
+        "DELETE", f"{base}/vb/c?versionId={v1}",
+        headers={"x-amz-bypass-governance-retention": "true"})
+    assert code == 403
+    # nor can compliance retention be shortened
+    shorter = (b"<Retention><Mode>COMPLIANCE</Mode>"
+               b"<RetainUntilDate>2190-01-01T00:00:00Z</RetainUntilDate>"
+               b"</Retention>")
+    code, _, _ = _reqh("PUT", f"{base}/vb/c?retention", shorter,
+                       headers={"x-amz-bypass-governance-retention":
+                                "true"})
+    assert code == 403
+
+
+def test_legal_hold(vbucket):
+    base, _ = vbucket
+    _enable(base)
+    assert _req("PUT", f"{base}/vb?object-lock", LOCK_ON)[0] == 200
+    _, _, h = _req("PUT", f"{base}/vb/h", b"held")
+    v1 = h["x-amz-version-id"]
+    on = b"<LegalHold><Status>ON</Status></LegalHold>"
+    off = b"<LegalHold><Status>OFF</Status></LegalHold>"
+    code, _, _ = _req("PUT", f"{base}/vb/h?legal-hold", on)
+    assert code == 200
+    code, body, _ = _req("GET", f"{base}/vb/h?legal-hold")
+    assert code == 200 and b"<Status>ON</Status>" in body
+    # hold beats even governance bypass
+    code, _, _ = _reqh(
+        "DELETE", f"{base}/vb/h?versionId={v1}",
+        headers={"x-amz-bypass-governance-retention": "true"})
+    assert code == 403
+    code, _, _ = _req("PUT", f"{base}/vb/h?legal-hold", off)
+    assert code == 200
+    code, _, _ = _req("DELETE", f"{base}/vb/h?versionId={v1}")
+    assert code == 204
+
+
+def test_nested_key_resurrection_recreates_dirs(vbucket):
+    """Deleting the marker of a nested key must recreate the pruned
+    parent directories before promoting the archived version back
+    (found by driving the daemon: rename into a pruned dir crashed)."""
+    base, _ = vbucket
+    _enable(base)
+    _req("PUT", f"{base}/vb/deep/ly/nested.bin", b"payload")
+    code, _, dh = _req("DELETE", f"{base}/vb/deep/ly/nested.bin")
+    assert code == 204
+    marker = dh["x-amz-version-id"]
+    code, _, _ = _req("DELETE",
+                      f"{base}/vb/deep/ly/nested.bin?versionId={marker}")
+    assert code == 204
+    code, body, _ = _req("GET", f"{base}/vb/deep/ly/nested.bin")
+    assert code == 200 and body == b"payload"
+
+
+def test_unversioned_bucket_unchanged(vbucket):
+    base, _ = vbucket
+    code, _, h = _req("PUT", f"{base}/vb/plain", b"data")
+    assert code == 200 and "x-amz-version-id" not in h
+    code, _, _ = _req("DELETE", f"{base}/vb/plain")
+    assert code == 204
+    code, _, gh = _req("GET", f"{base}/vb/plain")
+    assert code == 404 and "x-amz-delete-marker" not in gh
