@@ -39,10 +39,14 @@ class ClusterMgr(ReplicatedFsm):
         self.volumes: dict[int, VolumeInfo] = {}
         self.services: dict[str, list[str]] = {}
         self.kv: dict[str, str] = {}
+        # shardnode catalog (clustermgr/catalog role): space -> sorted
+        # [{shard_id, start, end, addrs}] range map
+        self.spaces: dict[str, list[dict]] = {}
         self._next_disk = 1
         self._next_vid = 1
         self._next_bid = 1
         self._next_chunk = 1
+        self._next_shard = 1
         self._init_fsm("cm", data_dir, me, peers, node_pool)
 
     def _state_dict(self) -> dict:
@@ -54,8 +58,9 @@ class ClusterMgr(ReplicatedFsm):
             "volumes": {k: v.to_dict() for k, v in self.volumes.items()},
             "services": self.services,
             "kv": self.kv,
+            "spaces": self.spaces,
             "next": [self._next_disk, self._next_vid, self._next_bid,
-                     self._next_chunk],
+                     self._next_chunk, self._next_shard],
         }
 
     def _load_state_dict(self, state: dict) -> None:
@@ -66,8 +71,11 @@ class ClusterMgr(ReplicatedFsm):
                         for k, v in state["volumes"].items()}
         self.services = state["services"]
         self.kv = state["kv"]
+        self.spaces = state.get("spaces", {})
+        nxt = state["next"]
         (self._next_disk, self._next_vid, self._next_bid,
-         self._next_chunk) = state["next"]
+         self._next_chunk) = nxt[:4]
+        self._next_shard = nxt[4] if len(nxt) > 4 else 1
 
     def _state_bytes(self) -> bytes:
         with self._lock:
@@ -271,6 +279,77 @@ class ClusterMgr(ReplicatedFsm):
         with self._lock:
             return self.kv.get(key, default)
 
+    # ---------------- shardnode catalog ----------------
+    # clustermgr/catalog role: the authoritative space -> range-shard
+    # map shardnode clients route by, raft-replicated like every other
+    # piece of clustermgr state.
+    def create_space(self, name: str, shard_count: int,
+                     replica_addrs: list[str]) -> list[dict]:
+        """Carve the keyspace into `shard_count` contiguous ranges over
+        one replica set. Range bounds use the reference's hex-prefix
+        style split of a flat namespace."""
+        if not 1 <= shard_count <= 4096:
+            # beyond 4096 initial ranges the 16-bit bounds would
+            # collide into degenerate [x, x) shards; grow by splitting
+            raise ValueError("shard_count must be in 1..4096")
+        bounds = [""] + [
+            format(i * 65536 // shard_count, "04x")
+            for i in range(1, shard_count)
+        ] + [""]
+        with self._propose_lock:
+            return self._commit({
+                "op": "create_space", "name": name,
+                "bounds": bounds, "addrs": replica_addrs})
+
+    def _apply_create_space(self, name: str, bounds: list[str],
+                            addrs: list[str]) -> list[dict]:
+        if name in self.spaces:
+            raise ValueError(f"space {name!r} exists")
+        shards = []
+        for i in range(len(bounds) - 1):
+            shards.append({"shard_id": self._next_shard,
+                           "start": bounds[i], "end": bounds[i + 1],
+                           "addrs": list(addrs)})
+            self._next_shard += 1
+        self.spaces[name] = shards
+        return [dict(s) for s in shards]
+
+    def alloc_shard_id(self) -> int:
+        with self._propose_lock:
+            return self._commit({"op": "alloc_shard"})
+
+    def _apply_alloc_shard(self) -> int:
+        sid = self._next_shard
+        self._next_shard += 1
+        return sid
+
+    def register_split(self, space: str, parent_id: int, child_id: int,
+                       split_key: str) -> None:
+        with self._propose_lock:
+            self._commit({"op": "register_split", "space": space,
+                          "parent_id": parent_id, "child_id": child_id,
+                          "split_key": split_key})
+
+    def _apply_register_split(self, space: str, parent_id: int,
+                              child_id: int, split_key: str) -> None:
+        from .shardnode import split_ranges
+
+        split_ranges(self.spaces[space], parent_id, child_id, split_key)
+
+    def route_key(self, space: str, key: str) -> dict:
+        from .shardnode import route_ranges
+
+        with self._lock:
+            try:
+                return route_ranges(self.spaces[space], key)
+            except KeyError:
+                raise KeyError(
+                    f"no shard owns {key!r} in space {space!r}") from None
+
+    def get_space(self, name: str) -> list[dict]:
+        with self._lock:
+            return [dict(s) for s in self.spaces[name]]
+
     def stat(self) -> dict:
         with self._lock:
             return {
@@ -336,6 +415,39 @@ class ClusterMgr(ReplicatedFsm):
 
     def rpc_stat(self, args, body):
         return self.stat()
+
+    def rpc_create_space(self, args, body):
+        self._leader_gate()
+        try:
+            shards = self.create_space(args["name"], args["shard_count"],
+                                       args["addrs"])
+        except ValueError as e:
+            raise rpc.RpcError(409, str(e)) from None
+        return {"shards": shards}
+
+    def rpc_get_space(self, args, body):
+        self._leader_gate()
+        try:
+            return {"shards": self.get_space(args["name"])}
+        except KeyError:
+            raise rpc.RpcError(404, f"no space {args['name']!r}") from None
+
+    def rpc_route_key(self, args, body):
+        self._leader_gate()
+        try:
+            return {"shard": self.route_key(args["space"], args["key"])}
+        except KeyError as e:
+            raise rpc.RpcError(404, str(e)) from None
+
+    def rpc_alloc_shard_id(self, args, body):
+        self._leader_gate()
+        return {"shard_id": self.alloc_shard_id()}
+
+    def rpc_register_split(self, args, body):
+        self._leader_gate()
+        self.register_split(args["space"], args["parent_id"],
+                            args["child_id"], args["split_key"])
+        return {}
 
     def rpc_raft_status(self, args, body):
         return self.raft.status() if self.raft else {"role": "standalone"}

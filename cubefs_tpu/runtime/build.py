@@ -166,5 +166,38 @@ def load() -> ctypes.CDLL:
             lib.cfs_truncate.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
             lib.cfs_flush.restype = c.c_int
             lib.cfs_flush.argtypes = [c.c_void_p, c.c_int]
+            # ordered KV store (RocksDB-analog shard/state engine)
+            lib.kv_open.restype = c.c_void_p
+            lib.kv_open.argtypes = [c.c_char_p]
+            lib.kv_close.argtypes = [c.c_void_p]
+            lib.kv_put.restype = c.c_int
+            lib.kv_put.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32,
+                                   c.c_char_p, c.c_uint32]
+            lib.kv_del.restype = c.c_int
+            lib.kv_del.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32]
+            lib.kv_get.restype = c.c_int64
+            lib.kv_get.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32,
+                                   c.c_void_p, c.c_uint32]
+            lib.kv_count.restype = c.c_uint64
+            lib.kv_count.argtypes = [c.c_void_p]
+            lib.kv_scan.restype = c.c_int64
+            lib.kv_scan.argtypes = [
+                c.c_void_p, c.c_char_p, c.c_uint32, c.c_char_p, c.c_uint32,
+                c.c_uint32, c.c_void_p, c.c_uint32,
+                c.POINTER(c.c_uint32), c.POINTER(c.c_uint32)]
+            lib.kv_median.restype = c.c_int64
+            lib.kv_median.argtypes = [c.c_void_p, c.c_char_p, c.c_uint32,
+                                      c.c_char_p, c.c_uint32, c.c_void_p,
+                                      c.c_uint32]
+            lib.kv_batch.restype = c.c_int64
+            lib.kv_batch.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+            lib.kv_compact.restype = c.c_int
+            lib.kv_compact.argtypes = [c.c_void_p]
+            lib.kv_clear.restype = c.c_int
+            lib.kv_clear.argtypes = [c.c_void_p]
+            lib.kv_wal_bytes.restype = c.c_uint64
+            lib.kv_wal_bytes.argtypes = [c.c_void_p]
+            lib.kv_snap_bytes.restype = c.c_uint64
+            lib.kv_snap_bytes.argtypes = [c.c_void_p]
             _lib = lib
     return _lib

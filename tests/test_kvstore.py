@@ -1,0 +1,97 @@
+"""Native ordered-KV engine (runtime/src/kvstore.cc — the RocksDB
+choke-point analog: kvstorev2/rocksdb.go, store_rocksdb.go roles)."""
+
+import os
+
+import pytest
+
+from cubefs_tpu.runtime.kvstore import KvError, KvStore
+
+
+def test_basic_ops_and_order(tmp_path):
+    kv = KvStore(str(tmp_path))
+    kv.put(b"b", b"2")
+    kv.put(b"a", b"1")
+    kv.put(b"c", b"3")
+    assert kv.get(b"a") == b"1"
+    assert [k for k, _ in kv.scan()] == [b"a", b"b", b"c"]
+    assert [k for k, _ in kv.scan(b"b", b"c")] == [b"b"]
+    assert kv.count() == 3
+    kv.delete(b"b")
+    with pytest.raises(KeyError):
+        kv.delete(b"b")
+    with pytest.raises(KeyError):
+        kv.get(b"b")
+    assert b"a" in kv and b"b" not in kv
+    kv.close()
+
+
+def test_reopen_recovers_wal_and_snapshot(tmp_path):
+    kv = KvStore(str(tmp_path))
+    for i in range(100):
+        kv.put(f"k{i:03d}".encode(), f"v{i}".encode())
+    kv.compact()  # snapshot
+    for i in range(100, 150):
+        kv.put(f"k{i:03d}".encode(), f"v{i}".encode())  # WAL-only
+    kv.delete(b"k000")
+    kv.close()
+    kv = KvStore(str(tmp_path))
+    assert kv.count() == 149
+    assert kv.get(b"k149") == b"v149"
+    with pytest.raises(KeyError):
+        kv.get(b"k000")
+    kv.close()
+
+
+def test_torn_tail_is_dropped(tmp_path):
+    kv = KvStore(str(tmp_path))
+    kv.put(b"good", b"yes")
+    kv.close()
+    with open(tmp_path / "kv.wal", "ab") as f:
+        f.write(b"\x12\x34 torn garbage that is not a frame")
+    kv = KvStore(str(tmp_path))
+    assert kv.get(b"good") == b"yes" and kv.count() == 1
+    # the store keeps working: the torn tail was truncated away
+    kv.put(b"more", b"data")
+    kv.close()
+    kv = KvStore(str(tmp_path))
+    assert kv.count() == 2
+    kv.close()
+
+
+def test_batch_is_atomic_single_sync(tmp_path):
+    kv = KvStore(str(tmp_path))
+    kv.put(b"stale", b"x")
+    kv.apply_batch([("put", f"b{i}", b"v") for i in range(50)]
+                   + [("delete", "stale", None)])
+    assert kv.count() == 50
+    with pytest.raises(KeyError):
+        kv.get(b"stale")
+    kv.close()
+    kv = KvStore(str(tmp_path))
+    assert kv.count() == 50
+    kv.close()
+
+
+def test_scan_grows_buffer_for_fat_values(tmp_path):
+    """A record bigger than the 1 MiB page must not silently truncate
+    the scan (splits and snapshots rely on completeness)."""
+    kv = KvStore(str(tmp_path))
+    fat = os.urandom(3 << 20)
+    kv.put(b"aa", b"small")
+    kv.put(b"bb", fat)
+    kv.put(b"cc", b"tail")
+    got = {k: v for k, v in kv.scan()}
+    assert set(got) == {b"aa", b"bb", b"cc"}
+    assert got[b"bb"] == fat
+    kv.close()
+
+
+def test_autocompaction_bounds_wal(tmp_path):
+    kv = KvStore(str(tmp_path))
+    for i in range(5000):
+        kv.put(b"hot", os.urandom(512))  # same key rewritten
+    # WAL must have been folded into snapshots along the way
+    assert kv.wal_bytes() < 3 << 20
+    assert kv.count() == 1
+    kv.close()

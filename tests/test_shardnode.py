@@ -1,0 +1,212 @@
+"""Durable ShardNode: native-KV persistence, kill-and-restart recovery,
+range split, clustermgr catalog (blobstore/shardnode/storage/shard.go +
+clustermgr/catalog parity)."""
+
+import time
+
+import pytest
+
+from cubefs_tpu.blob.clustermgr import ClusterMgr
+from cubefs_tpu.blob.shardnode import Catalog, ShardNode
+from cubefs_tpu.utils import rpc
+from cubefs_tpu.utils.rpc import NodePool
+
+from test_tools import _kv_call, make_sn_cluster
+
+
+def _leader_of(nodes, shard_id):
+    for sn in nodes:
+        r = sn.rafts.get(shard_id)
+        if r is not None and r.status()["role"] == "leader":
+            return sn
+    return None
+
+
+def test_shard_kill_and_restart_preserves_items(tmp_path):
+    pool, nodes = make_sn_cluster(tmp_path)
+    try:
+        for i in range(8):
+            _kv_call(pool, nodes, "kv_put",
+                     {"shard_id": 1, "key": f"a{i:02d}"}, f"v{i}".encode())
+        _kv_call(pool, nodes, "kv_put", {"shard_id": 2, "key": "zz"}, b"Z")
+    finally:
+        for sn in nodes:
+            sn.stop()
+    # full-cluster restart from disk: manifest reopens every shard and
+    # its raft group; the native KV already holds the items (no raft
+    # snapshot needed to see data)
+    pool2 = NodePool()
+    nodes2 = []
+    for i in range(3):
+        sn = ShardNode(i, addr=f"sn{i}", node_pool=pool2,
+                       data_dir=str(tmp_path / f"sn{i}"))
+        pool2.bind(f"sn{i}", sn)
+        nodes2.append(sn)
+    try:
+        assert set(nodes2[0].shards) == {1, 2}
+
+        # durable store readable immediately on every node that had
+        # applied before the kill (at minimum the old leader), before
+        # any election or raft replay
+        def _direct(shard_id, key):
+            n = 0
+            for sn in nodes2:
+                try:
+                    sn.shards[shard_id].get(key)
+                    n += 1
+                except KeyError:
+                    pass
+            return n
+
+        assert _direct(1, "a03") >= 1
+        assert _direct(2, "zz") >= 1
+        # and the replicated write path comes back
+        _kv_call(pool2, nodes2, "kv_put", {"shard_id": 1, "key": "post"},
+                 b"restart")
+        _, v = _kv_call(pool2, nodes2, "kv_get",
+                        {"shard_id": 1, "key": "post"})
+        assert v == b"restart"
+        _, v = _kv_call(pool2, nodes2, "kv_get",
+                        {"shard_id": 1, "key": "a07"})
+        assert v == b"v7"
+    finally:
+        for sn in nodes2:
+            sn.stop()
+
+
+def test_shard_split_moves_range_and_survives_restart(tmp_path):
+    pool = NodePool()
+    nodes = []
+    peers = [f"sn{i}" for i in range(3)]
+    for i in range(3):
+        sn = ShardNode(i, addr=f"sn{i}", node_pool=pool,
+                       data_dir=str(tmp_path / f"sn{i}"))
+        pool.bind(f"sn{i}", sn)
+        nodes.append(sn)
+    for sn in nodes:
+        sn.create_shard(1, "", "", peers=peers)
+    try:
+        for i in range(20):
+            _kv_call(pool, nodes, "kv_put",
+                     {"shard_id": 1, "key": f"k{i:02d}"}, f"v{i}".encode())
+        meta = _kv_call(pool, nodes, "shard_split",
+                        {"shard_id": 1, "child_id": 2})[0]
+        split_key = meta["split_key"]
+        assert meta["child_id"] == 2 and split_key == "k10"
+        time.sleep(0.5)  # let followers apply the split
+        for sn in nodes:
+            assert sn.shards[1].end == split_key
+            assert sn.shards[2].start == split_key
+            assert sn.shards[1].count() == 10
+            assert sn.shards[2].count() == 10
+        # both halves serve reads and writes through their own groups
+        _, v = _kv_call(pool, nodes, "kv_get",
+                        {"shard_id": 1, "key": "k04"})
+        assert v == b"v4"
+        _, v = _kv_call(pool, nodes, "kv_get",
+                        {"shard_id": 2, "key": "k15"})
+        assert v == b"v15"
+        _kv_call(pool, nodes, "kv_put", {"shard_id": 2, "key": "k99"},
+                 b"post-split")
+    finally:
+        for sn in nodes:
+            sn.stop()
+    # restart: the child shard must come back from the manifest
+    pool2 = NodePool()
+    nodes2 = []
+    for i in range(3):
+        sn = ShardNode(i, addr=f"sn{i}", node_pool=pool2,
+                       data_dir=str(tmp_path / f"sn{i}"))
+        pool2.bind(f"sn{i}", sn)
+        nodes2.append(sn)
+    try:
+        assert set(nodes2[0].shards) == {1, 2}
+        assert nodes2[0].shards[1].end == split_key
+        # k99 may still be in a restarted follower's unapplied raft WAL
+        # suffix: read through the cluster (leader has it by definition)
+        _, v = _kv_call(pool2, nodes2, "kv_get",
+                        {"shard_id": 2, "key": "k99"})
+        assert v == b"post-split"
+        _, v = _kv_call(pool2, nodes2, "kv_get",
+                        {"shard_id": 2, "key": "k15"})
+        assert v == b"v15"
+    finally:
+        for sn in nodes2:
+            sn.stop()
+
+
+def test_split_too_small_rejected(tmp_path):
+    pool = NodePool()
+    sn = ShardNode(0, addr="sn0", node_pool=pool,
+                   data_dir=str(tmp_path / "sn0"))
+    pool.bind("sn0", sn)
+    sn.create_shard(1, "", "")
+    try:
+        sn.shards[1].apply({"op": "put", "key": "only",
+                            "value_hex": b"x".hex()})
+        with pytest.raises(rpc.RpcError) as ei:
+            sn.split_shard(1, 2)
+        assert ei.value.code == 400
+    finally:
+        sn.stop()
+
+
+def test_clustermgr_catalog_space_and_split(tmp_path):
+    cm_ = ClusterMgr(data_dir=str(tmp_path / "cm"))
+    shards = cm_.create_space("blobs", 4, ["sn0", "sn1", "sn2"])
+    assert len(shards) == 4
+    assert shards[0]["start"] == "" and shards[-1]["end"] == ""
+    assert [s["start"] for s in shards[1:]] == ["4000", "8000", "c000"]
+    with pytest.raises(ValueError):
+        cm_.create_space("blobs", 2, ["sn0"])
+    r = cm_.route_key("blobs", "a-key")
+    assert r["start"] <= "a-key" and ("a-key" < r["end"] or not r["end"])
+    # split registration narrows the parent and inserts the child
+    child_id = cm_.alloc_shard_id()
+    cm_.register_split("blobs", r["shard_id"], child_id, "a0")
+    assert cm_.route_key("blobs", "a1")["shard_id"] == child_id
+    assert cm_.route_key("blobs", "90")["shard_id"] == r["shard_id"]
+    # idempotent re-registration (retried caller)
+    cm_.register_split("blobs", r["shard_id"], child_id, "a0")
+    assert len(cm_.get_space("blobs")) == 5
+
+
+def test_catalog_client_split_routing():
+    cat = Catalog()
+    cat.create_space("s", [
+        {"shard_id": 1, "start": "", "end": "m", "addrs": ["a"]},
+        {"shard_id": 2, "start": "m", "end": "", "addrs": ["b"]},
+    ])
+    cat.apply_split("s", 1, 3, "g")
+    assert cat.route("s", "apple")["shard_id"] == 1
+    assert cat.route("s", "house")["shard_id"] == 3
+    assert cat.route("s", "zebra")["shard_id"] == 2
+
+
+def test_shardnode_durable_over_real_http(tmp_path):
+    """Single durable shardnode behind a REAL RpcServer (the in-process
+    pool hides redirect/socket behavior — memory: drive new distributed
+    paths over real HTTP)."""
+    sn = ShardNode(0, data_dir=str(tmp_path / "sn"))
+    srv = rpc.RpcServer(sn, service="shardnode").start()
+    try:
+        cli = rpc.Client(srv.addr)
+        cli.call("create_shard", {"shard_id": 7, "start": "", "end": ""})
+        cli.call("kv_put", {"shard_id": 7, "key": "http"}, b"payload")
+        _, v = cli.call("kv_get", {"shard_id": 7, "key": "http"})
+        assert v == b"payload"
+        meta, _ = cli.call("list_shards", {})
+        assert meta["shards"][0]["items"] == 1
+    finally:
+        srv.stop()
+        sn.stop()
+    # process restart analog
+    sn2 = ShardNode(0, data_dir=str(tmp_path / "sn"))
+    srv2 = rpc.RpcServer(sn2, service="shardnode").start()
+    try:
+        cli = rpc.Client(srv2.addr)
+        _, v = cli.call("kv_get", {"shard_id": 7, "key": "http"})
+        assert v == b"payload"
+    finally:
+        srv2.stop()
+        sn2.stop()

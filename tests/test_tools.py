@@ -78,7 +78,14 @@ def test_shardnode_replicated_kv(tmp_path):
         assert meta["keys"] == ["alpha"]
         # replicated to all members
         time.sleep(0.3)
-        assert sum(1 for sn in nodes if sn.shards[1].kv.get("alpha") == b"v1") >= 2
+
+        def _has(sn):
+            try:
+                return sn.shards[1].get("alpha") == b"v1"
+            except KeyError:
+                return False
+
+        assert sum(1 for sn in nodes if _has(sn)) >= 2
         _kv_call(pool, nodes, "kv_delete", {"shard_id": 1, "key": "alpha"})
         with pytest.raises((rpc.RpcError, TimeoutError)):
             _kv_call(pool, nodes, "kv_get", {"shard_id": 1, "key": "alpha"},
