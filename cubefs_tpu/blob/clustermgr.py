@@ -336,6 +336,46 @@ class ClusterMgr(ReplicatedFsm):
 
         split_ranges(self.spaces[space], parent_id, child_id, split_key)
 
+    # shardnode liveness (volatile, leader-local — the same contract as
+    # disk heartbeats: a fresh leader starts blind and the scheduler's
+    # grace period covers it)
+    def shardnode_heartbeat(self, addr: str) -> None:
+        with self._lock:
+            if not hasattr(self, "_sn_heartbeat"):
+                self._sn_heartbeat = {}
+            self._sn_heartbeat[addr] = time.time()
+
+    def shardnode_last_seen(self, addr: str) -> float | None:
+        with self._lock:
+            return getattr(self, "_sn_heartbeat", {}).get(addr)
+
+    def suspect_dead_shardnodes(self) -> list[str]:
+        """Shardnode addrs referenced by any space that have missed the
+        heartbeat window (never-seen addrs are NOT suspected — a blind
+        fresh leader must not declare the world dead)."""
+        now = time.time()
+        with self._lock:
+            hb = getattr(self, "_sn_heartbeat", {})
+            referenced = {a for shards in self.spaces.values()
+                          for s in shards for a in s["addrs"]}
+            return sorted(
+                a for a in referenced
+                if a in hb and now - hb[a] > self.HEARTBEAT_TIMEOUT)
+
+    def update_shard_addrs(self, space: str, shard_id: int,
+                           addrs: list[str]) -> None:
+        with self._propose_lock:
+            self._commit({"op": "update_shard_addrs", "space": space,
+                          "shard_id": shard_id, "addrs": addrs})
+
+    def _apply_update_shard_addrs(self, space: str, shard_id: int,
+                                  addrs: list[str]) -> None:
+        for s in self.spaces[space]:
+            if s["shard_id"] == shard_id:
+                s["addrs"] = list(addrs)
+                return
+        raise KeyError(f"shard {shard_id} not in space {space!r}")
+
     def route_key(self, space: str, key: str) -> dict:
         from .shardnode import route_ranges
 
@@ -349,6 +389,13 @@ class ClusterMgr(ReplicatedFsm):
     def get_space(self, name: str) -> list[dict]:
         with self._lock:
             return [dict(s) for s in self.spaces[name]]
+
+    def snapshot_spaces(self) -> dict[str, list[dict]]:
+        """Copy of the whole catalog under the lock — sweeps must not
+        iterate live dicts the raft apply thread mutates."""
+        with self._lock:
+            return {name: [dict(s) for s in shards]
+                    for name, shards in self.spaces.items()}
 
     def stat(self) -> dict:
         with self._lock:
@@ -447,6 +494,16 @@ class ClusterMgr(ReplicatedFsm):
         self._leader_gate()
         self.register_split(args["space"], args["parent_id"],
                             args["child_id"], args["split_key"])
+        return {}
+
+    def rpc_shardnode_heartbeat(self, args, body):
+        self.shardnode_heartbeat(args["addr"])
+        return {}
+
+    def rpc_update_shard_addrs(self, args, body):
+        self._leader_gate()
+        self.update_shard_addrs(args["space"], args["shard_id"],
+                                args["addrs"])
         return {}
 
     def rpc_raft_status(self, args, body):

@@ -142,7 +142,7 @@ class Scheduler:
         given, receives True only when a NEW task was created."""
         with self._lock:
             for t in self.tasks.values():
-                if (t["vid"] == vid and t["unit_index"] == unit_index
+                if (t.get("vid") == vid and t.get("unit_index") == unit_index
                         and t["state"] in ("pending", "leased")):
                     return t["task_id"]  # idempotent re-queue
             vol = self.cm.get_volume(vid)
@@ -172,6 +172,105 @@ class Scheduler:
                 created_flag.append(True)
             self._record(task["task_id"], "queued", vid=vid,
                          unit=unit_index, reason=reason)
+            self._checkpoint()
+            return task["task_id"]
+
+    # ---------------- shard-domain tasks ----------------
+    # shard_disk_repairer.go / shard_migrate.go parity: when a shardnode
+    # dies (or an operator migrates a replica), queue a task that swaps
+    # the replica out of every affected shard's raft group. Raft itself
+    # moves the data (InstallSnapshot + appends); the task is the
+    # control-plane choreography, leased/parked like every other task.
+    def collect_dead_shardnodes(self) -> list[str]:
+        if not self.switch.enabled("shard_repair"):
+            return []
+        if not getattr(self.cm, "is_leader", lambda: True)():
+            return []
+        if getattr(self.cm, "raft", None) is not None:
+            now = time.time()
+            if getattr(self, "_leader_since", None) is None:
+                self._leader_since = now
+            if now - self._leader_since < 2 * self.cm.HEARTBEAT_TIMEOUT:
+                return []
+        dead = self.cm.suspect_dead_shardnodes()
+        for addr in dead:
+            self.repair_shardnode(addr)
+        return dead
+
+    def repair_shardnode(self, dead_addr: str) -> int:
+        """Queue one shard_repair task per shard replicated on
+        `dead_addr`; idempotent. Returns tasks queued."""
+        n = 0
+        with self._lock:
+            for space, shards in self.cm.snapshot_spaces().items():
+                for s in shards:
+                    if dead_addr in s["addrs"]:
+                        if self._queue_shard_task(
+                                "shard_repair", space, s, dead_addr):
+                            n += 1
+        return n
+
+    def shard_migrate(self, space: str, shard_id: int, src_addr: str,
+                      dest_addr: str | None = None) -> str | None:
+        """Manual replica move (shard_migrate.go / manual_migrater
+        analog); healthy source stays up until the new member is in."""
+        with self._lock:
+            s = next(x for x in self.cm.get_space(space)
+                     if x["shard_id"] == shard_id)
+            if src_addr not in s["addrs"]:
+                raise ValueError(f"{src_addr} not a replica of shard "
+                                 f"{shard_id}")
+            return self._queue_shard_task("shard_migrate", space, s,
+                                          src_addr, dest_addr)
+
+    def _healthy_shardnodes(self, exclude: set[str]) -> list[str]:
+        now = time.time()
+        out = []
+        for addr in self.cm.get_service("shardnode"):
+            if addr in exclude:
+                continue
+            seen = self.cm.shardnode_last_seen(addr)
+            if seen is not None and now - seen <= self.cm.HEARTBEAT_TIMEOUT:
+                out.append(addr)
+        return out
+
+    def _queue_shard_task(self, kind: str, space: str, shard: dict,
+                          src_addr: str,
+                          dest_addr: str | None = None) -> str | None:
+        with self._lock:
+            for t in self.tasks.values():
+                if (t.get("space") == space
+                        and t.get("shard_id") == shard["shard_id"]
+                        and t["state"] in ("pending", "leased")):
+                    return t["task_id"]  # idempotent re-queue
+            if dest_addr is None:
+                candidates = self._healthy_shardnodes(set(shard["addrs"]))
+                if not candidates:
+                    return None  # nowhere to go yet; next sweep retries
+                dest_addr = candidates[0]
+            new_addrs = [dest_addr if a == src_addr else a
+                         for a in shard["addrs"]]
+            task = {
+                "task_id": uuid.uuid4().hex[:16],
+                "type": kind,
+                "space": space,
+                "shard_id": shard["shard_id"],
+                "start": shard["start"],
+                "end": shard["end"],
+                "src_addr": src_addr,
+                "dest_addr": dest_addr,
+                "old_addrs": list(shard["addrs"]),
+                "new_addrs": new_addrs,
+                "state": "pending",
+                "lease_until": 0.0,
+                "worker": None,
+                "attempts": 0,
+                "reason": f"{kind} away from {src_addr}",
+            }
+            self.tasks[task["task_id"]] = task
+            self._record(task["task_id"], "queued", space=space,
+                         shard=shard["shard_id"], src=src_addr,
+                         dest=dest_addr)
             self._checkpoint()
             return task["task_id"]
 
@@ -434,6 +533,11 @@ class Scheduler:
             self._record(task_id, "done", worker=worker_id)
             # checkpoint AFTER the cm writeback: a crash in between must
             # re-run the (idempotent) repair, never lose it
+            if t["type"] in ("shard_repair", "shard_migrate"):
+                self.cm.update_shard_addrs(t["space"], t["shard_id"],
+                                           t["new_addrs"])
+                self._checkpoint()
+                return
             self.cm.update_volume_unit(
                 t["vid"], t["unit_index"], t["dest_disk"], t["dest_chunk"],
                 t["dest_addr"],
@@ -485,6 +589,7 @@ class Scheduler:
                         continue  # replicated cm: only the leader's
                         # scheduler generates tasks
                     self.collect_broken_disks()
+                    self.collect_dead_shardnodes()
                     self.consume_repair_msgs()
                     self.consume_delete_msgs()
                     self._ticks = getattr(self, "_ticks", 0) + 1

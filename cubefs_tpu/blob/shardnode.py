@@ -247,7 +247,10 @@ class ShardNode:
         sh.on_range_change = self._save_manifest
         self.shards[shard_id] = sh
         self._peers[shard_id] = list(peers) if peers else None
-        if peers and len(peers) > 1:
+        # only a MEMBER may run the group's raft: a node migrated away
+        # from a shard keeps its (stale) data but must not campaign
+        # against the real group after a restart
+        if peers and len(peers) > 1 and self.addr in peers:
             node = raftlib.RaftNode(
                 f"sn{shard_id}", self.addr, peers, sh.apply, self.pool,
                 data_dir=os.path.join(self.data_dir, f"sn_{shard_id}")
@@ -315,24 +318,65 @@ class ShardNode:
         is node -> shard, matching every RPC path."""
         with self._lock:
             child_id, split_key = rec["child_id"], rec["split_key"]
-            if child_id in self.shards:  # replayed split after restart:
-                return {"child_id": child_id,  # manifest already has it
-                        "split_key": split_key}
-            if not parent.owns(split_key) or split_key == parent.start:
-                # a stale retry after an earlier split already narrowed
-                # the parent: applying it would create overlapping
-                # ranges (deterministic rejection on every replica)
-                raise ValueError(
-                    f"split key {split_key!r} outside parent range "
-                    f"[{parent.start!r}, {parent.end!r})")
-            child = self._open_shard(child_id, split_key, parent.end,
-                                     rec.get("peers"))
-            moved = parent.items_in(split_key, parent.end)
-            child.take_range(moved)
-            parent.drop_range([k for k, _ in moved])
+            if child_id in self.shards:
+                # WAL replay after restart: the child exists from the
+                # manifest, but replayed pre-split puts may have
+                # re-inserted upper-half keys into the parent's durable
+                # KV — reconcile instead of returning early, or those
+                # ghosts survive forever out of range
+                child = self.shards[child_id]
+            else:
+                if not parent.owns(split_key) or split_key == parent.start:
+                    # a stale retry after an earlier split already
+                    # narrowed the parent: applying it would create
+                    # overlapping ranges (deterministic rejection on
+                    # every replica)
+                    raise ValueError(
+                        f"split key {split_key!r} outside parent range "
+                        f"[{parent.start!r}, {parent.end!r})")
+                child = self._open_shard(child_id, split_key, parent.end,
+                                         rec.get("peers"))
+            # anything the parent still holds at/above the split key
+            # belongs to the child (re-put is idempotent on replay)
+            moved = parent.items_in(split_key, child.end)
+            if moved:
+                child.take_range(moved)
+                parent.drop_range([k for k, _ in moved])
             parent.end = split_key
             self._save_manifest()
             return {"child_id": child_id, "split_key": split_key}
+
+    def update_shard_peers(self, shard_id: int, peers: list[str]) -> None:
+        """Replica-set change for one shard (shard repair/migrate):
+        restart the shard's raft group over the new peer list, keeping
+        its durable KV and raft WAL. Single-replica-swap changes keep
+        quorum overlap between old and new configurations, the same
+        argument as raft single-server membership change."""
+        with self._lock:
+            sh = self.shards.get(shard_id)
+            if sh is None:
+                raise rpc.RpcError(404, f"shard {shard_id} not on node "
+                                        f"{self.node_id}")
+            old = self.rafts.pop(shard_id, None)
+            if old is not None:
+                old.stop()
+            self._peers[shard_id] = list(peers)
+            self._save_manifest()
+            if peers and len(peers) > 1 and self.addr in peers:
+                node = raftlib.RaftNode(
+                    f"sn{shard_id}", self.addr, peers, sh.apply, self.pool,
+                    data_dir=os.path.join(self.data_dir, f"sn_{shard_id}")
+                    if self.data_dir else None,
+                    snapshot_fn=sh.state_bytes,
+                    restore_fn=sh.restore_state,
+                )
+                raftlib.register_routes(self.extra_routes, node)
+                self.rafts[shard_id] = node.start()
+
+    def send_heartbeat(self, cm_client) -> None:
+        """Liveness report to clustermgr (blobnode heartbeat analog for
+        the shard domain); deployments call this on a timer."""
+        cm_client.call("shardnode_heartbeat", {"addr": self.addr})
 
     def stop(self) -> None:
         for r in self.rafts.values():
@@ -374,6 +418,10 @@ class ShardNode:
 
     def rpc_shard_split(self, args, body):
         return self.split_shard(args["shard_id"], args["child_id"])
+
+    def rpc_update_shard_peers(self, args, body):
+        self.update_shard_peers(args["shard_id"], args["peers"])
+        return {}
 
     def rpc_list_shards(self, args, body):
         with self._lock:

@@ -97,6 +97,8 @@ class RepairWorker:
             renew_stop.set()
 
     def _execute(self, task: dict) -> None:
+        if task["type"] in ("shard_repair", "shard_migrate"):
+            return self._execute_shard_swap(task)
         vol = VolumeInfo.from_dict(
             self.cm.call("get_volume", {"vid": task["vid"]})[0]["volume"]
         )
@@ -170,6 +172,38 @@ class RepairWorker:
                          "chunk_id": task["dest_chunk"], "bid": bid},
                         rec[out_pos].tobytes(),
                     )
+
+    def _execute_shard_swap(self, task: dict) -> None:
+        """shard_repair / shard_migrate execution (shard_disk_repairer
+        role): swap one replica of a shard's raft group. Raft moves the
+        data — the new member starts empty and the leader catches it up
+        (appends or InstallSnapshot); this choreography is idempotent,
+        so a lease expiry mid-way just re-runs it.
+
+        Order matters: the NEW member must exist before survivors
+        repoint at it, or the shrunk group could elect without it."""
+        new_addrs = task["new_addrs"]
+        dest = self.nodes.get(task["dest_addr"])
+        dest.call("create_shard", {
+            "shard_id": task["shard_id"], "start": task["start"],
+            "end": task["end"], "peers": new_addrs})
+        # re-issue the peer list on the destination too: a retried task
+        # may find the shard pre-created with a stale set
+        dest.call("update_shard_peers", {
+            "shard_id": task["shard_id"], "peers": new_addrs})
+        for addr in new_addrs:
+            if addr == task["dest_addr"]:
+                continue
+            self.nodes.get(addr).call("update_shard_peers", {
+                "shard_id": task["shard_id"], "peers": new_addrs})
+        # the old replica (if it still answers) leaves the group; best
+        # effort — a dead node is the usual reason we're here
+        try:
+            self.nodes.get(task["src_addr"]).call("update_shard_peers", {
+                "shard_id": task["shard_id"],
+                "peers": [a for a in new_addrs]})
+        except Exception:
+            pass
 
     def _list_bids(self, vol: VolumeInfo, exclude: int) -> list[int]:
         for u in vol.units:

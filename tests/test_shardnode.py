@@ -130,6 +130,19 @@ def test_shard_split_moves_range_and_survives_restart(tmp_path):
         _, v = _kv_call(pool2, nodes2, "kv_get",
                         {"shard_id": 2, "key": "k15"})
         assert v == b"v15"
+        # raft WAL replay re-applied pre-split puts into the parent and
+        # then the split record: the reconcile must leave NO ghost keys
+        # >= split_key in any parent replica
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            ghosts = [k for sn in nodes2
+                      for k in sn.shards[1].list("", 100)
+                      if k >= split_key]
+            if not ghosts and all(sn.shards[1].count() <= 10
+                                  for sn in nodes2):
+                break
+            time.sleep(0.2)
+        assert not ghosts, f"out-of-range ghosts survived replay: {ghosts}"
     finally:
         for sn in nodes2:
             sn.stop()
@@ -181,6 +194,116 @@ def test_catalog_client_split_routing():
     assert cat.route("s", "apple")["shard_id"] == 1
     assert cat.route("s", "house")["shard_id"] == 3
     assert cat.route("s", "zebra")["shard_id"] == 2
+
+
+def test_shard_repair_replaces_killed_replica(tmp_path):
+    """e2e shard-domain repair (shard_disk_repairer.go parity): a
+    shardnode dies -> scheduler detects via stale heartbeat -> queues a
+    shard_repair task -> worker swaps the replica set -> the new member
+    is caught up by raft and the catalog repoints."""
+    from cubefs_tpu.blob.scheduler import Scheduler
+    from cubefs_tpu.blob.worker import RepairWorker
+
+    pool = NodePool()
+    cm_ = ClusterMgr()
+    pool.bind("cm", cm_)
+    nodes = {}
+    for i in range(4):
+        sn = ShardNode(i, addr=f"sn{i}", node_pool=pool,
+                       data_dir=str(tmp_path / f"sn{i}"))
+        pool.bind(f"sn{i}", sn)
+        cm_.register_service("shardnode", f"sn{i}")
+        cm_.shardnode_heartbeat(f"sn{i}")
+        nodes[f"sn{i}"] = sn
+    replicas = ["sn0", "sn1", "sn2"]
+    cm_.create_space("s", 1, replicas)
+    shard_id = cm_.get_space("s")[0]["shard_id"]
+    for a in replicas:
+        nodes[a].create_shard(shard_id, "", "", peers=replicas)
+    live = [nodes[a] for a in replicas]
+    try:
+        for i in range(10):
+            _kv_call(pool, live, "kv_put",
+                     {"shard_id": shard_id, "key": f"k{i}"}, f"v{i}".encode())
+        # sn1 dies: stop it, and its heartbeat goes stale
+        nodes["sn1"].stop()
+        pool.bind("sn1", object())
+        cm_._sn_heartbeat["sn1"] = time.time() - 60
+        sched = Scheduler(cm_, node_pool=pool)
+        dead = sched.collect_dead_shardnodes()
+        assert dead == ["sn1"]
+        # idempotent: a second sweep queues nothing new
+        sched.collect_dead_shardnodes()
+        pending = [t for t in sched.tasks.values()
+                   if t["type"] == "shard_repair"]
+        assert len(pending) == 1 and pending[0]["dest_addr"] == "sn3"
+        worker = RepairWorker(rpc.Client(sched), rpc.Client(cm_), pool)
+        assert worker.run_once()
+        assert worker.completed == 1, sched.tasks
+        # catalog now points at the replacement
+        addrs = cm_.get_space("s")[0]["addrs"]
+        assert addrs == ["sn0", "sn3", "sn2"]
+        # raft catches the new member up; survivors + newcomer serve
+        survivors = [nodes[a] for a in addrs]
+        _kv_call(pool, survivors, "kv_put",
+                 {"shard_id": shard_id, "key": "post-repair"}, b"ok")
+        _, v = _kv_call(pool, survivors, "kv_get",
+                        {"shard_id": shard_id, "key": "k3"})
+        assert v == b"v3"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                if nodes["sn3"].shards[shard_id].get("k3") == b"v3":
+                    break
+            except KeyError:
+                pass
+            time.sleep(0.2)
+        assert nodes["sn3"].shards[shard_id].get("k3") == b"v3"
+    finally:
+        for sn in nodes.values():
+            sn.stop()
+
+
+def test_shard_manual_migrate(tmp_path):
+    """shard_migrate.go parity: operator moves one replica off a
+    healthy node."""
+    from cubefs_tpu.blob.scheduler import Scheduler
+    from cubefs_tpu.blob.worker import RepairWorker
+
+    pool = NodePool()
+    cm_ = ClusterMgr()
+    pool.bind("cm", cm_)
+    nodes = {}
+    for i in range(4):
+        sn = ShardNode(i, addr=f"sn{i}", node_pool=pool,
+                       data_dir=str(tmp_path / f"sn{i}"))
+        pool.bind(f"sn{i}", sn)
+        cm_.register_service("shardnode", f"sn{i}")
+        cm_.shardnode_heartbeat(f"sn{i}")
+        nodes[f"sn{i}"] = sn
+    replicas = ["sn0", "sn1", "sn2"]
+    cm_.create_space("s", 1, replicas)
+    shard_id = cm_.get_space("s")[0]["shard_id"]
+    for a in replicas:
+        nodes[a].create_shard(shard_id, "", "", peers=replicas)
+    try:
+        _kv_call(pool, [nodes[a] for a in replicas], "kv_put",
+                 {"shard_id": shard_id, "key": "x"}, b"1")
+        sched = Scheduler(cm_, node_pool=pool)
+        tid = sched.shard_migrate("s", shard_id, "sn2", "sn3")
+        assert tid
+        worker = RepairWorker(rpc.Client(sched), rpc.Client(cm_), pool)
+        assert worker.run_once() and worker.completed == 1
+        assert cm_.get_space("s")[0]["addrs"] == ["sn0", "sn1", "sn3"]
+        # the migrated-away node no longer runs this shard's raft group
+        assert shard_id not in nodes["sn2"].rafts
+        survivors = [nodes[a] for a in ("sn0", "sn1", "sn3")]
+        _, v = _kv_call(pool, survivors, "kv_get",
+                        {"shard_id": shard_id, "key": "x"})
+        assert v == b"1"
+    finally:
+        for sn in nodes.values():
+            sn.stop()
 
 
 def test_shardnode_durable_over_real_http(tmp_path):
