@@ -42,6 +42,7 @@ class ClusterMgr(ReplicatedFsm):
         # shardnode catalog (clustermgr/catalog role): space -> sorted
         # [{shard_id, start, end, addrs}] range map
         self.spaces: dict[str, list[dict]] = {}
+        self._sn_heartbeat: dict[str, float] = {}  # volatile, leader-local
         self._next_disk = 1
         self._next_vid = 1
         self._next_bid = 1
@@ -341,13 +342,11 @@ class ClusterMgr(ReplicatedFsm):
     # grace period covers it)
     def shardnode_heartbeat(self, addr: str) -> None:
         with self._lock:
-            if not hasattr(self, "_sn_heartbeat"):
-                self._sn_heartbeat = {}
             self._sn_heartbeat[addr] = time.time()
 
     def shardnode_last_seen(self, addr: str) -> float | None:
         with self._lock:
-            return getattr(self, "_sn_heartbeat", {}).get(addr)
+            return self._sn_heartbeat.get(addr)
 
     def suspect_dead_shardnodes(self) -> list[str]:
         """Shardnode addrs referenced by any space that have missed the
@@ -355,7 +354,7 @@ class ClusterMgr(ReplicatedFsm):
         fresh leader must not declare the world dead)."""
         now = time.time()
         with self._lock:
-            hb = getattr(self, "_sn_heartbeat", {})
+            hb = self._sn_heartbeat
             referenced = {a for shards in self.spaces.values()
                           for s in shards for a in s["addrs"]}
             return sorted(

@@ -103,15 +103,8 @@ class Scheduler:
         cluster with migrations."""
         if not self.switch.enabled("disk_repair"):
             return []
-        if not getattr(self.cm, "is_leader", lambda: True)():
-            self._leader_since = None
+        if not self._leader_grace_ok():
             return []
-        if getattr(self.cm, "raft", None) is not None:
-            now = time.time()
-            if getattr(self, "_leader_since", None) is None:
-                self._leader_since = now
-            if now - self._leader_since < 2 * self.cm.HEARTBEAT_TIMEOUT:
-                return []
         newly = []
         for disk_id in self.cm.suspect_dead_disks():
             self.mark_disk_broken(disk_id)
@@ -181,17 +174,26 @@ class Scheduler:
     # the replica out of every affected shard's raft group. Raft itself
     # moves the data (InstallSnapshot + appends); the task is the
     # control-plane choreography, leased/parked like every other task.
-    def collect_dead_shardnodes(self) -> list[str]:
-        if not self.switch.enabled("shard_repair"):
-            return []
+    def _leader_grace_ok(self) -> bool:
+        """Shared failure-detector gate: non-leaders reset the grace
+        clock; a (re-)elected leader waits out a full heartbeat window
+        before trusting its blind, leader-local liveness view."""
         if not getattr(self.cm, "is_leader", lambda: True)():
-            return []
+            self._leader_since = None
+            return False
         if getattr(self.cm, "raft", None) is not None:
             now = time.time()
             if getattr(self, "_leader_since", None) is None:
                 self._leader_since = now
             if now - self._leader_since < 2 * self.cm.HEARTBEAT_TIMEOUT:
-                return []
+                return False
+        return True
+
+    def collect_dead_shardnodes(self) -> list[str]:
+        if not self.switch.enabled("shard_repair"):
+            return []
+        if not self._leader_grace_ok():
+            return []
         dead = self.cm.suspect_dead_shardnodes()
         for addr in dead:
             self.repair_shardnode(addr)
@@ -220,6 +222,13 @@ class Scheduler:
             if src_addr not in s["addrs"]:
                 raise ValueError(f"{src_addr} not a replica of shard "
                                  f"{shard_id}")
+            if dest_addr is not None:
+                if dest_addr in s["addrs"]:
+                    raise ValueError(f"{dest_addr} is already a replica "
+                                     f"of shard {shard_id}")
+                if dest_addr not in self.cm.get_service("shardnode"):
+                    raise ValueError(f"{dest_addr} is not a registered "
+                                     f"shardnode")
             return self._queue_shard_task("shard_migrate", space, s,
                                           src_addr, dest_addr)
 
@@ -247,7 +256,21 @@ class Scheduler:
                 candidates = self._healthy_shardnodes(set(shard["addrs"]))
                 if not candidates:
                     return None  # nowhere to go yet; next sweep retries
-                dest_addr = candidates[0]
+                # least-load spread (pick_destination analog): count
+                # catalog replicas + already-queued repairs per addr so
+                # a 50-shard node's death doesn't dogpile one spare
+                load: dict[str, int] = {c: 0 for c in candidates}
+                for shards in self.cm.snapshot_spaces().values():
+                    for x in shards:
+                        for a in x["addrs"]:
+                            if a in load:
+                                load[a] += 1
+                for t in self.tasks.values():
+                    if (t["type"] in ("shard_repair", "shard_migrate")
+                            and t["state"] in ("pending", "leased")
+                            and t["dest_addr"] in load):
+                        load[t["dest_addr"]] += 1
+                dest_addr = min(candidates, key=lambda c: load[c])
             new_addrs = [dest_addr if a == src_addr else a
                          for a in shard["addrs"]]
             task = {
@@ -586,8 +609,12 @@ class Scheduler:
             while not self._stop.wait(interval):
                 try:
                     if not getattr(self.cm, "is_leader", lambda: True)():
-                        continue  # replicated cm: only the leader's
-                        # scheduler generates tasks
+                        # replicated cm: only the leader's scheduler
+                        # generates tasks — and losing leadership must
+                        # reset the grace clock even while the switch
+                        # gates skip the collectors
+                        self._leader_since = None
+                        continue
                     self.collect_broken_disks()
                     self.collect_dead_shardnodes()
                     self.consume_repair_msgs()

@@ -337,8 +337,11 @@ class ShardNode:
                 child = self._open_shard(child_id, split_key, parent.end,
                                          rec.get("peers"))
             # anything the parent still holds at/above the split key
-            # belongs to the child (re-put is idempotent on replay)
-            moved = parent.items_in(split_key, child.end)
+            # belongs to the child or its descendants (re-put is
+            # idempotent on replay). Unbounded upper: the child's end
+            # may already be narrowed by a LATER split in the manifest,
+            # and that split's own replay cascades the uppers onward.
+            moved = parent.items_in(split_key, "")
             if moved:
                 child.take_range(moved)
                 parent.drop_range([k for k, _ in moved])

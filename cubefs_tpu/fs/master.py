@@ -122,6 +122,56 @@ class Master(ReplicatedFsm):
         with self._lock:
             self._load_state_dict(json.loads(data))
 
+    # ---- incremental snapshot segments (metadata_snapshot.go role:
+    # snapshot cost is O(touched volumes/users), not O(cluster)) ----
+    _SEG_OPS = {  # op -> (prefix, record key) for per-entity segments
+        "put_volume": ("vol", "name"),
+        "add_mp": ("vol", "name"),
+        "update_dp": ("vol", "name"),
+        "set_vol_capacity": ("vol", "name"),
+        "set_quota": ("vol", "name"),
+        "delete_quota": ("vol", "name"),
+        "put_user": ("user", "ak"),
+        "delete_user": ("user", "ak"),
+        "set_grant": ("user", "ak"),
+    }
+
+    def _segments_of(self, rec: dict) -> list[str]:
+        op = rec["op"]
+        segs = []
+        ent = self._SEG_OPS.get(op)
+        if ent is not None:
+            segs.append(f"{ent[0]}:{rec[ent[1]]}")
+        if op in ("put_volume", "add_mp", "decommission"):
+            segs.append("meta")  # id counters / drain set moved
+        return segs or ["meta"]  # unknown future op: at least the meta
+
+    def _segment_state(self, seg: str):
+        kind, _, key = seg.partition(":")
+        with self._lock:
+            if kind == "vol":
+                return self.volumes.get(key)
+            if kind == "user":
+                return self.users.get(key)
+            return {"next": [self._next_pid, self._next_dp],
+                    "decommissioned": sorted(self.decommissioned)}
+
+    def _load_segment_state(self, seg: str, value) -> None:
+        kind, _, key = seg.partition(":")
+        if kind == "vol":
+            self.volumes[key] = value
+        elif kind == "user":
+            self.users[key] = value
+        else:
+            self._next_pid, self._next_dp = value["next"]
+            self.decommissioned = set(value["decommissioned"])
+
+    def _all_segments(self) -> list[str]:
+        with self._lock:
+            return (["meta"]
+                    + [f"vol:{n}" for n in self.volumes]
+                    + [f"user:{a}" for a in self.users])
+
     def _apply(self, rec: dict):
         rec = dict(rec)
         op = rec.pop("op")

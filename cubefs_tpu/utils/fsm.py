@@ -17,6 +17,21 @@ Provided:
     raises RpcError(421, "leader=...") on a follower
   * `is_leader` / `leader_addr` / `_leader_gate`
   * `snapshot()` — standalone wal rotation (raft compacts on its own)
+
+Incremental snapshots (master/metadata_snapshot.go + RocksDB role): a
+host that additionally implements the segment contract
+
+  * `_segments_of(record) -> list[str]` — segment ids an op dirties
+  * `_segment_state(seg_id) -> json-able | None` — current value
+    (None = the segment no longer exists)
+  * `_load_segment_state(seg_id, value)` — restore one segment
+
+gets O(dirty) standalone snapshots: applies mark segments dirty, and
+`snapshot()` writes only those into a native ordered-KV segment store
+(runtime kvstore: its own WAL + compaction bound recovery cost) before
+rotating the op WAL. Full-state `_state_dict` remains the raft
+InstallSnapshot shape — segmentation is about the LOCAL persistence
+path, which is exactly where the reference leans on RocksDB.
 """
 
 from __future__ import annotations
@@ -39,6 +54,9 @@ class ReplicatedFsm:
         self._propose_lock = threading.Lock()  # serializes decide+commit
         self.raft = None
         self.extra_routes: dict = {}
+        self._fsm_dirty: set[str] = set()
+        self._segmented = hasattr(self, "_segments_of")
+        self._seg_store = None
         if peers and len(peers) > 1:
             from ..parallel import raft as raftlib
 
@@ -79,6 +97,8 @@ class ReplicatedFsm:
             # applied and replay to a different state
             with self._wal_lock:
                 out = self._apply(dict(record))
+                if self._segmented:
+                    self._fsm_dirty.update(self._segments_of(record))
                 if self._wal is not None:
                     self._wal.write(json.dumps(record) + "\n")
                     self._wal.flush()
@@ -104,9 +124,26 @@ class ReplicatedFsm:
     def _restore_bytes(self, data: bytes) -> None:
         self._load_state_dict(json.loads(data))
 
+    def _seg_dir(self) -> str:
+        return os.path.join(self._fsm_data_dir, "segments")
+
+    def _open_seg_store(self):
+        if self._seg_store is None:
+            from ..runtime.kvstore import KvStore
+
+            self._seg_store = KvStore(self._seg_dir())
+        return self._seg_store
+
     def _fsm_load(self) -> None:
+        # the legacy full-state file is removed only AFTER a complete
+        # migration into the segment store — while it exists it stays
+        # authoritative (a crash mid-migration leaves a PARTIAL store)
         if os.path.exists(self._snap_path()):
             self._load_state_dict(json.load(open(self._snap_path())))
+        elif self._segmented and os.path.isdir(self._seg_dir()):
+            kv = self._open_seg_store()
+            for k, v in kv.scan():
+                self._load_segment_state(k.decode(), json.loads(v))
         if os.path.exists(self._wal_path()):
             for line in open(self._wal_path()):
                 line = line.strip()
@@ -116,22 +153,57 @@ class ReplicatedFsm:
                     except json.JSONDecodeError:
                         break  # torn tail
                     self._apply(rec)
+                    if self._segmented:
+                        # replayed ops must re-dirty their segments: the
+                        # store's copy predates them
+                        self._fsm_dirty.update(self._segments_of(rec))
 
-    def snapshot(self) -> None:
-        """Standalone mode: rotate the wal under a snapshot (raft mode
-        compacts through its own snapshot machinery)."""
+    def snapshot(self) -> int:
+        """Standalone mode: persist state and rotate the wal (raft mode
+        compacts through its own snapshot machinery). Segmented hosts
+        write only DIRTY segments — O(touched), not O(state). Returns
+        the number of segments written (0 for full-state hosts)."""
         if not self._fsm_data_dir or self.raft is not None:
-            return
+            return 0
         with self._wal_lock:
-            tmp = self._snap_path() + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self._state_dict(), f)
-            os.replace(tmp, self._snap_path())
+            written = 0
+            if self._segmented:
+                kv = self._open_seg_store()
+                if kv.count() == 0 or os.path.exists(self._snap_path()):
+                    # first segmented snapshot (fresh store, or
+                    # migrating off a legacy full-state file): EVERY
+                    # segment must land, or rotating the wal would drop
+                    # the untouched remainder
+                    self._fsm_dirty.update(self._all_segments())
+                for seg in sorted(self._fsm_dirty):
+                    val = self._segment_state(seg)
+                    if val is None:
+                        try:
+                            kv.delete(seg.encode())
+                        except KeyError:
+                            pass
+                    else:
+                        kv.put(seg.encode(), json.dumps(val).encode())
+                    written += 1
+                self._fsm_dirty.clear()
+                # the op wal only rotates once its effects are durable
+                # in the segment store (kv_put fsyncs per mutation)
+                if os.path.exists(self._snap_path()):
+                    os.remove(self._snap_path())  # legacy file migrated
+            else:
+                tmp = self._snap_path() + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(self._state_dict(), f)
+                os.replace(tmp, self._snap_path())
             if self._wal is not None:
                 self._wal.close()
             open(self._wal_path(), "w").close()
             self._wal = open(self._wal_path(), "a")
+            return written
 
     def fsm_stop(self) -> None:
         if self.raft is not None:
             self.raft.stop()
+        if self._seg_store is not None:
+            self._seg_store.close()
+            self._seg_store = None

@@ -1,0 +1,98 @@
+"""Incremental master snapshots: O(dirty) segment persistence
+(master/metadata_snapshot.go + RocksDB-backed raftstore role)."""
+
+import json
+import os
+
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.utils.rpc import NodePool
+
+
+def _mk_master(tmp_path):
+    return Master(NodePool(), data_dir=str(tmp_path / "master"),
+                  allow_single_node=True)
+
+
+def _synth_vol(i):
+    return {"name": f"v{i:05d}", "mps": [{"pid": i * 2 + 1}],
+            "dps": [{"dp_id": i * 2 + 1}]}
+
+
+def test_snapshot_cost_is_o_dirty_not_o_state(tmp_path):
+    m = _mk_master(tmp_path)
+    n = 2000
+    for i in range(n):
+        m._commit({"op": "put_volume", "name": f"v{i:05d}",
+                   "vol": _synth_vol(i)})
+    first = m.snapshot()
+    assert first >= n + 1  # every volume + the meta segment
+    # touch ONE volume: the next snapshot writes one segment, not 2000
+    m._commit({"op": "set_vol_capacity", "name": "v00007",
+               "capacity": 123})
+    second = m.snapshot()
+    assert second <= 2, f"snapshot rewrote {second} segments for 1 change"
+    # untouched state: zero segments
+    assert m.snapshot() == 0
+    m.fsm_stop()
+
+
+def test_segment_restart_recovers_state_and_wal_tail(tmp_path):
+    m = _mk_master(tmp_path)
+    for i in range(50):
+        m._commit({"op": "put_volume", "name": f"v{i:05d}",
+                   "vol": _synth_vol(i)})
+    m.snapshot()
+    # post-snapshot tail lives only in the op wal
+    m._commit({"op": "set_vol_capacity", "name": "v00003",
+               "capacity": 999})
+    m._commit({"op": "put_volume", "name": "tail-vol",
+               "vol": {"name": "tail-vol", "mps": [{"pid": 900}],
+                       "dps": [{"dp_id": 901}]}})
+    m.fsm_stop()
+    m2 = _mk_master(tmp_path)
+    assert len(m2.volumes) == 51
+    assert m2.volumes["v00003"]["capacity"] == 999
+    assert m2._next_pid == 901 and m2._next_dp == 902
+    # replayed wal ops re-dirtied their segments: snapshotting now
+    # persists them and truncates the wal
+    assert 1 <= m2.snapshot() <= 4
+    m2.fsm_stop()
+    m3 = _mk_master(tmp_path)
+    assert m3.volumes["v00003"]["capacity"] == 999
+    assert "tail-vol" in m3.volumes
+    m3.fsm_stop()
+
+
+def test_deleted_user_segment_is_removed(tmp_path):
+    m = _mk_master(tmp_path)
+    cred = m.create_user("alice")
+    m.snapshot()
+    m.delete_user(cred["access_key"])
+    m.snapshot()
+    m.fsm_stop()
+    m2 = _mk_master(tmp_path)
+    assert cred["access_key"] not in m2.users
+    m2.fsm_stop()
+
+
+def test_legacy_fullstate_snapshot_migrates(tmp_path):
+    # simulate a pre-segmentation data dir: full-state snapshot.json
+    d = tmp_path / "master"
+    os.makedirs(d)
+    state = {"volumes": {"old": {"name": "old", "mps": [{"pid": 5}],
+                                 "dps": [{"dp_id": 6}]}},
+             "next": [10, 11], "decommissioned": ["dead-node"],
+             "users": {}}
+    with open(d / "snapshot.json", "w") as f:
+        json.dump(state, f)
+    m = _mk_master(tmp_path)
+    assert "old" in m.volumes and "dead-node" in m.decommissioned
+    # first segmented snapshot migrates EVERYTHING and retires the file
+    written = m.snapshot()
+    assert written >= 2
+    assert not os.path.exists(d / "snapshot.json")
+    m.fsm_stop()
+    m2 = _mk_master(tmp_path)
+    assert "old" in m2.volumes and m2._next_pid == 10
+    assert "dead-node" in m2.decommissioned
+    m2.fsm_stop()
