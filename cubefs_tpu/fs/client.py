@@ -32,7 +32,8 @@ _META_PACKET_OPS = {"lookup": pkt.OP_META_LOOKUP,
                     "readdir": pkt.OP_META_READDIR,
                     "submit": pkt.OP_META_SUBMIT,
                     "dentry_count": pkt.OP_META_DENTRY_COUNT,
-                    "alloc_ino": pkt.OP_META_ALLOC_INO}
+                    "alloc_ino": pkt.OP_META_ALLOC_INO,
+                    "walk": pkt.OP_META_WALK}
 
 
 
@@ -152,6 +153,75 @@ class MetaWrapper:
     def update_mps(self, mps: list[dict]) -> None:
         """Adopt a refreshed partition table (e.g. after an mp split)."""
         self.mps = mps
+
+    def walk(self, ino: int, names: list[str],
+             stat: bool = False) -> tuple[int, dict | None]:
+        """Server-side path walk: ONE round trip consumes as many
+        components as the target node leader-serves (vs one lookup RT
+        per component). Partial results resume at the partition owning
+        the returned ino; a no-progress partial (leadership mid-move)
+        degrades to a single classic lookup so the loop always
+        terminates."""
+        names = list(names)
+        out: dict = {}
+        while names:
+            mp = self._mp_for(ino)
+            out = self._call(mp, "walk",
+                             {"ino": ino, "names": names,
+                              "stat": stat})[0]
+            remaining = out["remaining"]
+            if not remaining:
+                ino = out["ino"]
+                break
+            if out["ino"] == ino and len(remaining) == len(names):
+                # no progress (leadership mid-move): one classic lookup
+                # guarantees forward motion
+                ino = self.lookup(ino, names[0])
+                names = names[1:]
+            else:
+                ino, names = out["ino"], remaining
+            out = {}
+        inode = out.get("inode")
+        if stat and inode is None:
+            inode = self.inode_get(ino)
+        return ino, inode
+
+    def mknod(self, parent: int, name: str, typ: str, mode: int = 0o644,
+              target=None, quota_ids: list[int] | None = None) -> int:
+        """Compound create: inode + dentry in ONE commit against the
+        parent's partition (locality-preserving placement). Falls back
+        to the classic alloc-elsewhere + two commits when the parent's
+        inode range is exhausted."""
+        rec = {"op": "mknod", "parent": parent, "name": name, "type": typ,
+               "mode": mode, "ts": time.time()}
+        if target is not None:
+            rec["target"] = target
+        if quota_ids:
+            rec["quota_ids"] = list(quota_ids)
+        try:
+            mp = self._mp_for(parent)
+            return self._call(mp, "submit",
+                              {"record": rec})[0]["result"]["ino"]
+        except FsError as e:
+            if e.errno != 28:
+                raise
+        inode = self.inode_create(typ, mode, target=target,
+                                  quota_ids=quota_ids)
+        try:
+            self.dentry_create(parent, name, inode["ino"])
+        except FsError:
+            self.inode_delete(inode["ino"])
+            raise
+        return inode["ino"]
+
+    def unlink2(self, parent: int, name: str) -> int:
+        """Compound unlink (dentry + inode, one commit). Raises
+        FsError(18) when the child inode is in another partition — the
+        caller runs the classic two-op path."""
+        mp = self._mp_for(parent)
+        rec = {"op": "unlink2", "parent": parent, "name": name,
+               "ts": time.time()}
+        return self._call(mp, "submit", {"record": rec})[0]["result"]["ino"]
 
     def inode_get(self, ino: int) -> dict:
         mp = self._mp_for(ino)
@@ -649,9 +719,10 @@ class FileSystem:
 
     # ---- path helpers ----
     def resolve(self, path: str) -> int:
-        ino = mn.ROOT_INO
-        for part in [p for p in path.split("/") if p]:
-            ino = self.meta.lookup(ino, part)
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return mn.ROOT_INO
+        ino, _ = self.meta.walk(mn.ROOT_INO, parts)
         return ino
 
     def _parent_of(self, path: str) -> tuple[int, str]:
@@ -661,10 +732,14 @@ class FileSystem:
     def _walk_parent(self, path: str) -> tuple[int, list[int], str]:
         """Resolve the parent dir, returning (parent_ino, ancestor_inos
         incl. parent, leaf_name) — the ancestor chain feeds quota
-        inheritance."""
+        inheritance, so the per-component walk only runs when quotas
+        are actually configured; otherwise one server-side walk."""
         parts = [p for p in path.split("/") if p]
         if not parts:
             raise FsError(22, "root has no parent")
+        if not self.quotas:
+            parent, _ = self.meta.walk(mn.ROOT_INO, parts[:-1])
+            return parent, [parent], parts[-1]
         parent = mn.ROOT_INO
         chain = [parent]
         for part in parts[:-1]:
@@ -683,25 +758,14 @@ class FileSystem:
     # ---- files & dirs ----
     def mkdir(self, path: str, mode: int = 0o755) -> int:
         parent, name = self._parent_of(path)
-        inode = self.meta.inode_create(mn.DIR, mode)
-        try:
-            self.meta.dentry_create(parent, name, inode["ino"])
-        except FsError:
-            self.meta.inode_delete(inode["ino"])
-            raise
-        return inode["ino"]
+        return self.meta.mknod(parent, name, mn.DIR, mode)
 
     def create(self, path: str, mode: int = 0o644) -> int:
         self._maybe_refresh_quotas()
         parent, ancestors, name = self._walk_parent(path)
         qids = self._inherited_quota_ids(ancestors)
-        inode = self.meta.inode_create(mn.FILE, mode, quota_ids=qids)
-        try:
-            self.meta.dentry_create(parent, name, inode["ino"])
-        except FsError:
-            self.meta.inode_delete(inode["ino"])
-            raise
-        return inode["ino"]
+        return self.meta.mknod(parent, name, mn.FILE, mode,
+                               quota_ids=qids)
 
     def write_file(self, path: str, data: bytes, append: bool = False) -> int:
         try:
@@ -751,10 +815,23 @@ class FileSystem:
         return self.meta.readdir(self.resolve(path))
 
     def stat(self, path: str) -> dict:
-        return self.meta.inode_get(self.resolve(path))
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return self.meta.inode_get(mn.ROOT_INO)
+        _, inode = self.meta.walk(mn.ROOT_INO, parts, stat=True)
+        return inode
 
     def unlink(self, path: str) -> None:
         parent, name = self._parent_of(path)
+        try:
+            # compound: dentry + inode in one commit (mknod placement
+            # puts them in the same partition); errno 18 = foreign inode
+            ino = self.meta.unlink2(parent, name)
+            self.data.close_stream(ino)
+            return
+        except FsError as e:
+            if e.errno != 18:
+                raise
         ino = self.meta.lookup(parent, name)
         inode = self.meta.inode_get(ino)
         if inode["type"] == mn.DIR and self.meta.dentry_count(ino) > 0:

@@ -56,6 +56,7 @@ OP_META_READDIR = 0x22
 OP_META_SUBMIT = 0x23
 OP_META_DENTRY_COUNT = 0x24
 OP_META_ALLOC_INO = 0x25
+OP_META_WALK = 0x26
 
 RESULT_OK = 0
 RESULT_RPC = 0xE1  # structured rpc error: code+message ride the args

@@ -53,14 +53,26 @@ def cluster(tmp_path):
     c.stop()
 
 
+def _mkdir_scattered(fs, path):
+    """mkdir via the classic two-op path: inode allocated round-robin
+    across partitions (the compound mknod fast path would colocate the
+    child with its parent, which is exactly what this test must avoid)."""
+    from cubefs_tpu.fs import metanode as mn
+
+    parent, name = fs._parent_of(path)
+    inode = fs.meta.inode_create(mn.DIR, 0o755)
+    fs.meta.dentry_create(parent, name, inode["ino"])
+    return inode["ino"]
+
+
 def _dirs_on_distinct_mps(fs):
     """Create directories until two land on different meta partitions;
     returns (path_a, ino_a, path_b, ino_b)."""
-    first_path, first_ino = "/d0", fs.mkdir("/d0")
+    first_path, first_ino = "/d0", _mkdir_scattered(fs, "/d0")
     first_pid = fs.meta._mp_for(first_ino)["pid"]
     for i in range(1, 64):
         p = f"/d{i}"
-        ino = fs.mkdir(p)
+        ino = _mkdir_scattered(fs, p)
         if fs.meta._mp_for(ino)["pid"] != first_pid:
             return first_path, first_ino, p, ino
     raise AssertionError("could not place dirs on distinct partitions")
@@ -358,7 +370,7 @@ def test_rename_over_remote_dir_victim_uses_guarded_tx(cluster):
     victim_path = None
     for i in range(32):
         p = f"/vic{i}"
-        ino = fs.mkdir(p)
+        ino = _mkdir_scattered(fs, p)  # compound mknod would colocate
         if fs.meta._mp_for(ino)["pid"] != root_pid:
             victim_path = p
             victim_ino = ino
