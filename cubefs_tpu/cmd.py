@@ -83,13 +83,19 @@ def run_role(cfg: dict):
         psrv = svc.serve_packets(host=cfg.get("listen_host", "127.0.0.1"),
                                  port=int(cfg.get("packet_port", 0)))
         print(f"[metanode] packet plane on {psrv.addr}", flush=True)
+        # native C++ read plane (metaserve.cc) beside the Python planes
+        raddr = svc.serve_native(host=cfg.get("listen_host", "127.0.0.1"),
+                                 port=int(cfg.get("read_port", 0)))
+        if raddr:
+            print(f"[metanode] native read plane on {raddr}", flush=True)
         master = rpc.Client(cfg["master_addr"])
         zone = cfg.get("zone", "default")
         master.call("register", {"kind": "meta", "addr": srv.addr,
-                                 "zone": zone, "packet_addr": psrv.addr})
+                                 "zone": zone, "packet_addr": psrv.addr,
+                                 "read_addr": raddr})
         _heartbeat_loop(lambda: master.call(
             "heartbeat", {"kind": "meta", "addr": srv.addr, "zone": zone,
-                          "packet_addr": psrv.addr}))
+                          "packet_addr": psrv.addr, "read_addr": raddr}))
 
         def _dp_view():
             meta, _ = master.call("dp_view", {})

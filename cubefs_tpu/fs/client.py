@@ -35,6 +35,10 @@ _META_PACKET_OPS = {"lookup": pkt.OP_META_LOOKUP,
                     "alloc_ino": pkt.OP_META_ALLOC_INO,
                     "walk": pkt.OP_META_WALK}
 
+# read ops additionally served by the metanode's native C++ read plane
+# (runtime/src/metaserve.cc) when the view advertises meta_read_addrs
+_META_READ_OPS = {"lookup", "inode_get", "readdir", "dentry_count", "walk"}
+
 
 
 
@@ -52,8 +56,12 @@ class MetaWrapper:
         # discipline as the data path)
         self.packet_addrs: dict[str, str] = dict(
             vol_view.get("meta_packet_addrs") or {})
+        # native C++ read plane (fastest): read ops try it first, then
+        # the Python packet plane, then HTTP — per-plane negative cache
+        self.read_addrs: dict[str, str] = dict(
+            vol_view.get("meta_read_addrs") or {})
         self._packet_clients: dict[str, object] = {}
-        self._packet_down: dict[str, float] = {}  # addr -> retry-after ts
+        self._packet_down: dict[str, float] = {}  # plane addr -> retry ts
 
     def _mp_for(self, ino: int) -> dict:
         for mp in self.mps:
@@ -74,7 +82,8 @@ class MetaWrapper:
             payload["record"] = dict(payload["record"])
             payload["record"].setdefault("op_id", uuid.uuid4().hex)
         try:
-            if self.packet_addrs and method in _META_PACKET_OPS:
+            if ((self.packet_addrs or self.read_addrs)
+                    and method in _META_PACKET_OPS):
                 # same replica/redirect loop, per-address call swapped
                 # for the packet transport (with per-address HTTP
                 # fallback inside _packet_one)
@@ -93,27 +102,36 @@ class MetaWrapper:
             raise
 
     def _packet_one(self, addr: str, method: str, payload: dict) -> dict:
-        """One meta call to one node: packet plane if advertised and not
-        negative-cached, HTTP otherwise. Packet rpc-status errors are
-        re-raised as RpcError so BOTH transports share one redirect /
-        errno semantics."""
+        """One meta call to one node, trying the fastest advertised
+        plane first: native C++ read plane (read ops only) -> Python
+        packet plane -> HTTP. Packet rpc-status errors are re-raised as
+        RpcError so every transport shares one redirect / errno
+        semantics; protocol-level failures negative-cache that plane
+        only and fall through to the next."""
+        planes = []
+        if method in _META_READ_OPS and addr in self.read_addrs:
+            planes.append(self.read_addrs[addr])
         paddr = self.packet_addrs.get(addr)
-        if paddr and time.monotonic() >= self._packet_down.get(addr, 0.0):
-            cli = self._packet_clients.get(addr)
+        if paddr:
+            planes.append(paddr)
+        for plane in planes:
+            if time.monotonic() < self._packet_down.get(plane, 0.0):
+                continue
+            cli = self._packet_clients.get(plane)
             if cli is None:
-                cli = self._packet_clients[addr] = pkt.PacketClient(
-                    paddr, timeout=10.0, connect_timeout=2.0)
+                cli = self._packet_clients[plane] = pkt.PacketClient(
+                    plane, timeout=10.0, connect_timeout=2.0)
             try:
                 rargs, _ = cli.call(_META_PACKET_OPS[method], args=payload)
                 return rargs
             except pkt.PacketError as e:
                 if e.code is not None:
                     raise rpc.RpcError(e.code, e.message) from None
-                # protocol-level failure (crc, desync): distrust the
-                # plane for a while, fall through to HTTP
-                self._packet_down[addr] = time.monotonic() + 30.0
+                # protocol-level failure (crc, desync): distrust this
+                # plane for a while, fall through to the next
+                self._packet_down[plane] = time.monotonic() + 30.0
             except (ConnectionError, OSError, TimeoutError):
-                self._packet_down[addr] = time.monotonic() + 30.0
+                self._packet_down[plane] = time.monotonic() + 30.0
         meta, _ = self.nodes.get(addr).call(method, payload)
         return meta
 

@@ -446,16 +446,20 @@ class Master(ReplicatedFsm):
                 info["packet_addr"] = packet_addr
 
     def register_metanode(self, addr: str, zone: str = "default",
-                          packet_addr: str | None = None) -> None:
+                          packet_addr: str | None = None,
+                          read_addr: str | None = None) -> None:
         with self._lock:
             info = self.metanodes.setdefault(addr, {"addr": addr})
             info["hb"] = time.time()
             info["zone"] = zone
             if packet_addr:
                 info["packet_addr"] = packet_addr
+            if read_addr:
+                info["read_addr"] = read_addr
 
     def heartbeat(self, addr: str, kind: str, zone: str | None = None,
-                  packet_addr: str | None = None) -> None:
+                  packet_addr: str | None = None,
+                  read_addr: str | None = None) -> None:
         with self._lock:
             reg = self.datanodes if kind == "data" else self.metanodes
             # unknown addr re-registers: a restarted master recovers its
@@ -468,6 +472,8 @@ class Master(ReplicatedFsm):
                 info["zone"] = zone or "default"
             if packet_addr:
                 info["packet_addr"] = packet_addr
+            if read_addr:
+                info["read_addr"] = read_addr
 
     def _live(self, reg: dict) -> list[str]:
         now = time.time()
@@ -652,11 +658,15 @@ class Master(ReplicatedFsm):
             meta_packet_addrs = {a: i["packet_addr"]
                                  for a, i in self.metanodes.items()
                                  if i.get("packet_addr")}
+            meta_read_addrs = {a: i["read_addr"]
+                               for a, i in self.metanodes.items()
+                               if i.get("read_addr")}
             return {"name": name, "mps": [dict(m) for m in vol["mps"]],
                     "dps": [dict(d) for d in vol["dps"]],
                     "quotas": dict(vol.get("quotas", {})),
                     "packet_addrs": packet_addrs,
-                    "meta_packet_addrs": meta_packet_addrs}
+                    "meta_packet_addrs": meta_packet_addrs,
+                    "meta_read_addrs": meta_read_addrs}
 
     def _meta_load(self) -> dict[str, int]:
         """Replica count per metanode across all volumes (placement load)."""
@@ -832,12 +842,14 @@ class Master(ReplicatedFsm):
                                    packet_addr=args.get("packet_addr"))
         else:
             self.register_metanode(args["addr"], zone,
-                                   packet_addr=args.get("packet_addr"))
+                                   packet_addr=args.get("packet_addr"),
+                                   read_addr=args.get("read_addr"))
         return {}
 
     def rpc_heartbeat(self, args, body):
         self.heartbeat(args["addr"], args["kind"], args.get("zone"),
-                       packet_addr=args.get("packet_addr"))
+                       packet_addr=args.get("packet_addr"),
+                       read_addr=args.get("read_addr"))
         return {}
 
     def rpc_node_list(self, args, body):

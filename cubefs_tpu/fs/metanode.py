@@ -84,6 +84,12 @@ class MetaPartition:
         # the leader's submit door, never the deterministic apply
         self.enforce = {"vol_full": False, "exceeded": set()}
         self.data_dir = data_dir
+        # native read-plane mirror (runtime/src/metaserve.cc): when
+        # attached, every apply re-states its tree mutation into the C++
+        # store under this same lock, so the native server always serves
+        # what a leader-routed Python read would
+        self._mir = None  # (ctypes lib, MetaServe handle)
+        self._last_tx_ops = None  # mirror hint from _apply_tx_commit
         self._oplog = None
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
@@ -131,6 +137,8 @@ class MetaPartition:
             try:
                 result = getattr(self, f"_apply_{op}")(record)
                 self._dirty |= self._DIRTY_MAP.get(op, set(self._SEGMENTS))
+                if self._mir is not None:
+                    self._mirror_op(record, result)
                 outcome = (result, None)
             except MetaError as e:
                 outcome = (None, (e.code, str(e)))
@@ -187,6 +195,78 @@ class MetaPartition:
         with self._lock:
             self._load_state_dict(json.loads(data))
             self._dirty = set(self._SEGMENTS)  # checkpoint must re-dump
+            if self._mir is not None:
+                self._mirror_full()
+
+    # ---------------- native read-plane mirror ----------------
+    def attach_mirror(self, lib, handle) -> None:
+        with self._lock:
+            self._mir = (lib, handle)
+            self._mirror_full()
+
+    def _mirror_full(self) -> None:
+        lib, h = self._mir
+        lib.ms_clear(h, self.pid)
+        for ino in self.inodes:
+            self._mirror_inode(ino)
+        for parent, d in self.dentries.items():
+            lib.ms_ensure_dir(h, self.pid, parent)
+            for name, ino in d.items():
+                nb = name.encode()
+                lib.ms_put_dentry(h, self.pid, parent, nb, len(nb), ino)
+
+    def _mirror_inode(self, ino: int) -> None:
+        lib, h = self._mir
+        inode = self.inodes.get(ino)
+        if inode is None:
+            lib.ms_del_inode(h, self.pid, ino)
+        else:
+            blob = json.dumps(inode).encode()
+            lib.ms_put_inode(h, self.pid, ino, blob, len(blob))
+
+    def _mirror_dentry(self, parent: int, name: str) -> None:
+        """Re-state one dentry from current tree state (self-correcting:
+        works for link, replace and remove alike)."""
+        lib, h = self._mir
+        nb = name.encode()
+        ino = self.dentries.get(parent, {}).get(name)
+        if ino is None:
+            lib.ms_del_dentry(h, self.pid, parent, nb, len(nb))
+        else:
+            lib.ms_put_dentry(h, self.pid, parent, nb, len(nb), ino)
+
+    def _mirror_op(self, r: dict, result) -> None:
+        """Called under the partition lock right after a successful
+        apply; mirrors exactly the trees the op touched."""
+        lib, h = self._mir
+        op = r["op"]
+        if op in ("mk_inode", "mknod"):
+            ino = r["ino"] if op == "mk_inode" else result["ino"]
+            self._mirror_inode(ino)
+            if r["type"] == DIR:
+                lib.ms_ensure_dir(h, self.pid, ino)
+            if op == "mknod":
+                self._mirror_dentry(r["parent"], r["name"])
+        elif op == "rm_inode":
+            lib.ms_del_inode(h, self.pid, r["ino"])
+            lib.ms_del_dir(h, self.pid, r["ino"])
+        elif op == "unlink2":
+            self._mirror_dentry(r["parent"], r["name"])
+            lib.ms_del_inode(h, self.pid, result["ino"])
+            lib.ms_del_dir(h, self.pid, result["ino"])
+        elif op in ("mk_dentry", "rm_dentry"):
+            self._mirror_dentry(r["parent"], r["name"])
+        elif op == "rename_local":
+            self._mirror_dentry(r["src_parent"], r["src_name"])
+            self._mirror_dentry(r["dst_parent"], r["dst_name"])
+        elif op in ("append_extents", "set_attr", "set_xattr", "truncate"):
+            self._mirror_inode(r["ino"])
+        elif op == "tx_commit":
+            for o in self._last_tx_ops or ():
+                if o["kind"] in ("guard_empty_dir", "mutex"):
+                    continue
+                self._mirror_dentry(o["parent"], o["name"])
+            self._last_tx_ops = None
 
     # ---------------- snapshot / recovery ----------------
     # Segmented checkpoint (partition_store.go analog: each tree dumps
@@ -543,12 +623,14 @@ class MetaPartition:
 
     def _apply_tx_commit(self, r: dict) -> dict:
         tx_id = r["tx_id"]
+        self._last_tx_ops = None  # idempotent retry must not replay hints
         done = self.tx_committed.get(tx_id)
         if done is not None:
             return {"victims": done["victims"]}  # idempotent retry
         tx = self.tx_pending.pop(tx_id, None)
         if tx is None:
             raise MetaError(ENOENT, f"tx {tx_id} not prepared here")
+        self._last_tx_ops = tx["ops"]  # mirror hint (not FSM state)
         victims: list[int] = []
         for op in tx["ops"]:
             if op["kind"] in ("guard_empty_dir", "mutex"):
@@ -799,9 +881,35 @@ class MetaNode:
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        # native read plane (runtime/src/metaserve.cc): the C++ tree
+        # mirror + GIL-free packet server for the hot read ops. Falls
+        # back to Python-only when the toolchain is absent.
+        self._native_lib = None
+        self._native_h = None
+        self.native_addr: str | None = None
+        if os.environ.get("CUBEFS_NATIVE_META", "1") != "0":
+            try:
+                from ..runtime import build as rt_build
+
+                self._native_lib = rt_build.load()
+                self._native_h = self._native_lib.ms_create()
+            except Exception:
+                self._native_lib = None
+                self._native_h = None
         self._tx_scanner = threading.Thread(target=self._tx_scan_loop,
                                             daemon=True)
         self._tx_scanner.start()
+
+    def serve_native(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the C++ read-plane server; returns its addr (None when
+        the native runtime is unavailable)."""
+        if self._native_h is None:
+            return None
+        p = self._native_lib.ms_serve(self._native_h, host.encode(), port)
+        if p < 0:
+            return None
+        self.native_addr = f"{host}:{p}"
+        return self.native_addr
 
     def create_partition(self, pid: int, start: int, end: int,
                          peers: list[str] | None = None) -> MetaPartition:
@@ -815,6 +923,14 @@ class MetaNode:
                         if self.data_dir and not replicated else None)
                 mp = MetaPartition(pid, start, end, pdir)
                 self.partitions[pid] = mp
+                if self._native_h is not None:
+                    self._native_lib.ms_add_partition(
+                        self._native_h, pid, start, end)
+                    mp.attach_mirror(self._native_lib, self._native_h)
+                    if not replicated:
+                        # standalone partitions always leader-serve
+                        self._native_lib.ms_set_serving(
+                            self._native_h, pid, 1, b"")
                 if replicated:
                     if not self.addr or self.pool is None:
                         raise rpc.RpcError(
@@ -832,6 +948,18 @@ class MetaNode:
                         restore_fn=mp.restore_state,
                     )
                     raftlib.register_routes(self.extra_routes, node)
+                    if self._native_h is not None:
+                        # serving flag flips synchronously with every
+                        # role transition — the native plane redirects
+                        # (421 leader=...) exactly when Python would
+                        lib, h = self._native_lib, self._native_h
+
+                        def _on_role(role, leader, _pid=pid):
+                            lib.ms_set_serving(
+                                h, _pid, 1 if role == "leader" else 0,
+                                (leader or "").encode())
+
+                        node.role_listener = _on_role
                     self.rafts[pid] = node.start()
             return self.partitions[pid]
 
@@ -858,6 +986,11 @@ class MetaNode:
         self._stop.set()
         for r in self.rafts.values():
             r.stop()
+        if self._native_h is not None:
+            # stop the listener + connections; the store handle is NOT
+            # destroyed — partitions still hold mirror references, and a
+            # post-stop apply must never write into freed memory
+            self._native_lib.ms_stop(self._native_h)
 
     # ---------------- transaction resolution (the TM scan) --------------
     def _submit_local(self, pid: int, record: dict):
@@ -1183,6 +1316,8 @@ class MetaNode:
             if raft_node is not None:
                 raft_node.stop()
             self.partitions.pop(pid, None)
+            if self._native_h is not None:
+                self._native_lib.ms_drop_partition(self._native_h, pid)
         return {}
 
     def rpc_set_enforcement(self, args, body):

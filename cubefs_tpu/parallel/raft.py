@@ -71,6 +71,11 @@ class RaftNode:
         self.data_dir = data_dir
 
         self._lock = threading.RLock()
+        # synchronous role/leader-change hook (e.g. the native meta read
+        # plane's serving flag): invoked UNDER the node lock at every
+        # transition, so listeners must be non-blocking and must never
+        # call back into this node
+        self.role_listener = None
         self.term = 0
         self.voted_for: str | None = None
         self.log: list[dict] = []  # entries AFTER log_base
@@ -397,6 +402,7 @@ class RaftNode:
             if args["term"] > self.term or self.role != "follower":
                 self._step_down(args["term"])
             self.leader = args["leader"]
+            self._notify_role()
             self._last_heard = time.monotonic()
             if args["index"] <= self.log_base:
                 return {"ok": True, "term": self.term}
@@ -418,6 +424,7 @@ class RaftNode:
             self.role = "candidate"
             self.voted_for = self.me
             self.leader = None
+            self._notify_role()
             self._persist_meta()
             term = self.term
             last_index = self._last_index()
@@ -470,6 +477,7 @@ class RaftNode:
                 return
             self.role = "leader"
             self.leader = self.me
+            self._notify_role()
             n = self._last_index() + 1
             self.next_index = {p: n for p in self.peers}
             self.match_index = {p: 0 for p in self.peers}
@@ -484,12 +492,21 @@ class RaftNode:
             ev.set()  # wake blocked follower-mode repl threads
         self._broadcast_append()
 
+    def _notify_role(self) -> None:
+        fn = self.role_listener
+        if fn is not None:
+            try:
+                fn(self.role, self.leader)
+            except Exception:
+                pass
+
     def _step_down(self, term: int) -> None:
         # caller holds the lock
         self.term = max(self.term, term)
         self.role = "follower"
         self.voted_for = None
         self.leader = None  # stale self/old-leader would misroute redirects
+        self._notify_role()
         self._persist_meta()
         # do NOT reset the election timer here (Raft §5.2: only a GRANTED
         # vote or a valid AppendEntries resets it — both callers set
@@ -727,6 +744,7 @@ class RaftNode:
             if args["term"] > self.term or self.role != "follower":
                 self._step_down(args["term"])
             self.leader = args["leader"]
+            self._notify_role()
             self._last_heard = time.monotonic()
             prev_index = args["prev_index"]
             entries = args["entries"]

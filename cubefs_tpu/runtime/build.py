@@ -47,7 +47,8 @@ def build() -> str:
     srcs = [
         os.path.join(_SRC, f) for f in sorted(os.listdir(_SRC)) if f.endswith(".cc")
     ]
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, *srcs]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", _SO, *srcs]
     subprocess.run(cmd, check=True, capture_output=True, text=True)
     with open(_STAMP, "w") as f:
         f.write(src_hash)
@@ -199,5 +200,29 @@ def load() -> ctypes.CDLL:
             lib.kv_wal_bytes.argtypes = [c.c_void_p]
             lib.kv_snap_bytes.restype = c.c_uint64
             lib.kv_snap_bytes.argtypes = [c.c_void_p]
+            # native metanode read plane (manager_op.go hot-loop analog)
+            lib.ms_create.restype = c.c_void_p
+            lib.ms_destroy.argtypes = [c.c_void_p]
+            lib.ms_add_partition.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_uint64, c.c_uint64]
+            lib.ms_drop_partition.argtypes = [c.c_void_p, c.c_uint64]
+            lib.ms_set_serving.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_int, c.c_char_p]
+            lib.ms_put_inode.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_uint64, c.c_char_p, c.c_uint32]
+            lib.ms_del_inode.argtypes = [c.c_void_p, c.c_uint64, c.c_uint64]
+            lib.ms_ensure_dir.argtypes = [c.c_void_p, c.c_uint64, c.c_uint64]
+            lib.ms_del_dir.argtypes = [c.c_void_p, c.c_uint64, c.c_uint64]
+            lib.ms_put_dentry.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_uint64, c.c_char_p, c.c_uint32,
+                c.c_uint64]
+            lib.ms_del_dentry.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_uint64, c.c_char_p, c.c_uint32]
+            lib.ms_clear.argtypes = [c.c_void_p, c.c_uint64]
+            lib.ms_op_count.restype = c.c_uint64
+            lib.ms_op_count.argtypes = [c.c_void_p]
+            lib.ms_serve.restype = c.c_int
+            lib.ms_serve.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+            lib.ms_stop.argtypes = [c.c_void_p]
             _lib = lib
     return _lib

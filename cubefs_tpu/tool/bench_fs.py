@@ -106,12 +106,76 @@ def _inprocess_fs(workdir: str, n_data: int = 3, n_meta: int = 2):
     return FileSystem(view, pool), metas
 
 
-def deployed_ab(workdir: str, files: int = 300, threads: int = 8) -> dict:
+def _stat_proc(view, paths, secs, threads, q):
+    """One saturation client process: `threads` threads hammering stat.
+    Separate PROCESSES because a single Python client tops out on its
+    own GIL long before the native server does — server capacity only
+    shows under multi-process load (the reference measures mdtest with
+    8 clients x 64 procs for the same reason)."""
+    from ..fs.client import FileSystem
+    from ..utils.rpc import NodePool
+
+    fs = FileSystem(view, NodePool())
+    stop = time.perf_counter() + secs
+    counts = [0] * threads
+
+    def worker(t):
+        i = t
+        while time.perf_counter() < stop:
+            fs.stat(paths[i % len(paths)])
+            i += threads
+            counts[t] += 1
+
+    pool = ThreadPoolExecutor(threads)
+    list(pool.map(worker, range(threads)))
+    pool.shutdown()
+    q.put(sum(counts))
+
+
+def saturated_stat(view, procs: int = 8, threads: int = 4,
+                   secs: float = 3.0, dirs: int = 64) -> float:
+    """Aggregate stat ops/s from `procs` client processes (server-side
+    capacity measurement; the mdtest dir-stat shape)."""
+    import multiprocessing as mp_mod
+    import uuid
+
+    from ..fs.client import FileSystem
+    from ..utils.rpc import NodePool
+
+    fs = FileSystem(view, NodePool())
+    root = f"/sat_{uuid.uuid4().hex[:6]}"
+    fs.mkdir(root)
+    paths = []
+    for i in range(dirs):
+        fs.mkdir(f"{root}/d{i}")
+        paths.append(f"{root}/d{i}")
+    q = mp_mod.Queue()
+    ps = [mp_mod.Process(target=_stat_proc,
+                         args=(view, paths, secs, threads, q))
+          for _ in range(procs)]
+    t0 = time.perf_counter()
+    for p in ps:
+        p.start()
+    total = sum(q.get() for _ in ps)
+    for p in ps:
+        p.join()
+    dt = time.perf_counter() - t0
+    for i in range(dirs):
+        fs.unlink(f"{root}/d{i}")
+    fs.unlink(root)
+    return round(total / dt, 1)
+
+
+def deployed_ab(workdir: str, files: int = 300, threads: int = 8,
+                procs: int = 8) -> dict:
     """Launch the real-socket deploy cluster and run the mdtest shapes
-    twice: meta ops over HTTP only vs over the binary packet plane
-    (manager_op.go parity). The in-process NodePool default cannot show
+    three ways: meta ops over HTTP only, over the binary packet plane
+    (manager_op.go parity), and with the native C++ read plane
+    (metaserve.cc) on top. The in-process NodePool default cannot show
     this — its 'RPC' is a function call — so the transport A/B only
-    means something against live listeners."""
+    means something against live listeners. A multi-process saturation
+    phase then measures server-side stat capacity past the single
+    client's GIL ceiling."""
     from ..deploy.cluster import Cluster as DeployCluster
     from ..fs.client import FileSystem
     from ..utils import rpc
@@ -136,11 +200,18 @@ def deployed_ab(workdir: str, files: int = 300, threads: int = 8) -> dict:
                 break
             except Exception:
                 time.sleep(0.5)
-        http_view = {**view, "meta_packet_addrs": {}}
+        http_view = {**view, "meta_packet_addrs": {}, "meta_read_addrs": {}}
+        pkt_view = {**view, "meta_read_addrs": {}}
         out["meta_http"] = run(FileSystem(http_view, NodePool()),
                                files=files, io_mb=4, threads=threads)
-        out["meta_packet"] = run(FileSystem(view, NodePool()),
+        out["meta_packet"] = run(FileSystem(pkt_view, NodePool()),
                                  files=files, io_mb=4, threads=threads)
+        out["meta_native"] = run(FileSystem(view, NodePool()),
+                                 files=files, io_mb=4, threads=threads)
+        out["stat_saturation"] = {
+            "packet_ops": saturated_stat(pkt_view, procs=procs),
+            "native_ops": saturated_stat(view, procs=procs),
+        }
     finally:
         c.down()
     return out
@@ -154,13 +225,17 @@ def main(argv=None):
     ap.add_argument("--io-mb", type=int, default=16)
     ap.add_argument("--threads", type=int, default=8)
     ap.add_argument("--deploy", action="store_true",
-                    help="real-socket cluster; A/B meta HTTP vs packet")
+                    help="real-socket cluster; A/B meta HTTP vs packet "
+                         "vs native read plane")
+    ap.add_argument("--procs", type=int, default=8,
+                    help="client processes for the saturation phase")
     args = ap.parse_args(argv)
     metas = []
     if args.deploy:
         workdir = tempfile.mkdtemp(prefix="cubefs-bench-deploy-")
         print(json.dumps(deployed_ab(workdir, files=args.files,
-                                     threads=args.threads)))
+                                     threads=args.threads,
+                                     procs=args.procs)))
         return
     if args.master:
         from ..fs.client import FileSystem

@@ -27,11 +27,13 @@ class FsCluster:
             node = MetaNode(i, data_dir=str(tmp_path / f"meta{i}"),
                             addr=addr, node_pool=self.pool)
             self.pool.bind(addr, node)
-            # the binary meta plane listens on real TCP beside the
-            # in-process routes, so every e2e test exercises it
+            # the binary meta plane AND the native C++ read plane listen
+            # on real TCP beside the in-process routes, so every e2e
+            # test exercises both
             psrv = node.serve_packets()
             self.meta_packet_srvs.append(psrv)
-            self.master.register_metanode(addr, packet_addr=psrv.addr)
+            self.master.register_metanode(addr, packet_addr=psrv.addr,
+                                          read_addr=node.serve_native())
             self.metas.append(node)
         for i in range(n_data):
             addr = f"data{i}"
