@@ -151,6 +151,19 @@ def main() -> None:
     for _ in range(cpu_iters):
         cpu_eng.encode_parity(one_stripe, 3)
     rs63_cpu_gibs = cpu_iters * 6 * s63 / (time.perf_counter() - t0) / (1 << 30)
+    # native SIMD CPU engine (gfcpu.cc): the real CPU leg of the
+    # size-class crossover (numpy stays as the golden baseline above)
+    rs63_cpp_gibs, crossover = None, None
+    try:
+        cpp_eng = ec_engine.get_engine("cpp")
+        cpp_eng.encode_parity(one_stripe, 3)  # warm
+        t0 = time.perf_counter()
+        for _ in range(8):
+            cpp_eng.encode_parity(one_stripe, 3)
+        rs63_cpp_gibs = 8 * 6 * s63 / (time.perf_counter() - t0) / (1 << 30)
+        crossover = ec_engine.measure_crossover()
+    except Exception as e:
+        print(f"bench: cpp engine unavailable: {e}", file=sys.stderr)
     x1 = jax.device_put(one_stripe, dev)
     chain1 = jax.jit(lambda a: jnp.tile(rs_kernel.encode_parity(a, 3), (2, 1)))
     dt = timed_slope(chain1, x1, k1=4, k2=68)
@@ -302,6 +315,9 @@ def main() -> None:
                 "vs_baseline": round(repair_gibs / target_gibs, 3),
                 "extras": {
                     "rs63_1mib_single_cpu_gibs": round(rs63_cpu_gibs, 3),
+                    "rs63_1mib_single_cpp_gibs": (round(rs63_cpp_gibs, 3)
+                                                  if rs63_cpp_gibs else None),
+                    "crossover_policy": crossover,
                     "rs63_1mib_single_dev_gibs": round(rs63_dev_gibs, 3),
                     "encode_1024stripes_gibs": round(encode_gibs, 3),
                     "repair_jnp_gibs": round(repair_jnp_gibs, 3),

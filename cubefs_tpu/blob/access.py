@@ -45,7 +45,9 @@ DEFAULT_POLICIES = [
 @dataclass
 class AccessConfig:
     blob_size: int = 8 << 20  # max payload bytes per blob
-    engine: str | None = None
+    # 'auto' = measured size-class crossover (codec/engine.py): small
+    # user PUTs ride the native CPU engine, large ones the device
+    engine: str | None = "auto"
     policies: list = field(default_factory=lambda: list(DEFAULT_POLICIES))
     max_workers: int = 16
     put_quorum_override: int | None = None  # tests

@@ -63,9 +63,43 @@ class JaxEngine:
         return np.asarray(rs_kernel.encode_parity(np.asarray(data), n_parity))
 
 
+class CppEngine:
+    """Native SIMD GF engine (runtime/src/gfcpu.cc — the klauspost-AVX2
+    fallback role). ~50x the numpy table path on one core, which makes
+    the CPU-vs-device size-class crossover a real policy instead of a
+    foregone conclusion."""
+
+    name = "cpp"
+
+    def __init__(self):
+        from ..runtime import build as rt_build
+
+        self._lib = rt_build.load()
+
+    def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        coeff = np.ascontiguousarray(coeff, dtype=np.uint8)
+        shards = np.ascontiguousarray(shards, dtype=np.uint8)
+        lead = shards.shape[:-2]
+        c, s = shards.shape[-2:]
+        m = coeff.shape[0]
+        if coeff.shape[1] != c:
+            raise ValueError(f"matrix is {coeff.shape}, shards have {c} rows")
+        batch = int(np.prod(lead)) if lead else 1
+        out = np.empty((batch, m, s), dtype=np.uint8)
+        # zero-copy: both arrays are contiguous; pass their buffers
+        self._lib.gf_apply(coeff.ctypes.data, m, c, shards.ctypes.data,
+                           out.ctypes.data, s, batch)
+        return out.reshape(*lead, m, s)
+
+    def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
+        return self.matrix_apply(
+            gf256.parity_matrix(data.shape[-2], n_parity), data)
+
+
 _REGISTRY: dict[str, Callable[[], Engine]] = {
     "numpy": NumpyEngine,
     "tpu": JaxEngine,
+    "cpp": CppEngine,
 }
 
 
@@ -89,3 +123,113 @@ def get_engine(name: str | None = None) -> Engine:
     if name not in _instances:
         _instances[name] = _REGISTRY[name]()
     return _instances[name]
+
+
+# ---------------- measured size-class crossover (policy.go role) --------
+# The reference picks codemodes by object size class
+# (blobstore/common/codemode/policy.go); the analogous decision here is
+# CPU-vs-device per stripe size: one small stripe cannot amortize device
+# dispatch, a large batch leaves the CPU far behind. The table is
+# MEASURED on this host+device pair, not assumed.
+
+_POLICY_SIZES = (64 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20)
+_policy: list | None = None
+
+
+def _policy_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "artifacts", "CROSSOVER.json")
+
+
+def measure_crossover(sizes=_POLICY_SIZES, repeats: int = 3,
+                      save: bool = True) -> list:
+    """Times the cpp vs device engine on RS(6+3)-shaped single stripes
+    per total-size class; returns [[max_total_bytes, engine], ...]
+    sorted ascending. Persisted so later processes inherit the policy
+    without re-measuring."""
+    import json
+    import time
+
+    table = []
+    candidates = ["tpu"]
+    try:
+        get_engine("cpp")
+        candidates.insert(0, "cpp")
+    except Exception:
+        pass
+    rng = np.random.default_rng(11)
+    for total in sizes:
+        s = max(1, total // 6)
+        stripe = rng.integers(0, 256, (6, s), dtype=np.uint8)
+        best, best_dt = candidates[0], float("inf")
+        for name in candidates:
+            eng = get_engine(name)
+            eng.encode_parity(stripe, 3)  # warm (compile/dispatch)
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                eng.encode_parity(stripe, 3)
+            dt = (time.perf_counter() - t0) / repeats
+            if dt < best_dt:
+                best, best_dt = name, dt
+        table.append([total, best])
+    if save:
+        try:
+            os.makedirs(os.path.dirname(_policy_path()), exist_ok=True)
+            with open(_policy_path(), "w") as f:
+                json.dump({"table": table}, f)
+        except OSError:
+            pass
+    global _policy
+    _policy = table
+    return table
+
+
+def _load_policy() -> list:
+    global _policy
+    if _policy is None:
+        import json
+
+        try:
+            with open(_policy_path()) as f:
+                _policy = json.load(f)["table"]
+        except Exception:
+            # unmeasured host: conservative static split — native CPU
+            # for sub-MiB stripes, device beyond
+            have_cpp = True
+            try:
+                get_engine("cpp")
+            except Exception:
+                have_cpp = False
+            small = "cpp" if have_cpp else "numpy"
+            _policy = [[1 << 20, small], [1 << 62, "tpu"]]
+    return _policy
+
+
+def engine_for(nbytes: int) -> Engine:
+    """The measured-best engine for a stripe of `nbytes` total."""
+    for limit, name in _load_policy():
+        if nbytes <= limit:
+            try:
+                return get_engine(name)
+            except Exception:
+                break
+    return get_engine()
+
+
+class AutoEngine:
+    """Per-call policy dispatch: route each stripe batch to the
+    measured-best engine for its size (`engine='auto'`)."""
+
+    name = "auto"
+
+    def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        return engine_for(int(np.asarray(shards).nbytes)).matrix_apply(
+            coeff, shards)
+
+    def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
+        return engine_for(int(np.asarray(data).nbytes)).encode_parity(
+            data, n_parity)
+
+
+_REGISTRY["auto"] = AutoEngine

@@ -194,12 +194,13 @@ class FuseMount:
             valid, _pad, fh, size = struct.unpack_from("<IIQQ", body)
             attrs = {}
             if valid & (1 << 3):  # FATTR_SIZE
-                if size == 0:
-                    fs.meta.truncate(nodeid, 0)
-                    fs.data.close_stream(nodeid)
-                    # freed extents ride the metanode freelist
-                else:
-                    attrs["size"] = size
+                # EVERY size change rides the real truncate op: a bare
+                # size attr leaves stale extents, and a later extend
+                # resurrects pre-truncate bytes instead of zeros (POSIX
+                # violation caught by tests/conformance/test_posix_ltp)
+                fs.meta.truncate(nodeid, size)
+                fs.data.close_stream(nodeid)
+                # freed extents ride the metanode freelist
             if valid & (1 << 0):  # FATTR_MODE
                 mode = struct.unpack_from("<I", body, 68)[0]
                 attrs["mode"] = mode & 0o7777

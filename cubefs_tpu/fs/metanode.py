@@ -1206,6 +1206,10 @@ class MetaNode:
                 from ..parallel.raft import NotLeaderError
 
                 try:
+                    # raft-level group commit: concurrent proposes share
+                    # one fsync and ride one append RPC (an FSM-level
+                    # submit batcher was measured 12% SLOWER — the raft
+                    # batching already captures the win)
                     res = raft_node.propose(args["record"])
                 except NotLeaderError as e:
                     raise rpc.RpcError(self.REDIRECT,

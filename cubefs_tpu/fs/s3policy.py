@@ -27,6 +27,7 @@ XA_ACL = "s3.acl"
 XA_POLICY = "s3.policy"
 XA_CORS = "s3.cors"
 XA_TAGS = "s3.tags"
+XA_META = "s3.meta"  # {"ct": content-type, "meta": {lower-name: value}}
 XA_LIFECYCLE = "s3.lifecycle"
 
 CANNED_ACLS = ("private", "public-read", "public-read-write",

@@ -224,5 +224,14 @@ def load() -> ctypes.CDLL:
             lib.ms_serve.restype = c.c_int
             lib.ms_serve.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
             lib.ms_stop.argtypes = [c.c_void_p]
+            lib.ms_bench.restype = c.c_double
+            lib.ms_bench.argtypes = [c.c_char_p, c.c_int, c.c_int,
+                                     c.c_char_p, c.c_int, c.c_int]
+            # native CPU GF(2^8) engine (klauspost AVX2 fallback role);
+            # mat/in are raw numpy buffer pointers (zero-copy)
+            lib.gf_apply.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_uint64, c.c_void_p,
+                c.c_void_p, c.c_uint64, c.c_uint64]
+            lib.gf_cpu_level.restype = c.c_int
             _lib = lib
     return _lib

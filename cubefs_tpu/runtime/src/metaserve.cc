@@ -733,6 +733,63 @@ int ms_serve(void* h, const char* host, int port) {
   return (int)ntohs(addr.sin_port);
 }
 
+// Load generator for capacity measurement (the mdtest-shape driver):
+// `conns` connections (one thread each) issue `iters` serial
+// round-trips of the same request frame. Returns elapsed seconds, or
+// -1 when any connection fails or any reply is an error. Lives here so
+// capacity numbers measure the server without a Python client's GIL in
+// the loop (this box benches on one core).
+double ms_bench(const char* host, int port, int opcode,
+                const char* args_json, int iters, int conns) {
+  std::string args = args_json ? args_json : "";
+  PacketHdr h{};
+  h.magic = MAGIC;
+  h.opcode = (uint8_t)opcode;
+  h.crc = crc32_ieee(nullptr, 0);
+  h.asize = (uint32_t)args.size();
+  std::string frame((const char*)&h, sizeof h);
+  frame += args;
+  std::atomic<bool> failed{false};
+  auto worker = [&]() {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in a{};
+    a.sin_family = AF_INET;
+    a.sin_port = htons((uint16_t)port);
+    if (fd < 0 || inet_pton(AF_INET, host, &a.sin_addr) != 1 ||
+        connect(fd, (sockaddr*)&a, sizeof a) != 0) {
+      failed.store(true);
+      if (fd >= 0) close(fd);
+      return;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::string body;
+    for (int i = 0; i < iters && !failed.load(); i++) {
+      PacketHdr rh;
+      if (!send_all(fd, frame.data(), frame.size()) ||
+          !recv_exact(fd, &rh, sizeof rh)) {
+        failed.store(true);
+        break;
+      }
+      size_t rest = (size_t)rh.asize + rh.psize;
+      body.resize(rest);
+      if (rest && !recv_exact(fd, &body[0], rest)) {
+        failed.store(true);
+        break;
+      }
+      if (rh.result != 0) failed.store(true);
+    }
+    close(fd);
+  };
+  auto t0 = std::chrono::steady_clock::now();
+  std::vector<std::thread> ts;
+  for (int c = 0; c < conns; c++) ts.emplace_back(worker);
+  for (auto& t : ts) t.join();
+  auto t1 = std::chrono::steady_clock::now();
+  if (failed.load()) return -1;
+  return std::chrono::duration<double>(t1 - t0).count();
+}
+
 void ms_stop(void* h) {
   auto* ms = (MetaServe*)h;
   ms->stopping.store(true);

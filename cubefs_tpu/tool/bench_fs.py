@@ -166,6 +166,46 @@ def saturated_stat(view, procs: int = 8, threads: int = 4,
     return round(total / dt, 1)
 
 
+def native_loadgen(view, iters: int = 30_000, conns: int = 4) -> dict:
+    """Server-capacity measurement with the C++ load generator
+    (metaserve.cc ms_bench): serial round-trips over `conns`
+    connections with no Python client in the loop. This is the honest
+    server-side number on a box where client and server share cores —
+    the Python saturation phase above measures the full-system
+    (client-bound) figure."""
+    import json as _json
+    import uuid
+
+    from ..fs.client import FileSystem
+    from ..runtime import build as rt_build
+    from ..utils.rpc import NodePool
+
+    read_addrs = view.get("meta_read_addrs") or {}
+    if not read_addrs:
+        return {}
+    fs = FileSystem(view, NodePool())
+    root = f"/lg_{uuid.uuid4().hex[:6]}"
+    fs.mkdir(root)
+    ino = fs.resolve(root)
+    mp = fs.meta._mp_for(ino)
+    lib = rt_build.load()
+    out: dict = {}
+    # hit the node leader-serving the root's partition
+    for addr in list(mp.get("addrs") or [mp["addr"]]):
+        raddr = read_addrs.get(addr)
+        if not raddr:
+            continue
+        host, port = raddr.rsplit(":", 1)
+        args = _json.dumps({"ino": 1, "names": [root.lstrip("/")],
+                            "stat": True}).encode()
+        dt = lib.ms_bench(host.encode(), int(port), 0x26, args, iters, conns)
+        if dt > 0:
+            out["walk_stat_ops"] = round(conns * iters / dt, 1)
+            break
+    fs.unlink(root)
+    return out
+
+
 def deployed_ab(workdir: str, files: int = 300, threads: int = 8,
                 procs: int = 8) -> dict:
     """Launch the real-socket deploy cluster and run the mdtest shapes
@@ -212,6 +252,7 @@ def deployed_ab(workdir: str, files: int = 300, threads: int = 8,
             "packet_ops": saturated_stat(pkt_view, procs=procs),
             "native_ops": saturated_stat(view, procs=procs),
         }
+        out["native_loadgen"] = native_loadgen(view)
     finally:
         c.down()
     return out

@@ -1,0 +1,169 @@
+"""Independent S3 client for conformance testing (s3tests role).
+
+Role parity: docker/s3tests/*.py + docker/script/run_test.sh:264-293 —
+the reference validates its S3 gateway with an EXTERNAL python client
+suite, not with the gateway's own code. This client is deliberately
+implemented from the AWS Signature Version 4 specification (canonical
+request -> string-to-sign -> derived signing key), sharing NOTHING with
+cubefs_tpu/fs/s3auth.py: an agreement bug duplicated on both sides
+would pass the in-tree tests but fail here.
+
+Stdlib only (the image has no boto3): http.client keep-alive requests,
+SigV4 header signing, SigV4 presigned URLs.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import http.client
+import urllib.parse
+
+_ALGO = "AWS4-HMAC-SHA256"
+_SAFE = "-_.~"  # RFC 3986 unreserved (AWS canonical encoding set)
+
+
+def _uri_encode(s: str, *, slash_ok: bool = False) -> str:
+    return urllib.parse.quote(s, safe=_SAFE + ("/" if slash_ok else ""))
+
+
+def _canonical_query(params: dict[str, str]) -> str:
+    pairs = sorted((_uri_encode(k), _uri_encode(str(v)))
+                   for k, v in params.items())
+    return "&".join(f"{k}={v}" for k, v in pairs)
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def _signing_key(secret: str, date: str, region: str, service: str) -> bytes:
+    k = _hmac(("AWS4" + secret).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+class S3Client:
+    """One bucket-style endpoint, path-addressed (http://host:port/bucket/key)."""
+
+    def __init__(self, endpoint: str, access_key: str | None = None,
+                 secret_key: str | None = None, region: str = "us-east-1",
+                 timeout: float = 15.0):
+        u = urllib.parse.urlsplit(endpoint)
+        self.host, self.port = u.hostname, u.port
+        self.ak, self.sk = access_key, secret_key
+        self.region = region
+        self.timeout = timeout
+
+    # ---------------- SigV4 (from the AWS sigv4 documentation) ----------
+    def _sign(self, method: str, path: str, query: dict[str, str],
+              headers: dict[str, str], payload: bytes) -> dict[str, str]:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        date = now.strftime("%Y%m%d")
+        payload_hash = hashlib.sha256(payload).hexdigest()
+        headers = {**headers, "host": f"{self.host}:{self.port}",
+                   "x-amz-date": amz_date,
+                   "x-amz-content-sha256": payload_hash}
+        lower = {k.lower(): " ".join(str(v).split())
+                 for k, v in headers.items()}
+        signed = ";".join(sorted(lower))
+        canonical = "\n".join([
+            method,
+            _uri_encode(path, slash_ok=True),
+            _canonical_query(query),
+            "".join(f"{k}:{lower[k]}\n" for k in sorted(lower)),
+            signed,
+            payload_hash,
+        ])
+        scope = f"{date}/{self.region}/s3/aws4_request"
+        sts = "\n".join([
+            _ALGO, amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+        sig = hmac.new(_signing_key(self.sk, date, self.region, "s3"),
+                       sts.encode(), hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"{_ALGO} Credential={self.ak}/{scope}, "
+            f"SignedHeaders={signed}, Signature={sig}")
+        return headers
+
+    def presign(self, method: str, path: str, expires: int = 60,
+                query: dict[str, str] | None = None) -> str:
+        """SigV4 presigned URL (UNSIGNED-PAYLOAD, per the spec)."""
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        date = now.strftime("%Y%m%d")
+        scope = f"{date}/{self.region}/s3/aws4_request"
+        q = dict(query or {})
+        q.update({
+            "X-Amz-Algorithm": _ALGO,
+            "X-Amz-Credential": f"{self.ak}/{scope}",
+            "X-Amz-Date": amz_date,
+            "X-Amz-Expires": str(expires),
+            "X-Amz-SignedHeaders": "host",
+        })
+        canonical = "\n".join([
+            method,
+            _uri_encode(path, slash_ok=True),
+            _canonical_query(q),
+            f"host:{self.host}:{self.port}\n",
+            "host",
+            "UNSIGNED-PAYLOAD",
+        ])
+        sts = "\n".join([
+            _ALGO, amz_date, scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ])
+        sig = hmac.new(_signing_key(self.sk, date, self.region, "s3"),
+                       sts.encode(), hashlib.sha256).hexdigest()
+        q["X-Amz-Signature"] = sig
+        qs = urllib.parse.urlencode(q)
+        return f"http://{self.host}:{self.port}{path}?{qs}"
+
+    # ---------------- request ----------------
+    def request(self, method: str, path: str,
+                query: dict[str, str] | None = None,
+                headers: dict[str, str] | None = None,
+                body: bytes = b"", sign: bool = True):
+        """Returns (status, body bytes, headers dict)."""
+        query = dict(query or {})
+        headers = dict(headers or {})
+        if sign and self.ak:
+            headers = self._sign(method, path, query, headers, body)
+        qs = _canonical_query(query)
+        target = _uri_encode(path, slash_ok=True) + (f"?{qs}" if qs else "")
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request(method, target, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data, dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    # ---------------- convenience ops ----------------
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   headers: dict | None = None):
+        return self.request("PUT", f"/{bucket}/{key}", body=data,
+                            headers=headers)
+
+    def get_object(self, bucket: str, key: str, headers: dict | None = None,
+                   query: dict | None = None):
+        return self.request("GET", f"/{bucket}/{key}", headers=headers,
+                            query=query)
+
+    def head_object(self, bucket: str, key: str):
+        return self.request("HEAD", f"/{bucket}/{key}")
+
+    def delete_object(self, bucket: str, key: str,
+                      query: dict | None = None):
+        return self.request("DELETE", f"/{bucket}/{key}", query=query)
+
+    def list_objects_v2(self, bucket: str, **params):
+        q = {"list-type": "2"}
+        q.update({k.replace("_", "-"): v for k, v in params.items()})
+        return self.request("GET", f"/{bucket}", query=q)

@@ -1,0 +1,270 @@
+"""LTP-lite POSIX conformance against the FUSE mount.
+
+Role parity: docker/script/run_test.sh:234-248 runs the Linux Test
+Project filesystem suite against a mounted CubeFS volume. This is that
+battery scaled to the semantics the VFS layer must get right, driven
+through REAL kernel syscalls (os.*) on a real /dev/fuse mount — nothing
+here touches the SDK directly, so a bug hidden by the SDK's own
+conventions still fails. Skips when /dev/fuse or root is unavailable.
+"""
+
+import errno
+import hashlib
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from tests.test_fs_e2e import FsCluster
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists("/dev/fuse") or os.geteuid() != 0,
+    reason="needs /dev/fuse and root",
+)
+
+
+@pytest.fixture(scope="module")
+def mnt(tmp_path_factory):
+    from cubefs_tpu.fs import fuse
+
+    tmp = tmp_path_factory.mktemp("ltp")
+    c = FsCluster(tmp)
+    mnt = str(tmp / "mnt")
+    m = fuse.mount(c.fs, mnt)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            os.listdir(mnt)
+            break
+        except OSError:
+            time.sleep(0.1)
+    yield mnt
+    m.unmount()
+    c.stop()
+
+
+def _errno_of(fn, *a, **kw) -> int:
+    try:
+        fn(*a, **kw)
+    except OSError as e:
+        return e.errno
+    return 0
+
+
+# ---- open(2) flag semantics ----
+
+def test_open_excl_and_trunc(mnt):
+    p = f"{mnt}/oflags"
+    fd = os.open(p, os.O_CREAT | os.O_WRONLY, 0o644)
+    os.write(fd, b"hello world")
+    os.close(fd)
+    # O_EXCL on an existing file must EEXIST
+    assert _errno_of(os.open, p, os.O_CREAT | os.O_EXCL | os.O_WRONLY) \
+        == errno.EEXIST
+    # O_TRUNC empties it
+    os.close(os.open(p, os.O_WRONLY | os.O_TRUNC))
+    assert os.stat(p).st_size == 0
+
+
+def test_append_mode(mnt):
+    p = f"{mnt}/appendfile"
+    with open(p, "wb") as f:
+        f.write(b"AAAA")
+    with open(p, "ab") as f:
+        f.write(b"BBBB")
+    assert open(p, "rb").read() == b"AAAABBBB"
+
+
+def test_seek_write_hole_reads_zero(mnt):
+    p = f"{mnt}/holes"
+    fd = os.open(p, os.O_CREAT | os.O_WRONLY, 0o644)
+    os.pwrite(fd, b"END", 1 << 16)
+    os.close(fd)
+    st = os.stat(p)
+    assert st.st_size == (1 << 16) + 3
+    data = open(p, "rb").read()
+    assert data[: 1 << 16] == b"\0" * (1 << 16)
+    assert data[1 << 16:] == b"END"
+
+
+# ---- rename(2) semantics ----
+
+def test_rename_matrix(mnt):
+    base = f"{mnt}/ren"
+    os.mkdir(base)
+    open(f"{base}/f1", "wb").write(b"one")
+    open(f"{base}/f2", "wb").write(b"two")
+    # file -> existing file: silent replace
+    os.rename(f"{base}/f1", f"{base}/f2")
+    assert open(f"{base}/f2", "rb").read() == b"one"
+    assert not os.path.exists(f"{base}/f1")
+    # file -> existing dir must fail EISDIR
+    os.mkdir(f"{base}/d1")
+    assert _errno_of(os.rename, f"{base}/f2", f"{base}/d1") == errno.EISDIR
+    # dir -> non-empty dir must fail ENOTEMPTY (or EEXIST per POSIX)
+    os.mkdir(f"{base}/d2")
+    open(f"{base}/d1/child", "wb").write(b"x")
+    assert _errno_of(os.rename, f"{base}/d2", f"{base}/d1") in (
+        errno.ENOTEMPTY, errno.EEXIST)
+    # dir -> empty dir: replace
+    os.mkdir(f"{base}/d3")
+    os.rename(f"{base}/d2", f"{base}/d3")
+    assert not os.path.exists(f"{base}/d2")
+    # cross-directory move carries content
+    os.rename(f"{base}/d1/child", f"{base}/d3/child")
+    assert open(f"{base}/d3/child", "rb").read() == b"x"
+
+
+def test_renameat2_noreplace(mnt):
+    base = f"{mnt}/ren2"
+    os.mkdir(base)
+    open(f"{base}/a", "wb").write(b"a")
+    open(f"{base}/b", "wb").write(b"b")
+    try:
+        os.rename2  # not a real API; use ctypes-free path via os.replace?
+    except AttributeError:
+        pass
+    # RENAME_NOREPLACE via the syscall module if available
+    if hasattr(os, "RWF_NOWAIT") or True:
+        import ctypes
+        import ctypes.util
+
+        libc = ctypes.CDLL(ctypes.util.find_library("c"), use_errno=True)
+        AT_FDCWD = -100
+        RENAME_NOREPLACE = 1
+        rc = libc.renameat2(AT_FDCWD, f"{base}/a".encode(),
+                            AT_FDCWD, f"{base}/b".encode(),
+                            RENAME_NOREPLACE)
+        assert rc == -1 and ctypes.get_errno() == errno.EEXIST
+        rc = libc.renameat2(AT_FDCWD, f"{base}/a".encode(),
+                            AT_FDCWD, f"{base}/c".encode(),
+                            RENAME_NOREPLACE)
+        assert rc == 0
+        assert open(f"{base}/c", "rb").read() == b"a"
+
+
+# ---- unlink/rmdir ----
+
+def test_unlink_rmdir_errors(mnt):
+    base = f"{mnt}/rm"
+    os.mkdir(base)
+    os.mkdir(f"{base}/d")
+    open(f"{base}/d/f", "wb").write(b"x")
+    assert _errno_of(os.rmdir, f"{base}/d") in (errno.ENOTEMPTY,
+                                                errno.EEXIST)
+    assert _errno_of(os.unlink, f"{base}/d") in (errno.EISDIR, errno.EPERM)
+    assert _errno_of(os.rmdir, f"{base}/d/f") == errno.ENOTDIR
+    assert _errno_of(os.unlink, f"{base}/ghost") == errno.ENOENT
+    os.unlink(f"{base}/d/f")
+    os.rmdir(f"{base}/d")
+    assert not os.path.exists(f"{base}/d")
+
+
+# ---- truncate ----
+
+def test_truncate_shrink_extend(mnt):
+    p = f"{mnt}/trunc"
+    open(p, "wb").write(b"0123456789")
+    os.truncate(p, 4)
+    assert open(p, "rb").read() == b"0123"
+    os.truncate(p, 8)  # extend: zero-filled
+    assert open(p, "rb").read() == b"0123\0\0\0\0"
+
+
+# ---- symlink / readlink ----
+
+def test_symlink_readlink(mnt):
+    base = f"{mnt}/sym"
+    os.mkdir(base)
+    open(f"{base}/target", "wb").write(b"pointed-at")
+    os.symlink("target", f"{base}/link")
+    assert os.readlink(f"{base}/link") == "target"
+    assert open(f"{base}/link", "rb").read() == b"pointed-at"
+    assert os.lstat(f"{base}/link").st_mode & 0o170000 == 0o120000
+
+
+# ---- xattr ----
+
+def test_xattr_roundtrip(mnt):
+    p = f"{mnt}/xat"
+    open(p, "wb").write(b"x")
+    os.setxattr(p, "user.proj", b"tpu")
+    os.setxattr(p, "user.tier", b"hot")
+    assert os.getxattr(p, "user.proj") == b"tpu"
+    names = set(os.listxattr(p))
+    assert {"user.proj", "user.tier"} <= names
+    os.removexattr(p, "user.proj")
+    assert "user.proj" not in set(os.listxattr(p))
+    assert _errno_of(os.getxattr, p, "user.proj") == errno.ENODATA
+
+
+# ---- mtime / chmod ----
+
+def test_stat_times_and_chmod(mnt):
+    p = f"{mnt}/attrs"
+    open(p, "wb").write(b"x")
+    st0 = os.stat(p)
+    time.sleep(1.1)
+    open(p, "ab").write(b"y")
+    st1 = os.stat(p)
+    assert st1.st_mtime > st0.st_mtime
+    assert st1.st_size == 2
+    os.chmod(p, 0o600)
+    assert os.stat(p).st_mode & 0o777 == 0o600
+
+
+# ---- directory scale + readdir completeness ----
+
+def test_readdir_completeness(mnt):
+    base = f"{mnt}/many"
+    os.mkdir(base)
+    names = {f"f{i:03d}" for i in range(120)}
+    for n in names:
+        open(f"{base}/{n}", "wb").write(b".")
+    assert set(os.listdir(base)) == names
+    out = subprocess.run(["ls", base], capture_output=True, text=True)
+    assert len(out.stdout.split()) == 120
+
+
+# ---- data integrity at size ----
+
+def test_large_file_integrity(mnt):
+    p = f"{mnt}/big8m"
+    blob = os.urandom(8 << 20)
+    with open(p, "wb") as f:
+        f.write(blob)
+    got = open(p, "rb").read()
+    assert hashlib.sha256(got).hexdigest() == \
+        hashlib.sha256(blob).hexdigest()
+    # random pread offsets match
+    with open(p, "rb") as f:
+        for off in (0, 4096, (4 << 20) + 17, (8 << 20) - 100):
+            f.seek(off)
+            assert f.read(64) == blob[off: off + 64]
+
+
+# ---- concurrency ----
+
+def test_concurrent_writers_distinct_files(mnt):
+    base = f"{mnt}/conc"
+    os.mkdir(base)
+    errs = []
+
+    def w(i):
+        try:
+            payload = bytes([i]) * 10000
+            with open(f"{base}/w{i}", "wb") as f:
+                f.write(payload)
+            assert open(f"{base}/w{i}", "rb").read() == payload
+        except Exception as e:  # surfaced below
+            errs.append(e)
+
+    ts = [threading.Thread(target=w, args=(i,)) for i in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    assert len(os.listdir(base)) == 8

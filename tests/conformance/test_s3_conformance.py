@@ -1,0 +1,364 @@
+"""External S3 conformance suite (docker/s3tests role).
+
+Drives the objectnode gateway over raw HTTP with the INDEPENDENT SigV4
+client in s3client.py — nothing here imports the gateway's own auth or
+XML code, so a bug duplicated between the gateway and its in-tree tests
+still fails here. Shapes follow the ceph/s3-tests categories the
+reference runs in CI (docker/script/run_test.sh:264-293): object CRUD
+and metadata, ranges, listings, multipart, copy, batch delete, ACL,
+tagging, presigned URLs, versioning, object lock, and signature
+negative cases."""
+
+import re
+import time
+
+import pytest
+
+from cubefs_tpu.fs import s3auth
+from cubefs_tpu.fs.authnode import UserStore
+from cubefs_tpu.fs.client import FileSystem
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+from cubefs_tpu.fs.objectnode import ObjectNode
+from cubefs_tpu.utils.rpc import NodePool
+
+from s3client import S3Client
+
+B = "conf"  # the bucket under test
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("s3conf")
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas, datas = [], []
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    for i in range(3):
+        node = DataNode(i, str(tmp / f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", node)
+        master.register_datanode(f"data{i}")
+        datas.append(node)
+    view = master.create_volume("confvol", mp_count=2, dp_count=2)
+    fs = FileSystem(view, pool)
+    users = UserStore()
+    owner = users.create_user("owner")
+    users.grant(owner["access_key"], "confvol", "rw")
+    stranger = users.create_user("stranger")  # authenticated, no grant
+    auth = s3auth.S3V4Authenticator(users, {B: "confvol"})
+    s3 = ObjectNode({B: fs}, authenticator=auth).start()
+    yield {"endpoint": f"http://{s3.addr}", "owner": owner,
+           "stranger": stranger, "fs": fs}
+    s3.stop()
+    for m in metas:
+        m.stop()
+    for d in datas:
+        d.stop()
+
+
+@pytest.fixture(scope="module")
+def cli(stack):
+    return S3Client(stack["endpoint"], stack["owner"]["access_key"],
+                    stack["owner"]["secret_key"])
+
+
+# ---------------- object CRUD + metadata ----------------
+
+def test_put_get_head_delete_roundtrip(cli):
+    body = b"conformance payload " * 100
+    code, _, h = cli.put_object(B, "crud/a.bin", body)
+    assert code == 200
+    assert "ETag" in h or "Etag" in h
+    code, got, h = cli.get_object(B, "crud/a.bin")
+    assert code == 200 and got == body
+    assert int(h["Content-Length"]) == len(body)
+    code, _, h = cli.head_object(B, "crud/a.bin")
+    assert code == 200 and int(h["Content-Length"]) == len(body)
+    code, _, _ = cli.delete_object(B, "crud/a.bin")
+    assert code == 204
+    code, got, _ = cli.get_object(B, "crud/a.bin")
+    assert code == 404 and b"NoSuchKey" in got
+
+
+def test_user_metadata_roundtrip(cli):
+    code, _, _ = cli.put_object(B, "crud/meta.txt", b"m",
+                                headers={"x-amz-meta-project": "tpu",
+                                         "Content-Type": "text/x-conf"})
+    assert code == 200
+    code, _, h = cli.head_object(B, "crud/meta.txt")
+    assert code == 200
+    lower = {k.lower(): v for k, v in h.items()}
+    assert lower.get("x-amz-meta-project") == "tpu"
+    assert lower.get("content-type") == "text/x-conf"
+
+
+def test_nonexistent_key_and_bucket_errors(cli):
+    code, body, _ = cli.get_object(B, "missing/void.bin")
+    assert code == 404 and b"<Code>NoSuchKey</Code>" in body
+    code, body, _ = cli.request("GET", "/nosuchbucket/k")
+    assert code in (403, 404)  # unmapped bucket must not leak content
+
+
+def test_range_reads(cli):
+    body = bytes(range(256)) * 64
+    assert cli.put_object(B, "crud/range.bin", body)[0] == 200
+    code, got, h = cli.get_object(B, "crud/range.bin",
+                                  headers={"Range": "bytes=100-299"})
+    assert code == 206 and got == body[100:300]
+    cr = {k.lower(): v for k, v in h.items()}["content-range"]
+    assert re.fullmatch(rf"bytes 100-299/{len(body)}", cr)
+    code, got, _ = cli.get_object(B, "crud/range.bin",
+                                  headers={"Range": "bytes=-100"})
+    assert code == 206 and got == body[-100:]
+    code, got, _ = cli.get_object(B, "crud/range.bin",
+                                  headers={"Range": f"bytes={len(body)}-"})
+    assert code == 416  # unsatisfiable
+
+
+# ---------------- listings ----------------
+
+def test_list_objects_v2_prefix_delimiter_pagination(cli):
+    for k in ("lst/a/1", "lst/a/2", "lst/b/1", "lst/top"):
+        assert cli.put_object(B, k, b"x")[0] == 200
+    code, body, _ = cli.list_objects_v2(B, prefix="lst/", delimiter="/")
+    assert code == 200
+    assert b"<Key>lst/top</Key>" in body
+    assert b"<Prefix>lst/a/</Prefix>" in body and \
+        b"<Prefix>lst/b/</Prefix>" in body
+    assert b"<Key>lst/a/1</Key>" not in body  # rolled up
+    # pagination walks every key exactly once
+    seen = []
+    token = None
+    while True:
+        params = {"prefix": "lst/", "max_keys": "2"}
+        if token:
+            params["continuation_token"] = token
+        code, body, _ = cli.list_objects_v2(B, **params)
+        assert code == 200
+        seen += re.findall(rb"<Key>([^<]+)</Key>", body)
+        m = re.search(rb"<NextContinuationToken>([^<]+)", body)
+        if b"<IsTruncated>true</IsTruncated>" not in body:
+            break
+        assert m, "truncated listing must carry a continuation token"
+        token = m.group(1).decode()
+    assert sorted(seen) == [b"lst/a/1", b"lst/a/2", b"lst/b/1", b"lst/top"]
+
+
+# ---------------- multipart ----------------
+
+def test_multipart_upload_lifecycle(cli):
+    key = "mp/big.bin"
+    code, body, _ = cli.request("POST", f"/{B}/{key}",
+                                query={"uploads": ""})
+    assert code == 200
+    upload_id = re.search(rb"<UploadId>([^<]+)", body).group(1).decode()
+    parts = [b"A" * (5 << 20), b"B" * (5 << 20), b"C" * 123]
+    etags = []
+    for i, part in enumerate(parts, start=1):
+        code, _, h = cli.request(
+            "PUT", f"/{B}/{key}",
+            query={"uploadId": upload_id, "partNumber": str(i)}, body=part)
+        assert code == 200
+        etags.append({k.lower(): v for k, v in h.items()}["etag"])
+    code, body, _ = cli.request(
+        "GET", f"/{B}/{key}", query={"uploadId": upload_id})
+    assert code == 200 and body.count(b"<PartNumber>") == 3
+    xml = "<CompleteMultipartUpload>" + "".join(
+        f"<Part><PartNumber>{i}</PartNumber><ETag>{e}</ETag></Part>"
+        for i, e in enumerate(etags, start=1)
+    ) + "</CompleteMultipartUpload>"
+    code, body, _ = cli.request("POST", f"/{B}/{key}",
+                                query={"uploadId": upload_id},
+                                body=xml.encode())
+    assert code == 200 and b"CompleteMultipartUploadResult" in body
+    code, got, _ = cli.get_object(B, key)
+    assert code == 200 and got == b"".join(parts)
+
+
+def test_multipart_abort_discards(cli):
+    key = "mp/aborted.bin"
+    code, body, _ = cli.request("POST", f"/{B}/{key}", query={"uploads": ""})
+    upload_id = re.search(rb"<UploadId>([^<]+)", body).group(1).decode()
+    cli.request("PUT", f"/{B}/{key}",
+                query={"uploadId": upload_id, "partNumber": "1"},
+                body=b"zzz")
+    code, _, _ = cli.request("DELETE", f"/{B}/{key}",
+                             query={"uploadId": upload_id})
+    assert code == 204
+    assert cli.get_object(B, key)[0] == 404
+
+
+# ---------------- copy + batch delete ----------------
+
+def test_copy_object(cli):
+    src_body = b"copy me " * 50
+    assert cli.put_object(B, "cp/src.bin", src_body)[0] == 200
+    code, body, _ = cli.request(
+        "PUT", f"/{B}/cp/dst.bin",
+        headers={"x-amz-copy-source": f"/{B}/cp/src.bin"})
+    assert code == 200 and b"CopyObjectResult" in body
+    code, got, _ = cli.get_object(B, "cp/dst.bin")
+    assert code == 200 and got == src_body
+
+
+def test_batch_delete(cli):
+    for k in ("bd/1", "bd/2"):
+        assert cli.put_object(B, k, b"x")[0] == 200
+    xml = (b"<Delete><Object><Key>bd/1</Key></Object>"
+           b"<Object><Key>bd/2</Key></Object>"
+           b"<Object><Key>bd/ghost</Key></Object></Delete>")
+    code, body, _ = cli.request("POST", f"/{B}", query={"delete": ""},
+                                body=xml)
+    assert code == 200
+    assert body.count(b"<Deleted>") >= 2
+    assert cli.get_object(B, "bd/1")[0] == 404
+
+
+# ---------------- ACL / tagging ----------------
+
+def test_tagging_roundtrip(cli):
+    assert cli.put_object(B, "tag/obj", b"x")[0] == 200
+    xml = (b"<Tagging><TagSet><Tag><Key>env</Key><Value>prod</Value></Tag>"
+           b"</TagSet></Tagging>")
+    code, _, _ = cli.request("PUT", f"/{B}/tag/obj",
+                             query={"tagging": ""}, body=xml)
+    assert code == 200
+    code, body, _ = cli.request("GET", f"/{B}/tag/obj",
+                                query={"tagging": ""})
+    assert code == 200 and b"<Key>env</Key>" in body \
+        and b"<Value>prod</Value>" in body
+    code, _, _ = cli.request("DELETE", f"/{B}/tag/obj",
+                             query={"tagging": ""})
+    assert code == 204
+    code, body, _ = cli.request("GET", f"/{B}/tag/obj",
+                                query={"tagging": ""})
+    assert code == 200 and b"<Key>env</Key>" not in body
+
+
+def test_acl_roundtrip(cli):
+    assert cli.put_object(B, "acl/obj", b"x")[0] == 200
+    code, _, _ = cli.request("PUT", f"/{B}/acl/obj", query={"acl": ""},
+                             headers={"x-amz-acl": "public-read"})
+    assert code == 200
+    code, body, _ = cli.request("GET", f"/{B}/acl/obj", query={"acl": ""})
+    assert code == 200 and b"AccessControlPolicy" in body
+
+
+# ---------------- auth: negatives + presigned ----------------
+
+def test_bad_signature_rejected(stack):
+    bad = S3Client(stack["endpoint"], stack["owner"]["access_key"],
+                   "wrong-secret-key")
+    code, body, _ = bad.put_object(B, "authz/x", b"x")
+    assert code == 403 and b"SignatureDoesNotMatch" in body
+
+
+def test_unsigned_request_rejected(stack):
+    anon = S3Client(stack["endpoint"])  # no credentials at all
+    code, _, _ = anon.put_object(B, "authz/anon", b"x")
+    assert code == 403
+
+
+def test_ungranted_user_rejected(stack):
+    other = S3Client(stack["endpoint"], stack["stranger"]["access_key"],
+                     stack["stranger"]["secret_key"])
+    code, _, _ = other.put_object(B, "authz/other", b"x")
+    assert code == 403
+
+
+def test_presigned_get_and_put(cli, stack):
+    import urllib.request
+
+    assert cli.put_object(B, "ps/obj", b"presigned")[0] == 200
+    url = cli.presign("GET", f"/{B}/ps/obj", expires=60)
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.read() == b"presigned"
+    put_url = cli.presign("PUT", f"/{B}/ps/via-put", expires=60)
+    req = urllib.request.Request(put_url, data=b"uploaded", method="PUT")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    assert cli.get_object(B, "ps/via-put")[1] == b"uploaded"
+
+
+def test_presigned_expiry_honored(cli):
+    import urllib.error
+    import urllib.request
+
+    assert cli.put_object(B, "ps/exp", b"x")[0] == 200
+    url = cli.presign("GET", f"/{B}/ps/exp", expires=1)
+    time.sleep(2.5)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(url, timeout=10)
+    assert ei.value.code == 403
+
+
+# ---------------- versioning + object lock ----------------
+
+def test_versioning_lifecycle(cli):
+    code, _, _ = cli.request(
+        "PUT", f"/{B}", query={"versioning": ""},
+        body=b"<VersioningConfiguration><Status>Enabled</Status>"
+             b"</VersioningConfiguration>")
+    assert code == 200
+    code, _, h1 = cli.put_object(B, "ver/doc", b"one")
+    v1 = {k.lower(): v for k, v in h1.items()}["x-amz-version-id"]
+    code, _, h2 = cli.put_object(B, "ver/doc", b"two")
+    v2 = {k.lower(): v for k, v in h2.items()}["x-amz-version-id"]
+    assert v1 != v2
+    assert cli.get_object(B, "ver/doc")[1] == b"two"
+    assert cli.get_object(B, "ver/doc",
+                          query={"versionId": v1})[1] == b"one"
+    code, body, _ = cli.request("GET", f"/{B}", query={"versions": ""})
+    assert code == 200 and body.count(b"<Version>") >= 2
+    # delete -> marker; latest GET 404s; old version still readable
+    code, _, dh = cli.delete_object(B, "ver/doc")
+    assert code == 204
+    assert cli.get_object(B, "ver/doc")[0] == 404
+    assert cli.get_object(B, "ver/doc", query={"versionId": v1})[1] == b"one"
+    code, body, _ = cli.request("GET", f"/{B}", query={"versions": ""})
+    assert b"<DeleteMarker>" in body
+    # removing the marker restores the object
+    marker = {k.lower(): v for k, v in dh.items()}["x-amz-version-id"]
+    code, _, _ = cli.delete_object(B, "ver/doc",
+                                   query={"versionId": marker})
+    assert code == 204
+    assert cli.get_object(B, "ver/doc")[1] == b"two"
+
+
+def test_object_lock_blocks_delete(cli):
+    import datetime
+
+    # AWS requires the bucket-level lock configuration before any
+    # per-object retention (and the gateway correctly enforces that)
+    code, _, _ = cli.request(
+        "PUT", f"/{B}", query={"object-lock": ""},
+        body=b"<ObjectLockConfiguration><ObjectLockEnabled>Enabled"
+             b"</ObjectLockEnabled></ObjectLockConfiguration>")
+    assert code == 200
+    until = (datetime.datetime.now(datetime.timezone.utc)
+             + datetime.timedelta(seconds=3600)).strftime(
+                 "%Y-%m-%dT%H:%M:%SZ")
+    code, _, h = cli.put_object(
+        B, "lock/obj", b"held",
+        headers={"x-amz-object-lock-mode": "COMPLIANCE",
+                 "x-amz-object-lock-retain-until-date": until})
+    assert code == 200
+    vid = {k.lower(): v for k, v in h.items()}.get("x-amz-version-id")
+    target_q = {"versionId": vid} if vid else None
+    code, body, _ = cli.delete_object(B, "lock/obj", query=target_q)
+    assert code == 403  # retention denies a versioned/hard delete
+    # legal hold on another object
+    assert cli.put_object(B, "lock/held2", b"x")[0] == 200
+    code, _, _ = cli.request(
+        "PUT", f"/{B}/lock/held2", query={"legal-hold": ""},
+        body=b"<LegalHold><Status>ON</Status></LegalHold>")
+    assert code == 200
+    code, body, _ = cli.request("GET", f"/{B}/lock/held2",
+                                query={"legal-hold": ""})
+    assert code == 200 and b"ON" in body

@@ -1,0 +1,100 @@
+"""Native SIMD CPU codec engine (runtime/src/gfcpu.cc) + the measured
+size-class crossover policy (codec/engine.py engine_for/auto)."""
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.codec import codemode as cm
+from cubefs_tpu.codec import engine as E
+from cubefs_tpu.codec.encoder import CodecConfig, new_encoder
+from cubefs_tpu.ops import gf256
+
+
+@pytest.fixture(scope="module")
+def cpp():
+    try:
+        return E.get_engine("cpp")
+    except Exception as e:
+        pytest.skip(f"native runtime unavailable: {e}")
+
+
+def test_bit_identical_vs_numpy(cpp, rng):
+    npy = E.get_engine("numpy")
+    for shape in [(1, 64), (6, 1 << 12), (12, 4096 + 7)]:  # incl. tails
+        data = rng.integers(0, 256, shape, dtype=np.uint8)
+        for m in (1, 3, 4):
+            assert (cpp.encode_parity(data, m)
+                    == npy.encode_parity(data, m)).all()
+    # batched + arbitrary (reconstruct-shaped) matrices
+    data = rng.integers(0, 256, (3, 2, 6, 1000), dtype=np.uint8)
+    mat = rng.integers(0, 256, (8, 6), dtype=np.uint8)
+    assert (cpp.matrix_apply(mat, data)
+            == npy.matrix_apply(mat, data)).all()
+
+
+def test_matches_pinned_goldens(cpp):
+    """The same independent fixtures that gate the device kernels gate
+    the native CPU path (tests/fixtures/generate.py re-derives the math
+    with different primitives)."""
+    import os
+
+    fix = os.path.join(os.path.dirname(__file__), "fixtures", "rs6p3.bin")
+    raw = np.fromfile(fix, dtype=np.uint8)
+    # fixture layout: 6 data shards then 3 parity shards, equal length
+    s = raw.size // 9
+    data, parity = raw[: 6 * s].reshape(6, s), raw[6 * s:].reshape(3, s)
+    assert (cpp.encode_parity(data, 3) == parity).all()
+
+
+def test_full_encoder_roundtrip_on_cpp(rng):
+    try:
+        E.get_engine("cpp")
+    except Exception as e:
+        pytest.skip(f"native runtime unavailable: {e}")
+    enc = new_encoder(CodecConfig(mode=cm.CodeMode.EC6P3, engine="cpp"))
+    data = rng.integers(0, 256, (6, 2048), dtype=np.uint8)
+    shards = enc.encode(np.vstack([data, np.zeros((3, 2048), np.uint8)]))
+    gold = shards.copy()
+    shards[0, :] = 0
+    shards[7, :] = 0
+    rec = enc.reconstruct(shards, bad_idx=[0, 7])
+    assert (rec == gold).all()
+
+
+def test_crossover_policy_and_auto(cpp, rng, tmp_path, monkeypatch):
+    monkeypatch.setattr(E, "_policy_path",
+                        lambda: str(tmp_path / "CROSSOVER.json"))
+    E._policy = None
+    table = E.measure_crossover(sizes=(64 << 10, 1 << 20), repeats=1)
+    assert len(table) == 2 and all(name in ("cpp", "tpu", "numpy")
+                                   for _, name in table)
+    # the persisted table is what a fresh process loads
+    E._policy = None
+    assert E._load_policy() == table
+    eng = E.engine_for(32 << 10)
+    assert eng.name == table[0][1]
+    auto = E.get_engine("auto")
+    d = rng.integers(0, 256, (6, 512), dtype=np.uint8)
+    assert (auto.encode_parity(d, 3)
+            == E.get_engine("numpy").encode_parity(d, 3)).all()
+
+
+def test_zero_coefficient_rows(cpp):
+    """Rows with zero coefficients skip inputs entirely — the output
+    must still be exact (identity-matrix prefix reproduces inputs)."""
+    data = np.arange(4 * 100, dtype=np.uint8).reshape(4, 100)
+    ident = np.eye(4, dtype=np.uint8)
+    assert (cpp.matrix_apply(ident, data) == data).all()
+    zero = np.zeros((2, 4), dtype=np.uint8)
+    assert (cpp.matrix_apply(zero, data) == 0).all()
+
+
+def test_gf_properties_random(cpp, rng):
+    """Linearity over GF(2): apply(m, a^b) == apply(m, a) ^ apply(m, b)."""
+    a = rng.integers(0, 256, (5, 333), dtype=np.uint8)
+    b = rng.integers(0, 256, (5, 333), dtype=np.uint8)
+    m = rng.integers(0, 256, (7, 5), dtype=np.uint8)
+    assert (cpp.matrix_apply(m, a ^ b)
+            == cpp.matrix_apply(m, a) ^ cpp.matrix_apply(m, b)).all()
+    # scalar consistency with the table implementation
+    assert gf256.EXP is not None  # tables built the same way
