@@ -38,7 +38,11 @@ class ClusterMgr(ReplicatedFsm):
         self.disks: dict[int, DiskInfo] = {}
         self.volumes: dict[int, VolumeInfo] = {}
         self.services: dict[str, list[str]] = {}
-        self.kv: dict[str, str] = {}
+        self.kv: dict[str, str] = {}  # configmgr: dynamic cluster config
+        self.kvs: dict[str, str] = {}  # kvmgr: general KV (task ckpts &c)
+        # scopemgr: named monotonic id scopes (scopemgr/scopemgr.go role);
+        # "bid" is seeded from the legacy counter on first use
+        self.scopes: dict[str, int] = {}
         # shardnode catalog (clustermgr/catalog role): space -> sorted
         # [{shard_id, start, end, addrs}] range map
         self.spaces: dict[str, list[dict]] = {}
@@ -59,6 +63,8 @@ class ClusterMgr(ReplicatedFsm):
             "volumes": {k: v.to_dict() for k, v in self.volumes.items()},
             "services": self.services,
             "kv": self.kv,
+            "kvs": self.kvs,
+            "scopes": self.scopes,
             "spaces": self.spaces,
             "next": [self._next_disk, self._next_vid, self._next_bid,
                      self._next_chunk, self._next_shard],
@@ -72,6 +78,8 @@ class ClusterMgr(ReplicatedFsm):
                         for k, v in state["volumes"].items()}
         self.services = state["services"]
         self.kv = state["kv"]
+        self.kvs = state.get("kvs", {})
+        self.scopes = state.get("scopes", {})
         self.spaces = state.get("spaces", {})
         nxt = state["next"]
         (self._next_disk, self._next_vid, self._next_bid,
@@ -245,15 +253,42 @@ class ClusterMgr(ReplicatedFsm):
         self._next_chunk += 1
         return cid
 
-    # ---------------- scope (BID) allocation ----------------
+    # ---------------- scope allocation (scopemgr role) ----------------
+    # Named monotonic id ranges (scopemgr/scopemgr.go): BIDs are the
+    # "bid" scope; any subsystem can carve its own id space without a
+    # new FSM op. Allocation happens inside apply, so a lagging new
+    # leader can never re-issue a committed range.
     def alloc_bids(self, count: int) -> int:
         with self._propose_lock:
             return self._commit({"op": "alloc_bids", "count": count})
 
     def _apply_alloc_bids(self, count: int) -> int:
-        start = self._next_bid
-        self._next_bid += count
+        # BIDs ARE the "bid" scope: both APIs draw from one counter, so
+        # neither can ever re-issue a range the other handed out
+        return self._apply_alloc_scope("bid", count)
+
+    def alloc_scope(self, name: str, count: int = 1) -> int:
+        """First id of a freshly committed [start, start+count) range."""
+        if count < 1:
+            raise ValueError("count must be >= 1")
+        with self._propose_lock:
+            return self._commit({"op": "alloc_scope", "name": name,
+                                 "count": count})
+
+    def _apply_alloc_scope(self, name: str, count: int) -> int:
+        if name == "bid" and "bid" not in self.scopes:
+            # seed from the legacy counter (pre-scope snapshots)
+            self.scopes["bid"] = self._next_bid
+        start = self.scopes.get(name, 1)
+        self.scopes[name] = start + count
+        if name == "bid":
+            self._next_bid = self.scopes["bid"]  # keep legacy field honest
         return start
+
+    def scope_watermark(self, name: str) -> int:
+        """Next unissued id for a scope (inspection/CLI)."""
+        with self._lock:
+            return self.scopes.get(name, 1)
 
     # ---------------- service registry & config ----------------
     def register_service(self, name: str, addr: str) -> None:
@@ -279,6 +314,51 @@ class ClusterMgr(ReplicatedFsm):
     def get_config(self, key: str, default: str | None = None) -> str | None:
         with self._lock:
             return self.kv.get(key, default)
+
+    def delete_config(self, key: str) -> None:
+        with self._propose_lock:
+            self._commit({"op": "delete_config", "key": key})
+
+    def _apply_delete_config(self, key: str) -> None:
+        self.kv.pop(key, None)
+
+    def list_config(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self.kv)
+
+    # ---------------- general KV (kvmgr role) ----------------
+    # blobstore/clustermgr/kvmgr: replicated general-purpose KV with
+    # prefix/marker paging — scheduler checkpoints and task records ride
+    # here in the reference.
+    def kv_set(self, key: str, value: str) -> None:
+        with self._propose_lock:
+            self._commit({"op": "kv_set", "key": key, "value": value})
+
+    def _apply_kv_set(self, key: str, value: str) -> None:
+        self.kvs[key] = value
+
+    def kv_get(self, key: str) -> str | None:
+        with self._lock:
+            return self.kvs.get(key)
+
+    def kv_delete(self, key: str) -> None:
+        with self._propose_lock:
+            self._commit({"op": "kv_delete", "key": key})
+
+    def _apply_kv_delete(self, key: str) -> None:
+        self.kvs.pop(key, None)
+
+    def kv_list(self, prefix: str = "", marker: str = "",
+                count: int = 100) -> tuple[list[tuple[str, str]], str]:
+        """Sorted (key, value) page after `marker`; returns
+        (items, next_marker) with next_marker == "" on the last page."""
+        count = max(1, int(count))
+        with self._lock:
+            keys = sorted(k for k in self.kvs
+                          if k.startswith(prefix) and k > marker)
+            page = keys[:count]
+            nxt = page[-1] if len(keys) > count else ""
+            return [(k, self.kvs[k]) for k in page], nxt
 
     # ---------------- shardnode catalog ----------------
     # clustermgr/catalog role: the authoritative space -> range-shard
@@ -455,6 +535,49 @@ class ClusterMgr(ReplicatedFsm):
     def rpc_register_service(self, args, body):
         self.register_service(args["name"], args["addr"])
         return {}
+
+    def rpc_set_config(self, args, body):
+        self._leader_gate()
+        self.set_config(args["key"], args["value"])
+        return {}
+
+    def rpc_get_config(self, args, body):
+        return {"value": self.get_config(args["key"])}
+
+    def rpc_delete_config(self, args, body):
+        self._leader_gate()
+        self.delete_config(args["key"])
+        return {}
+
+    def rpc_list_config(self, args, body):
+        return {"config": self.list_config()}
+
+    def rpc_kv_set(self, args, body):
+        self._leader_gate()
+        self.kv_set(args["key"], args["value"])
+        return {}
+
+    def rpc_kv_get(self, args, body):
+        return {"value": self.kv_get(args["key"])}
+
+    def rpc_kv_delete(self, args, body):
+        self._leader_gate()
+        self.kv_delete(args["key"])
+        return {}
+
+    def rpc_kv_list(self, args, body):
+        items, marker = self.kv_list(args.get("prefix", ""),
+                                     args.get("marker", ""),
+                                     int(args.get("count", 100)))
+        return {"items": items, "marker": marker}
+
+    def rpc_alloc_scope(self, args, body):
+        self._leader_gate()
+        return {"start": self.alloc_scope(args["name"],
+                                          int(args.get("count", 1)))}
+
+    def rpc_scope_watermark(self, args, body):
+        return {"next": self.scope_watermark(args["name"])}
 
     def rpc_get_service(self, args, body):
         return {"addrs": self.get_service(args["name"])}

@@ -213,15 +213,24 @@ def run_role(cfg: dict):
 
     if role == "access":
         from .blob.access import AccessConfig, AccessHandler
-        from .blob.mq import MessageQueue
+        from .blob.mq import MessageQueue, QueueProducer
 
         q_dir = cfg.get("queue_dir")
+        mq_members = cfg.get("mq_members")  # replicated bus (Kafka role)
+        if mq_members:
+            rq = QueueProducer("repair", mq_members, pool,
+                               int(cfg.get("mq_partitions", 2)))
+            dq = QueueProducer("delete", mq_members, pool,
+                               int(cfg.get("mq_partitions", 2)))
+        else:
+            rq = MessageQueue(q_dir, "repair") if q_dir else None
+            dq = MessageQueue(q_dir, "delete") if q_dir else None
         svc = AccessHandler(
             rpc.Client(cfg["clustermgr_addr"]), pool,
             AccessConfig(blob_size=int(cfg.get("blob_size", 8 << 20)),
-                         engine=cfg.get("ec_engine")),
-            repair_queue=MessageQueue(q_dir, "repair") if q_dir else None,
-            delete_queue=MessageQueue(q_dir, "delete") if q_dir else None,
+                         engine=cfg.get("ec_engine", "auto")),
+            repair_queue=rq,
+            delete_queue=dq,
             proxy_client=rpc.Client(cfg["proxy_addr"]) if cfg.get("proxy_addr") else None,
         )
         return _serve(rpc.expose(svc), cfg), svc
@@ -241,15 +250,33 @@ def run_role(cfg: dict):
 
         cm = ClusterMgr(data_dir=cfg.get("data_dir"))
         q_dir = cfg.get("queue_dir")
+        mq_routes: dict = {}
+        if cfg.get("mq_me") and cfg.get("mq_peers"):
+            # replicated bus member (Kafka role): this scheduler hosts a
+            # raft member of each topic; producers relay via mq_*_put
+            from .blob.mq import ReplicatedQueue
+
+            nparts = int(cfg.get("mq_partitions", 2))
+            rq = ReplicatedQueue("repair", cfg["mq_me"], cfg["mq_peers"],
+                                 pool, data_dir=cfg.get("mq_dir"),
+                                 n_partitions=nparts)
+            dq = ReplicatedQueue("delete", cfg["mq_me"], cfg["mq_peers"],
+                                 pool, data_dir=cfg.get("mq_dir"),
+                                 n_partitions=nparts)
+            mq_routes = {**rq.extra_routes, **dq.extra_routes}
+        else:
+            rq = MessageQueue(q_dir, "repair") if q_dir else None
+            dq = MessageQueue(q_dir, "delete") if q_dir else None
         svc = Scheduler(
             cm,
-            repair_queue=MessageQueue(q_dir, "repair") if q_dir else None,
-            delete_queue=MessageQueue(q_dir, "delete") if q_dir else None,
+            repair_queue=rq,
+            delete_queue=dq,
             node_pool=pool,
             data_dir=cfg.get("task_dir"),
         )
         svc.start()
-        routes = {**rpc.expose(svc), **{f"cm_{k}": v for k, v in rpc.expose(cm).items()}}
+        routes = {**rpc.expose(svc), **mq_routes,
+                  **{f"cm_{k}": v for k, v in rpc.expose(cm).items()}}
         return _serve(dict(routes, role=lambda a, b: {"role": "scheduler"}), cfg), svc
 
     if role == "fsgateway":

@@ -382,9 +382,7 @@ class ObjectNode:
                     if not self._check("s3:PutObjectAcl", bucket, key):
                         return
                     canned = self.headers.get("x-amz-acl", "private")
-                    if canned not in ("private", "public-read",
-                                      "public-read-write",
-                                      "authenticated-read"):
+                    if canned not in s3policy.CANNED_ACLS:
                         return self._error(400, "InvalidArgument", canned)
                     try:
                         fs.setxattr("/" + key, s3policy.XA_ACL, canned)

@@ -18,6 +18,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 
 #if defined(__x86_64__) || defined(__i386__)
 #include <immintrin.h>
@@ -29,24 +30,26 @@ namespace {
 constexpr uint16_t POLY = 0x11D;
 
 uint8_t MUL[256][256];
-bool tables_ready = false;
+std::once_flag mul_once;
 
 void build_tables() {
-  if (tables_ready) return;
-  uint8_t exp[512];
-  int log[256] = {0};
-  int x = 1;
-  for (int i = 0; i < 255; i++) {
-    exp[i] = (uint8_t)x;
-    log[x] = i;
-    x <<= 1;
-    if (x & 0x100) x ^= POLY;
-  }
-  for (int i = 255; i < 510; i++) exp[i] = exp[i - 255];
-  for (int a = 0; a < 256; a++)
-    for (int b = 0; b < 256; b++)
-      MUL[a][b] = (a && b) ? exp[log[a] + log[b]] : 0;
-  tables_ready = true;
+  // call_once: ctypes drops the GIL, so concurrent first encodes would
+  // otherwise read MUL mid-build (silent wrong parity)
+  std::call_once(mul_once, [] {
+    uint8_t exp[512];
+    int log[256] = {0};
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+      exp[i] = (uint8_t)x;
+      log[x] = i;
+      x <<= 1;
+      if (x & 0x100) x ^= POLY;
+    }
+    for (int i = 255; i < 510; i++) exp[i] = exp[i - 255];
+    for (int a = 0; a < 256; a++)
+      for (int b = 0; b < 256; b++)
+        MUL[a][b] = (a && b) ? exp[log[a] + log[b]] : 0;
+  });
 }
 
 // scalar accumulate: out ^= c * in  (last-resort portable path)

@@ -164,6 +164,40 @@ class ClusterMgrClient(_Base):
     def register_service(self, name: str, addr: str) -> None:
         self._call("register_service", {"name": name, "addr": addr})
 
+    # configmgr surface (clustermgr/configmgr analog)
+    def set_config(self, key: str, value: str) -> None:
+        self._call("set_config", {"key": key, "value": value})
+
+    def get_config(self, key: str) -> str | None:
+        return self._call("get_config", {"key": key})[0]["value"]
+
+    def delete_config(self, key: str) -> None:
+        self._call("delete_config", {"key": key})
+
+    def list_config(self) -> dict:
+        return self._call("list_config")[0]["config"]
+
+    # kvmgr surface (clustermgr/kvmgr analog)
+    def kv_set(self, key: str, value: str) -> None:
+        self._call("kv_set", {"key": key, "value": value})
+
+    def kv_get(self, key: str) -> str | None:
+        return self._call("kv_get", {"key": key})[0]["value"]
+
+    def kv_delete(self, key: str) -> None:
+        self._call("kv_delete", {"key": key})
+
+    def kv_list(self, prefix: str = "", marker: str = "",
+                count: int = 100) -> tuple[list, str]:
+        out = self._call("kv_list", {"prefix": prefix, "marker": marker,
+                                     "count": count})[0]
+        return out["items"], out["marker"]
+
+    # scopemgr surface (clustermgr/scopemgr analog)
+    def alloc_scope(self, name: str, count: int = 1) -> int:
+        return self._call("alloc_scope",
+                          {"name": name, "count": count})[0]["start"]
+
 
 class AuthClient(_Base):
     """Ticket service surface (sdk/auth/api.go analog): key

@@ -1,0 +1,201 @@
+"""Replicated partitioned message bus (blob/mq.ReplicatedQueue): the
+Kafka-survivability analog. Driven over REAL RpcServer HTTP sockets —
+in-process fixtures hide redirect/election bugs (see
+test_raft.py::test_http_raft_survives_poisoned_sdk_leader_cache)."""
+
+import time
+
+import pytest
+
+from cubefs_tpu.blob.mq import MessageQueue, ReplicatedQueue
+from cubefs_tpu.utils import rpc
+from cubefs_tpu.utils.rpc import NodePool
+
+
+def _wait_all_leaders(queues, deadline_s=15):
+    """Every partition has exactly one leader among members."""
+    deadline = time.time() + deadline_s
+    n = queues[0].n
+    while time.time() < deadline:
+        leaders = [[q for q in queues
+                    if q.rafts[p].status()["role"] == "leader"]
+                   for p in range(n)]
+        if all(len(ls) == 1 for ls in leaders):
+            return leaders
+        time.sleep(0.05)
+    raise AssertionError("partitions did not elect")
+
+
+@pytest.fixture
+def bus(tmp_path):
+    pool = NodePool()
+    servers, queues, hosts = [], [], []
+    for i in range(3):
+        class Host:
+            extra_routes: dict = {}
+        h = Host()
+        srv = rpc.RpcServer(h, service=f"mq{i}").start()
+        servers.append(srv)
+        hosts.append(h)
+    addrs = [s.addr for s in servers]
+    for i, h in enumerate(hosts):
+        q = ReplicatedQueue("repair", addrs[i], addrs, pool,
+                            data_dir=str(tmp_path / f"n{i}"),
+                            n_partitions=2)
+        h.extra_routes = q.extra_routes
+        queues.append(q)
+    yield pool, servers, queues
+    for q in queues:
+        q.stop()
+    for s in servers:
+        s.stop()
+
+
+def test_put_from_any_member_poll_from_one(bus):
+    pool, servers, queues = bus
+    _wait_all_leaders(queues)
+    for i in range(10):
+        queues[i % 3].put({"vid": i})  # producers on every member
+    # ONE consumer drains the whole topic regardless of which members
+    # lead the partitions (peeks relay to partition leaders)
+    deadline = time.time() + 10
+    got: list = []
+    while time.time() < deadline:
+        got = [m for _, m in queues[0].poll(64)]
+        if len(got) == 10:
+            break
+        time.sleep(0.05)
+    assert sorted(m["vid"] for m in got) == list(range(10))
+
+
+def test_ack_is_replicated_and_survives(bus):
+    pool, servers, queues = bus
+    _wait_all_leaders(queues)
+    for i in range(6):
+        queues[0].put({"vid": i})
+    # consume + ack everything from whichever nodes lead
+    deadline = time.time() + 10
+    acked = 0
+    while acked < 6 and time.time() < deadline:
+        for q in queues:
+            for off, _ in q.poll(64):
+                q.ack(off)
+                acked += 1
+        time.sleep(0.05)
+    assert acked == 6
+    time.sleep(0.3)  # ack entries commit to followers
+    assert sum(q.backlog() for q in queues) / len(queues) < 1
+
+
+def test_events_survive_leader_loss(bus):
+    """The point of the component: pending events outlive a node."""
+    pool, servers, queues = bus
+    _wait_all_leaders(queues)
+    for i in range(8):
+        queues[0].put({"vid": i})
+    # let replication land on followers
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if all(f.backlog() >= 1 for q in queues for f in q.fsms):
+            break
+        time.sleep(0.05)
+    # kill the leader of partition 0 (http + raft)
+    victim = next(q for q in queues if q.rafts[0].status()["role"] == "leader")
+    vi = queues.index(victim)
+    victim.stop()
+    servers[vi].stop()
+    survivors = [q for q in queues if q is not victim]
+    deadline = time.time() + 20
+    got: list = []
+    while time.time() < deadline:
+        got = [m for _, m in survivors[0].poll(64)]
+        if len(got) == 8:
+            break
+        time.sleep(0.1)
+    assert sorted(m["vid"] for m in got) == list(range(8)), \
+        "unacked events lost with the dead node"
+    # and producers keep working through the survivors
+    survivors[0].put({"vid": 99})
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if any(m["vid"] == 99 for _, m in survivors[1].poll(64)):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("post-failover put not visible")
+
+
+def test_scheduler_consumes_replicated_queue(tmp_path):
+    """Drop-in compatibility: the scheduler's consumer loop runs
+    unchanged against the replicated bus (single member = leader of
+    every partition)."""
+    pool = NodePool()
+
+    class Host:
+        extra_routes: dict = {}
+
+    h = Host()
+    srv = rpc.RpcServer(h, service="mq").start()
+    q = ReplicatedQueue("deletes", srv.addr, [srv.addr], pool,
+                        data_dir=str(tmp_path / "solo"), n_partitions=2)
+    h.extra_routes = q.extra_routes
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(n.status()["role"] == "leader" for n in q.rafts):
+                break
+            time.sleep(0.05)
+        for i in range(5):
+            q.put({"bid": i})
+        seen = []
+        for off, msg in q.poll(64):
+            seen.append(msg["bid"])
+            q.ack(off)
+        assert sorted(seen) == list(range(5))
+        assert q.backlog() == 0
+    finally:
+        q.stop()
+        srv.stop()
+
+
+def test_restart_recovers_from_wal(tmp_path):
+    """A full-bus restart (all members) replays unacked events from the
+    raft WALs — nothing rides only memory."""
+    pool = NodePool()
+
+    class Host:
+        extra_routes: dict = {}
+
+    h = Host()
+    srv = rpc.RpcServer(h, service="mq").start()
+    q = ReplicatedQueue("t", srv.addr, [srv.addr], pool,
+                        data_dir=str(tmp_path / "r"), n_partitions=1)
+    h.extra_routes = q.extra_routes
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if q.rafts[0].status()["role"] == "leader":
+            break
+        time.sleep(0.05)
+    q.put({"vid": 1})
+    q.put({"vid": 2})
+    off, msg = q.poll(1)[0]
+    q.ack(off)
+    q.stop()
+    q2 = ReplicatedQueue("t", srv.addr, [srv.addr], pool,
+                         data_dir=str(tmp_path / "r"), n_partitions=1)
+    h.extra_routes = q2.extra_routes
+    try:
+        # WAL entries apply asynchronously after election — wait for the
+        # replayed state to converge, then assert the acked msg is gone
+        deadline = time.time() + 10
+        msgs: list = []
+        while time.time() < deadline:
+            if q2.rafts[0].status()["role"] == "leader":
+                msgs = [m for _, m in q2.poll(64)]
+                if [m["vid"] for m in msgs] == [2]:
+                    break
+            time.sleep(0.05)
+        assert [m["vid"] for m in msgs] == [2]  # acked 1 stays acked
+    finally:
+        q2.stop()
+        srv.stop()
