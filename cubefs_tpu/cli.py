@@ -79,6 +79,22 @@ def main(argv=None):
                         help="disk status code (disk-status) or volume "
                              "status filter (vols)")
 
+    p_cm = sub.add_parser("cm")  # clustermgr managers (config/kv/scope)
+    p_cm.add_argument("action",
+                      choices=["config-get", "config-set", "config-del",
+                               "config-list", "kv-get", "kv-set", "kv-del",
+                               "kv-list", "scope-alloc", "scope-next"])
+    p_cm.add_argument("args", nargs="*")
+    p_cm.add_argument("--clustermgr", required=True)
+    p_cm.add_argument("--prefix", default="")
+    p_cm.add_argument("--count", type=int, default=100)
+
+    p_mq = sub.add_parser("mq")  # replicated bus introspection
+    p_mq.add_argument("action", choices=["status", "backlog"])
+    p_mq.add_argument("--member", required=True, help="bus member addr")
+    p_mq.add_argument("--topic", default="all",
+                      help="one topic (e.g. repair/delete) or 'all'")
+
     p_node = sub.add_parser("node")
     p_node.add_argument("action", choices=["list", "decommission"])
     p_node.add_argument("--master", required=True)
@@ -275,6 +291,57 @@ def main(argv=None):
 
             out = {"actions": MasterClient(args.master).check_replicas()}
         print(json.dumps(out, indent=2))
+
+    elif args.group == "cm":
+        from .sdk.clients import ClusterMgrClient
+
+        cmc = ClusterMgrClient(args.clustermgr)
+        a = args.args
+        needs = {"config-get": 1, "config-set": 2, "config-del": 1,
+                 "kv-get": 1, "kv-set": 2, "kv-del": 1,
+                 "scope-alloc": 1, "scope-next": 1}
+        if len(a) < needs.get(args.action, 0):
+            sys.exit(f"cm {args.action} needs {needs[args.action]} "
+                     f"positional argument(s)")
+        if args.action == "config-get":
+            print(json.dumps({"value": cmc.get_config(a[0])}))
+        elif args.action == "config-set":
+            cmc.set_config(a[0], a[1])
+        elif args.action == "config-del":
+            cmc.delete_config(a[0])
+        elif args.action == "config-list":
+            print(json.dumps(cmc.list_config(), indent=2))
+        elif args.action == "kv-get":
+            print(json.dumps({"value": cmc.kv_get(a[0])}))
+        elif args.action == "kv-set":
+            cmc.kv_set(a[0], a[1])
+        elif args.action == "kv-del":
+            cmc.kv_delete(a[0])
+        elif args.action == "kv-list":
+            items, marker = cmc.kv_list(prefix=args.prefix,
+                                        marker=a[0] if a else "",
+                                        count=args.count)
+            print(json.dumps({"items": items, "marker": marker}, indent=2))
+        elif args.action == "scope-alloc":
+            count = int(a[1]) if len(a) > 1 else 1
+            print(json.dumps({"start": cmc.alloc_scope(a[0], count)}))
+        elif args.action == "scope-next":
+            meta, _ = rpc.call(args.clustermgr, "scope_watermark",
+                               {"name": a[0]})
+            print(json.dumps(meta))
+
+    elif args.group == "mq":
+        meta, _ = rpc.call(args.member, "mq_status", {})
+        if args.topic != "all":
+            if args.topic not in meta:
+                sys.exit(f"no topic {args.topic!r}; have {sorted(meta)}")
+            meta = {args.topic: meta[args.topic]}
+        if args.action == "status":
+            print(json.dumps(meta, indent=2))
+        else:  # backlog
+            total = {t: sum(p["backlog"] for p in st["partitions"])
+                     for t, st in meta.items()}
+            print(json.dumps(total))
 
     elif args.group == "flash":
         from .sdk import FlashClient, FlashGroupClient

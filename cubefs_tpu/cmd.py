@@ -263,7 +263,9 @@ def run_role(cfg: dict):
             dq = ReplicatedQueue("delete", cfg["mq_me"], cfg["mq_peers"],
                                  pool, data_dir=cfg.get("mq_dir"),
                                  n_partitions=nparts)
-            mq_routes = {**rq.extra_routes, **dq.extra_routes}
+            mq_routes = {**rq.extra_routes, **dq.extra_routes,
+                         "mq_status": lambda a, b: {
+                             "repair": rq.status(), "delete": dq.status()}}
         else:
             rq = MessageQueue(q_dir, "repair") if q_dir else None
             dq = MessageQueue(q_dir, "delete") if q_dir else None

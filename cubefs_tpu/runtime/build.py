@@ -123,6 +123,10 @@ def load() -> ctypes.CDLL:
             lib.cfs_codec_encode.argtypes = [
                 c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_uint64, c.c_int,
                 c.c_char_p, c.c_void_p]
+            lib.cfs_codec_encode_shm.restype = c.c_int
+            lib.cfs_codec_encode_shm.argtypes = [
+                c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_uint64, c.c_int,
+                c.c_void_p, c.c_void_p]
             lib.cfs_codec_crc32.argtypes = [
                 c.c_char_p, c.c_int, c.c_uint64, c.c_char_p, c.c_uint64, c.c_void_p]
             # POSIX file surface over the FsGateway (libcfs analog)

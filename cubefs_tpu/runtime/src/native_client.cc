@@ -18,8 +18,11 @@
 // Build: part of libcubefs_rt.so (see runtime/build.py).
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <cerrno>
@@ -585,6 +588,55 @@ int cfs_blob_delete(const char* host, int port, const char* args_json) {
 
 // EC encode offload: data = batch*n shards of shard_size bytes; parity
 // (batch*m*shard_size) written to out.
+// Shared-memory encode for a CO-LOCATED sidecar (codec/service.py
+// rpc_encode_shm): shards land in a /dev/shm file, only shapes ride
+// HTTP. Measured 6-8x the body-over-HTTP path, whose framing+copies
+// cap the boundary at ~0.4 GiB/s (SURVEY §7 hard part 2).
+int cfs_codec_encode_shm(const char* host, int port, int n, int m,
+                         uint64_t shard_size, int batch,
+                         const uint8_t* data, uint8_t* parity_out) {
+  size_t in_bytes = (size_t)batch * n * shard_size;
+  size_t out_bytes = (size_t)batch * m * shard_size;
+  char path[128];
+  snprintf(path, sizeof path, "/dev/shm/cubefs-codec-%d-XXXXXX",
+           (int)getpid());
+  int fd = mkstemp(path);
+  if (fd < 0) {
+    nc_set_err("mkstemp /dev/shm failed");
+    return -1;
+  }
+  int rc = -1;
+  uint8_t* map = nullptr;
+  do {
+    if (ftruncate(fd, (off_t)(in_bytes + out_bytes)) != 0) {
+      nc_set_err("ftruncate shm failed");
+      break;
+    }
+    map = (uint8_t*)mmap(nullptr, in_bytes + out_bytes,
+                         PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (map == MAP_FAILED) {
+      map = nullptr;
+      nc_set_err("mmap shm failed");
+      break;
+    }
+    memcpy(map, data, in_bytes);
+    char args[256];
+    snprintf(args, sizeof args,
+             "{\"n\": %d, \"m\": %d, \"shard_size\": %llu, \"batch\": %d, "
+             "\"shm\": \"%s\"}",
+             n, m, (unsigned long long)shard_size, batch, path);
+    std::vector<uint8_t> resp;
+    int st = http_post(host, port, "encode_shm", args, nullptr, 0, &resp);
+    if (st != 200) break;
+    memcpy(parity_out, map + in_bytes, out_bytes);
+    rc = 0;
+  } while (false);
+  if (map) munmap(map, in_bytes + out_bytes);
+  close(fd);
+  unlink(path);
+  return rc;
+}
+
 int cfs_codec_encode(const char* host, int port, int n, int m,
                      uint64_t shard_size, int batch, const uint8_t* data,
                      uint8_t* parity_out) {

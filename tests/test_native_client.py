@@ -46,6 +46,103 @@ def test_c_client_codec_encode(lib, rng):
         srv.stop()
 
 
+def test_c_client_codec_encode_shm(lib, rng):
+    """Shared-memory boundary (encode_shm): bit-identical with the HTTP
+    body path, and the shm file is cleaned up afterwards."""
+    import glob
+    import os
+
+    srv = rpc.RpcServer(rpc.expose(CodecService()), service="codec").start()
+    try:
+        host, port = _host_port(srv.addr)
+        n, m, s, b = 6, 3, 4096, 3
+        data = np.ascontiguousarray(
+            rng.integers(0, 256, (b, n, s), dtype=np.uint8))
+        parity = np.zeros((b, m, s), dtype=np.uint8)
+        rc = lib.cfs_codec_encode_shm(
+            host, port, n, m, s, b,
+            data.ctypes.data_as(ctypes.c_void_p),
+            parity.ctypes.data_as(ctypes.c_void_p))
+        assert rc == 0, lib.cfs_last_error()
+        for i in range(b):
+            expect = gf256.gf_matmul(gf256.parity_matrix(n, m), data[i])
+            assert np.array_equal(parity[i], expect)
+        assert not glob.glob(f"/dev/shm/cubefs-codec-{os.getpid()}-*"), \
+            "shm scratch file leaked"
+    finally:
+        srv.stop()
+
+
+def test_codec_reconstruct_shm_roundtrip(rng):
+    """reconstruct_shm layout contract over a real server: survivors
+    (ascending `present` order) at offset 0, recovered `wanted` rows
+    written right after — bit-identical with the in-process engine."""
+    import os
+    import tempfile
+
+    svc = CodecService(engine="numpy")
+    srv = rpc.RpcServer(rpc.expose(svc), service="codec").start()
+    fd, path = tempfile.mkstemp(prefix="cubefs-codec-", dir="/dev/shm")
+    try:
+        n, m, s, b = 6, 3, 2048, 2
+        data = rng.integers(0, 256, (b, n, s), dtype=np.uint8)
+        parity = np.stack([gf256.gf_matmul(gf256.parity_matrix(n, m), d)
+                           for d in data])
+        full = np.concatenate([data, parity], axis=1)  # (b, n+m, s)
+        bad = [1, 7]
+        present = [i for i in range(n + m) if i not in bad]
+        surv = full[:, present[:n], :]
+        os.truncate(fd, b * n * s + b * len(bad) * s)
+        mm = np.memmap(path, dtype=np.uint8, mode="r+")
+        mm[: b * n * s] = np.ascontiguousarray(surv).reshape(-1)
+        mm.flush()
+        meta, _ = rpc.call(srv.addr, "reconstruct_shm",
+                           {"n": n, "total": n + m, "present": present,
+                            "wanted": bad, "shard_size": s, "batch": b,
+                            "shm": path})
+        assert meta["shape"] == [b, len(bad), s]
+        got = np.array(mm[meta["offset"]:
+                          meta["offset"] + b * len(bad) * s]
+                       ).reshape(b, len(bad), s)
+        assert np.array_equal(got, full[:, bad, :])
+        # unsorted present must be rejected, not silently miscomputed
+        import pytest as _pytest
+
+        from cubefs_tpu.utils.rpc import RpcError
+        with _pytest.raises(RpcError):
+            rpc.call(srv.addr, "reconstruct_shm",
+                     {"n": n, "total": n + m,
+                      "present": list(reversed(present)), "wanted": bad,
+                      "shard_size": s, "batch": b, "shm": path})
+    finally:
+        os.close(fd)
+        os.unlink(path)
+        srv.stop()
+
+
+def test_codec_shm_path_validation():
+    """The service must refuse shm paths outside its /dev/shm prefix —
+    a hostile path would make it read/write arbitrary files."""
+    svc = CodecService(engine="numpy")
+    srv = rpc.RpcServer(rpc.expose(svc), service="codec").start()
+    try:
+        import pytest as _pytest
+
+        from cubefs_tpu.utils.rpc import RpcError
+        with _pytest.raises(RpcError) as ei:
+            rpc.call(srv.addr, "encode_shm",
+                     {"n": 2, "m": 1, "shard_size": 4, "batch": 1,
+                      "shm": "/etc/passwd"})
+        assert ei.value.code == 400
+        with _pytest.raises(RpcError) as ei:
+            rpc.call(srv.addr, "encode_shm",
+                     {"n": 2, "m": 1, "shard_size": 4, "batch": 1,
+                      "shm": "/dev/shm/cubefs-codec-x/../../etc/passwd"})
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
 def test_c_client_codec_crc32(lib, rng):
     srv = rpc.RpcServer(rpc.expose(CodecService()), service="codec").start()
     try:
