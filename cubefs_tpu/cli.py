@@ -96,9 +96,11 @@ def main(argv=None):
                       help="one topic (e.g. repair/delete) or 'all'")
 
     p_node = sub.add_parser("node")
-    p_node.add_argument("action", choices=["list", "decommission"])
+    p_node.add_argument("action", choices=["list", "decommission",
+                                           "offline-disk", "disk-sweep"])
     p_node.add_argument("--master", required=True)
-    p_node.add_argument("--addr", help="datanode address (for decommission)")
+    p_node.add_argument("--addr", help="datanode address")
+    p_node.add_argument("--disk", help="disk path (offline-disk)")
 
     p_mp = sub.add_parser("mp")
     p_mp.add_argument("action", choices=["split", "check"])
@@ -220,6 +222,13 @@ def main(argv=None):
             if not args.addr:
                 sys.exit("node decommission needs --addr")
             out = master.call("decommission_datanode", {"addr": args.addr})[0]
+        elif args.action == "offline-disk":
+            if not args.addr or not args.disk:
+                sys.exit("node offline-disk needs --addr and --disk")
+            out = master.call("offline_disk", {"addr": args.addr,
+                                               "path": args.disk})[0]
+        elif args.action == "disk-sweep":
+            out = master.call("check_broken_disks", {})[0]
         else:
             out = master.call("node_list", {})[0]
         print(json.dumps(out, indent=2))

@@ -109,7 +109,8 @@ def run_role(cfg: dict):
 
         # the node learns its own address only after the server binds
         svc = DataNode(int(cfg.get("node_id", 0)), cfg["data_dir"], "pending", pool,
-                       qos=cfg.get("qos"))  # {"read_bps":..., "write_bps":...}
+                       qos=cfg.get("qos"),  # {"read_bps":..., "write_bps":...}
+                       disks=cfg.get("disks"))  # multi-disk: list of dirs
         srv = _serve(svc, cfg)  # live routing: per-dp raft handlers
         svc.addr = srv.addr
         # the binary packet plane (hot data path) listens beside HTTP
@@ -119,10 +120,14 @@ def run_role(cfg: dict):
         master = rpc.Client(cfg["master_addr"])
         zone = cfg.get("zone", "default")
         master.call("register", {"kind": "data", "addr": srv.addr,
-                                 "zone": zone, "packet_addr": psrv.addr})
+                                 "zone": zone, "packet_addr": psrv.addr,
+                                 "disks": svc.disk_report()})
+        # heartbeats carry the disk report: the master's disk manager
+        # migrates partitions off any disk reported broken
         _heartbeat_loop(lambda: master.call(
             "heartbeat", {"kind": "data", "addr": srv.addr, "zone": zone,
-                          "packet_addr": psrv.addr}))
+                          "packet_addr": psrv.addr,
+                          "disks": svc.disk_report()}))
         return srv, svc
 
     if role == "objectnode":

@@ -30,6 +30,7 @@ from .extent_store import BlockCrcError, ExtentError, ExtentStore
 class DataPartition:
     def __init__(self, dp_id: int, path: str, peers: list[str], leader: str):
         self.dp_id = dp_id
+        self.path = path
         self.store = ExtentStore(path)
         self.peers = list(peers)  # all replica addrs incl. leader
         self.leader = leader
@@ -81,11 +82,17 @@ class DataPartition:
 
 class DataNode:
     def __init__(self, node_id: int, root_dir: str, addr: str, node_pool,
-                 qos=None):
+                 qos=None, disks: list[str] | None = None):
         from ..utils.ratelimit import DiskQos
 
         self.node_id = node_id
         self.root = root_dir
+        # multi-disk model (datanode/space_manager.go + disk.go role):
+        # each dp lives on ONE disk; a failed disk takes down its dps
+        # only, and the master's disk manager migrates exactly those
+        self.disks = [os.path.abspath(d) for d in (disks or [root_dir])]
+        self.disk_broken: set[str] = set()  # sticky per-disk health
+        self.dp_disk: dict[int, str] = {}  # dp_id -> disk path
         self.addr = addr
         self.nodes = node_pool  # addr -> rpc client (for chain forward)
         # client-facing IO shaping (datanode/limit.go): raft applies and
@@ -106,23 +113,41 @@ class DataNode:
         # still current, so writes landing mid-repair are never lost
         self.pending_repairs: dict[tuple[int, int, str], dict] = {}
         self._repair_lock = threading.Lock()
-        os.makedirs(root_dir, exist_ok=True)
-        # reopen partitions found on disk (raft rejoins via its wal once
-        # the master re-pushes the peer set through create_partition)
-        for name in os.listdir(root_dir):
-            if name.startswith("dp_") and os.path.isdir(os.path.join(root_dir, name)):
-                dp_id = int(name[3:])
-                dp = DataPartition(dp_id, os.path.join(root_dir, name), [], "")
-                self.partitions[dp_id] = dp
-                if len(dp.peers) > 1:
-                    self._start_dp_raft(dp)
+        for d in self.disks:
+            os.makedirs(d, exist_ok=True)
+        # reopen partitions found on every disk (raft rejoins via its
+        # wal once the master re-pushes the peer set)
+        for disk in self.disks:
+            for name in os.listdir(disk):
+                if name.startswith("dp_") and os.path.isdir(
+                        os.path.join(disk, name)):
+                    dp_id = int(name[3:])
+                    dp = DataPartition(dp_id, os.path.join(disk, name), [], "")
+                    self.partitions[dp_id] = dp
+                    self.dp_disk[dp_id] = disk
+                    if len(dp.peers) > 1:
+                        self._start_dp_raft(dp)
+
+    def _pick_disk(self) -> str:
+        """Healthy disk with the fewest partitions (space_manager.go
+        placement role)."""
+        healthy = [d for d in self.disks if d not in self.disk_broken]
+        if not healthy:
+            raise rpc.RpcError(503, f"all disks broken on {self.addr}")
+        counts = {d: 0 for d in healthy}
+        for disk in self.dp_disk.values():
+            if disk in counts:
+                counts[disk] += 1
+        return min(healthy, key=lambda d: (counts[d], d))
 
     def create_partition(self, dp_id: int, peers: list[str], leader: str) -> None:
         with self._lock:
             if dp_id not in self.partitions:
+                disk = self._pick_disk()
                 self.partitions[dp_id] = DataPartition(
-                    dp_id, os.path.join(self.root, f"dp_{dp_id}"), peers, leader
+                    dp_id, os.path.join(disk, f"dp_{dp_id}"), peers, leader
                 )
+                self.dp_disk[dp_id] = disk
             else:
                 dp = self.partitions[dp_id]
                 dp.peers, dp.leader = list(peers), leader
@@ -146,7 +171,7 @@ class DataNode:
         node = raftlib.RaftNode(
             f"dp{dp.dp_id}", self.addr, dp.peers, dp.apply_random_write,
             self.nodes,
-            data_dir=os.path.join(self.root, f"dp_{dp.dp_id}", "raft"),
+            data_dir=os.path.join(dp.path, "raft"),
         )
         raftlib.register_routes(self.extra_routes, node)
         dp.raft = node.start()
@@ -157,7 +182,75 @@ class DataNode:
         dp = self.partitions.get(dp_id)
         if dp is None:
             raise rpc.RpcError(404, f"dp {dp_id} not on {self.addr}")
+        disk = self.dp_disk.get(dp_id)
+        if disk in self.disk_broken:
+            raise rpc.RpcError(
+                503, f"disk {disk} on {self.addr} is broken")
         return dp
+
+    def mark_disk_broken(self, path: str) -> None:
+        """Sticky disk failure (disk.go triggerDiskError role): IO
+        errors and operator action land here; the next heartbeat's disk
+        report makes the master migrate this disk's partitions."""
+        self.disk_broken.add(os.path.abspath(path))
+
+    def _disk_io_guard(self, dp_id: int, exc: Exception):
+        """Store failure triage (disk.go triggerDiskError role): the
+        extent store surfaces every failure as ExtentError, which could
+        be a logical error OR a dying disk. Disambiguate with a direct
+        write+fsync probe on the disk — a failed probe marks the disk
+        broken (sticky) and surfaces 503 so clients fail over and the
+        heartbeat report triggers migration; a healthy probe re-raises
+        the original error unchanged."""
+        disk = self.dp_disk.get(dp_id)
+        if disk is not None and disk not in self.disk_broken:
+            probe = os.path.join(disk, ".health_probe")
+            try:
+                with open(probe, "wb") as f:
+                    f.write(b"ok")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.unlink(probe)
+            except OSError:
+                self.disk_broken.add(disk)
+        if disk in self.disk_broken:
+            raise rpc.RpcError(
+                503, f"disk {disk} failed on {self.addr}: {exc}") from None
+        raise exc
+
+    def drop_partition(self, dp_id: int) -> None:
+        """Remove a replica this node no longer owns (the master
+        repointed the replica set after a disk migration): stop its
+        raft member, close the store and delete the data — a stale
+        live replica would keep serving bytes that no longer receive
+        writes."""
+        import shutil
+
+        with self._lock:
+            dp = self.partitions.pop(dp_id, None)
+            disk = self.dp_disk.pop(dp_id, None)
+        if dp is None:
+            return
+        if dp.raft is not None:
+            dp.raft.stop()
+        try:
+            dp.store.close()
+        except Exception:
+            pass
+        if disk is not None:
+            shutil.rmtree(os.path.join(disk, f"dp_{dp_id}"),
+                          ignore_errors=True)
+
+    def disk_report(self) -> dict:
+        """Per-disk health + resident dps (heartbeat payload; the
+        master's disk manager consumes it)."""
+        with self._lock:
+            out = {}
+            for d in self.disks:
+                out[d] = {"broken": d in self.disk_broken,
+                          "dps": sorted(i for i, dd in self.dp_disk.items()
+                                        if dd == d)}
+            return out
 
     # ---------------- write path (chain replication) ----------------
     def write(self, dp_id: int, extent_id: int, offset: int, data: bytes,
@@ -172,7 +265,10 @@ class DataNode:
         and a raft overwrite in different orders."""
         dp = self._dp(dp_id)
         if not chain:
-            dp.store.write(extent_id, offset, data)
+            try:
+                dp.store.write(extent_id, offset, data)
+            except (OSError, ExtentError) as e:
+                self._disk_io_guard(dp_id, e)
             return
         if dp.leader and dp.leader != self.addr:
             if hops <= 0:
@@ -198,7 +294,10 @@ class DataNode:
                         503, f"dp {dp_id} raft reconfiguring; retry")
                 self._random_write(dp, extent_id, offset, data)
                 return
-            dp.store.write(extent_id, offset, data)
+            try:
+                dp.store.write(extent_id, offset, data)
+            except (OSError, ExtentError) as e:
+                self._disk_io_guard(dp_id, e)
             self._chain_forward(dp, extent_id, offset, data)
 
     def _chain_forward(self, dp: DataPartition, extent_id: int, offset: int,
@@ -331,7 +430,12 @@ class DataNode:
         dp = self._dp(dp_id)
         if self.qos is not None and not internal:
             self.qos.acquire_read(length)
-        return dp.store.read(extent_id, offset, length)
+        try:
+            return dp.store.read(extent_id, offset, length)
+        except BlockCrcError:
+            raise  # data integrity, not disk death: 409 path upstream
+        except (OSError, ExtentError) as e:
+            self._disk_io_guard(dp_id, e)
 
     # ---------------- repair (CRC fingerprint diff) ----------------
     def extent_fingerprint(self, dp_id: int, extent_id: int) -> tuple[int, int]:
@@ -411,6 +515,17 @@ class DataNode:
     def rpc_extent_fingerprint(self, args, body):
         size, crc = self.extent_fingerprint(args["dp_id"], args["extent_id"])
         return {"size": size, "crc": crc}
+
+    def rpc_disk_report(self, args, body):
+        return {"disks": self.disk_report()}
+
+    def rpc_drop_partition(self, args, body):
+        self.drop_partition(args["dp_id"])
+        return {}
+
+    def rpc_mark_disk_broken(self, args, body):
+        self.mark_disk_broken(args["path"])
+        return {}
 
     def rpc_list_extents(self, args, body):
         store = self._dp(args["dp_id"]).store

@@ -437,13 +437,16 @@ class Master(ReplicatedFsm):
 
     # ---------------- registries ----------------
     def register_datanode(self, addr: str, zone: str = "default",
-                          packet_addr: str | None = None) -> None:
+                          packet_addr: str | None = None,
+                          disks: dict | None = None) -> None:
         with self._lock:
             info = self.datanodes.setdefault(addr, {"addr": addr})
             info["hb"] = time.time()
             info["zone"] = zone
             if packet_addr:
                 info["packet_addr"] = packet_addr
+            if disks is not None:
+                info["disks"] = disks
 
     def register_metanode(self, addr: str, zone: str = "default",
                           packet_addr: str | None = None,
@@ -459,7 +462,8 @@ class Master(ReplicatedFsm):
 
     def heartbeat(self, addr: str, kind: str, zone: str | None = None,
                   packet_addr: str | None = None,
-                  read_addr: str | None = None) -> None:
+                  read_addr: str | None = None,
+                  disks: dict | None = None) -> None:
         with self._lock:
             reg = self.datanodes if kind == "data" else self.metanodes
             # unknown addr re-registers: a restarted master recovers its
@@ -474,6 +478,11 @@ class Master(ReplicatedFsm):
                 info["packet_addr"] = packet_addr
             if read_addr:
                 info["read_addr"] = read_addr
+            if disks is not None:
+                # the disk report feeds the disk manager: a disk flagged
+                # broken here gets its partitions migrated by the next
+                # check_replicas sweep (master/disk_manager.go role)
+                info["disks"] = disks
 
     def _live(self, reg: dict) -> list[str]:
         now = time.time()
@@ -788,11 +797,69 @@ class Master(ReplicatedFsm):
                             continue
                         plans.append((vname, dict(dp), dead_addr, cands[0],
                                       healthy[0]))
+        return self._execute_rebuilds(plans)
+
+    # ---------------- disk manager (master/disk_manager.go role) --------
+    def offline_disk(self, addr: str, path: str) -> list:
+        """Migrate every dp whose replica on `addr` lives on `path` to
+        other nodes — the node itself stays in service for its healthy
+        disks. Driven by the operator (disk offline) or the sweep when
+        a heartbeat disk report flags the disk broken."""
+        with self._lock:
+            info = self.datanodes.get(addr)
+            if info is None:
+                raise MasterError(f"unknown datanode {addr}")
+            report = (info.get("disks") or {}).get(path)
+            if report is None:
+                raise MasterError(f"{addr} reports no disk {path}")
+            dp_ids = set(report.get("dps") or [])
+        # mark the disk on the NODE first: placement must stop preferring
+        # the freshly emptied disk, and the next heartbeat's report keeps
+        # the broken flag authoritative across master restarts
+        try:
+            self.nodes.get(addr).call("mark_disk_broken", {"path": path})
+        except rpc.RpcError:
+            pass  # node unreachable: migration below still proceeds
+        return self._migrate_dps_off(addr, dp_ids)
+
+    def _migrate_dps_off(self, addr: str, dp_ids: set) -> list:
+        """Rebuild the `addr` replica of each dp in dp_ids onto another
+        live node (the per-dp half of decommission; same resync path).
+        The node is ALIVE here, so the superseded replica is dropped
+        from it — a stale live replica would keep serving bytes that no
+        longer receive writes."""
+        with self._lock:
+            live = set(self._live(self.datanodes))
+            plans = []
+            for vname, vol in self.volumes.items():
+                for dp in vol["dps"]:
+                    if dp["dp_id"] not in dp_ids or addr not in dp["replicas"]:
+                        continue
+                    healthy = [a for a in dp["replicas"]
+                               if a != addr and a in live]
+                    cands = [a for a in live
+                             if a not in dp["replicas"]] or (
+                                 [a for a in live if a != addr]
+                                 if self.allow_single_node else [])
+                    if not healthy or not cands:
+                        continue
+                    plans.append((vname, dict(dp), addr, cands[0],
+                                  healthy[0]))
+        actions = self._execute_rebuilds(plans)
+        for dp_id, dead, _new in actions:
+            try:
+                self.nodes.get(dead).call("drop_partition", {"dp_id": dp_id})
+            except rpc.RpcError:
+                pass  # node went away mid-migration: nothing to drop
+        return actions
+
+    def _execute_rebuilds(self, plans: list) -> list:
+        """Shared rebuild driver (check_replicas + the disk manager):
+        re-checks each plan against the LIVE dp entry — an earlier
+        rebuild in the same sweep may have repointed it, and working
+        from the planning snapshot would commit a stale replica list."""
         actions = []
         for vname, dp_snapshot, dead_addr, new_addr, src in plans:
-            # re-read the LIVE dp entry: an earlier rebuild in this same
-            # sweep may have repointed it, and working from the planning
-            # snapshot would commit a stale replica list over it
             with self._lock:
                 dp = next((d for d in self.volumes[vname]["dps"]
                            if d["dp_id"] == dp_snapshot["dp_id"]), None)
@@ -804,6 +871,19 @@ class Master(ReplicatedFsm):
                 actions.append((dp["dp_id"], dead_addr, new_addr))
             except rpc.RpcError:
                 continue  # retried on the next sweep
+        return actions
+
+    def check_broken_disks(self) -> list:
+        """Sweep half of the disk manager: every disk a heartbeat
+        report marked broken gets its partitions migrated."""
+        with self._lock:
+            broken = [(addr, path, set(rep.get("dps") or []))
+                      for addr, info in self.datanodes.items()
+                      for path, rep in (info.get("disks") or {}).items()
+                      if rep.get("broken")]
+        actions = []
+        for addr, path, dp_ids in broken:
+            actions += self._migrate_dps_off(addr, dp_ids)
         return actions
 
     def _rebuild_replica(self, vname: str, dp: dict, dead: str, new: str,
@@ -839,7 +919,8 @@ class Master(ReplicatedFsm):
         zone = args.get("zone", "default")
         if args["kind"] == "data":
             self.register_datanode(args["addr"], zone,
-                                   packet_addr=args.get("packet_addr"))
+                                   packet_addr=args.get("packet_addr"),
+                                   disks=args.get("disks"))
         else:
             self.register_metanode(args["addr"], zone,
                                    packet_addr=args.get("packet_addr"),
@@ -849,8 +930,21 @@ class Master(ReplicatedFsm):
     def rpc_heartbeat(self, args, body):
         self.heartbeat(args["addr"], args["kind"], args.get("zone"),
                        packet_addr=args.get("packet_addr"),
-                       read_addr=args.get("read_addr"))
+                       read_addr=args.get("read_addr"),
+                       disks=args.get("disks"))
         return {}
+
+    def rpc_offline_disk(self, args, body):
+        self._leader_gate()
+        try:
+            actions = self.offline_disk(args["addr"], args["path"])
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+        return {"actions": actions}
+
+    def rpc_check_broken_disks(self, args, body):
+        self._leader_gate()
+        return {"actions": self.check_broken_disks()}
 
     def rpc_node_list(self, args, body):
         return self.node_list()
@@ -905,6 +999,13 @@ class Master(ReplicatedFsm):
                     dps[str(dp["dp_id"])] = {
                         "dp_id": dp["dp_id"], "replicas": dp["replicas"]}
             return {"dps": dps}
+
+    def rpc_check_replica_health(self, args, body):
+        """One sweep of both failure domains: dead NODES (replica
+        rebuild) and broken DISKS (partition migration)."""
+        self._leader_gate()
+        return {"actions": self.check_replicas()
+                + self.check_broken_disks()}
 
     def rpc_check_replicas(self, args, body):
         # a deposed leader must not run datanode-mutating rebuilds

@@ -285,3 +285,97 @@ def test_e2e_cluster_serves_reads_natively(tmp_path, rng):
     for n, psrv in metas:
         psrv.stop()
         n.stop()
+
+
+def test_mirror_fuzz_consistency(node, cli, rng):
+    """Randomized op sequence (creates, renames, unlinks, xattr, attr,
+    truncate, restore) with the native mirror compared against the
+    Python trees after every burst — the mirror must never drift."""
+    import random
+
+    mp = node.partitions[1]
+    r = random.Random(0xF0F0)
+    dirs = [1]
+    files: list[tuple[int, str]] = []  # (parent, name)
+
+    def compare():
+        for d in dirs:
+            got, _ = cli.call(pkt.OP_META_READDIR,
+                              args={"pid": 1, "parent": d})
+            assert got["entries"] == mp.readdir(d), f"dir {d} drifted"
+            for name, ino in mp.readdir(d).items():
+                gi, _ = cli.call(pkt.OP_META_INODE_GET,
+                                 args={"pid": 1, "ino": ino})
+                assert gi["inode"] == mp.inode_get(ino), f"ino {ino} drifted"
+
+    for burst in range(6):
+        for _ in range(25):
+            op = r.random()
+            if op < 0.35 or not files:  # create file or dir
+                parent = r.choice(dirs)
+                name = f"n{r.randrange(10_000)}"
+                typ = DIR if r.random() < 0.3 else FILE
+                try:
+                    res = _submit(node, 1, {"op": "mknod", "parent": parent,
+                                            "name": name, "type": typ,
+                                            "mode": 0o755})
+                except Exception:
+                    continue
+                if typ == DIR:
+                    dirs.append(res["ino"])
+                else:
+                    files.append((parent, name))
+            elif op < 0.5:  # rename within/between dirs
+                parent, name = r.choice(files)
+                dst_parent = r.choice(dirs)
+                dst = f"r{r.randrange(10_000)}"
+                try:
+                    ino = mp.lookup(parent, name)
+                    _submit(node, 1, {"op": "rename_local",
+                                      "src_parent": parent,
+                                      "src_name": name,
+                                      "dst_parent": dst_parent,
+                                      "dst_name": dst, "ino": ino})
+                    files.remove((parent, name))
+                    files.append((dst_parent, dst))
+                except Exception:
+                    pass
+            elif op < 0.65:  # unlink
+                parent, name = r.choice(files)
+                try:
+                    _submit(node, 1, {"op": "unlink2", "parent": parent,
+                                      "name": name})
+                    files.remove((parent, name))
+                except Exception:
+                    pass
+            elif op < 0.8:  # xattr / attr
+                parent, name = r.choice(files)
+                try:
+                    ino = mp.lookup(parent, name)
+                    _submit(node, 1, {"op": "set_xattr", "ino": ino,
+                                      "key": f"user.k{r.randrange(4)}",
+                                      "value": f"v{r.randrange(100)}"})
+                    _submit(node, 1, {"op": "set_attr", "ino": ino,
+                                      "mode": r.randrange(0o777)})
+                except Exception:
+                    pass
+            else:  # extents + truncate
+                parent, name = r.choice(files)
+                try:
+                    ino = mp.lookup(parent, name)
+                    _submit(node, 1, {
+                        "op": "append_extents", "ino": ino,
+                        "size": r.randrange(1, 100_000),
+                        "extents": [{"dp_id": 1, "extent_id": 1,
+                                     "file_offset": 0, "offset": 0,
+                                     "size": 100}]})
+                    if r.random() < 0.5:
+                        _submit(node, 1, {"op": "truncate", "ino": ino,
+                                          "size": r.randrange(50_000)})
+                except Exception:
+                    pass
+        compare()
+    # snapshot/restore keeps the mirror honest too
+    state = mp.state_bytes()
+    mp.restore_state(state)
+    compare()
