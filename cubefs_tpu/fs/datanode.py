@@ -168,8 +168,20 @@ class DataNode:
     def _start_dp_raft(self, dp: DataPartition) -> None:
         from ..parallel import raft as raftlib
 
+        def apply_guarded(entry, _dp=dp):
+            # a store failure inside the raft apply (incl. on replicas,
+            # where apply exceptions are swallowed) must still run the
+            # disk triage, or a follower's dying disk is never detected
+            try:
+                return _dp.apply_random_write(entry)
+            except (OSError, ExtentError):
+                disk = self.dp_disk.get(_dp.dp_id)
+                if disk is not None:
+                    self._probe_disk(disk)
+                raise
+
         node = raftlib.RaftNode(
-            f"dp{dp.dp_id}", self.addr, dp.peers, dp.apply_random_write,
+            f"dp{dp.dp_id}", self.addr, dp.peers, apply_guarded,
             self.nodes,
             data_dir=os.path.join(dp.path, "raft"),
         )
@@ -194,6 +206,33 @@ class DataNode:
         report makes the master migrate this disk's partitions."""
         self.disk_broken.add(os.path.abspath(path))
 
+    def _probe_disk(self, disk: str) -> None:
+        """Write+fsync health probe; a failure marks the disk broken
+        (sticky). Per-call unique probe name: concurrent probes must
+        not race each other's unlink into a false positive. ENOSPC and
+        EDQUOT are NOT death — a full disk is healthy, just full, and
+        evacuating it would move data for nothing."""
+        import errno as errno_mod
+        import uuid
+
+        if disk in self.disk_broken:
+            return
+        probe = os.path.join(disk, f".health_probe.{uuid.uuid4().hex[:8]}")
+        try:
+            with open(probe, "wb") as f:
+                f.write(b"ok")
+                f.flush()
+                os.fsync(f.fileno())
+            os.unlink(probe)
+        except OSError as pe:
+            if pe.errno in (errno_mod.ENOSPC, errno_mod.EDQUOT):
+                try:
+                    os.unlink(probe)
+                except OSError:
+                    pass
+                return
+            self.disk_broken.add(disk)
+
     def _disk_io_guard(self, dp_id: int, exc: Exception):
         """Store failure triage (disk.go triggerDiskError role): the
         extent store surfaces every failure as ExtentError, which could
@@ -203,16 +242,8 @@ class DataNode:
         heartbeat report triggers migration; a healthy probe re-raises
         the original error unchanged."""
         disk = self.dp_disk.get(dp_id)
-        if disk is not None and disk not in self.disk_broken:
-            probe = os.path.join(disk, ".health_probe")
-            try:
-                with open(probe, "wb") as f:
-                    f.write(b"ok")
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.unlink(probe)
-            except OSError:
-                self.disk_broken.add(disk)
+        if disk is not None:
+            self._probe_disk(disk)
         if disk in self.disk_broken:
             raise rpc.RpcError(
                 503, f"disk {disk} failed on {self.addr}: {exc}") from None

@@ -797,7 +797,10 @@ class Master(ReplicatedFsm):
                             continue
                         plans.append((vname, dict(dp), dead_addr, cands[0],
                                       healthy[0]))
-        return self._execute_rebuilds(plans)
+        # one sweep covers BOTH failure domains: dead nodes above,
+        # broken disks below — existing periodic check_replicas callers
+        # must pick up the disk manager without new wiring
+        return self._execute_rebuilds(plans) + self.check_broken_disks()
 
     # ---------------- disk manager (master/disk_manager.go role) --------
     def offline_disk(self, addr: str, path: str) -> list:
@@ -809,10 +812,18 @@ class Master(ReplicatedFsm):
             info = self.datanodes.get(addr)
             if info is None:
                 raise MasterError(f"unknown datanode {addr}")
-            report = (info.get("disks") or {}).get(path)
-            if report is None:
-                raise MasterError(f"{addr} reports no disk {path}")
-            dp_ids = set(report.get("dps") or [])
+            cached = (info.get("disks") or {}).get(path)
+        # prefer a LIVE disk report: partitions placed since the last
+        # heartbeat must not be silently left behind on the dying disk
+        report = cached
+        try:
+            live = self.nodes.get(addr).call("disk_report", {})[0]["disks"]
+            report = live.get(path, cached)
+        except rpc.RpcError:
+            pass  # unreachable node: the cached report is the best view
+        if report is None:
+            raise MasterError(f"{addr} reports no disk {path}")
+        dp_ids = set(report.get("dps") or [])
         # mark the disk on the NODE first: placement must stop preferring
         # the freshly emptied disk, and the next heartbeat's report keeps
         # the broken flag authoritative across master restarts
@@ -1001,11 +1012,10 @@ class Master(ReplicatedFsm):
             return {"dps": dps}
 
     def rpc_check_replica_health(self, args, body):
-        """One sweep of both failure domains: dead NODES (replica
-        rebuild) and broken DISKS (partition migration)."""
+        """Alias of check_replicas (which sweeps both failure domains:
+        dead nodes AND broken disks)."""
         self._leader_gate()
-        return {"actions": self.check_replicas()
-                + self.check_broken_disks()}
+        return {"actions": self.check_replicas()}
 
     def rpc_check_replicas(self, args, body):
         # a deposed leader must not run datanode-mutating rebuilds

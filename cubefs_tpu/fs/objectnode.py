@@ -27,6 +27,21 @@ from . import s3version
 from .client import FileSystem, FsError
 
 
+def _http_date(unix: float) -> str:
+    import email.utils
+
+    return email.utils.formatdate(unix, usegmt=True)
+
+
+def _parse_http_date(s: str) -> float | None:
+    import email.utils
+
+    try:
+        return email.utils.parsedate_to_datetime(s).timestamp()
+    except (TypeError, ValueError):
+        return None
+
+
 class ObjectNode:
     def __init__(self, volumes: dict[str, FileSystem], host="127.0.0.1", port=0,
                  authenticator=None, audit_sinks=None):
@@ -465,10 +480,11 @@ class ObjectNode:
                         "x-amz-metadata-directive", "COPY") != "REPLACE":
                     rec = outer._obj_meta(sfs, sk)
                     outer._obj_meta_save(fs, key, rec.get("ct"),
-                                         rec.get("meta") or {})
+                                         rec.get("meta") or {}, etag=etag)
                 else:
                     ct_in, meta_in = outer._req_obj_meta(self.headers)
-                    outer._obj_meta_save(fs, key, ct_in, meta_in)
+                    outer._obj_meta_save(fs, key, ct_in, meta_in,
+                                         etag=etag)
                 # PUT-time object-lock headers apply to the version just
                 # written (AWS: x-amz-object-lock-{mode,retain-until-date,
                 # legal-hold} on PutObject); validated above
@@ -725,7 +741,7 @@ class ObjectNode:
                         return self._error(404, "NoSuchKey", key)
                     tags = json.loads(raw) if raw else {}
                     return self._reply(200, s3policy.tagging_to_xml(tags))
-                if not key:  # ListObjectsV2 (+ delimiter and pagination)
+                if not key:  # ListObjects V1/V2 (+ delimiter, pagination)
                     if not self._check("s3:ListBucket", bucket):
                         return
                     prefix = query.get("prefix", [""])[0]
@@ -738,7 +754,11 @@ class ObjectNode:
                     if max_keys < 1:
                         return self._error(400, "InvalidArgument",
                                            "max-keys must be positive")
-                    token = query.get("continuation-token", [""])[0]
+                    v2 = query.get("list-type", [""])[0] == "2"
+                    # V1's `marker` is "start after this key" — the same
+                    # contract as our V2 continuation token
+                    token = (query.get("continuation-token", [""])[0]
+                             if v2 else query.get("marker", [""])[0])
                     keys, prefixes, next_token, truncated = outer._list_v2(
                         fs, prefix, delimiter, max_keys, token
                     )
@@ -752,16 +772,26 @@ class ObjectNode:
                         f"</CommonPrefixes>"
                         for p in prefixes
                     )
-                    nt = (f"<NextContinuationToken>{xs.escape(next_token)}"
-                          f"</NextContinuationToken>") if next_token else ""
+                    if v2:
+                        extra = (f"<KeyCount>{len(keys) + len(prefixes)}"
+                                 f"</KeyCount>")
+                        if next_token:
+                            extra += (f"<NextContinuationToken>"
+                                      f"{xs.escape(next_token)}"
+                                      f"</NextContinuationToken>")
+                    else:  # V1: Marker/NextMarker shapes
+                        extra = (f"<Marker>{xs.escape(token)}</Marker>")
+                        if truncated:
+                            extra += (f"<NextMarker>"
+                                      f"{xs.escape(next_token)}"
+                                      f"</NextMarker>")
                     body = (
                         f"<?xml version='1.0'?><ListBucketResult>"
                         f"<Name>{bucket}</Name><Prefix>{xs.escape(prefix)}</Prefix>"
                         f"<Delimiter>{xs.escape(delimiter)}</Delimiter>"
                         f"<MaxKeys>{max_keys}</MaxKeys>"
                         f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
-                        f"<KeyCount>{len(keys) + len(prefixes)}</KeyCount>"
-                        f"{items}{cps}{nt}"
+                        f"{extra}{items}{cps}"
                         f"</ListBucketResult>"
                     ).encode()
                     return self._reply(200, body)
@@ -778,6 +808,13 @@ class ObjectNode:
                         200, data, ctype="application/octet-stream",
                         headers={"x-amz-version-id": vmeta["vid"],
                                  **self._cors(bucket)})
+                mrec, mst = outer._obj_meta_state(fs, key)
+                cond = outer._conditional(self.headers, mrec, mst)
+                if cond == 304:
+                    _, mh = outer._meta_reply_headers(mrec, mst)
+                    return self._reply(304, headers=mh)
+                if cond == 412:
+                    return self._error(412, "PreconditionFailed", key)
                 rng_hdr = self.headers.get("Range", "")
                 span = None
                 if rng_hdr.startswith("bytes=") and "," not in rng_hdr:
@@ -808,7 +845,7 @@ class ObjectNode:
                                 headers={"Content-Range": f"bytes */{size}"})
                         data = fs.read_file("/" + key, offset=lo,
                                             length=hi - lo + 1)
-                        mct, mhdrs = outer._obj_meta_headers(fs, key)
+                        mct, mhdrs = outer._meta_reply_headers(mrec, mst)
                         return self._reply(
                             206, data, ctype=mct,
                             headers={"Content-Range":
@@ -829,7 +866,7 @@ class ObjectNode:
                             b"<Code>NoSuchKey</Code></Error>",
                             headers={"x-amz-delete-marker": "true"})
                     return self._error(404, "NoSuchKey", key)
-                mct, mhdrs = outer._obj_meta_headers(fs, key)
+                mct, mhdrs = outer._meta_reply_headers(mrec, mst)
                 self._reply(200, data, ctype=mct,
                             headers={**mhdrs, **self._cors(bucket)})
 
@@ -976,6 +1013,12 @@ class ObjectNode:
                 if raw in (b"200", b"201", b"204"):
                     status = int(raw)
                 etag = hashlib.md5(fields["file"]).hexdigest()
+                # the ETag persists like every other write path, so
+                # GET/HEAD/conditionals work on POST-uploaded objects
+                ct_field = fields.get("Content-Type")
+                outer._obj_meta_save(
+                    fs, key,
+                    ct_field.decode() if ct_field else None, {}, etag=etag)
                 body = b""
                 if status == 201:
                     body = (
@@ -1029,11 +1072,16 @@ class ObjectNode:
                                 b"<Code>NoSuchKey</Code></Error>",
                                 headers={"x-amz-delete-marker": "true"})
                         return self._error(404, "NoSuchKey", key)
+                mrec, mst = outer._obj_meta_state(fs, key)
+                cond = outer._conditional(self.headers, mrec, mst)
+                if cond == 412:
+                    return self._error(412, "PreconditionFailed", key)
                 # HEAD: standard Content-Length describes what GET would
                 # return; no body follows (RFC 9110)
-                self._audit(200, 0)
-                self.send_response(200)
-                mct, mhdrs = outer._obj_meta_headers(fs, key)
+                code = 304 if cond == 304 else 200
+                self._audit(code, 0)
+                self.send_response(code)
+                mct, mhdrs = outer._meta_reply_headers(mrec, mst)
                 self.send_header("Content-Type", mct)
                 self.send_header("Content-Length", str(st["size"]))
                 for hk, hv in mhdrs.items():
@@ -1183,9 +1231,9 @@ class ObjectNode:
         if meta_raw:  # metadata captured at initiate
             rec = json.loads(meta_raw)
             self._obj_meta_save(fs, key, rec.get("ct"),
-                                rec.get("meta") or {})
+                                rec.get("meta") or {}, etag=etag)
         else:
-            self._obj_meta_save(fs, key, None, {})
+            self._obj_meta_save(fs, key, None, {}, etag=etag)
         self._abort_multipart(fs, upload_id)  # clear staging
         return etag
 
@@ -1332,14 +1380,16 @@ class ObjectNode:
 
     # ---- object metadata (fs_volume.go xattr-backed metadata role) ----
     def _obj_meta_save(self, fs: FileSystem, key: str,
-                       ctype: str | None, meta: dict) -> None:
-        """Persist Content-Type + x-amz-meta-* beside the object (an
-        xattr, like the reference stores OSS metadata in inode xattrs).
-        An overwrite PUT always rewrites the record — stale metadata
-        from a previous version of the key must not survive."""
-        if ctype or meta:
+                       ctype: str | None, meta: dict,
+                       etag: str | None = None) -> None:
+        """Persist Content-Type + x-amz-meta-* + ETag beside the object
+        (an xattr, like the reference stores OSS metadata in inode
+        xattrs). An overwrite PUT always rewrites the record — stale
+        metadata from a previous version of the key must not survive."""
+        if ctype or meta or etag:
             fs.setxattr("/" + key, s3policy.XA_META,
-                        json.dumps({"ct": ctype or "", "meta": meta}))
+                        json.dumps({"ct": ctype or "", "meta": meta,
+                                    "etag": etag or ""}))
         else:
             try:
                 fs.setxattr("/" + key, s3policy.XA_META, None)
@@ -1360,12 +1410,69 @@ class ObjectNode:
                 if k.lower().startswith("x-amz-meta-")}
         return headers.get("Content-Type"), meta
 
-    def _obj_meta_headers(self, fs: FileSystem, key: str) -> tuple[str, dict]:
-        """(content-type, extra reply headers) for GET/HEAD."""
+    def _obj_meta_state(self, fs: FileSystem, key: str) -> tuple[dict, dict | None]:
+        """ONE fetch of (metadata record, stat) shared by conditional
+        evaluation and reply-header construction — GET/HEAD must not
+        pay the metanode round-trips twice."""
         rec = self._obj_meta(fs, key)
+        try:
+            st = fs.stat("/" + key)
+        except FsError:
+            st = None
+        return rec, st
+
+    def _meta_reply_headers(self, rec: dict,
+                            st: dict | None) -> tuple[str, dict]:
+        """(content-type, extra reply headers) for GET/HEAD — user
+        metadata, ETag and Last-Modified (clients and SDKs condition on
+        both; see _conditional)."""
         ctype = rec.get("ct") or "application/octet-stream"
-        return ctype, {f"x-amz-meta-{k}": v
-                       for k, v in (rec.get("meta") or {}).items()}
+        hdrs = {f"x-amz-meta-{k}": v
+                for k, v in (rec.get("meta") or {}).items()}
+        if rec.get("etag"):
+            hdrs["ETag"] = f'"{rec["etag"]}"'
+        if st is not None:
+            hdrs["Last-Modified"] = _http_date(st["mtime"])
+        return ctype, hdrs
+
+    def _obj_meta_headers(self, fs: FileSystem, key: str) -> tuple[str, dict]:
+        return self._meta_reply_headers(*self._obj_meta_state(fs, key))
+
+    def _conditional(self, req_headers, rec: dict,
+                     st: dict | None) -> int | None:
+        """RFC 7232 / S3 conditional requests for GET/HEAD: returns 304,
+        412 or None (proceed). Precedence per the RFC: If-Match and
+        If-Unmodified-Since fail first (412); If-None-Match overrides
+        If-Modified-Since (304)."""
+        if st is None:
+            return None  # the caller's 404 path owns missing keys
+        etag = rec.get("etag") or ""
+        # HTTP dates carry whole seconds; comparing the raw fractional
+        # mtime against them breaks revalidation with our OWN
+        # Last-Modified (always "modified since" by the fraction)
+        mtime = int(st["mtime"])
+
+        def match(header_val: str) -> bool:
+            vals = [v.strip().strip('"') for v in header_val.split(",")]
+            return "*" in vals or (bool(etag) and etag in vals)
+
+        im = req_headers.get("If-Match")
+        if im is not None and not match(im):
+            return 412
+        ius = req_headers.get("If-Unmodified-Since")
+        if ius is not None:
+            t = _parse_http_date(ius)
+            if t is not None and mtime > t:
+                return 412
+        inm = req_headers.get("If-None-Match")
+        if inm is not None:
+            return 304 if match(inm) else None
+        ims = req_headers.get("If-Modified-Since")
+        if ims is not None:
+            t = _parse_http_date(ims)
+            if t is not None and mtime <= t:
+                return 304
+        return None
 
     # ---- key <-> path adaptation ----
     def _put_object(self, fs: FileSystem, key: str, data: bytes) -> None:

@@ -31,39 +31,12 @@ namespace {
 constexpr uint64_t kBlockSize = 128 * 1024;  // util.BlockSize parity
 constexpr uint64_t kMaxExtent = 128ull << 20;
 
-uint32_t crc32_ieee(uint32_t crc, const uint8_t* p, size_t n);
-
-struct CrcTables2 {
-  uint32_t t[8][256];
-  CrcTables2() {
-    for (uint32_t i = 0; i < 256; i++) {
-      uint32_t c = i;
-      for (int k = 0; k < 8; k++) c = (c >> 1) ^ ((c & 1) ? 0xEDB88320u : 0);
-      t[0][i] = c;
-    }
-    for (uint32_t i = 0; i < 256; i++)
-      for (int j = 1; j < 8; j++)
-        t[j][i] = (t[j - 1][i] >> 8) ^ t[0][t[j - 1][i] & 0xFF];
-  }
-};
-const CrcTables2 kCrc2;
+// CRC32 delegates to the shared native kernel (crc32cpu.cc): CLMUL
+// folding at ~13 GB/s with a table fallback, bit-identical with zlib.
+extern "C" uint32_t rt_crc32(uint32_t crc, const uint8_t* p, size_t n);
 
 uint32_t crc32_ieee(uint32_t crc, const uint8_t* p, size_t n) {
-  crc = ~crc;
-  while (n >= 8) {
-    crc ^= (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
-           ((uint32_t)p[3] << 24);
-    uint32_t hi = (uint32_t)p[4] | ((uint32_t)p[5] << 8) |
-                  ((uint32_t)p[6] << 16) | ((uint32_t)p[7] << 24);
-    crc = kCrc2.t[7][crc & 0xFF] ^ kCrc2.t[6][(crc >> 8) & 0xFF] ^
-          kCrc2.t[5][(crc >> 16) & 0xFF] ^ kCrc2.t[4][crc >> 24] ^
-          kCrc2.t[3][hi & 0xFF] ^ kCrc2.t[2][(hi >> 8) & 0xFF] ^
-          kCrc2.t[1][(hi >> 16) & 0xFF] ^ kCrc2.t[0][hi >> 24];
-    p += 8;
-    n -= 8;
-  }
-  while (n--) crc = (crc >> 8) ^ kCrc2.t[0][(crc ^ *p++) & 0xFF];
-  return ~crc;
+  return rt_crc32(crc, p, n);
 }
 
 struct Extent {

@@ -121,6 +121,63 @@ def test_range_reads(cli):
     assert code == 416  # unsatisfiable
 
 
+def test_etag_last_modified_and_conditionals(cli):
+    body = b"conditional payload"
+    code, _, ph = cli.put_object(B, "cond/obj", body)
+    etag = {k.lower(): v for k, v in ph.items()}["etag"]
+    code, got, h = cli.get_object(B, "cond/obj")
+    hl = {k.lower(): v for k, v in h.items()}
+    assert hl["etag"] == etag
+    assert "last-modified" in hl
+    code, _, hh = cli.head_object(B, "cond/obj")
+    hhl = {k.lower(): v for k, v in hh.items()}
+    assert hhl["etag"] == etag and "last-modified" in hhl
+    # If-None-Match with the current ETag -> 304, no body
+    code, got, _ = cli.get_object(B, "cond/obj",
+                                  headers={"If-None-Match": etag})
+    assert code == 304 and got == b""
+    code, got, _ = cli.get_object(B, "cond/obj",
+                                  headers={"If-None-Match": '"bogus"'})
+    assert code == 200 and got == body
+    # If-Match mismatched -> 412
+    code, got, _ = cli.get_object(B, "cond/obj",
+                                  headers={"If-Match": '"bogus"'})
+    assert code == 412 and b"PreconditionFailed" in got
+    code, got, _ = cli.get_object(B, "cond/obj",
+                                  headers={"If-Match": etag})
+    assert code == 200 and got == body
+    # If-Modified-Since in the future -> 304
+    code, _, _ = cli.get_object(
+        B, "cond/obj",
+        headers={"If-Modified-Since":
+                 "Fri, 01 Jan 2100 00:00:00 GMT"})
+    assert code == 304
+    # If-Unmodified-Since in the past -> 412
+    code, _, _ = cli.get_object(
+        B, "cond/obj",
+        headers={"If-Unmodified-Since":
+                 "Mon, 01 Jan 2001 00:00:00 GMT"})
+    assert code == 412
+
+
+def test_list_objects_v1(cli):
+    for k in ("v1/a", "v1/b", "v1/c"):
+        assert cli.put_object(B, k, b"x")[0] == 200
+    # no list-type=2: the V1 shape (Marker/NextMarker, no KeyCount)
+    code, body, _ = cli.request("GET", f"/{B}",
+                                query={"prefix": "v1/", "max-keys": "2"})
+    assert code == 200
+    assert b"<KeyCount>" not in body and b"ContinuationToken" not in body
+    assert b"<IsTruncated>true</IsTruncated>" in body
+    m = re.search(rb"<NextMarker>([^<]+)</NextMarker>", body)
+    assert m, "truncated V1 listing must carry NextMarker"
+    code, body2, _ = cli.request(
+        "GET", f"/{B}",
+        query={"prefix": "v1/", "marker": m.group(1).decode()})
+    assert code == 200 and b"<Key>v1/c</Key>" in body2
+    assert b"<Key>v1/a</Key>" not in body2
+
+
 # ---------------- listings ----------------
 
 def test_list_objects_v2_prefix_delimiter_pagination(cli):

@@ -1,0 +1,66 @@
+"""Shared native CRC32 kernel (runtime/src/crc32cpu.cc): CLMUL folding
+with table fallback, bit-identical with zlib across lengths, seeds and
+alignments — the CPU half of the reference's fastcrc32 role."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def lib():
+    try:
+        from cubefs_tpu.runtime import build
+
+        return build.load()
+    except Exception as e:
+        pytest.skip(f"native runtime unavailable: {e}")
+
+
+def test_bit_identical_vs_zlib(lib, rng):
+    # boundary-heavy lengths: below/at/above the 64B clmul gate, odd
+    # tails, block sizes the stores actually use
+    lengths = (list(range(0, 130)) +
+               [255, 256, 1023, 4096, 65535, 65536, 65537,
+                128 * 1024, 128 * 1024 + 3, (1 << 20) + 13])
+    for ln in lengths:
+        buf = rng.integers(0, 256, ln + 8, dtype=np.uint8)
+        for off in (0, 3):
+            data = buf[off:off + ln].tobytes()
+            assert lib.rt_crc32(0, data, ln) == zlib.crc32(data), ln
+
+
+def test_seeded_and_incremental(lib, rng):
+    a = rng.integers(0, 256, 70_000, dtype=np.uint8).tobytes()
+    b = rng.integers(0, 256, 33_333, dtype=np.uint8).tobytes()
+    assert lib.rt_crc32(0, a, len(a)) == zlib.crc32(a)
+    # incremental: crc(a+b) == crc(b, seed=crc(a)) through the kernel
+    seed = lib.rt_crc32(0, a, len(a))
+    assert lib.rt_crc32(seed, b, len(b)) == zlib.crc32(a + b)
+
+
+def test_store_crc_rides_the_kernel(lib, rng):
+    """cs_crc32 (the chunk store's exported CRC) must agree with the
+    shared kernel AND zlib — the stores delegate now."""
+    data = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    assert lib.cs_crc32(data, len(data)) == zlib.crc32(data)
+    assert lib.cs_crc32(data, len(data)) == lib.rt_crc32(0, data, len(data))
+
+
+def test_matches_pinned_golden(lib):
+    """The same independent fixture that gates the device CRC kernel
+    gates the native one (tests/fixtures/generate.py)."""
+    import os
+
+    fix = os.path.join(os.path.dirname(__file__), "fixtures", "crc32.bin")
+    raw = open(fix, "rb").read()
+    # fixture: payload then one u32le crc per 4KiB block (see generate.py)
+    import struct
+
+    nblk = len(raw) // (4096 + 4)
+    payload, crcs = raw[: nblk * 4096], raw[nblk * 4096:]
+    for i in range(nblk):
+        want = struct.unpack_from("<I", crcs, i * 4)[0]
+        blk = payload[i * 4096:(i + 1) * 4096]
+        assert lib.rt_crc32(0, blk, len(blk)) == want

@@ -60,7 +60,7 @@ def test_s3_put_get_list_delete(fscluster, rng):
         _req("PUT", f"{base}/mybucket/notes.txt", b"hi")
         code, got, _ = _req("GET", f"{base}/mybucket/photos/2026/cat.jpg")
         assert code == 200 and got == body
-        code, listing, _ = _req("GET", f"{base}/mybucket?prefix=photos/")
+        code, listing, _ = _req("GET", f"{base}/mybucket?list-type=2&prefix=photos/")
         assert code == 200
         assert b"photos/2026/cat.jpg" in listing and b"notes.txt" not in listing
         code, listing, _ = _req("GET", f"{base}/mybucket")
@@ -70,7 +70,7 @@ def test_s3_put_get_list_delete(fscluster, rng):
         code, body2, _ = _req("GET", f"{base}/mybucket/photos/2026/cat.jpg")
         assert code == 404 and b"NoSuchKey" in body2
         # empty intermediate dirs pruned
-        code, listing, _ = _req("GET", f"{base}/mybucket?prefix=photos/")
+        code, listing, _ = _req("GET", f"{base}/mybucket?list-type=2&prefix=photos/")
         assert b"<KeyCount>0</KeyCount>" in listing
     finally:
         s3.stop()
@@ -288,7 +288,7 @@ def test_s3_list_v2_delimiter_and_pagination(fscluster):
         seen = []
         token = ""
         for _ in range(10):
-            q = f"?max-keys=2" + (f"&continuation-token={token}" if token else "")
+            q = f"?list-type=2&max-keys=2" + (f"&continuation-token={token}" if token else "")
             code, body, _ = _req("GET", f"{base}{q}")
             import re
             seen += re.findall(rb"<Key>([^<]+)</Key>", body)
@@ -313,7 +313,7 @@ def test_s3_list_v2_prefix_group_pagination(fscluster):
         import re
         entries, token = [], ""
         for _ in range(8):
-            q = "delimiter=/&max-keys=1" + (f"&continuation-token={token}" if token else "")
+            q = "list-type=2&delimiter=/&max-keys=1" + (f"&continuation-token={token}" if token else "")
             code, body, _ = _req("GET", f"{base}?{q}")
             assert code == 200
             entries += re.findall(rb"<(?:Key|Prefix)>([^<]+)</", body)
