@@ -251,6 +251,20 @@ class MetaWrapper:
             "op": "rm_inode", "ino": ino, "ts": time.time()}})
         return res[0]["result"].get("extents", [])
 
+    # ---- hardlinks (metanode CreateLink role) ----
+    def inc_nlink(self, ino: int) -> int:
+        mp = self._mp_for(ino)
+        res = self._call(mp, "submit", {"record": {
+            "op": "inc_nlink", "ino": ino, "ts": time.time()}})
+        return res[0]["result"]["nlink"]
+
+    def dec_nlink(self, ino: int) -> bool:
+        """Drop one link; True when the inode was removed (last link)."""
+        mp = self._mp_for(ino)
+        res = self._call(mp, "submit", {"record": {
+            "op": "dec_nlink", "ino": ino, "ts": time.time()}})
+        return res[0]["result"]["removed"]
+
     def dentry_create(self, parent: int, name: str, ino: int) -> None:
         mp = self._mp_for(parent)
         self._call(mp, "submit", {"record": {
@@ -855,12 +869,45 @@ class FileSystem:
         if inode["type"] == mn.DIR and self.meta.dentry_count(ino) > 0:
             raise FsError(mn.ENOTEMPTY, f"{path} not empty")
         self.meta.dentry_delete(parent, name)
-        # rm_inode moves the extents onto the partition's replicated
-        # freelist; the metanode's background scan deletes them from the
-        # datanodes — a client crash ANYWHERE in this sequence leaks at
-        # most an orphan inode, which fsck reclaims (never raw extents)
-        self.meta.inode_delete(ino)
-        self.data.close_stream(ino)
+        # dec_nlink removes the inode only on the LAST link, moving its
+        # extents onto the partition's replicated freelist; the
+        # metanode's background scan deletes them from the datanodes —
+        # a client crash ANYWHERE in this sequence leaks at most an
+        # orphan inode, which fsck reclaims (never raw extents)
+        if self.meta.dec_nlink(ino):
+            self.data.close_stream(ino)
+
+    def link(self, existing: str, new: str) -> int:
+        """Hardlink (link(2)): a second dentry to the same inode.
+        Directories are EPERM, per POSIX."""
+        ino = self.resolve(existing)
+        parent, name = self._parent_of(new)
+        return self.link_at(ino, parent, name)["ino"]
+
+    def link_at(self, ino: int, new_parent: int, name: str) -> dict:
+        """Inode-based link for the FUSE opcode handler; returns the
+        post-link inode dict. Bumps nlink FIRST, then installs the
+        dentry — a crash in between leaks an overcounted nlink (fsck's
+        reachability pass reclaims it), never a dentry pointing at a
+        removable inode."""
+        inode = self.meta.inode_get(ino)
+        if inode["type"] == mn.DIR:
+            raise FsError(mn.EPERM,
+                          "hardlinks to directories are not allowed")
+        inode["nlink"] = self.meta.inc_nlink(ino)
+        try:
+            self.meta.dentry_create(new_parent, name, ino)
+        except FsError:
+            # DEFINITE semantic rejection (e.g. EEXIST): safe to
+            # compensate. A transport-level RpcError is AMBIGUOUS — the
+            # dentry may have committed — so the overcount is left for
+            # fsck; compensating there could free a still-linked inode.
+            try:
+                self.meta.dec_nlink(ino)
+            except (FsError, rpc.RpcError):
+                pass  # overcount leak at worst; fsck reclaims
+            raise
+        return inode
 
     def rename(self, old: str, new: str) -> None:
         old_parent, old_name = self._parent_of(old)
@@ -946,11 +993,12 @@ class FileSystem:
                 except (FsError, rpc.RpcError):
                     pass  # TX_TTL expiry releases a stranded lock
         if victim is not None:
-            # replaced target: drop its inode (post-commit cleanup; a
+            # replaced target: drop ONE link (post-commit cleanup; a
             # crash here leaves an unreferenced inode for fsck, never a
-            # dangling dentry). Extents ride the server-side freelist.
-            self.meta.inode_delete(victim)
-            self.data.close_stream(victim)
+            # dangling dentry). Other hardlinks keep the inode alive;
+            # the last link's extents ride the server-side freelist.
+            if self.meta.dec_nlink(victim):
+                self.data.close_stream(victim)
 
     def _in_subtree(
         self, root_ino: int, target_ino: int, deadline: float | None = None

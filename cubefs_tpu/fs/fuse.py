@@ -33,6 +33,7 @@ FUSE_KERNEL_MINOR = 31
 (FUSE_LOOKUP, FUSE_FORGET, FUSE_GETATTR, FUSE_SETATTR) = (1, 2, 3, 4)
 FUSE_READLINK, FUSE_SYMLINK = 5, 6
 FUSE_MKDIR, FUSE_UNLINK, FUSE_RMDIR, FUSE_RENAME = 9, 10, 11, 12
+FUSE_LINK = 13
 FUSE_OPEN, FUSE_READ, FUSE_WRITE, FUSE_STATFS, FUSE_RELEASE = 14, 15, 16, 17, 18
 FUSE_FSYNC, FUSE_SETXATTR, FUSE_GETXATTR, FUSE_FLUSH = 20, 21, 22, 25
 FUSE_LISTXATTR, FUSE_REMOVEXATTR = 23, 24
@@ -306,9 +307,17 @@ class FuseMount:
             if opcode == FUSE_RMDIR and fs.meta.dentry_count(ino) > 0:
                 raise FsError(mn.ENOTEMPTY, "directory not empty")
             fs.meta.dentry_delete(nodeid, name)
-            fs.meta.inode_delete(ino)  # extents ride the freelist
-            fs.data.close_stream(ino)
+            # last link removes the inode (extents ride the freelist);
+            # other hardlinks keep it alive
+            if fs.meta.dec_nlink(ino):
+                fs.data.close_stream(ino)
             self._reply(unique)
+
+        elif opcode == FUSE_LINK:
+            (old_ino,) = struct.unpack_from("<Q", body)
+            name = body[8:].split(b"\x00", 1)[0].decode()
+            # link_at returns the post-link inode: no extra round trip
+            self._entry_reply(unique, fs.link_at(old_ino, nodeid, name))
 
         elif opcode == FUSE_RENAME:
             newdir = struct.unpack_from("<Q", body)[0]

@@ -34,6 +34,7 @@ class MetaError(Exception):
         self.code = code
 
 
+EPERM = 1
 ENOENT = 2
 EEXIST = 17
 EBUSY = 16
@@ -250,10 +251,17 @@ class MetaPartition:
         elif op == "rm_inode":
             lib.ms_del_inode(h, self.pid, r["ino"])
             lib.ms_del_dir(h, self.pid, r["ino"])
+        elif op in ("inc_nlink", "dec_nlink"):
+            self._mirror_inode(r["ino"])  # handles removal (None) too
+            if op == "dec_nlink" and result.get("removed"):
+                lib.ms_del_dir(h, self.pid, r["ino"])
         elif op == "unlink2":
             self._mirror_dentry(r["parent"], r["name"])
-            lib.ms_del_inode(h, self.pid, result["ino"])
-            lib.ms_del_dir(h, self.pid, result["ino"])
+            if result.get("removed", True):
+                lib.ms_del_inode(h, self.pid, result["ino"])
+                lib.ms_del_dir(h, self.pid, result["ino"])
+            else:  # a hardlink remains: the inode changed (nlink)
+                self._mirror_inode(result["ino"])
         elif op in ("mk_dentry", "rm_dentry"):
             self._mirror_dentry(r["parent"], r["name"])
         elif op == "rename_local":
@@ -281,6 +289,8 @@ class MetaPartition:
     _DIRTY_MAP = {
         "mk_inode": {"inodes", "dentries"},
         "rm_inode": {"inodes", "dentries", "freelist"},
+        "inc_nlink": {"inodes"},
+        "dec_nlink": {"inodes", "dentries", "freelist"},
         "mk_dentry": {"dentries"},
         "rm_dentry": {"dentries"},
         "rename_local": {"dentries"},
@@ -511,6 +521,12 @@ class MetaPartition:
         if inode["type"] == DIR and self.dentries.get(ino):
             raise MetaError(ENOTEMPTY, f"{name!r} not empty")
         del d[name]
+        if inode["type"] != DIR and inode.get("nlink", 1) > 1:
+            # other hardlinks remain: drop this dentry + one link only
+            inode["nlink"] -= 1
+            inode["ctime"] = r.get("ts", time.time())
+            return {"ino": ino, "extents": [], "deferred": False,
+                    "removed": False}
         self.inodes.pop(ino)
         self.dentries.pop(ino, None)
         exts = inode["extents"]
@@ -518,7 +534,37 @@ class MetaPartition:
         if deferred:
             self.freelist[str(ino)] = {
                 "extents": deferred, "ts": r.get("ts", 0.0)}
-        return {"ino": ino, "extents": exts, "deferred": bool(deferred)}
+        return {"ino": ino, "extents": exts, "deferred": bool(deferred),
+                "removed": True}
+
+    def _apply_inc_nlink(self, r: dict) -> dict:
+        """Hardlink support (metanode CreateLink role): bump the link
+        count; the dentry itself lands via mk_dentry on the PARENT's
+        partition (two commits client-side; a crash between them leaks
+        an overcounted nlink for fsck, never a dangling dentry)."""
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            raise MetaError(ENOENT, f"inode {r['ino']}")
+        if inode["type"] == DIR:
+            raise MetaError(EPERM,
+                            "hardlinks to directories are not allowed")
+        inode["nlink"] = inode.get("nlink", 1) + 1
+        inode["ctime"] = r.get("ts", time.time())
+        return {"nlink": inode["nlink"]}
+
+    def _apply_dec_nlink(self, r: dict) -> dict:
+        """Drop one link; the inode (and its extents, via the deferred
+        freelist) goes only when the LAST link goes. Directories never
+        carry extra links, so a dec removes them outright."""
+        ino = r["ino"]
+        inode = self.inodes.get(ino)
+        if inode is None:
+            raise MetaError(ENOENT, f"inode {ino}")
+        if inode["type"] != DIR and inode.get("nlink", 1) > 1:
+            inode["nlink"] -= 1
+            inode["ctime"] = r.get("ts", time.time())
+            return {"removed": False, "nlink": inode["nlink"]}
+        return {"removed": True, **self._apply_rm_inode(r)}
 
     def _apply_rm_dentry(self, r: dict) -> dict:
         parent, name = r["parent"], r["name"]

@@ -268,3 +268,34 @@ def test_concurrent_writers_distinct_files(mnt):
         t.join()
     assert not errs
     assert len(os.listdir(base)) == 8
+
+
+# ---- hardlinks (link(2)) ----
+
+def test_hardlink_semantics(mnt):
+    base = f"{mnt}/hl"
+    os.mkdir(base)
+    with open(f"{base}/orig", "wb") as f:
+        f.write(b"shared bytes")
+    os.link(f"{base}/orig", f"{base}/alias")
+    st = os.stat(f"{base}/orig")
+    assert st.st_nlink == 2
+    assert os.stat(f"{base}/alias").st_ino == st.st_ino
+    # content visible through both names; write via one, read via other
+    with open(f"{base}/alias", "ab") as f:
+        f.write(b"+more")
+    assert open(f"{base}/orig", "rb").read() == b"shared bytes+more"
+    # unlinking one name keeps the data reachable via the other
+    os.unlink(f"{base}/orig")
+    assert open(f"{base}/alias", "rb").read() == b"shared bytes+more"
+    assert os.stat(f"{base}/alias").st_nlink == 1
+    os.unlink(f"{base}/alias")
+    assert not os.path.exists(f"{base}/alias")
+    # directories refuse hardlinks (EPERM)
+    os.mkdir(f"{base}/d")
+    assert _errno_of(os.link, f"{base}/d", f"{base}/dlink") == errno.EPERM
+    # linking over an existing name is EEXIST
+    open(f"{base}/x", "wb").write(b"x")
+    open(f"{base}/y", "wb").write(b"y")
+    assert _errno_of(os.link, f"{base}/x", f"{base}/y") == errno.EEXIST
+    assert os.stat(f"{base}/x").st_nlink == 1  # failed link rolled back

@@ -191,7 +191,8 @@ def test_disk_manager_over_real_sockets(tmp_path, rng):
         fs = FileSystem(view, pool)
         p = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
         fs.write_file("/real.bin", p)
-        victim = datas[0]
+        victim = next(d for d in datas
+                      if any(r["dps"] for r in d.disk_report().values()))
         disk = next(d for d, r in victim.disk_report().items() if r["dps"])
         affected = set(victim.disk_report()[disk]["dps"])
         # heartbeat over HTTP carries the report

@@ -637,3 +637,29 @@ def test_meta_packet_failover_to_http(cluster):
     assert fs.read_file("/fo/b") == b"y"
     assert set(fs.readdir("/fo")) == {"a", "b"}
     assert fs.meta._packet_down, "failover must negative-cache the plane"
+
+
+def test_hardlinks_via_sdk(cluster):
+    """link(2) semantics at the SDK level: shared inode, per-link
+    unlink, rename-over-link decrements instead of deleting."""
+    fs = cluster.fs
+    fs.write_file("/h1", b"payload")
+    ino = fs.resolve("/h1")
+    assert fs.link("/h1", "/h2") == ino
+    assert fs.meta.inode_get(ino)["nlink"] == 2
+    assert fs.read_file("/h2") == b"payload"
+    fs.unlink("/h1")
+    # data lives on through the second link
+    assert fs.read_file("/h2") == b"payload"
+    assert fs.meta.inode_get(ino)["nlink"] == 1
+    # rename over a hardlinked victim only drops one link
+    fs.write_file("/other", b"x")
+    fs.link("/h2", "/h3")
+    fs.rename("/other", "/h2")  # replaces the h2 NAME, not the inode
+    assert fs.read_file("/h3") == b"payload"
+    assert fs.meta.inode_get(ino)["nlink"] == 1
+    fs.unlink("/h3")
+    from cubefs_tpu.fs.client import FsError
+    import pytest as _p
+    with _p.raises(FsError):
+        fs.read_file("/h3")
