@@ -117,16 +117,22 @@ def run_role(cfg: dict):
         psrv = svc.serve_packets(host=cfg.get("listen_host", "127.0.0.1"),
                                  port=int(cfg.get("packet_port", 0)))
         print(f"[datanode] packet plane on {psrv.addr}", flush=True)
+        # native C++ read plane (dataserve.cc) beside the Python planes
+        raddr = svc.serve_native(host=cfg.get("listen_host", "127.0.0.1"),
+                                 port=int(cfg.get("read_port", 0)))
+        if raddr:
+            print(f"[datanode] native read plane on {raddr}", flush=True)
         master = rpc.Client(cfg["master_addr"])
         zone = cfg.get("zone", "default")
         master.call("register", {"kind": "data", "addr": srv.addr,
                                  "zone": zone, "packet_addr": psrv.addr,
+                                 "read_addr": raddr,
                                  "disks": svc.disk_report()})
         # heartbeats carry the disk report: the master's disk manager
         # migrates partitions off any disk reported broken
         _heartbeat_loop(lambda: master.call(
             "heartbeat", {"kind": "data", "addr": srv.addr, "zone": zone,
-                          "packet_addr": psrv.addr,
+                          "packet_addr": psrv.addr, "read_addr": raddr,
                           "disks": svc.disk_report()}))
         return srv, svc
 

@@ -476,8 +476,11 @@ class ExtentClient:
         # replicas that advertise one serve reads over persistent TCP
         self.packet_addrs: dict[str, str] = dict(
             vol_view.get("packet_addrs") or {})
+        # native C++ read plane (dataserve.cc): reads try it first
+        self.read_addrs: dict[str, str] = dict(
+            vol_view.get("data_read_addrs") or {})
         self._packet_clients: dict[str, object] = {}
-        self._packet_down: dict[str, float] = {}  # addr -> retry-after ts
+        self._packet_down: dict[str, float] = {}  # plane addr -> retry ts
         self._rr = 0
         self._lock = threading.Lock()
         # per-inode open extent: ino -> (dp, extent_id, next_offset)
@@ -643,12 +646,15 @@ class ExtentClient:
         transports enter DataNode.write()."""
         addr = dp["leader"]
         paddr = self.packet_addrs.get(addr)
-        if paddr and time.monotonic() >= self._packet_down.get(addr, 0.0):
+        # keyed by PLANE addr, shared with _read_one: a packet plane a
+        # read discovered dead suppresses writes too (and one client
+        # pool serves both directions)
+        if paddr and time.monotonic() >= self._packet_down.get(paddr, 0.0):
             from ..utils import packet as pkt
 
-            cli = self._packet_clients.get(addr)
+            cli = self._packet_clients.get(paddr)
             if cli is None:
-                cli = self._packet_clients[addr] = pkt.PacketClient(
+                cli = self._packet_clients[paddr] = pkt.PacketClient(
                     paddr, timeout=30.0, connect_timeout=2.0)
             try:
                 cli.call(pkt.OP_WRITE, partition=dp["dp_id"], extent=eid,
@@ -661,31 +667,39 @@ class ExtentClient:
                 # an automatic RPC resend would double its load (and
                 # could land behind a newer same-offset write). Surface
                 # the timeout; the caller owns the retry decision.
-                self._packet_down[addr] = time.monotonic() + 30.0
+                self._packet_down[paddr] = time.monotonic() + 30.0
                 raise rpc.RpcError(
                     504, f"packet write to {addr} timed out; "
                          f"possibly still executing") from None
             except (ConnectionError, OSError):
-                self._packet_down[addr] = time.monotonic() + 30.0
+                self._packet_down[paddr] = time.monotonic() + 30.0
         self.nodes.get(addr).call(
             "write", {"dp_id": dp["dp_id"], "extent_id": eid,
                       "offset": off}, data)
 
     def _read_one(self, addr: str, dp_id: int, eid: int, off: int,
                   ln: int) -> bytes:
-        """One replica read: the binary packet plane when the node
-        advertises it (falling back to RPC on transport errors), RPC
-        otherwise."""
-        paddr = self.packet_addrs.get(addr)
-        if paddr and time.monotonic() >= self._packet_down.get(addr, 0.0):
-            from ..utils import packet as pkt
+        """One replica read, trying the fastest advertised plane first:
+        the native C++ read plane (dataserve.cc), then the Python
+        packet plane, then RPC. Transport failures negative-cache that
+        plane only; protocol errors surface (the caller fails over to
+        another replica)."""
+        from ..utils import packet as pkt
 
-            cli = self._packet_clients.get(addr)
+        planes = []
+        if addr in self.read_addrs:
+            planes.append(self.read_addrs[addr])
+        if addr in self.packet_addrs:
+            planes.append(self.packet_addrs[addr])
+        for plane in planes:
+            if time.monotonic() < self._packet_down.get(plane, 0.0):
+                continue
+            cli = self._packet_clients.get(plane)
             if cli is None:
                 # short connect timeout: a blackholed packet port must
                 # not stall reads before the RPC fallback kicks in
-                cli = self._packet_clients[addr] = pkt.PacketClient(
-                    paddr, timeout=30.0, connect_timeout=2.0)
+                cli = self._packet_clients[plane] = pkt.PacketClient(
+                    plane, timeout=30.0, connect_timeout=2.0)
             try:
                 _, data = cli.call(pkt.OP_READ, partition=dp_id, extent=eid,
                                    offset=off, args={"length": ln})
@@ -696,13 +710,13 @@ class ExtentClient:
                 # don't stack a second 30s wait on the same node: count
                 # it as a replica failure so the read fails over to the
                 # NEXT replica immediately
-                self._packet_down[addr] = time.monotonic() + 30.0
+                self._packet_down[plane] = time.monotonic() + 30.0
                 raise rpc.RpcError(
                     504, f"packet read from {addr} timed out") from None
             except (ConnectionError, OSError):
                 # plane down: remember it and stop paying the connect
                 # cost on every read until the cooldown passes
-                self._packet_down[addr] = time.monotonic() + 30.0
+                self._packet_down[plane] = time.monotonic() + 30.0
         _, data = self.nodes.get(addr).call(
             "read", {"dp_id": dp_id, "extent_id": eid,
                      "offset": off, "length": ln},

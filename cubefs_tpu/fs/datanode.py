@@ -102,7 +102,21 @@ class DataNode:
         self.partitions: dict[int, DataPartition] = {}
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = threading.RLock()
-        self.broken = False
+        self._broken = False
+        # native C++ read plane (runtime/src/dataserve.cc): serves
+        # OP_READ from the same extent-store handles, GIL-free
+        self._native_lib = None
+        self._native_h = None
+        self.native_addr: str | None = None
+        if os.environ.get("CUBEFS_NATIVE_DATA", "1") != "0":
+            try:
+                from ..runtime import build as rt_build
+
+                self._native_lib = rt_build.load()
+                self._native_h = self._native_lib.ds_create()
+            except Exception:
+                self._native_lib = None
+                self._native_h = None
         # chain legs that failed mid-append: (dp_id, extent_id) -> peers
         # whose replica diverged in the appended range. Repaired
         # immediately in the background (not left to the next fsck /
@@ -125,8 +139,45 @@ class DataNode:
                     dp = DataPartition(dp_id, os.path.join(disk, name), [], "")
                     self.partitions[dp_id] = dp
                     self.dp_disk[dp_id] = disk
+                    self._native_register(dp)
                     if len(dp.peers) > 1:
                         self._start_dp_raft(dp)
+
+    @property
+    def broken(self) -> bool:
+        return self._broken
+
+    @broken.setter
+    def broken(self, v: bool) -> None:
+        # the native read plane honors the same kill switch (tests and
+        # failure simulations set this attribute directly)
+        self._broken = v
+        if self._native_h is not None:
+            self._native_lib.ds_set_down(self._native_h, 1 if v else 0)
+
+    def serve_native(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the C++ read plane; returns its addr (None when the
+        native runtime is unavailable, or when client-read QoS is
+        configured — the native plane does not shape reads, and
+        silently bypassing a configured limit would make QoS dead
+        config; such deployments keep the Python plane)."""
+        if self._native_h is None:
+            return None
+        if self.qos is not None and getattr(self.qos, "read", None):
+            return None
+        p = self._native_lib.ds_serve(self._native_h, host.encode(), port)
+        if p < 0:
+            return None
+        self.native_addr = f"{host}:{p}"
+        return self.native_addr
+
+    def _native_register(self, dp: DataPartition) -> None:
+        if self._native_h is None:
+            return
+        disk = self.dp_disk.get(dp.dp_id)
+        serving = 0 if disk in self.disk_broken else 1
+        self._native_lib.ds_add_partition(
+            self._native_h, dp.dp_id, dp.store.handle, serving)
 
     def _pick_disk(self) -> str:
         """Healthy disk with the fewest partitions (space_manager.go
@@ -148,6 +199,7 @@ class DataNode:
                     dp_id, os.path.join(disk, f"dp_{dp_id}"), peers, leader
                 )
                 self.dp_disk[dp_id] = disk
+                self._native_register(self.partitions[dp_id])
             else:
                 dp = self.partitions[dp_id]
                 dp.peers, dp.leader = list(peers), leader
@@ -203,8 +255,16 @@ class DataNode:
     def mark_disk_broken(self, path: str) -> None:
         """Sticky disk failure (disk.go triggerDiskError role): IO
         errors and operator action land here; the next heartbeat's disk
-        report makes the master migrate this disk's partitions."""
-        self.disk_broken.add(os.path.abspath(path))
+        report makes the master migrate this disk's partitions. The
+        native read plane stops serving the disk's dps immediately."""
+        path = os.path.abspath(path)
+        with self._lock:  # vs create/drop_partition mutating dp_disk
+            self.disk_broken.add(path)
+            affected = [dp_id for dp_id, d in self.dp_disk.items()
+                        if d == path]
+        if self._native_h is not None:
+            for dp_id in affected:
+                self._native_lib.ds_set_serving(self._native_h, dp_id, 0)
 
     def _probe_disk(self, disk: str) -> None:
         """Write+fsync health probe; a failure marks the disk broken
@@ -231,7 +291,7 @@ class DataNode:
                 except OSError:
                     pass
                 return
-            self.disk_broken.add(disk)
+            self.mark_disk_broken(disk)  # also stops native serving
 
     def _disk_io_guard(self, dp_id: int, exc: Exception):
         """Store failure triage (disk.go triggerDiskError role): the
@@ -262,6 +322,9 @@ class DataNode:
             disk = self.dp_disk.pop(dp_id, None)
         if dp is None:
             return
+        if self._native_h is not None:
+            # drains in-flight native reads BEFORE the store closes
+            self._native_lib.ds_drop_partition(self._native_h, dp_id)
         if dp.raft is not None:
             dp.raft.stop()
         try:
@@ -274,7 +337,18 @@ class DataNode:
 
     def disk_report(self) -> dict:
         """Per-disk health + resident dps (heartbeat payload; the
-        master's disk manager consumes it)."""
+        master's disk manager consumes it). Also drains native-plane
+        read failures into the disk triage — a dying disk that only
+        serves GIL-free reads must still get probed and migrated."""
+        if self._native_h is not None:
+            import ctypes
+
+            buf = (ctypes.c_uint64 * 64)()
+            n = self._native_lib.ds_take_failed(self._native_h, buf, 64)
+            for i in range(n):
+                disk = self.dp_disk.get(int(buf[i]))
+                if disk is not None:
+                    self._probe_disk(disk)
         with self._lock:
             out = {}
             for d in self.disks:
@@ -648,6 +722,16 @@ class DataNode:
         srv = getattr(self, "_packet_srv", None)
         if srv is not None:
             srv.stop()
+        if self._native_h is not None:
+            # stop the native plane and drain its reads BEFORE closing
+            # stores: a read racing a close would touch freed memory.
+            # Null the handle first so concurrent callers skip it, then
+            # free the DataServe (no leak per node lifecycle).
+            h, self._native_h = self._native_h, None
+            self._native_lib.ds_stop(h)
+            for dp_id in list(self.partitions):
+                self._native_lib.ds_drop_partition(h, dp_id)
+            self._native_lib.ds_destroy(h)
         for dp in self.partitions.values():
             if dp.raft is not None:
                 dp.raft.stop()

@@ -55,6 +55,12 @@ class ExtentStore:
             raise ExtentError(f"extent store {self.directory} is closed")
         return h
 
+    @property
+    def handle(self):
+        """Raw native store handle for the C++ read plane (dataserve.cc)
+        — the registrar must ds_drop the partition BEFORE close()."""
+        return self._h
+
     def close(self) -> None:
         with self._lock:
             if self._h:

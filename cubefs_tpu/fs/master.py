@@ -438,13 +438,16 @@ class Master(ReplicatedFsm):
     # ---------------- registries ----------------
     def register_datanode(self, addr: str, zone: str = "default",
                           packet_addr: str | None = None,
-                          disks: dict | None = None) -> None:
+                          disks: dict | None = None,
+                          read_addr: str | None = None) -> None:
         with self._lock:
             info = self.datanodes.setdefault(addr, {"addr": addr})
             info["hb"] = time.time()
             info["zone"] = zone
             if packet_addr:
                 info["packet_addr"] = packet_addr
+            if read_addr:
+                info["read_addr"] = read_addr
             if disks is not None:
                 info["disks"] = disks
 
@@ -670,12 +673,16 @@ class Master(ReplicatedFsm):
             meta_read_addrs = {a: i["read_addr"]
                                for a, i in self.metanodes.items()
                                if i.get("read_addr")}
+            data_read_addrs = {a: i["read_addr"]
+                               for a, i in self.datanodes.items()
+                               if i.get("read_addr")}
             return {"name": name, "mps": [dict(m) for m in vol["mps"]],
                     "dps": [dict(d) for d in vol["dps"]],
                     "quotas": dict(vol.get("quotas", {})),
                     "packet_addrs": packet_addrs,
                     "meta_packet_addrs": meta_packet_addrs,
-                    "meta_read_addrs": meta_read_addrs}
+                    "meta_read_addrs": meta_read_addrs,
+                    "data_read_addrs": data_read_addrs}
 
     def _meta_load(self) -> dict[str, int]:
         """Replica count per metanode across all volumes (placement load)."""
@@ -931,7 +938,8 @@ class Master(ReplicatedFsm):
         if args["kind"] == "data":
             self.register_datanode(args["addr"], zone,
                                    packet_addr=args.get("packet_addr"),
-                                   disks=args.get("disks"))
+                                   disks=args.get("disks"),
+                                   read_addr=args.get("read_addr"))
         else:
             self.register_metanode(args["addr"], zone,
                                    packet_addr=args.get("packet_addr"),

@@ -241,5 +241,20 @@ def load() -> ctypes.CDLL:
             lib.rt_crc32.restype = c.c_uint32
             lib.rt_crc32.argtypes = [c.c_uint32, c.c_void_p, c.c_size_t]
             lib.rt_crc32_level.restype = c.c_int
+            # native datanode read plane (dataserve.cc)
+            lib.ds_create.restype = c.c_void_p
+            lib.ds_destroy.argtypes = [c.c_void_p]
+            lib.ds_add_partition.argtypes = [
+                c.c_void_p, c.c_uint64, c.c_void_p, c.c_int]
+            lib.ds_set_serving.argtypes = [c.c_void_p, c.c_uint64, c.c_int]
+            lib.ds_drop_partition.argtypes = [c.c_void_p, c.c_uint64]
+            lib.ds_set_down.argtypes = [c.c_void_p, c.c_int]
+            lib.ds_op_count.restype = c.c_uint64
+            lib.ds_op_count.argtypes = [c.c_void_p]
+            lib.ds_take_failed.restype = c.c_int
+            lib.ds_take_failed.argtypes = [c.c_void_p, c.c_void_p, c.c_int]
+            lib.ds_serve.restype = c.c_int
+            lib.ds_serve.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+            lib.ds_stop.argtypes = [c.c_void_p]
             _lib = lib
     return _lib

@@ -39,7 +39,10 @@ class FsCluster:
             addr = f"data{i}"
             node = DataNode(i, str(tmp_path / f"data{i}"), addr, self.pool)
             self.pool.bind(addr, node)
-            self.master.register_datanode(addr)
+            # the native C++ data read plane listens on real TCP too,
+            # so every e2e read exercises it
+            self.master.register_datanode(addr,
+                                          read_addr=node.serve_native())
             self.datas.append(node)
         self.view = self.master.create_volume("vol1", mp_count=2, dp_count=3)
         self.fs = FileSystem(self.view, self.pool)
