@@ -143,6 +143,12 @@ class DataNode:
                     if len(dp.peers) > 1:
                         self._start_dp_raft(dp)
 
+    # Native-plane handle discipline: EVERY ds_* call happens while
+    # holding self._lock and re-checks _native_h; stop() nulls the
+    # attribute under that lock before destroying, so a concurrent
+    # caller (e.g. the heartbeat thread's disk_report) can never use a
+    # freed handle.
+
     @property
     def broken(self) -> bool:
         return self._broken
@@ -152,8 +158,9 @@ class DataNode:
         # the native read plane honors the same kill switch (tests and
         # failure simulations set this attribute directly)
         self._broken = v
-        if self._native_h is not None:
-            self._native_lib.ds_set_down(self._native_h, 1 if v else 0)
+        with self._lock:
+            if self._native_h is not None:
+                self._native_lib.ds_set_down(self._native_h, 1 if v else 0)
 
     def serve_native(self, host: str = "127.0.0.1", port: int = 0):
         """Start the C++ read plane; returns its addr (None when the
@@ -161,23 +168,26 @@ class DataNode:
         configured — the native plane does not shape reads, and
         silently bypassing a configured limit would make QoS dead
         config; such deployments keep the Python plane)."""
-        if self._native_h is None:
-            return None
         if self.qos is not None and getattr(self.qos, "read", None):
             return None
-        p = self._native_lib.ds_serve(self._native_h, host.encode(), port)
+        with self._lock:
+            if self._native_h is None:
+                return None
+            p = self._native_lib.ds_serve(self._native_h, host.encode(),
+                                          port)
         if p < 0:
             return None
         self.native_addr = f"{host}:{p}"
         return self.native_addr
 
     def _native_register(self, dp: DataPartition) -> None:
-        if self._native_h is None:
-            return
-        disk = self.dp_disk.get(dp.dp_id)
-        serving = 0 if disk in self.disk_broken else 1
-        self._native_lib.ds_add_partition(
-            self._native_h, dp.dp_id, dp.store.handle, serving)
+        with self._lock:
+            if self._native_h is None:
+                return
+            disk = self.dp_disk.get(dp.dp_id)
+            serving = 0 if disk in self.disk_broken else 1
+            self._native_lib.ds_add_partition(
+                self._native_h, dp.dp_id, dp.store.handle, serving)
 
     def _pick_disk(self) -> str:
         """Healthy disk with the fewest partitions (space_manager.go
@@ -262,9 +272,10 @@ class DataNode:
             self.disk_broken.add(path)
             affected = [dp_id for dp_id, d in self.dp_disk.items()
                         if d == path]
-        if self._native_h is not None:
-            for dp_id in affected:
-                self._native_lib.ds_set_serving(self._native_h, dp_id, 0)
+            if self._native_h is not None:
+                for dp_id in affected:
+                    self._native_lib.ds_set_serving(self._native_h,
+                                                    dp_id, 0)
 
     def _probe_disk(self, disk: str) -> None:
         """Write+fsync health probe; a failure marks the disk broken
@@ -322,9 +333,10 @@ class DataNode:
             disk = self.dp_disk.pop(dp_id, None)
         if dp is None:
             return
-        if self._native_h is not None:
-            # drains in-flight native reads BEFORE the store closes
-            self._native_lib.ds_drop_partition(self._native_h, dp_id)
+        with self._lock:
+            if self._native_h is not None:
+                # drains in-flight native reads BEFORE the store closes
+                self._native_lib.ds_drop_partition(self._native_h, dp_id)
         if dp.raft is not None:
             dp.raft.stop()
         try:
@@ -340,15 +352,18 @@ class DataNode:
         master's disk manager consumes it). Also drains native-plane
         read failures into the disk triage — a dying disk that only
         serves GIL-free reads must still get probed and migrated."""
-        if self._native_h is not None:
-            import ctypes
+        failed_disks = []
+        with self._lock:
+            if self._native_h is not None:
+                import ctypes
 
-            buf = (ctypes.c_uint64 * 64)()
-            n = self._native_lib.ds_take_failed(self._native_h, buf, 64)
-            for i in range(n):
-                disk = self.dp_disk.get(int(buf[i]))
-                if disk is not None:
-                    self._probe_disk(disk)
+                buf = (ctypes.c_uint64 * 64)()
+                n = self._native_lib.ds_take_failed(self._native_h, buf, 64)
+                failed_disks = [self.dp_disk[int(buf[i])]
+                                for i in range(n)
+                                if int(buf[i]) in self.dp_disk]
+        for disk in failed_disks:
+            self._probe_disk(disk)
         with self._lock:
             out = {}
             for d in self.disks:
@@ -722,12 +737,15 @@ class DataNode:
         srv = getattr(self, "_packet_srv", None)
         if srv is not None:
             srv.stop()
-        if self._native_h is not None:
-            # stop the native plane and drain its reads BEFORE closing
-            # stores: a read racing a close would touch freed memory.
-            # Null the handle first so concurrent callers skip it, then
-            # free the DataServe (no leak per node lifecycle).
+        with self._lock:
+            # null under the lock: every other ds_* caller holds this
+            # lock for its whole call, so once we observe/clear the
+            # handle here nobody can be mid-call on it
             h, self._native_h = self._native_h, None
+        if h is not None:
+            # stop the native plane and drain its reads BEFORE closing
+            # stores: a read racing a close would touch freed memory;
+            # then free the DataServe (no leak per node lifecycle)
             self._native_lib.ds_stop(h)
             for dp_id in list(self.partitions):
                 self._native_lib.ds_drop_partition(h, dp_id)

@@ -585,6 +585,36 @@ class ObjectNode:
                            or "private")
                     owner = self._principal or "owner"
                     return self._reply(200, s3policy.acl_to_xml(acl, owner))
+                if not key and "uploads" in query:  # ListMultipartUploads
+                    if not self._check("s3:ListBucketMultipartUploads",
+                                       bucket):
+                        return
+                    prefix = query.get("prefix", [""])[0]
+                    ups = []
+                    try:
+                        staging_root = fs.readdir("/.multipart")
+                    except FsError:
+                        staging_root = {}
+                    for upload_id in sorted(staging_root):
+                        try:
+                            k = fs.getxattr(f"/.multipart/{upload_id}",
+                                            "s3.key") or ""
+                        except FsError:
+                            continue
+                        if k.startswith(prefix):
+                            ups.append((k, upload_id))
+                    ups.sort()
+                    body = (
+                        "<?xml version='1.0'?><ListMultipartUploadsResult>"
+                        f"<Bucket>{bucket}</Bucket>"
+                        f"<Prefix>{xs.escape(prefix)}</Prefix>"
+                        "<IsTruncated>false</IsTruncated>"
+                        + "".join(
+                            f"<Upload><Key>{xs.escape(k)}</Key>"
+                            f"<UploadId>{u}</UploadId></Upload>"
+                            for k, u in ups)
+                        + "</ListMultipartUploadsResult>").encode()
+                    return self._reply(200, body)
                 if key and "acl" in query:  # GetObjectAcl
                     if not self._check("s3:GetObjectAcl", bucket, key):
                         return

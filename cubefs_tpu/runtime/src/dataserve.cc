@@ -28,12 +28,14 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "packetwire.h"
 
 extern "C" int64_t es_read(void* h, uint64_t extent_id, uint64_t off,
                            uint8_t* buf, uint64_t len);
+extern "C" uint64_t es_size(void* h, uint64_t extent_id);
 extern "C" const char* es_last_error(void* h);
 
 namespace {
@@ -64,7 +66,10 @@ struct DataServe {
   std::mutex conn_mu;
   std::vector<int> conn_fds;
   std::mutex fail_mu;
-  std::vector<uint64_t> failed_dps;  // es_read failures, drained by Python
+  // DISTINCT dps with es_read failures since the last drain: a set, so
+  // one dying dp's failure storm can neither grow memory nor push other
+  // dps' signals past the drain cap
+  std::unordered_set<uint64_t> failed_dps;
 
   std::shared_ptr<Partition> get(uint64_t dp) const {
     std::shared_lock l(pmu);
@@ -144,6 +149,14 @@ void serve_conn(DataServe* ds, int fd) {
       err_reply(fd, h, 400, "length too large");
       continue;
     }
+    // clamp the allocation to what the extent can actually yield: an
+    // unauthenticated request must not commit 128 MiB for a bogus
+    // extent/offset (es_read clamps identically, so replies match)
+    uint64_t esz = es_size(p->es, h.extent);
+    if (h.offset >= esz)
+      want = 0;
+    else if (want > esz - h.offset)
+      want = esz - h.offset;
     data.resize(want);
     int64_t got = want ? es_read(p->es, h.extent, h.offset, data.data(),
                                  want)
@@ -155,12 +168,16 @@ void serve_conn(DataServe* ds, int fd) {
         // drains this set so a dying disk that only serves native reads
         // still gets probed, marked and migrated
         std::lock_guard<std::mutex> g(ds->fail_mu);
-        ds->failed_dps.push_back(h.partition);
+        ds->failed_dps.insert(h.partition);
       }
       err_reply(fd, h, 409, e ? e : "extent read failed");
       continue;
     }
     pktwire::reply(fd, h, 0, "{}", data.data(), (size_t)got);
+    if (data.capacity() > (8u << 20) && want < (1u << 20)) {
+      // don't pin a large-read high-water mark for an idle connection
+      data.shrink_to_fit();
+    }
   }
   {
     std::lock_guard<std::mutex> g(ds->conn_mu);
@@ -247,9 +264,11 @@ int ds_take_failed(void* h, uint64_t* out, int cap) {
   auto* ds = (DataServe*)h;
   std::lock_guard<std::mutex> g(ds->fail_mu);
   int n = 0;
-  for (uint64_t dp : ds->failed_dps)
-    if (n < cap) out[n++] = dp;
-  ds->failed_dps.clear();
+  for (auto it = ds->failed_dps.begin();
+       it != ds->failed_dps.end() && n < cap;) {
+    out[n++] = *it;
+    it = ds->failed_dps.erase(it);  // entries past cap stay for next drain
+  }
   return n;
 }
 

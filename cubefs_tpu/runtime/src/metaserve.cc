@@ -19,10 +19,8 @@
 // they are raft-bound, so the GIL is not their ceiling.
 
 #include <arpa/inet.h>
-#include <netinet/in.h>
-#include <netinet/tcp.h>
-#include <sys/socket.h>
-#include <unistd.h>
+
+#include "packetwire.h"
 
 #include <algorithm>
 #include <atomic>
@@ -39,39 +37,6 @@
 #include <vector>
 
 namespace {
-
-// ---------------------------------------------------------------- crc32
-uint32_t crc_table[8][256];
-std::once_flag crc_once;
-
-void crc_init() {
-  for (uint32_t i = 0; i < 256; i++) {
-    uint32_t c = i;
-    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-    crc_table[0][i] = c;
-  }
-  for (uint32_t i = 0; i < 256; i++)
-    for (int s = 1; s < 8; s++)
-      crc_table[s][i] = crc_table[0][crc_table[s - 1][i] & 0xFF] ^
-                        (crc_table[s - 1][i] >> 8);
-}
-
-uint32_t crc32_ieee(const uint8_t* p, size_t n) {
-  std::call_once(crc_once, crc_init);
-  uint32_t c = 0xFFFFFFFFu;
-  while (n >= 8) {
-    c ^= (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
-         ((uint32_t)p[3] << 24);
-    c = crc_table[7][c & 0xFF] ^ crc_table[6][(c >> 8) & 0xFF] ^
-        crc_table[5][(c >> 16) & 0xFF] ^ crc_table[4][c >> 24] ^
-        crc_table[3][p[4]] ^ crc_table[2][p[5]] ^ crc_table[1][p[6]] ^
-        crc_table[0][p[7]];
-    p += 8;
-    n -= 8;
-  }
-  while (n--) c = crc_table[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
-  return c ^ 0xFFFFFFFFu;
-}
 
 // ------------------------------------------------------------- tiny JSON
 // Parses the flat-ish args objects the meta SDK sends ({"pid":1,
@@ -308,26 +273,19 @@ struct MetaServe {
   }
 };
 
-// 64-byte packet header, wire-identical to utils/packet.py HEADER
-#pragma pack(push, 1)
-struct PacketHdr {
-  uint8_t magic, opcode, flags, result;
-  uint32_t crc, psize, asize;
-  uint64_t partition, extent, offset, req_id;
-  uint8_t reserved[16];
-};
-#pragma pack(pop)
-static_assert(sizeof(PacketHdr) == 64, "header must be 64 bytes");
+using pktwire::PacketHdr;
+using pktwire::recv_exact;
+using pktwire::send_all;
 
-constexpr uint8_t MAGIC = 0xCF;
-constexpr uint8_t RESULT_RPC = 0xE1;
+constexpr uint8_t MAGIC = pktwire::MAGIC;
+constexpr uint8_t RESULT_RPC = pktwire::RESULT_RPC;
 constexpr uint8_t OP_META_LOOKUP = 0x20;
 constexpr uint8_t OP_META_INODE_GET = 0x21;
 constexpr uint8_t OP_META_READDIR = 0x22;
 constexpr uint8_t OP_META_DENTRY_COUNT = 0x24;
 constexpr uint8_t OP_META_WALK = 0x26;
 constexpr uint8_t OP_PING = 0x7F;
-constexpr uint32_t MAX_FRAME = 16u << 20;
+constexpr uint32_t MAX_FRAME = pktwire::MAX_FRAME;
 
 // errno -> wire code, matching utils/rpc.py errno_error: 400+errno for
 // small errnos (404/421 never arise from ENOENT/ENOTDIR), else 499
@@ -337,43 +295,6 @@ struct RpcReject {
   int code;
   std::string msg;
 };
-
-bool recv_exact(int fd, void* buf, size_t n) {
-  uint8_t* b = (uint8_t*)buf;
-  while (n) {
-    ssize_t r = recv(fd, b, n, 0);
-    if (r <= 0) return false;
-    b += r;
-    n -= (size_t)r;
-  }
-  return true;
-}
-
-bool send_all(int fd, const void* buf, size_t n) {
-  const uint8_t* b = (const uint8_t*)buf;
-  while (n) {
-    ssize_t r = send(fd, b, n, MSG_NOSIGNAL);
-    if (r <= 0) return false;
-    b += r;
-    n -= (size_t)r;
-  }
-  return true;
-}
-
-void reply(int fd, const PacketHdr& req, uint8_t result,
-           const std::string& args) {
-  PacketHdr h{};
-  h.magic = MAGIC;
-  h.opcode = req.opcode;
-  h.result = result;
-  h.crc = crc32_ieee(nullptr, 0);
-  h.psize = 0;
-  h.asize = (uint32_t)args.size();
-  h.req_id = req.req_id;
-  std::string frame((const char*)&h, sizeof h);
-  frame += args;
-  send_all(fd, frame.data(), frame.size());
-}
 
 void reply_err(int fd, const PacketHdr& req, const RpcReject& e) {
   std::string args = "{\"error\": ";
@@ -533,8 +454,8 @@ void serve_conn(MetaServe* ms, int fd) {
     if (h.asize && !recv_exact(fd, &args_buf[0], h.asize)) break;
     payload_buf.resize(h.psize);
     if (h.psize && !recv_exact(fd, &payload_buf[0], h.psize)) break;
-    if (crc32_ieee((const uint8_t*)payload_buf.data(), payload_buf.size()) !=
-        h.crc)
+    if (rt_crc32(0, (const uint8_t*)payload_buf.data(),
+                 payload_buf.size()) != h.crc)
       break;  // corrupt payload: drop
     ms->ops.fetch_add(1, std::memory_order_relaxed);
     JVal args;
@@ -745,7 +666,7 @@ double ms_bench(const char* host, int port, int opcode,
   PacketHdr h{};
   h.magic = MAGIC;
   h.opcode = (uint8_t)opcode;
-  h.crc = crc32_ieee(nullptr, 0);
+  h.crc = rt_crc32(0, nullptr, 0);
   h.asize = (uint32_t)args.size();
   std::string frame((const char*)&h, sizeof h);
   frame += args;

@@ -238,6 +238,26 @@ def test_multipart_upload_lifecycle(cli):
     assert code == 200 and got == b"".join(parts)
 
 
+def test_list_multipart_uploads(cli):
+    code, body, _ = cli.request("POST", f"/{B}/lmu/one.bin",
+                                query={"uploads": ""})
+    u1 = re.search(rb"<UploadId>([^<]+)", body).group(1).decode()
+    code, body, _ = cli.request("POST", f"/{B}/lmu/two.bin",
+                                query={"uploads": ""})
+    u2 = re.search(rb"<UploadId>([^<]+)", body).group(1).decode()
+    code, body, _ = cli.request("GET", f"/{B}",
+                                query={"uploads": "", "prefix": "lmu/"})
+    assert code == 200
+    assert body.count(b"<Upload>") == 2
+    assert u1.encode() in body and u2.encode() in body
+    # abort both; the listing empties
+    for key, u in (("lmu/one.bin", u1), ("lmu/two.bin", u2)):
+        cli.request("DELETE", f"/{B}/{key}", query={"uploadId": u})
+    code, body, _ = cli.request("GET", f"/{B}",
+                                query={"uploads": "", "prefix": "lmu/"})
+    assert body.count(b"<Upload>") == 0
+
+
 def test_multipart_abort_discards(cli):
     key = "mp/aborted.bin"
     code, body, _ = cli.request("POST", f"/{B}/{key}", query={"uploads": ""})
