@@ -58,9 +58,22 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # task-state checkpoint + transition record log (reference:
-        # scheduler checkpoints to clustermgr KV + recordlog audit files)
+        # scheduler checkpoints to clustermgr KV + recordlog audit
+        # files). With a data_dir, checkpoints are a local file; WITHOUT
+        # one, they ride the clustermgr's replicated kvmgr — task state
+        # then survives scheduler NODE loss, which is exactly why the
+        # reference checkpoints into clustermgr.
         self.data_dir = data_dir
+        self._cm_kv = (not data_dir and hasattr(cm_obj, "kv_get")
+                       and hasattr(cm_obj, "kv_set"))
+        self._kv_synced = False  # see _kv_flush_now: merge-before-write
+        self._kv_warned = False
+        self._kv_dirty = threading.Event()
+        if self._cm_kv:
+            threading.Thread(target=self._kv_flush_loop,
+                             daemon=True).start()
         self._recordlog = None
+        restored = {}
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             tpath = os.path.join(data_dir, "tasks.json")
@@ -69,12 +82,19 @@ class Scheduler:
                     restored = json.load(open(tpath))
                 except json.JSONDecodeError:
                     restored = {}
-                with self._lock:
-                    for t in restored.values():
-                        if t["state"] == "leased":
-                            t["state"] = "pending"  # lease died with us
-                    self.tasks = restored
             self._recordlog = open(os.path.join(data_dir, "records.jsonl"), "a")
+        elif self._cm_kv:
+            try:
+                raw = cm_obj.kv_get("sched/tasks")
+                restored = json.loads(raw) if raw else {}
+            except Exception:
+                restored = {}
+        if restored:
+            with self._lock:
+                for t in restored.values():
+                    if t["state"] == "leased":
+                        t["state"] = "pending"  # lease died with us
+                self.tasks = restored
 
     def _record(self, task_id: str, event: str, **kw) -> None:
         if self._recordlog is not None:
@@ -84,13 +104,63 @@ class Scheduler:
             self._recordlog.flush()
 
     def _checkpoint(self) -> None:
-        if not self.data_dir:
+        if self.data_dir:
+            tmp = os.path.join(self.data_dir, "tasks.json.tmp")
+            with self._lock:
+                with open(tmp, "w") as f:
+                    json.dump(self.tasks, f)
+            os.replace(tmp, os.path.join(self.data_dir, "tasks.json"))
             return
-        tmp = os.path.join(self.data_dir, "tasks.json.tmp")
+        if self._cm_kv:
+            # callers hold the scheduler RLock: the actual kv commit (a
+            # quorum raft round on a replicated cm) runs in the flusher
+            # thread so worker lease RPCs never queue behind it
+            self._kv_dirty.set()
+
+    def _kv_flush_now(self) -> None:
+        """One cm-KV checkpoint write (flusher thread; tests call it
+        directly for synchronous behavior)."""
+        # merge-before-first-write: a standby scheduler that won cm
+        # leadership restored an older (possibly empty) snapshot at
+        # construction — adopting kv-only tasks before overwriting
+        # keeps e.g. manually queued migrations from being lost
+        if not self._kv_synced:
+            try:
+                raw = self.cm.kv_get("sched/tasks")
+                remote = json.loads(raw) if raw else {}
+            except Exception:
+                remote = {}
+            with self._lock:
+                for tid, t in remote.items():
+                    if tid not in self.tasks:
+                        if t.get("state") == "leased":
+                            t["state"] = "pending"
+                        self.tasks[tid] = t
         with self._lock:
-            with open(tmp, "w") as f:
-                json.dump(self.tasks, f)
-        os.replace(tmp, os.path.join(self.data_dir, "tasks.json"))
+            # done tasks stay in memory for reporting but need no
+            # durability — an O(done-history) raft commit per
+            # transition is the wrong cost shape
+            blob = json.dumps({tid: t for tid, t in self.tasks.items()
+                               if t.get("state") != "done"})
+        try:
+            self.cm.kv_set("sched/tasks", blob)
+            self._kv_synced = True
+        except Exception as e:
+            self._kv_synced = False  # re-merge before the next write
+            if not self._kv_warned:
+                self._kv_warned = True
+                import sys
+
+                print(f"scheduler: cm-kv checkpoint failed ({e}); "
+                      f"will keep retrying", file=sys.stderr)
+
+    def _kv_flush_loop(self) -> None:
+        while True:
+            self._kv_dirty.wait()
+            if self._stop.is_set():
+                return
+            self._kv_dirty.clear()
+            self._kv_flush_now()  # bursts batch into one commit
 
     # ---------------- task generation ----------------
     def collect_broken_disks(self) -> list[int]:
@@ -630,6 +700,7 @@ class Scheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        self._kv_dirty.set()  # wake the kv flusher so it can exit
 
     # ---------------- RPC surface ----------------
     def rpc_acquire_task(self, args, body):

@@ -678,8 +678,14 @@ class DataNode:
                  "running": st["running"]}
                 for (dp, ext, peer), st in self.pending_repairs.items()
             ]
+        with self._lock:
+            native_ops = (self._native_lib.ds_op_count(self._native_h)
+                          if self._native_h is not None else 0)
         return {"node_id": self.node_id, "partitions": sorted(self.partitions),
-                "pending_repairs": pending}
+                "pending_repairs": pending,
+                "disks": self.disk_report(),
+                "native_read_ops": native_ops,
+                "native_read_addr": self.native_addr}
 
     # ---------------- binary packet plane (proto/packet.go analog) -----
     # The HOT data path speaks the 64-byte-header binary protocol over

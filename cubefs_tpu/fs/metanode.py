@@ -1351,6 +1351,20 @@ class MetaNode:
         with mp._lock:
             return {"inos": sorted(mp.inodes)}
 
+    def rpc_stat(self, args, body):
+        """Node-level stats (console/CLI): partitions, raft roles, and
+        the native read plane's serve counter."""
+        with self._lock:
+            parts = {pid: {"start": mp.start, "end": mp.end,
+                           "role": (self.rafts[pid].status()["role"]
+                                    if pid in self.rafts else "standalone")}
+                     for pid, mp in self.partitions.items()}
+        native_ops = (self._native_lib.ms_op_count(self._native_h)
+                      if self._native_h is not None else 0)
+        return {"node_id": self.node_id, "partitions": parts,
+                "native_read_ops": native_ops,
+                "native_read_addr": self.native_addr}
+
     def rpc_mp_fill(self, args, body):
         mp = self._mp_leader(args["pid"])
         with mp._lock:

@@ -489,3 +489,46 @@ def test_mq_compacts_acked_prefix(tmp_path):
     mq3 = MessageQueue(str(tmp_path / "q"), topic="t")
     got = [m["i"] for _, m in mq3.poll(500)]
     assert got[0] <= 130 and got[-1] == 249  # replay, never loss
+
+
+def test_scheduler_checkpoints_into_cm_kv(tmp_path):
+    """Without a data_dir the scheduler checkpoints task state into the
+    clustermgr's replicated kvmgr (the reference's design): a brand-new
+    scheduler over the same clustermgr restores the tasks, leases reset
+    to pending."""
+    from cubefs_tpu.blob.scheduler import Scheduler
+
+    cm = ClusterMgr(data_dir=str(tmp_path / "cm"), allow_colocated_units=True)
+    s1 = Scheduler(cm)
+    with s1._lock:
+        s1.tasks["t1"] = {"task_id": "t1", "kind": "repair",
+                          "state": "leased", "disk_id": 1}
+        s1.tasks["t2"] = {"task_id": "t2", "kind": "repair",
+                          "state": "pending", "disk_id": 2}
+    s1._kv_flush_now()  # the flusher thread's write, synchronously
+    assert cm.kv_get("sched/tasks")  # rode the replicated kvmgr
+    # a fresh scheduler (e.g. after node replacement) restores from cm
+    s2 = Scheduler(cm)
+    assert set(s2.tasks) == {"t1", "t2"}
+    assert s2.tasks["t1"]["state"] == "pending"  # lease died with s1
+    # standby-clobber guard: a scheduler constructed BEFORE the tasks
+    # existed (empty restore) must merge the kv state on its first
+    # write instead of overwriting it
+    s_empty = Scheduler.__new__(Scheduler)
+    s_empty.__init__(cm)
+    with s_empty._lock:
+        s_empty.tasks.pop("t1", None)
+        s_empty.tasks.pop("t2", None)
+        s_empty._kv_synced = False
+        s_empty.tasks["t3"] = {"task_id": "t3", "kind": "repair",
+                               "state": "pending", "disk_id": 3}
+    s_empty._kv_flush_now()
+    import json as _json
+    merged = _json.loads(cm.kv_get("sched/tasks"))
+    assert set(merged) == {"t1", "t2", "t3"}, "kv state clobbered"
+    # and a cm RESTART preserves the checkpoint (kvmgr persistence)
+    cm.snapshot()
+    cm2 = ClusterMgr(data_dir=str(tmp_path / "cm"),
+                     allow_colocated_units=True)
+    s3 = Scheduler(cm2)
+    assert set(s3.tasks) == {"t1", "t2", "t3"}
