@@ -147,6 +147,7 @@ class Scheduler:
             self._kv_synced = True
         except Exception as e:
             self._kv_synced = False  # re-merge before the next write
+            self._kv_dirty.set()  # the flusher RETRIES (with backoff)
             if not self._kv_warned:
                 self._kv_warned = True
                 import sys
@@ -158,9 +159,19 @@ class Scheduler:
         while True:
             self._kv_dirty.wait()
             if self._stop.is_set():
+                # drain the final checkpoint on graceful shutdown — a
+                # transition requested just before stop() must not be
+                # silently dropped (e.g. a manually queued migration)
+                if self._kv_dirty.is_set():
+                    self._kv_dirty.clear()
+                    self._kv_flush_now()
                 return
             self._kv_dirty.clear()
             self._kv_flush_now()  # bursts batch into one commit
+            if not self._kv_synced:
+                # failed write re-set the dirty flag: back off instead
+                # of hot-looping against a leaderless cm
+                self._stop.wait(1.0)
 
     # ---------------- task generation ----------------
     def collect_broken_disks(self) -> list[int]:

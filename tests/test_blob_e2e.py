@@ -514,8 +514,7 @@ def test_scheduler_checkpoints_into_cm_kv(tmp_path):
     # standby-clobber guard: a scheduler constructed BEFORE the tasks
     # existed (empty restore) must merge the kv state on its first
     # write instead of overwriting it
-    s_empty = Scheduler.__new__(Scheduler)
-    s_empty.__init__(cm)
+    s_empty = Scheduler(cm)
     with s_empty._lock:
         s_empty.tasks.pop("t1", None)
         s_empty.tasks.pop("t2", None)

@@ -403,3 +403,56 @@ def test_console_graphql_admin_surface(cluster):
     finally:
         con.stop()
         msrv.stop()
+
+
+def test_cli_cm_and_mq_groups(tmp_path, capsys):
+    """Round-5 CLI groups: clustermgr managers + replicated-bus status."""
+    import json as _json
+
+    from cubefs_tpu import cli
+    from cubefs_tpu.blob.clustermgr import ClusterMgr
+    from cubefs_tpu.blob.mq import ReplicatedQueue
+    from cubefs_tpu.utils import rpc as rpclib
+    from cubefs_tpu.utils.rpc import NodePool
+
+    cm = ClusterMgr(allow_colocated_units=True)
+    srv = rpclib.RpcServer(cm, service="cm").start()
+    try:
+        cli.main(["cm", "config-set", "scrub.on", "yes",
+                  "--clustermgr", srv.addr])
+        cli.main(["cm", "config-get", "scrub.on", "--clustermgr", srv.addr])
+        assert _json.loads(capsys.readouterr().out.strip())["value"] == "yes"
+        cli.main(["cm", "kv-set", "a/k", "v", "--clustermgr", srv.addr])
+        cli.main(["cm", "kv-list", "--clustermgr", srv.addr,
+                  "--prefix", "a/"])
+        assert "a/k" in capsys.readouterr().out
+        cli.main(["cm", "scope-alloc", "sid", "7", "--clustermgr", srv.addr])
+        assert _json.loads(capsys.readouterr().out.strip())["start"] == 1
+    finally:
+        srv.stop()
+
+    pool = NodePool()
+    h = type("H", (), {"extra_routes": {}})()
+    msrv = rpclib.RpcServer(h, service="mq").start()
+    q = ReplicatedQueue("repair", msrv.addr, [msrv.addr], pool,
+                        n_partitions=1)
+    h.extra_routes = dict(q.extra_routes)
+    h.extra_routes["mq_status"] = lambda a, b: {"repair": q.status()}
+    try:
+        import time as _t
+
+        deadline = _t.time() + 10
+        while _t.time() < deadline:
+            if q.rafts[0].status()["role"] == "leader":
+                break
+            _t.sleep(0.05)
+        q.put({"vid": 1})
+        cli.main(["mq", "backlog", "--member", msrv.addr])
+        out = _json.loads(capsys.readouterr().out.strip())
+        assert out == {"repair": 1}
+        cli.main(["mq", "status", "--member", msrv.addr,
+                  "--topic", "repair"])
+        assert "partitions" in capsys.readouterr().out
+    finally:
+        q.stop()
+        msrv.stop()
