@@ -792,11 +792,17 @@ class ObjectNode:
                     keys, prefixes, next_token, truncated = outer._list_v2(
                         fs, prefix, delimiter, max_keys, token
                     )
-                    items = "".join(
-                        f"<Contents><Key>{xs.escape(k)}</Key>"
-                        f"<Size>{sz}</Size></Contents>"
-                        for k, sz in keys
-                    )
+                    def _entry(k, sz, mt, et):
+                        # sync tools key their change detection on
+                        # ETag + LastModified in listings
+                        tag = f"<ETag>\"{et}\"</ETag>" if et else ""
+                        return (f"<Contents><Key>{xs.escape(k)}</Key>"
+                                f"<Size>{sz}</Size>"
+                                f"<LastModified>"
+                                f"{s3version.iso8601(mt)}</LastModified>"
+                                f"{tag}</Contents>")
+
+                    items = "".join(_entry(*t) for t in keys)
                     cps = "".join(
                         f"<CommonPrefixes><Prefix>{xs.escape(p)}</Prefix>"
                         f"</CommonPrefixes>"
@@ -1517,8 +1523,11 @@ class ObjectNode:
                     raise
         fs.write_file("/" + key, data)
 
-    def _list_objects(self, fs: FileSystem, prefix: str) -> list[tuple[str, int]]:
-        out: list[tuple[str, int]] = []
+    def _list_objects(self, fs: FileSystem, prefix: str) -> list[tuple]:
+        """Sorted (key, size, mtime, etag) for every object under
+        prefix — everything a listing entry needs, from the ONE inode
+        fetch the walk already performs."""
+        out: list[tuple] = []
 
         def walk(path: str, keybase: str):
             for name, ino in sorted(fs.readdir(path or "/").items()):
@@ -1529,7 +1538,13 @@ class ObjectNode:
                 if inode["type"] == mn.DIR:
                     walk(f"{path}/{name}", f"{k}/")
                 elif k.startswith(prefix):
-                    out.append((k, inode["size"]))
+                    raw = inode.get("xattr", {}).get(s3policy.XA_META)
+                    try:
+                        etag = (json.loads(raw).get("etag") or ""
+                                ) if raw else ""
+                    except ValueError:
+                        etag = ""  # one corrupt record must not 500 listings
+                    out.append((k, inode["size"], inode["mtime"], etag))
 
         walk("", "")
         return sorted(out)
@@ -1543,7 +1558,7 @@ class ObjectNode:
         stable under concurrent writes."""
         all_keys = sorted(self._list_objects(fs, prefix))  # global order
         if token:
-            all_keys = [(k, sz) for k, sz in all_keys if k > token]
+            all_keys = [t for t in all_keys if t[0] > token]
         keys: list = []
         prefixes: list = []
         last_raw = ""
@@ -1553,7 +1568,7 @@ class ObjectNode:
             if len(keys) + len(prefixes) >= max_keys:
                 truncated = True
                 break
-            k, sz = all_keys[i]
+            k, sz, mt, et = all_keys[i]
             if delimiter:
                 rest = k[len(prefix):]
                 d = rest.find(delimiter)
@@ -1566,7 +1581,7 @@ class ObjectNode:
                         last_raw = all_keys[i][0]
                         i += 1
                     continue
-            keys.append((k, sz))
+            keys.append((k, sz, mt, et))
             last_raw = k
             i += 1
         next_token = last_raw if truncated else ""

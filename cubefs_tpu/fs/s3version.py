@@ -417,7 +417,7 @@ class VersionStore:
         newest-first within a key. `list_keys_fn(prefix)` enumerates
         live keys (the gateway's walker); archived-only keys (latest is
         a marker) are found through the archive directory itself."""
-        keys = {k for k, _ in list_keys_fn(prefix)}
+        keys = {t[0] for t in list_keys_fn(prefix)}
         # keys whose only remnants are archived versions/markers
         try:
             for qname in self.fs.readdir(f"/{VDIR}"):

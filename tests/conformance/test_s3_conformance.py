@@ -160,6 +160,15 @@ def test_etag_last_modified_and_conditionals(cli):
     assert code == 412
 
 
+def test_listings_carry_etag_and_last_modified(cli):
+    code, _, ph = cli.put_object(B, "le/obj.bin", b"listing meta")
+    etag = {k.lower(): v for k, v in ph.items()}["etag"].strip('"')
+    code, body, _ = cli.list_objects_v2(B, prefix="le/")
+    assert code == 200
+    assert f"<ETag>\"{etag}\"</ETag>".encode() in body
+    assert re.search(rb"<LastModified>20\d\d-\d\d-\d\dT", body)
+
+
 def test_list_objects_v1(cli):
     for k in ("v1/a", "v1/b", "v1/c"):
         assert cli.put_object(B, k, b"x")[0] == 200
