@@ -299,3 +299,46 @@ def test_hardlink_semantics(mnt):
     open(f"{base}/y", "wb").write(b"y")
     assert _errno_of(os.link, f"{base}/x", f"{base}/y") == errno.EEXIST
     assert os.stat(f"{base}/x").st_nlink == 1  # failed link rolled back
+
+
+# ---- fd semantics ----
+
+def test_lseek_semantics(mnt):
+    p = f"{mnt}/seek"
+    with open(p, "wb") as f:
+        f.write(b"0123456789")
+    fd = os.open(p, os.O_RDONLY)
+    try:
+        assert os.lseek(fd, -3, os.SEEK_END) == 7
+        assert os.read(fd, 10) == b"789"
+        assert os.lseek(fd, 2, os.SEEK_SET) == 2
+        assert os.lseek(fd, 3, os.SEEK_CUR) == 5
+        assert os.read(fd, 2) == b"56"
+    finally:
+        os.close(fd)
+
+
+def test_fsync_then_visible_after_reopen(mnt):
+    p = f"{mnt}/durable"
+    fd = os.open(p, os.O_CREAT | os.O_WRONLY, 0o644)
+    os.write(fd, b"must survive")
+    os.fsync(fd)
+    os.close(fd)
+    assert open(p, "rb").read() == b"must survive"
+
+
+def test_rename_between_hardlink_aliases_is_noop(mnt):
+    """POSIX rename(2): when oldpath and newpath are DIFFERENT names
+    for the SAME inode, rename does nothing and both names remain.
+    Unlike a literal same-path rename (which the kernel short-circuits)
+    this reaches the filesystem — an unlink-then-link implementation
+    would delete one of the names."""
+    base = f"{mnt}/alias"
+    os.mkdir(base)
+    open(f"{base}/a", "wb").write(b"shared")
+    os.link(f"{base}/a", f"{base}/b")
+    os.rename(f"{base}/a", f"{base}/b")
+    assert sorted(os.listdir(base)) == ["a", "b"]
+    assert open(f"{base}/a", "rb").read() == b"shared"
+    assert open(f"{base}/b", "rb").read() == b"shared"
+    assert os.stat(f"{base}/a").st_nlink == 2
