@@ -840,10 +840,10 @@ class ObjectNode:
                             fs).read_version(key, vid_q)
                     except s3version.S3VersionError as e:
                         return self._error(e.http, e.code, str(e))
+                    vct, vhdrs = outer._version_reply_headers(fs, vmeta)
                     return self._reply(
-                        200, data, ctype="application/octet-stream",
-                        headers={"x-amz-version-id": vmeta["vid"],
-                                 **self._cors(bucket)})
+                        200, data, ctype=vct,
+                        headers={**vhdrs, **self._cors(bucket)})
                 mrec, mst = outer._obj_meta_state(fs, key)
                 cond = outer._conditional(self.headers, mrec, mst)
                 if cond == 304:
@@ -1086,7 +1086,6 @@ class ObjectNode:
                     return self._error(403, "AccessDenied",
                                        ".multipart is a reserved namespace")
                 vid_q = query.get("versionId", [""])[0]
-                vid_hdr = None
                 if vid_q:
                     try:
                         vmeta = s3version.VersionStore(fs).find(key, vid_q)
@@ -1096,7 +1095,10 @@ class ObjectNode:
                         return self._error(405, "MethodNotAllowed",
                                            "version is a delete marker")
                     st = {"size": vmeta["size"]}
-                    vid_hdr = vmeta["vid"]
+                    # the VERSION's metadata, not the current object's:
+                    # HEAD ?versionId must agree with GET ?versionId
+                    mct, mhdrs = outer._version_reply_headers(fs, vmeta)
+                    cond = None
                 else:
                     try:
                         st = fs.stat("/" + key)
@@ -1108,22 +1110,20 @@ class ObjectNode:
                                 b"<Code>NoSuchKey</Code></Error>",
                                 headers={"x-amz-delete-marker": "true"})
                         return self._error(404, "NoSuchKey", key)
-                mrec, mst = outer._obj_meta_state(fs, key)
-                cond = outer._conditional(self.headers, mrec, mst)
-                if cond == 412:
-                    return self._error(412, "PreconditionFailed", key)
+                    mrec, mst = outer._obj_meta_state(fs, key)
+                    cond = outer._conditional(self.headers, mrec, mst)
+                    if cond == 412:
+                        return self._error(412, "PreconditionFailed", key)
+                    mct, mhdrs = outer._meta_reply_headers(mrec, mst)
                 # HEAD: standard Content-Length describes what GET would
                 # return; no body follows (RFC 9110)
                 code = 304 if cond == 304 else 200
                 self._audit(code, 0)
                 self.send_response(code)
-                mct, mhdrs = outer._meta_reply_headers(mrec, mst)
                 self.send_header("Content-Type", mct)
                 self.send_header("Content-Length", str(st["size"]))
                 for hk, hv in mhdrs.items():
                     self.send_header(hk, hv)
-                if vid_hdr:
-                    self.send_header("x-amz-version-id", vid_hdr)
                 self.end_headers()
 
             def do_DELETE(self):
@@ -1473,6 +1473,26 @@ class ObjectNode:
 
     def _obj_meta_headers(self, fs: FileSystem, key: str) -> tuple[str, dict]:
         return self._meta_reply_headers(*self._obj_meta_state(fs, key))
+
+    def _version_reply_headers(self, fs: FileSystem,
+                               vmeta: dict) -> tuple[str, dict]:
+        """(content-type, headers) for a SPECIFIC version: the archived
+        object file carries its XA_META xattr (xattrs travel with the
+        rename), so versions serve the same Content-Type / user
+        metadata / ETag a plain GET of that generation would — incl.
+        the 'null' version of a pre-versioning object, whose etag lives
+        only in XA_META."""
+        try:
+            raw = fs.getxattr(vmeta["path"], s3policy.XA_META)
+            rec = json.loads(raw) if raw else {}
+        except (FsError, ValueError):
+            rec = {}
+        if not rec.get("etag") and vmeta.get("etag"):
+            rec = {**rec, "etag": vmeta["etag"]}
+        st = ({"mtime": vmeta["vts"] / 1e9} if vmeta.get("vts") else None)
+        ctype, hdrs = self._meta_reply_headers(rec, st)
+        hdrs["x-amz-version-id"] = vmeta["vid"]
+        return ctype, hdrs
 
     def _conditional(self, req_headers, rec: dict,
                      st: dict | None) -> int | None:

@@ -448,3 +448,38 @@ def test_object_lock_blocks_delete(cli):
     code, body, _ = cli.request("GET", f"/{B}/lock/held2",
                                 query={"legal-hold": ""})
     assert code == 200 and b"ON" in body
+
+
+def test_versioned_get_carries_etag_last_modified(cli):
+    cli.request("PUT", f"/{B}", query={"versioning": ""},
+                body=b"<VersioningConfiguration><Status>Enabled</Status>"
+                     b"</VersioningConfiguration>")
+    code, _, h1 = cli.put_object(B, "vmeta/doc", b"v-one")
+    v1 = {k.lower(): v for k, v in h1.items()}["x-amz-version-id"]
+    cli.put_object(B, "vmeta/doc", b"v-two")
+    code, body, h = cli.get_object(B, "vmeta/doc",
+                                   query={"versionId": v1})
+    assert code == 200 and body == b"v-one"
+    hl = {k.lower(): v for k, v in h.items()}
+    import hashlib as _h
+    assert hl["etag"] == f'"{_h.md5(b"v-one").hexdigest()}"'
+    assert "last-modified" in hl
+    # HEAD ?versionId agrees with GET ?versionId (the VERSION's ETag,
+    # not the current object's)
+    code, _, hh = cli.request("HEAD", f"/{B}/vmeta/doc",
+                              query={"versionId": v1})
+    hhl = {k.lower(): v for k, v in hh.items()}
+    assert code == 200
+    assert hhl["etag"] == f'"{_h.md5(b"v-one").hexdigest()}"'
+    assert hhl.get("x-amz-version-id") == v1
+    # metadata travels with the archived version
+    code, _, tph = cli.put_object(B, "vmeta/typed", b"t1",
+                                  headers={"Content-Type": "text/x-ver",
+                                           "x-amz-meta-gen": "one"})
+    tv1 = {k.lower(): v for k, v in tph.items()}["x-amz-version-id"]
+    cli.put_object(B, "vmeta/typed", b"t2")
+    code, _, th = cli.get_object(B, "vmeta/typed",
+                                 query={"versionId": tv1})
+    thl = {k.lower(): v for k, v in th.items()}
+    assert thl["content-type"] == "text/x-ver"
+    assert thl.get("x-amz-meta-gen") == "one"
