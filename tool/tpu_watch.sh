@@ -10,7 +10,7 @@
 # Usage: tool/tpu_watch.sh [round_tag]   (default r04)
 set -u
 cd "$(dirname "$0")/.."
-TAG="${1:-r04}"
+TAG="${1:-r05}"
 ART="artifacts/BENCH_tpu_${TAG}_early.json"
 while true; do
   if timeout 90 python -c "import jax; assert jax.devices()" 2>/dev/null; then
