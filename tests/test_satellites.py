@@ -181,7 +181,7 @@ def test_fgm_replicated_group_registry(tmp_path):
         pool.bind(me, m)
         mgrs.append(m)
     try:
-        deadline = time.time() + 5
+        deadline = time.time() + 20
         leader = None
         while time.time() < deadline and leader is None:
             leader = next((m for m in mgrs if m.is_leader()
@@ -196,7 +196,7 @@ def test_fgm_replicated_group_registry(tmp_path):
         leader.register_group(1, ["fn0", "fn1"])
         leader.register_group(2, ["fn2"])
         # replicated to followers
-        deadline = time.time() + 5
+        deadline = time.time() + 20
         while time.time() < deadline:
             if all(len(m.groups) == 2 for m in mgrs):
                 break
@@ -213,7 +213,7 @@ def test_fgm_replicated_group_registry(tmp_path):
         assert leader.ring()[1] == ["fn0"]
         # leader failover: the registry survives on a new leader
         leader.raft.stop()
-        deadline = time.time() + 5
+        deadline = time.time() + 20
         new_leader = None
         while time.time() < deadline and new_leader is None:
             new_leader = next(
