@@ -195,7 +195,8 @@ class ObjectNode:
                         xattr = fs.meta.inode_get(fs.resolve("/"))["xattr"]
                         conf = {k: xattr.get(k) for k in
                                 (s3policy.XA_ACL, s3policy.XA_POLICY,
-                                 s3policy.XA_CORS, s3policy.XA_LIFECYCLE)}
+                                 s3policy.XA_CORS, s3policy.XA_LIFECYCLE,
+                                 s3version.XA_VERSIONING)}
                     except FsError:
                         conf = {}
                 self._conf_cache = (bucket, conf)
@@ -865,9 +866,8 @@ class ObjectNode:
                         # IGNORED (full 200 body), never an error
                         span = None
                 try:
-                    if span is not None:
-                        st = fs.stat("/" + key)
-                        size = st["size"]
+                    if span is not None and mst is not None:
+                        size = mst["size"]  # the inode already fetched
                         lo, hi = span
                         if lo is None:  # suffix range: last N bytes
                             lo, hi = max(0, size - hi), size - 1
@@ -882,6 +882,8 @@ class ObjectNode:
                         data = fs.read_file("/" + key, offset=lo,
                                             length=hi - lo + 1)
                         mct, mhdrs = outer._meta_reply_headers(mrec, mst)
+                        mhdrs = outer._null_vid_backfill(self, bucket,
+                                                         mhdrs)
                         return self._reply(
                             206, data, ctype=mct,
                             headers={"Content-Range":
@@ -903,6 +905,7 @@ class ObjectNode:
                             headers={"x-amz-delete-marker": "true"})
                     return self._error(404, "NoSuchKey", key)
                 mct, mhdrs = outer._meta_reply_headers(mrec, mst)
+                mhdrs = outer._null_vid_backfill(self, bucket, mhdrs)
                 self._reply(200, data, ctype=mct,
                             headers={**mhdrs, **self._cors(bucket)})
 
@@ -1100,9 +1103,8 @@ class ObjectNode:
                     mct, mhdrs = outer._version_reply_headers(fs, vmeta)
                     cond = None
                 else:
-                    try:
-                        st = fs.stat("/" + key)
-                    except FsError:
+                    mrec, mst = outer._obj_meta_state(fs, key)
+                    if mst is None:
                         if s3version.VersionStore(fs).latest_is_marker(key):
                             return self._reply(
                                 404,
@@ -1110,11 +1112,12 @@ class ObjectNode:
                                 b"<Code>NoSuchKey</Code></Error>",
                                 headers={"x-amz-delete-marker": "true"})
                         return self._error(404, "NoSuchKey", key)
-                    mrec, mst = outer._obj_meta_state(fs, key)
+                    st = mst  # one inode fetch covers size + headers
                     cond = outer._conditional(self.headers, mrec, mst)
                     if cond == 412:
                         return self._error(412, "PreconditionFailed", key)
                     mct, mhdrs = outer._meta_reply_headers(mrec, mst)
+                    mhdrs = outer._null_vid_backfill(self, bucket, mhdrs)
                 # HEAD: standard Content-Length describes what GET would
                 # return; no body follows (RFC 9110)
                 code = 304 if cond == 304 else 200
@@ -1447,15 +1450,34 @@ class ObjectNode:
         return headers.get("Content-Type"), meta
 
     def _obj_meta_state(self, fs: FileSystem, key: str) -> tuple[dict, dict | None]:
-        """ONE fetch of (metadata record, stat) shared by conditional
-        evaluation and reply-header construction — GET/HEAD must not
-        pay the metanode round-trips twice."""
-        rec = self._obj_meta(fs, key)
+        """ONE inode fetch supplying everything the reply needs —
+        metadata record, size/mtime AND the live version id (its xattr
+        rides the same inode) — shared by conditional evaluation and
+        reply-header construction. Replaces what used to be two
+        resolve+inode_get pairs per GET/HEAD."""
         try:
-            st = fs.stat("/" + key)
+            inode = fs.stat("/" + key)  # walk with stat=True: ONE RPC
         except FsError:
-            st = None
-        return rec, st
+            return {}, None
+        xa = inode.get("xattr") or {}
+        raw = xa.get(s3policy.XA_META)
+        try:
+            rec = json.loads(raw) if raw else {}
+        except ValueError:
+            rec = {}  # corrupt record degrades to missing metadata
+        vid = xa.get(s3version.XA_VID)
+        if vid:
+            rec = {**rec, "_vid": vid}
+        return rec, {"size": inode["size"], "mtime": inode["mtime"]}
+
+    def _null_vid_backfill(self, handler, bucket: str, hdrs: dict) -> dict:
+        """AWS: on a versioning-configured bucket, plain GET/HEAD of a
+        pre-versioning object reports x-amz-version-id: null — the same
+        id ListObjectVersions and GET ?versionId=null use for it."""
+        if "x-amz-version-id" not in hdrs and handler._bucket_conf(
+                bucket).get(s3version.XA_VERSIONING):
+            hdrs = {**hdrs, "x-amz-version-id": "null"}
+        return hdrs
 
     def _meta_reply_headers(self, rec: dict,
                             st: dict | None) -> tuple[str, dict]:
@@ -1467,6 +1489,10 @@ class ObjectNode:
                 for k, v in (rec.get("meta") or {}).items()}
         if rec.get("etag"):
             hdrs["ETag"] = f'"{rec["etag"]}"'
+        if rec.get("_vid"):
+            # versioned buckets return the LIVE version's id on plain
+            # GET/HEAD (AWS behavior sync tools rely on)
+            hdrs["x-amz-version-id"] = rec["_vid"]
         if st is not None:
             hdrs["Last-Modified"] = _http_date(st["mtime"])
         return ctype, hdrs

@@ -483,3 +483,19 @@ def test_versioned_get_carries_etag_last_modified(cli):
     thl = {k.lower(): v for k, v in th.items()}
     assert thl["content-type"] == "text/x-ver"
     assert thl.get("x-amz-meta-gen") == "one"
+
+
+def test_plain_get_head_return_live_version_id(cli):
+    code, _, _ = cli.request(
+        "PUT", f"/{B}", query={"versioning": ""},
+        body=b"<VersioningConfiguration><Status>Enabled</Status>"
+             b"</VersioningConfiguration>")
+    assert code == 200  # self-contained: don't depend on test order
+    code, _, ph = cli.put_object(B, "vlive/obj", b"live")
+    vid = {k.lower(): v for k, v in ph.items()}["x-amz-version-id"]
+    code, _, h = cli.get_object(B, "vlive/obj")
+    assert {k.lower(): v for k, v in h.items()}.get(
+        "x-amz-version-id") == vid
+    code, _, hh = cli.head_object(B, "vlive/obj")
+    assert {k.lower(): v for k, v in hh.items()}.get(
+        "x-amz-version-id") == vid
