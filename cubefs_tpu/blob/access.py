@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextvars
 import threading
+import uuid
 import zlib
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from dataclasses import dataclass, field
@@ -103,9 +104,11 @@ class AccessHandler:
             vol = VolumeInfo.from_dict(meta["volume"])
             min_bid = meta["min_bid"]
         else:
-            meta, _ = self.cm.call("alloc_volume", {"codemode": mode})
+            meta, _ = self.cm.call("alloc_volume", {"codemode": mode,
+                                                    "op_id": uuid.uuid4().hex})
             vol = VolumeInfo.from_dict(meta["volume"])
-            meta, _ = self.cm.call("alloc_bids", {"count": len(blobs)})
+            meta, _ = self.cm.call("alloc_bids", {"count": len(blobs),
+                                                  "op_id": uuid.uuid4().hex})
             min_bid = meta["start"]
 
         # ---- batched device encode: group equal shard sizes ----

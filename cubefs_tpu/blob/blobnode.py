@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 
 import numpy as np
 
@@ -37,7 +38,8 @@ class BlobNode:
         """Register every disk with clustermgr and open its store."""
         for path in self._disk_paths:
             meta, _ = self.cm.call(
-                "register_disk", {"node_addr": self.addr, "path": path}
+                "register_disk", {"node_addr": self.addr, "path": path,
+                                  "op_id": uuid.uuid4().hex}
             )
             disk_id = meta["disk_id"]
             self.stores[disk_id] = ChunkStore(path)

@@ -101,12 +101,18 @@ class ClusterMgr(ReplicatedFsm):
             return getattr(self, f"_apply_{op}")(**rec)
 
     # ---------------- disks & nodes ----------------
-    def register_disk(self, node_addr: str, path: str) -> int:
+    def register_disk(self, node_addr: str, path: str,
+                      op_id: str | None = None) -> int:
         # ids allocate INSIDE apply: a new leader whose apply stream lags
-        # must never re-issue an id another leader already committed
+        # must never re-issue an id another leader already committed.
+        # op_id dedups transport retries — without it a retried register
+        # mints a second disk_id for the same physical disk.
         with self._propose_lock:
-            return self._commit({"op": "register_disk",
-                                 "node_addr": node_addr, "path": path})
+            rec = {"op": "register_disk", "node_addr": node_addr,
+                   "path": path}
+            if op_id is not None:
+                rec["op_id"] = op_id
+            return self._commit(rec)
 
     def _apply_register_disk(self, node_addr: str, path: str) -> int:
         disk_id = self._next_disk
@@ -148,7 +154,8 @@ class ClusterMgr(ReplicatedFsm):
             ]
 
     # ---------------- volumes ----------------
-    def alloc_volume(self, codemode: int) -> VolumeInfo:
+    def alloc_volume(self, codemode: int,
+                     op_id: str | None = None) -> VolumeInfo:
         """Create a volume: place its N+M+L chunks on distinct normal
         disks (distinctness waived only for single-node dev clusters)."""
         t = cm.tactic(codemode)
@@ -173,6 +180,8 @@ class ClusterMgr(ReplicatedFsm):
                 "picks": [{"disk_id": p.disk_id, "node_addr": p.node_addr}
                           for p in picks],
             }
+            if op_id is not None:
+                rec["op_id"] = op_id
             vid = self._commit(rec)
             return self.get_volume(vid)
 
@@ -258,22 +267,28 @@ class ClusterMgr(ReplicatedFsm):
     # "bid" scope; any subsystem can carve its own id space without a
     # new FSM op. Allocation happens inside apply, so a lagging new
     # leader can never re-issue a committed range.
-    def alloc_bids(self, count: int) -> int:
+    def alloc_bids(self, count: int, op_id: str | None = None) -> int:
         with self._propose_lock:
-            return self._commit({"op": "alloc_bids", "count": count})
+            rec = {"op": "alloc_bids", "count": count}
+            if op_id is not None:
+                rec["op_id"] = op_id
+            return self._commit(rec)
 
     def _apply_alloc_bids(self, count: int) -> int:
         # BIDs ARE the "bid" scope: both APIs draw from one counter, so
         # neither can ever re-issue a range the other handed out
         return self._apply_alloc_scope("bid", count)
 
-    def alloc_scope(self, name: str, count: int = 1) -> int:
+    def alloc_scope(self, name: str, count: int = 1,
+                    op_id: str | None = None) -> int:
         """First id of a freshly committed [start, start+count) range."""
         if count < 1:
             raise ValueError("count must be >= 1")
         with self._propose_lock:
-            return self._commit({"op": "alloc_scope", "name": name,
-                                 "count": count})
+            rec = {"op": "alloc_scope", "name": name, "count": count}
+            if op_id is not None:
+                rec["op_id"] = op_id
+            return self._commit(rec)
 
     def _apply_alloc_scope(self, name: str, count: int) -> int:
         if name == "bid" and "bid" not in self.scopes:
@@ -288,6 +303,12 @@ class ClusterMgr(ReplicatedFsm):
     def scope_watermark(self, name: str) -> int:
         """Next unissued id for a scope (inspection/CLI)."""
         with self._lock:
+            if name == "bid" and "bid" not in self.scopes:
+                # scope unseeded (no alloc since the pre-scope era): the
+                # legacy counter is still the authority, same fallback
+                # _apply_alloc_scope seeds from — reporting 1 here would
+                # claim already-issued BIDs as unissued
+                return self._next_bid
             return self.scopes.get(name, 1)
 
     # ---------------- service registry & config ----------------
@@ -490,7 +511,8 @@ class ClusterMgr(ReplicatedFsm):
     # ---------------- RPC surface ----------------
     def rpc_register_disk(self, args, body):
         self._leader_gate()
-        return {"disk_id": self.register_disk(args["node_addr"], args["path"])}
+        return {"disk_id": self.register_disk(args["node_addr"], args["path"],
+                                              op_id=args.get("op_id"))}
 
     def rpc_heartbeat(self, args, body):
         self.heartbeat(args["disk_ids"], args.get("chunk_counts"))
@@ -498,7 +520,8 @@ class ClusterMgr(ReplicatedFsm):
 
     def rpc_alloc_volume(self, args, body):
         self._leader_gate()
-        return {"volume": self.alloc_volume(args["codemode"]).to_dict()}
+        return {"volume": self.alloc_volume(
+            args["codemode"], op_id=args.get("op_id")).to_dict()}
 
     def rpc_get_volume(self, args, body):
         self._leader_gate()
@@ -506,7 +529,8 @@ class ClusterMgr(ReplicatedFsm):
 
     def rpc_alloc_bids(self, args, body):
         self._leader_gate()
-        return {"start": self.alloc_bids(args["count"])}
+        return {"start": self.alloc_bids(args["count"],
+                                         op_id=args.get("op_id"))}
 
     def rpc_set_disk_status(self, args, body):
         self.set_disk_status(args["disk_id"], args["status"])
@@ -574,7 +598,8 @@ class ClusterMgr(ReplicatedFsm):
     def rpc_alloc_scope(self, args, body):
         self._leader_gate()
         return {"start": self.alloc_scope(args["name"],
-                                          int(args.get("count", 1)))}
+                                          int(args.get("count", 1)),
+                                          op_id=args.get("op_id"))}
 
     def rpc_scope_watermark(self, args, body):
         return {"next": self.scope_watermark(args["name"])}

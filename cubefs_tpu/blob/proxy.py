@@ -10,6 +10,7 @@ path doesn't pay a control-plane round trip per blob.
 from __future__ import annotations
 
 import threading
+import uuid
 
 from ..utils import rpc
 from .types import VolumeInfo
@@ -42,7 +43,8 @@ class ProxyAllocator:
                 if used + blob_count <= self.VOLUME_REUSE:
                     self._vols[mode] = (vol, used + blob_count)
                     return vol
-        meta, _ = self.cm.call("alloc_volume", {"codemode": mode})
+        meta, _ = self.cm.call("alloc_volume", {"codemode": mode,
+                                                "op_id": uuid.uuid4().hex})
         vol = VolumeInfo.from_dict(meta["volume"])
         with self._lock:
             # another thread may have installed a fresher volume; ours
@@ -57,7 +59,8 @@ class ProxyAllocator:
                 self._bid_next += count
                 return first
         batch = max(self.BID_BATCH, count)
-        meta, _ = self.cm.call("alloc_bids", {"count": batch})
+        meta, _ = self.cm.call("alloc_bids", {"count": batch,
+                                              "op_id": uuid.uuid4().hex})
         with self._lock:
             # install the fresh lease; serve this request from its head
             self._bid_next = meta["start"] + count

@@ -152,7 +152,8 @@ class MetaWrapper:
         for step in range(len(mps)):
             mp = mps[(offset + step) % len(mps)]
             try:
-                ino = self._call(mp, "alloc_ino", {})[0]["ino"]
+                ino = self._call(mp, "alloc_ino",
+                                 {"op_id": uuid.uuid4().hex})[0]["ino"]
             except FsError as e:
                 if e.errno == 28:  # inode range exhausted
                     last = e
@@ -518,7 +519,9 @@ class ExtentClient:
             if stream is None:
                 dp = self._pick_dp()
                 leader = self.nodes.get(dp["leader"])
-                eid = leader.call("alloc_extent", {"dp_id": dp["dp_id"]})[0]["extent_id"]
+                eid = leader.call("alloc_extent",
+                                  {"dp_id": dp["dp_id"],
+                                   "op_id": uuid.uuid4().hex})[0]["extent_id"]
                 ext_off = 0
             else:
                 dp, eid, ext_off = stream
@@ -549,17 +552,31 @@ class ExtentClient:
         tiny extents and tiny-extent space compaction (punch-hole) are
         future work — fsck reports wholly-dead tiny extents meanwhile."""
         # reserve the (dp, extent, offset) range under the lock; the
-        # networked write + meta commit run OUTSIDE it so concurrent
-        # small-file writes overlap in flight but never in offsets
-        with self._tiny_lock:
-            tiny = self._tiny
-            if tiny is None or tiny[2] + len(data) > self.TINY_EXTENT_CAP:
-                dp = self._pick_dp()
-                eid = self.nodes.get(dp["leader"]).call(
-                    "alloc_extent", {"dp_id": dp["dp_id"]})[0]["extent_id"]
-                tiny = (dp, eid, 0)
-            dp, eid, off = tiny
-            self._tiny = (dp, eid, off + len(data))
+        # networked write + meta commit — AND the alloc_extent RPC when
+        # the shared extent rolls — run OUTSIDE it, so one slow datanode
+        # round-trip never stalls every concurrent small-file write
+        while True:
+            with self._tiny_lock:
+                tiny = self._tiny
+                if (tiny is not None
+                        and tiny[2] + len(data) <= self.TINY_EXTENT_CAP):
+                    dp, eid, off = tiny
+                    self._tiny = (dp, eid, off + len(data))
+                    break
+            # shared extent absent/full: allocate a replacement without
+            # holding the lock, then race to install it. A loser's spare
+            # extent stays empty (fsck reports it wholly dead); the
+            # loser re-checks and packs into the winner's extent.
+            dp = self._pick_dp()
+            eid = self.nodes.get(dp["leader"]).call(
+                "alloc_extent", {"dp_id": dp["dp_id"],
+                                 "op_id": uuid.uuid4().hex})[0]["extent_id"]
+            with self._tiny_lock:
+                cur = self._tiny
+                if cur is None or cur[2] + len(data) > self.TINY_EXTENT_CAP:
+                    off = 0
+                    self._tiny = (dp, eid, len(data))
+                    break
         self._leader_write(dp, eid, off, data)
         meta.append_extents(
             ino,

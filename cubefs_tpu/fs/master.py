@@ -587,6 +587,11 @@ class Master(ReplicatedFsm):
     def _create_volume_locked(self, name: str, mp_count: int, dp_count: int) -> dict:
         if mp_count < 1 or dp_count < 1:
             raise MasterError("mp_count and dp_count must be >= 1")
+        # Phase 1 — plan under the hot lock: dup-check, liveness, host
+        # selection, id allocation. NO RPC in here: heartbeats contend
+        # on _lock, so a slow node round-trip under it stalls liveness
+        # tracking for the whole cluster. _propose_lock (held by our
+        # caller) keeps the plan valid until commit.
         with self._lock:
             if name in self.volumes:
                 raise MasterError(f"volume {name!r} exists")
@@ -611,23 +616,39 @@ class Master(ReplicatedFsm):
                                            meta_replicas, meta_load)
                 for a in addrs:
                     meta_load[a] = meta_load.get(a, 0) + 1
-                    self.nodes.get(a).call(
-                        "create_partition",
-                        {"pid": pid, "start": start, "end": end, "peers": addrs},
-                    )
                 mps.append({"pid": pid, "start": start, "end": end,
                             "addr": addrs[0], "addrs": addrs})
 
             dps = []
             intra_load: dict[str, int] = {}
             for i in range(dp_count):
-                dps.append(self._create_dp(live_data, intra_load))
+                dps.append(self._plan_dp(live_data, intra_load))
             vol = {"name": name, "mps": mps, "dps": dps, "status": "active"}
+        # Phase 2 — issue the partition creates lock-free (safe to
+        # retry: nodes treat a duplicate create of a known pid/dp_id as
+        # get-or-refresh). A failure aborts before commit, leaving only
+        # idempotently re-creatable partitions behind.
+        for m in mps:
+            for a in m["addrs"]:
+                self.nodes.get(a).call(
+                    "create_partition",
+                    {"pid": m["pid"], "start": m["start"], "end": m["end"],
+                     "peers": m["addrs"]},
+                )
+        for d in dps:
+            for addr in d["replicas"]:
+                self.nodes.get(addr).call(
+                    "create_partition",
+                    {"dp_id": d["dp_id"], "peers": d["replicas"],
+                     "leader": d["leader"]},
+                )
         # commit the volume table through the FSM door (wal or raft)
         self._commit({"op": "put_volume", "name": name, "vol": vol})
         return self.client_view(name)
 
-    def _create_dp(self, live_data: list[str], intra_load: dict | None = None) -> dict:
+    def _plan_dp(self, live_data: list[str], intra_load: dict | None = None) -> dict:
+        """Place one dp — pure planning, caller holds _lock; the
+        create_partition RPCs go out after the lock is released."""
         dp_id = self._next_dp
         self._next_dp += 1
         k = min(self.replicas, len(live_data))
@@ -650,11 +671,6 @@ class Master(ReplicatedFsm):
             for a in picks:
                 intra_load[a] = intra_load.get(a, 0) + 1
             intra_load[leader] = intra_load.get(leader, 0) + 1
-        for addr in picks:
-            self.nodes.get(addr).call(
-                "create_partition",
-                {"dp_id": dp_id, "peers": picks, "leader": leader},
-            )
         return {"dp_id": dp_id, "replicas": picks, "leader": leader}
 
     def client_view(self, name: str) -> dict:
@@ -759,6 +775,7 @@ class Master(ReplicatedFsm):
             created = []
             try:
                 for a in addrs:
+                    # lint: allow[CFL002] _propose_lock is the cold proposal door, not the hot _lock (released above) — holding it keeps the split's after_end snapshot valid; only other proposers wait
                     self.nodes.get(a).call(
                         "create_partition",
                         {"pid": pid, "start": start, "end": end,
@@ -769,6 +786,7 @@ class Master(ReplicatedFsm):
                 # orphan partitions on the nodes that did succeed
                 for a in created:
                     try:
+                        # lint: allow[CFL002] same cold proposal door as the create above — rollback must finish before another proposer reuses the range
                         self.nodes.get(a).call("drop_partition",
                                                {"pid": pid})
                     except Exception:

@@ -80,6 +80,7 @@ class MetaPartition:
         self._seg_crcs: dict[str, int] = {}
         self._oplog_records = 0
         self._op_cache: dict[str, tuple] = {}  # op_id -> (result, err)
+        self._alloc_cache: dict[str, int] = {}  # alloc op_id -> ino
         # advisory enforcement flags pushed by the master's quota sweep
         # (meta_quota_manager.go analog) — NOT part of the FSM: they gate
         # the leader's submit door, never the deterministic apply
@@ -265,14 +266,22 @@ class MetaPartition:
         elif op in ("mk_dentry", "rm_dentry"):
             self._mirror_dentry(r["parent"], r["name"])
         elif op == "rename_local":
-            self._mirror_dentry(r["src_parent"], r["src_name"])
+            # add-before-delete: put the dst dentry first, then drop the
+            # src. The native read plane sees each mirror call
+            # individually — delete-first opens a window where the file
+            # is reachable under NEITHER name (a native lookup racing
+            # the rename gets spurious ENOENT)
             self._mirror_dentry(r["dst_parent"], r["dst_name"])
+            self._mirror_dentry(r["src_parent"], r["src_name"])
         elif op in ("append_extents", "set_attr", "set_xattr", "truncate"):
             self._mirror_inode(r["ino"])
         elif op == "tx_commit":
-            for o in self._last_tx_ops or ():
-                if o["kind"] in ("guard_empty_dir", "mutex"):
-                    continue
+            # same add-before-delete discipline for cross-partition
+            # renames: replay the dst links before the src removals so
+            # native readers never observe the no-name window
+            ops = [o for o in self._last_tx_ops or ()
+                   if o["kind"] not in ("guard_empty_dir", "mutex")]
+            for o in sorted(ops, key=lambda o: o["kind"] != "link"):
                 self._mirror_dentry(o["parent"], o["name"])
             self._last_tx_ops = None
 
@@ -417,14 +426,26 @@ class MetaPartition:
                         pass  # op failed identically at original apply time
 
     # ---------------- inode ops ----------------
-    def alloc_ino(self) -> int:
+    def alloc_ino(self, op_id: str | None = None) -> int:
+        """Reserve the next free inode number. The reservation is local
+        (not replicated — the ino only becomes durable via the mk_inode
+        submit), but a transport retry must get the SAME ino back, or
+        the lost first reservation leaks a number from the range and
+        the client may observe two different inos for one create."""
         with self._lock:
+            if op_id is not None and op_id in self._alloc_cache:
+                return self._alloc_cache[op_id]
             while self._next_ino in self.inodes or self._next_ino == ROOT_INO:
                 self._next_ino += 1
             if self._next_ino >= self.end:
                 raise MetaError(28, f"mp {self.pid} inode range exhausted")
             ino = self._next_ino
             self._next_ino += 1  # reserve: concurrent creates stay unique
+            if op_id is not None:
+                self._alloc_cache[op_id] = ino
+                if len(self._alloc_cache) > self.OP_CACHE_SIZE:
+                    for k in list(self._alloc_cache)[: self.OP_CACHE_SIZE // 2]:
+                        del self._alloc_cache[k]
             return ino
 
     def _apply_mk_inode(self, r: dict) -> dict:
@@ -970,11 +991,13 @@ class MetaNode:
                 mp = MetaPartition(pid, start, end, pdir)
                 self.partitions[pid] = mp
                 if self._native_h is not None:
+                    # lint: allow[CFL003] one-time partition registration (cold path); the pid serves nothing until this returns, so nobody is blocked
                     self._native_lib.ms_add_partition(
                         self._native_h, pid, start, end)
                     mp.attach_mirror(self._native_lib, self._native_h)
                     if not replicated:
                         # standalone partitions always leader-serve
+                        # lint: allow[CFL003] same cold registration path — flips serving before any reader knows the pid exists
                         self._native_lib.ms_set_serving(
                             self._native_h, pid, 1, b"")
                 if replicated:
@@ -1154,7 +1177,7 @@ class MetaNode:
         if self.pool is None:
             return False
         try:
-            rpc.call_replicas(
+            rpc.call_replicas(  # lint: allow[CFR001] record carries op_id "txpush-<tx_id>" (built above) — retries dedup in MetaPartition.apply
                 self.pool, list(part.get("addrs") or []), "submit",
                 {"pid": part["pid"], "record": record}, timeout=5.0,
                 deadline=6.0)
@@ -1266,7 +1289,8 @@ class MetaNode:
 
     def rpc_alloc_ino(self, args, body):
         try:
-            return {"ino": self._mp_leader(args["pid"]).alloc_ino()}
+            return {"ino": self._mp_leader(args["pid"]).alloc_ino(
+                op_id=args.get("op_id"))}
         except MetaError as e:
             raise _rpc_err(e) from None
 
@@ -1381,6 +1405,7 @@ class MetaNode:
                 raft_node.stop()
             self.partitions.pop(pid, None)
             if self._native_h is not None:
+                # lint: allow[CFL003] teardown must drain native readers BEFORE the trees free — intentionally atomic with the partition removal
                 self._native_lib.ms_drop_partition(self._native_h, pid)
         return {}
 

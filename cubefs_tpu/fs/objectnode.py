@@ -841,7 +841,19 @@ class ObjectNode:
                             fs).read_version(key, vid_q)
                     except s3version.S3VersionError as e:
                         return self._error(e.http, e.code, str(e))
-                    vct, vhdrs = outer._version_reply_headers(fs, vmeta)
+                    # conditionals evaluate against the ADDRESSED
+                    # version's etag/mtime — revalidating a cached copy
+                    # of version V must 304 on V's etag, and an
+                    # If-Match pinned to V must not 412 just because
+                    # the live object moved on
+                    vstate = outer._version_meta_state(fs, vmeta)
+                    vct, vhdrs = outer._version_reply_headers(
+                        fs, vmeta, state=vstate)
+                    cond = outer._conditional(self.headers, *vstate)
+                    if cond == 304:
+                        return self._reply(304, headers=vhdrs)
+                    if cond == 412:
+                        return self._error(412, "PreconditionFailed", key)
                     return self._reply(
                         200, data, ctype=vct,
                         headers={**vhdrs, **self._cors(bucket)})
@@ -1099,9 +1111,15 @@ class ObjectNode:
                                            "version is a delete marker")
                     st = {"size": vmeta["size"]}
                     # the VERSION's metadata, not the current object's:
-                    # HEAD ?versionId must agree with GET ?versionId
-                    mct, mhdrs = outer._version_reply_headers(fs, vmeta)
-                    cond = None
+                    # HEAD ?versionId must agree with GET ?versionId —
+                    # including 304/412, which evaluate against the
+                    # addressed version's etag/mtime
+                    vstate = outer._version_meta_state(fs, vmeta)
+                    mct, mhdrs = outer._version_reply_headers(
+                        fs, vmeta, state=vstate)
+                    cond = outer._conditional(self.headers, *vstate)
+                    if cond == 412:
+                        return self._error(412, "PreconditionFailed", key)
                 else:
                     mrec, mst = outer._obj_meta_state(fs, key)
                     if mst is None:
@@ -1500,14 +1518,12 @@ class ObjectNode:
     def _obj_meta_headers(self, fs: FileSystem, key: str) -> tuple[str, dict]:
         return self._meta_reply_headers(*self._obj_meta_state(fs, key))
 
-    def _version_reply_headers(self, fs: FileSystem,
-                               vmeta: dict) -> tuple[str, dict]:
-        """(content-type, headers) for a SPECIFIC version: the archived
-        object file carries its XA_META xattr (xattrs travel with the
-        rename), so versions serve the same Content-Type / user
-        metadata / ETag a plain GET of that generation would — incl.
-        the 'null' version of a pre-versioning object, whose etag lives
-        only in XA_META."""
+    def _version_meta_state(self, fs: FileSystem,
+                            vmeta: dict) -> tuple[dict, dict | None]:
+        """(rec, st) for a SPECIFIC version — the same shape
+        `_obj_meta_state` returns for the live object, so
+        `_conditional` evaluates 304/412 against the ADDRESSED
+        version's etag/mtime, not the current generation's."""
         try:
             raw = fs.getxattr(vmeta["path"], s3policy.XA_META)
             rec = json.loads(raw) if raw else {}
@@ -1516,6 +1532,20 @@ class ObjectNode:
         if not rec.get("etag") and vmeta.get("etag"):
             rec = {**rec, "etag": vmeta["etag"]}
         st = ({"mtime": vmeta["vts"] / 1e9} if vmeta.get("vts") else None)
+        return rec, st
+
+    def _version_reply_headers(self, fs: FileSystem, vmeta: dict,
+                               state: tuple | None = None
+                               ) -> tuple[str, dict]:
+        """(content-type, headers) for a SPECIFIC version: the archived
+        object file carries its XA_META xattr (xattrs travel with the
+        rename), so versions serve the same Content-Type / user
+        metadata / ETag a plain GET of that generation would — incl.
+        the 'null' version of a pre-versioning object, whose etag lives
+        only in XA_META. Pass `state` when the caller already fetched
+        `_version_meta_state` (avoids a second xattr round-trip)."""
+        rec, st = (state if state is not None
+                   else self._version_meta_state(fs, vmeta))
         ctype, hdrs = self._meta_reply_headers(rec, st)
         hdrs["x-amz-version-id"] = vmeta["vid"]
         return ctype, hdrs

@@ -68,7 +68,11 @@ def _parts_fn(chunk_len: int, tile_blocks: int, interpret: bool):
         r = chunks.shape[0]
         kwargs = {}
         if not interpret:
-            kwargs["compiler_params"] = pltpu.CompilerParams(
+            # renamed TPUCompilerParams -> CompilerParams across jax
+            # releases; accept either
+            params_cls = getattr(pltpu, "CompilerParams", None) or \
+                pltpu.TPUCompilerParams
+            kwargs["compiler_params"] = params_cls(
                 dimension_semantics=("parallel",)
             )
         return pl.pallas_call(

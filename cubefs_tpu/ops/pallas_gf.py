@@ -52,8 +52,11 @@ def _kernel(w_ref, x_ref, o_ref):
     # math is unchanged.
     x = x_ref[:].astype(jnp.int32)  # (N, T) bytes
     n, t = x.shape
-    planes = [((x >> k) & 1).astype(jnp.int8) for k in range(8)]
-    bits = jnp.concatenate(planes, axis=0)  # (8N, T) plane-major
+    planes = [(x >> k) & 1 for k in range(8)]
+    # one int8 convert on the concatenated block: per-plane converts of
+    # freshly shifted tiles trip older Mosaic ("multi-row shift with
+    # bitwidth != 32") and cost eight relayouts instead of one
+    bits = jnp.concatenate(planes, axis=0).astype(jnp.int8)  # (8N, T)
     w = w_ref[:]  # (8M, 8N) int8 0/1, plane-major both sides
     y = jax.lax.dot_general(
         w, bits, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
@@ -85,7 +88,11 @@ def _apply_fn(coeff_bytes: bytes, rows: int, cols: int, tile: int,
         if not interpret:
             # every grid step writes a disjoint output tile: let Mosaic
             # schedule them in any order / overlapping DMA
-            kwargs["compiler_params"] = pltpu.CompilerParams(
+            # renamed TPUCompilerParams -> CompilerParams across jax
+            # releases; accept either
+            params_cls = getattr(pltpu, "CompilerParams", None) or \
+                pltpu.TPUCompilerParams
+            kwargs["compiler_params"] = params_cls(
                 dimension_semantics=("parallel",)
             )
         return pl.pallas_call(

@@ -72,10 +72,14 @@ class RaftNode:
 
         self._lock = threading.RLock()
         # synchronous role/leader-change hook (e.g. the native meta read
-        # plane's serving flag): invoked UNDER the node lock at every
-        # transition, so listeners must be non-blocking and must never
-        # call back into this node
+        # plane's serving flag): invoked UNDER the node lock, so
+        # listeners must be non-blocking and must never call back into
+        # this node. Fired only when (role, leader) actually changes —
+        # listeners like ms_set_serving take an exclusive native lock,
+        # and re-firing on every heartbeat would block the GIL-free
+        # read plane once per heartbeat interval for no state change.
         self.role_listener = None
+        self._last_notified: tuple | None = None
         self.term = 0
         self.voted_for: str | None = None
         self.log: list[dict] = []  # entries AFTER log_base
@@ -493,12 +497,22 @@ class RaftNode:
         self._broadcast_append()
 
     def _notify_role(self) -> None:
+        # change-only: handle_append calls this on EVERY heartbeat, and
+        # an exclusive-locking listener re-fired per heartbeat is the
+        # native-read-plane stall regression. Dedup only once a
+        # listener exists, so one attached late still hears the current
+        # state on the next transition attempt.
         fn = self.role_listener
-        if fn is not None:
-            try:
-                fn(self.role, self.leader)
-            except Exception:
-                pass
+        if fn is None:
+            return
+        state = (self.role, self.leader)
+        if state == self._last_notified:
+            return
+        self._last_notified = state
+        try:
+            fn(self.role, self.leader)
+        except Exception:
+            pass
 
     def _step_down(self, term: int) -> None:
         # caller holds the lock

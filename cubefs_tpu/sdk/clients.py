@@ -9,6 +9,8 @@ console, tools, other services — never hand-roll method-name strings.
 
 from __future__ import annotations
 
+import uuid
+
 from ..utils import rpc
 
 
@@ -146,17 +148,20 @@ class ClusterMgrClient(_Base):
 
     def register_disk(self, node_addr: str, path: str) -> int:
         return self._call("register_disk", {
-            "node_addr": node_addr, "path": path})[0]["disk_id"]
+            "node_addr": node_addr, "path": path,
+            "op_id": uuid.uuid4().hex})[0]["disk_id"]
 
     def alloc_volume(self, codemode: int) -> dict:
         return self._call("alloc_volume",
-                          {"codemode": codemode})[0]["volume"]
+                          {"codemode": codemode,
+                           "op_id": uuid.uuid4().hex})[0]["volume"]
 
     def get_volume(self, vid: int) -> dict:
         return self._call("get_volume", {"vid": vid})[0]["volume"]
 
     def alloc_bids(self, count: int) -> dict:
-        return self._call("alloc_bids", {"count": count})[0]
+        return self._call("alloc_bids", {"count": count,
+                                         "op_id": uuid.uuid4().hex})[0]
 
     def get_service(self, name: str) -> dict:
         return self._call("get_service", {"name": name})[0]
@@ -196,7 +201,8 @@ class ClusterMgrClient(_Base):
     # scopemgr surface (clustermgr/scopemgr analog)
     def alloc_scope(self, name: str, count: int = 1) -> int:
         return self._call("alloc_scope",
-                          {"name": name, "count": count})[0]["start"]
+                          {"name": name, "count": count,
+                           "op_id": uuid.uuid4().hex})[0]["start"]
 
 
 class AuthClient(_Base):

@@ -14,7 +14,11 @@ Host class contract:
 Provided:
   * `_init_fsm(group_id, data_dir, me, peers, node_pool)`
   * `_commit(record)` — wal-append (atomic with apply) or raft-propose;
-    raises RpcError(421, "leader=...") on a follower
+    raises RpcError(421, "leader=...") on a follower. A record carrying
+    an `op_id` is applied at most once: duplicates (transport retries)
+    get the first outcome replayed from a bounded cache
+  * `_apply_deduped(record)` — the op_id-aware apply door (raft apply
+    fn and wal replay both route through it)
   * `is_leader` / `leader_addr` / `_leader_gate`
   * `snapshot()` — standalone wal rotation (raft compacts on its own)
 
@@ -52,6 +56,7 @@ class ReplicatedFsm:
         self._wal = None
         self._wal_lock = threading.Lock()  # apply+wal-append atomicity
         self._propose_lock = threading.Lock()  # serializes decide+commit
+        self._fsm_op_cache: dict[str, tuple] = {}  # op_id -> (result, exc)
         self.raft = None
         self.extra_routes: dict = {}
         self._fsm_dirty: set[str] = set()
@@ -63,7 +68,7 @@ class ReplicatedFsm:
             if data_dir:
                 os.makedirs(data_dir, exist_ok=True)
             self.raft = raftlib.RaftNode(
-                group_id, me, peers, self._apply, node_pool,
+                group_id, me, peers, self._apply_deduped, node_pool,
                 data_dir=os.path.join(data_dir, "raft") if data_dir else None,
                 snapshot_fn=self._state_bytes, restore_fn=self._restore_bytes,
             )
@@ -90,13 +95,49 @@ class ReplicatedFsm:
                                f"leader={self.leader_addr() or ''}")
 
     # ---------------- commit door ----------------
+    FSM_OP_CACHE_SIZE = 4096
+
+    def _apply_deduped(self, record: dict):
+        """Apply with at-most-once semantics: a record carrying an
+        `op_id` is applied once and its outcome (result or error)
+        replayed to transport-level retries — the rpc layer re-sends a
+        request whose response was lost on a stale connection, and
+        id-minting ops (alloc_*, register_disk) must not mint twice.
+        The cache is rebuilt from the same record stream on wal/raft
+        replay, so replicas and restarts agree. `op_id` is a transport
+        concern and is stripped before the host `_apply` sees the
+        record."""
+        op_id = record.get("op_id")
+        if op_id is None:
+            return self._apply(record)
+        if op_id in self._fsm_op_cache:
+            result, exc = self._fsm_op_cache[op_id]
+            if exc is not None:
+                raise exc
+            return result
+        rec = {k: v for k, v in record.items() if k != "op_id"}
+        try:
+            result = self._apply(rec)
+        except Exception as e:
+            self._fsm_remember(op_id, (None, e))
+            raise
+        self._fsm_remember(op_id, (result, None))
+        return result
+
+    def _fsm_remember(self, op_id: str, outcome: tuple) -> None:
+        self._fsm_op_cache[op_id] = outcome
+        if len(self._fsm_op_cache) > self.FSM_OP_CACHE_SIZE:
+            # drop oldest half (insertion-ordered dict)
+            for k in list(self._fsm_op_cache)[: self.FSM_OP_CACHE_SIZE // 2]:
+                del self._fsm_op_cache[k]
+
     def _commit(self, record: dict):
         if self.raft is None:
             # apply and wal-append must be one atomic step, else
             # concurrent commits can log in a different order than they
             # applied and replay to a different state
             with self._wal_lock:
-                out = self._apply(dict(record))
+                out = self._apply_deduped(dict(record))
                 if self._segmented:
                     self._fsm_dirty.update(self._segments_of(record))
                 if self._wal is not None:
@@ -152,7 +193,7 @@ class ReplicatedFsm:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
                         break  # torn tail
-                    self._apply(rec)
+                    self._apply_deduped(rec)
                     if self._segmented:
                         # replayed ops must re-dirty their segments: the
                         # store's copy predates them

@@ -262,10 +262,24 @@ def call(
     """Invoke method on a remote RpcServer; returns (meta, payload).
 
     Rides pooled keep-alive connections. A STALE reused connection
-    (peer closed while idle) is retried once on a fresh connection —
-    safe here because every mutating path is idempotent by design
-    (submits carry op_ids, raft appends/heartbeats are idempotent);
-    a TIMEOUT is never retried (the request may be executing)."""
+    (peer closed while idle) is retried once on a fresh connection; a
+    TIMEOUT is never retried (the request may be executing).
+
+    IDEMPOTENCY CONTRACT — the stale retry can re-send a request whose
+    FIRST send was already processed (the peer died after executing but
+    before responding). Every MUTATING method called through here must
+    therefore satisfy one of:
+
+      1. carry an ``op_id`` in its args/record — the server dedups it
+         and replays the first outcome (fs/metanode.py
+         MetaPartition.apply; utils/fsm.py ReplicatedFsm._apply_deduped
+         for master/clustermgr commits; alloc_ino/alloc_extent caches);
+      2. be idempotent by its own contract — absolute-value writes,
+         caller-keyed creates, sticky transitions — and be recorded
+         with a justification in tool/lint/rpc_allowlist.py.
+
+    ``python -m tool.lint`` (checker rpc-idempotency, CFR001) enforces
+    this at every call site; new unprotected mutations fail tier-1."""
     from . import trace as tracelib
 
     headers = {"X-Rpc-Args": json.dumps(args or {})}

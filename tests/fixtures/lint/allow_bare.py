@@ -1,0 +1,12 @@
+"""Suppression fixture: a bare allow[...] with no justification.
+
+Expected: CFG001 on the allow line, AND the underlying CFL001 still
+reported — an unjustified allow suppresses nothing.
+"""
+import time
+
+
+class Node:
+    def f(self):
+        with self._lock:
+            time.sleep(0.1)  # lint: allow[CFL001]

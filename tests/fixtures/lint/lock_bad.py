@@ -1,0 +1,29 @@
+"""Known-bad lock-discipline fixture: CFL001/002/003 each fire.
+
+Never imported — read as text by tests/test_lint.py and handed to the
+checker under a cubefs_tpu/fs/ relpath.
+"""
+import socket
+import time
+
+
+class Node:
+    def sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)                      # CFL001
+
+    def rpc_under_lock(self, rpc, addr):
+        with self._lock:
+            rpc.call(addr, "vol_view", {})       # CFL002
+
+    def pool_call_under_lock(self, pool, addr):
+        with self._mu:
+            pool.get(addr).call("stat", {})      # CFL002
+
+    def connect_under_lock(self, addr):
+        with self._lock:
+            socket.create_connection(addr)       # CFL002
+
+    def native_under_lock(self, lib):
+        with self._lock:
+            lib.ms_create(b"k", 0)               # CFL003

@@ -1,0 +1,23 @@
+"""Known-good lock-discipline fixture: zero findings expected.
+
+The plan-under-lock / call-outside restructuring, unlocked sleeps, and
+a closure DEFINED under a lock but called later (must not be flagged —
+the analysis is about what runs while the lock is held).
+"""
+import time
+
+
+class Node:
+    def plan_then_call(self, rpc, addr):
+        with self._lock:
+            payload = dict(self._state)          # plan under the lock
+        rpc.call(addr, "vol_view", payload)      # RPC after release
+
+    def unlocked_sleep(self):
+        time.sleep(0.1)
+
+    def closure_defined_under_lock(self):
+        with self._lock:
+            def later():
+                time.sleep(1.0)                  # runs after release
+            self._cb = later

@@ -1,0 +1,14 @@
+"""Known-bad rpc-idempotency fixture: CFR001 fires twice.
+
+Both calls name mutating ops that mint state (alloc_bids appends to a
+sequence, truncate is destructive) with no op_id and no allowlist
+entry for this fixture's relpath.
+"""
+
+
+class Client:
+    def alloc_without_token(self, cm):
+        return cm.call("alloc_bids", {"count": 8})           # CFR001
+
+    def truncate_replicas(self, rpc, pool, addrs):
+        rpc.call_replicas(pool, addrs, "truncate", {"ino": 5})  # CFR001

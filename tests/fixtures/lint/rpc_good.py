@@ -1,0 +1,19 @@
+"""Known-good rpc-idempotency fixture: zero findings expected.
+
+The three legitimate shapes: op_id threaded in the payload, a
+read-plane method, and a mutating method whose server-side contract is
+idempotent (allowlisted under ("*", "create_partition")).
+"""
+import uuid
+
+
+class Client:
+    def alloc_with_token(self, cm):
+        return cm.call("alloc_bids",
+                       {"count": 8, "op_id": uuid.uuid4().hex})
+
+    def read_only(self, cm):
+        return cm.call("volume_view", {})
+
+    def keyed_create(self, node, pid):
+        return node.call("create_partition", {"pid": pid})

@@ -1,0 +1,20 @@
+"""Known-bad tier1-purity fixture: CFP001/002/003 each fire.
+
+Module-level native builds and TPU probes run at pytest collection
+time; never imported by the real test suite.
+"""
+import ctypes
+
+import jax
+import libtpu                                    # CFP001
+
+from cubefs_tpu.runtime import build
+
+lib = build.load()                               # CFP002
+rt = ctypes.CDLL("libcubefs_rt.so")              # CFP002
+devs = jax.devices("tpu")                        # CFP003
+topo = aot_tpu.v5e_topology()                    # CFP003  # noqa: F821
+
+
+def test_uses_lib():
+    assert lib is not None

@@ -1,0 +1,11 @@
+"""tier1-purity exemption fixture: module marked slow, zero findings.
+
+A top-level ``pytestmark = pytest.mark.slow`` keeps the module out of
+tier-1 collection, so module-level TPU probes are its own business.
+"""
+import jax
+import pytest
+
+pytestmark = pytest.mark.slow
+
+devs = jax.devices("tpu")                        # exempt: slow module

@@ -1,0 +1,21 @@
+"""Known-bad tracer-safety fixture: every CFT code fires once.
+
+Never imported — read as text by tests/test_lint.py and handed to the
+checker under a cubefs_tpu/ops/ relpath.
+"""
+import jax
+import numpy as np
+
+
+@jax.jit
+def coerces_tracers(x, y):
+    a = int(x)                 # CFT001: concretizes the tracer
+    b = x.item()               # CFT002: host sync + concretization
+    c = np.asarray(y)          # CFT003: numpy on a traced value
+    x.block_until_ready()      # CFT004: host sync inside the graph
+    return a, b, c
+
+
+@jax.jit(static_argnames=("shape",))
+def unhashable_static(x, shape=[8, 8]):    # CFT005: list default
+    return x.reshape(shape)

@@ -1,0 +1,25 @@
+"""Known-good tracer-safety fixture: zero findings expected.
+
+Exercises the legitimate shapes the checker must NOT flag: coercions
+of static args, jnp (not np) conversions, numpy on closure constants,
+and host-plane helpers outside any traced function.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_W = np.asarray([1.0, 2.0])    # module level, concrete: fine
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def traced_ok(x, n=4):
+    scale = float(n)           # n is static: concrete at trace time
+    w = jnp.asarray(_W)        # jnp conversion stays on device
+    return x * scale + w[0] * jnp.sum(x) / n
+
+
+def host_helper(arr):
+    arr.block_until_ready()    # caller/benchmark boundary: not traced
+    return int(arr.sum())

@@ -113,3 +113,29 @@ def test_managers_replicated_failover(tmp_path):
                 s.stop()
             except Exception:
                 pass
+
+
+def test_scope_watermark_bid_fallback(tmp_path):
+    """Unseeded 'bid' scope (state restored from the pre-scope era):
+    the watermark must report the legacy _next_bid counter — 1 would
+    claim already-issued BIDs as unissued."""
+    cm = ClusterMgr(data_dir=str(tmp_path / "cm"))
+    cm._next_bid = 500          # as a pre-scope-era snapshot leaves it
+    assert "bid" not in cm.scopes
+    assert cm.scope_watermark("bid") == 500
+    start = cm.alloc_bids(4)    # seeding draws from the same counter
+    assert start == 500
+    assert cm.scope_watermark("bid") == 504
+
+
+def test_commit_dedups_by_op_id(tmp_path):
+    """The transport may re-send an already-processed request
+    (utils/rpc.py stale keep-alive retry); the FSM apply door must
+    absorb the duplicate instead of allocating twice."""
+    cm = ClusterMgr(data_dir=str(tmp_path / "cm"))
+    a = cm.alloc_bids(8, op_id="retry-1")
+    assert cm.alloc_bids(8, op_id="retry-1") == a   # replayed outcome
+    assert cm.alloc_bids(8, op_id="retry-2") == a + 8
+    d1 = cm.register_disk("dn1:1", "/d0", op_id="disk-1")
+    d2 = cm.register_disk("dn1:1", "/d0", op_id="disk-1")
+    assert d1 == d2 and len(cm.disks) == 1          # one physical disk

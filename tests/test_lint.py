@@ -1,0 +1,185 @@
+"""Tier-1 hook + unit tests for the cubefs-tpu lint suite (tool/lint).
+
+Each checker family gets at least one true-positive test (the known-bad
+fixture fires exactly the expected codes) and one true-negative test
+(the known-good fixture is silent). Fixtures live in
+tests/fixtures/lint/ — a directory `iter_py_files` skips, so the
+intentional violations in them never leak into a real lint run.
+
+`test_tree_is_lint_clean` is the tier-1 gate: the repo must lint clean
+under the shipped baseline, and the baseline must not carry stale
+fingerprints for findings that no longer exist.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tool.lint import cli, core
+from tool.lint.checkers.lock_discipline import LockDisciplineChecker
+from tool.lint.checkers.rpc_idempotency import (RpcIdempotencyChecker,
+                                                is_mutating)
+from tool.lint.checkers.tier1_purity import Tier1PurityChecker
+from tool.lint.checkers.tracer_safety import TracerSafetyChecker
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+
+
+def _module(fixture: str, relpath: str) -> core.Module:
+    """Parse a fixture under a relpath that puts it in a checker's dirs."""
+    with open(os.path.join(FIXTURES, fixture), encoding="utf-8") as f:
+        return core.Module(relpath, f.read())
+
+
+def _codes(violations):
+    return sorted(v.code for v in violations)
+
+
+# ---------------- tracer-safety ----------------
+
+def test_tracer_safety_true_positives():
+    mod = _module("tracer_bad.py", "cubefs_tpu/ops/fx.py")
+    found = TracerSafetyChecker().check(mod)
+    assert _codes(found) == ["CFT001", "CFT002", "CFT003", "CFT004",
+                             "CFT005"]
+
+
+def test_tracer_safety_true_negative():
+    mod = _module("tracer_good.py", "cubefs_tpu/ops/fx.py")
+    assert TracerSafetyChecker().check(mod) == []
+
+
+def test_tracer_safety_scoped_to_accel_dirs():
+    c = TracerSafetyChecker()
+    assert c.applies("cubefs_tpu/ops/pallas_gf.py")
+    assert not c.applies("cubefs_tpu/fs/master.py")
+
+
+# ---------------- lock-discipline ----------------
+
+def test_lock_discipline_true_positives():
+    mod = _module("lock_bad.py", "cubefs_tpu/fs/fx.py")
+    found = LockDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFL001", "CFL002", "CFL002", "CFL002",
+                             "CFL003"]
+
+
+def test_lock_discipline_true_negative():
+    mod = _module("lock_good.py", "cubefs_tpu/fs/fx.py")
+    assert LockDisciplineChecker().check(mod) == []
+
+
+# ---------------- rpc-idempotency ----------------
+
+def test_rpc_idempotency_true_positives():
+    mod = _module("rpc_bad.py", "cubefs_tpu/fs/fx.py")
+    found = RpcIdempotencyChecker().check(mod)
+    assert _codes(found) == ["CFR001", "CFR001"]
+
+
+def test_rpc_idempotency_true_negative():
+    mod = _module("rpc_good.py", "cubefs_tpu/fs/fx.py")
+    assert RpcIdempotencyChecker().check(mod) == []
+
+
+def test_rpc_empty_justification_is_cfr002(monkeypatch):
+    from tool.lint import rpc_allowlist
+    monkeypatch.setitem(rpc_allowlist.ALLOWLIST, ("*", "truncate"), "  ")
+    mod = _module("rpc_bad.py", "cubefs_tpu/fs/fx.py")
+    found = RpcIdempotencyChecker().check(mod)
+    # the truncate site degrades CFR001 -> CFR002; alloc_bids stays CFR001
+    assert _codes(found) == ["CFR001", "CFR002"]
+
+
+def test_rpc_allowlist_justifications_nonempty():
+    from tool.lint.rpc_allowlist import ALLOWLIST
+    for key, why in ALLOWLIST.items():
+        assert str(why).strip(), f"empty justification for {key}"
+
+
+def test_mutating_classifier():
+    assert is_mutating("alloc_bids")
+    assert is_mutating("set_quota")
+    assert is_mutating("submit")
+    assert not is_mutating("heartbeat")
+    assert not is_mutating("vol_view")
+
+
+# ---------------- tier1-purity ----------------
+
+def test_tier1_purity_true_positives():
+    mod = _module("tier1_bad.py", "tests/test_fx.py")
+    found = Tier1PurityChecker().check(mod)
+    assert _codes(found) == ["CFP001", "CFP002", "CFP002", "CFP003",
+                             "CFP003"]
+
+
+def test_tier1_purity_true_negative():
+    mod = _module("tier1_good.py", "tests/test_fx.py")
+    assert Tier1PurityChecker().check(mod) == []
+
+
+def test_tier1_purity_slow_modules_exempt():
+    mod = _module("tier1_slow_exempt.py", "tests/test_fx.py")
+    assert Tier1PurityChecker().check(mod) == []
+
+
+# ---------------- suppressions ----------------
+
+def test_bare_allow_is_cfg001_and_does_not_suppress():
+    mod = _module("allow_bare.py", "cubefs_tpu/fs/fx.py")
+    lock = LockDisciplineChecker().check(mod)
+    assert _codes(lock) == ["CFL001"]
+    assert not mod.suppressed(lock[0])          # bare allow is inert
+    assert _codes(core.bare_allow_violations(mod)) == ["CFG001"]
+
+
+def test_justified_allow_suppresses():
+    mod = _module("allow_ok.py", "cubefs_tpu/fs/fx.py")
+    lock = LockDisciplineChecker().check(mod)
+    assert _codes(lock) == ["CFL001"]
+    assert mod.suppressed(lock[0])              # comment on line above
+    assert core.bare_allow_violations(mod) == []
+
+
+# ---------------- baseline mechanics ----------------
+
+def test_baseline_roundtrip_is_a_multiset(tmp_path):
+    v = core.Violation("CFL001", "lock-discipline", "a.py", 3, "m")
+    w = core.Violation("CFL001", "lock-discipline", "a.py", 3, "m2")
+    path = str(tmp_path / "baseline.json")
+    core.save_baseline([v, w], path)
+    baseline = core.load_baseline(path)
+    assert baseline == {"CFL001:a.py:3": 2}
+    # two identical fingerprints absorbed, a third is fresh
+    fresh = core.apply_baseline([v, w, v], baseline)
+    assert len(fresh) == 1
+
+
+# ---------------- tier-1 gate: the tree itself ----------------
+
+def test_tree_is_lint_clean():
+    """The repo lints clean AND the shipped baseline has no stale
+    entries — regenerate with `python -m tool.lint --update-baseline`
+    after intentionally accepting a finding."""
+    violations, errors = cli.run_lint()
+    assert errors == [], f"unparseable files: {errors}"
+    baseline = core.load_baseline()
+    fresh = core.apply_baseline(violations, baseline)
+    assert fresh == [], "new lint findings:\n" + "\n".join(
+        v.render() for v in fresh)
+    current: dict[str, int] = {}
+    for v in violations:
+        current[v.fingerprint] = current.get(v.fingerprint, 0) + 1
+    stale = {fp: n for fp, n in baseline.items()
+             if current.get(fp, 0) < n}
+    assert not stale, f"baseline entries no longer in the tree: {stale}"
+
+
+def test_cli_entrypoint_exits_clean():
+    rc = subprocess.run(
+        [sys.executable, "-m", "tool.lint", "-q"],
+        cwd=core.REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, rc.stdout + rc.stderr

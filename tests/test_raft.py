@@ -441,3 +441,21 @@ def test_http_raft_survives_poisoned_sdk_leader_cache():
         node_b.stop()
         srv_a.stop()
         srv_b.stop()
+
+
+def test_role_listener_fires_on_change_only():
+    """handle_append runs _notify_role on EVERY heartbeat; a listener
+    must hear each (role, leader) state once, not 20x/s — re-firing an
+    exclusive-locking listener per heartbeat is the native-read-plane
+    stall regression. A listener attached late must still hear the
+    current state on the next heartbeat."""
+    members, _ = make_cluster(3)
+    try:
+        leader = wait_leader(members)
+        follower = next(m for m in members.values() if m is not leader)
+        calls = []
+        follower.node.role_listener = lambda r, l: calls.append((r, l))
+        time.sleep(12 * raft.RaftNode.HEARTBEAT)
+        assert calls == [("follower", leader.node.me)]
+    finally:
+        stop_all(members)

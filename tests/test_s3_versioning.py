@@ -307,3 +307,42 @@ def test_unversioned_bucket_unchanged(vbucket):
     assert code == 204
     code, _, gh = _req("GET", f"{base}/vb/plain")
     assert code == 404 and "x-amz-delete-marker" not in gh
+
+
+def test_versioned_conditional_get_head(vbucket):
+    """Conditional GET/HEAD of ?versionId=... must evaluate If-Match /
+    If-None-Match / If-Modified-Since against the ADDRESSED version's
+    etag and timestamp — they used to be checked against the live
+    object, so revalidating a cached archived version always 'changed'
+    and If-Match pinning never matched."""
+    base, _ = vbucket
+    _enable(base)
+    _, _, h1 = _req("PUT", f"{base}/vb/c.txt", b"one")
+    v1 = h1["x-amz-version-id"]
+    _req("PUT", f"{base}/vb/c.txt", b"two")
+    url1 = f"{base}/vb/c.txt?versionId={v1}"
+    code, _, vh = _reqh("GET", url1)
+    assert code == 200
+    etag1, lm1 = vh["ETag"], vh["Last-Modified"]
+    code, _, lh = _reqh("GET", f"{base}/vb/c.txt")
+    etag2 = lh["ETag"]
+    assert etag1 != etag2
+    # revalidation with the version's own etag: 304 on both verbs
+    code, body, ch = _reqh("GET", url1, headers={"If-None-Match": etag1})
+    assert code == 304 and body == b""
+    assert ch.get("x-amz-version-id") == v1
+    code, _, _ = _reqh("HEAD", url1, headers={"If-None-Match": etag1})
+    assert code == 304
+    # the LIVE version's etag must NOT 304 the archived one
+    code, body, _ = _reqh("GET", url1, headers={"If-None-Match": etag2})
+    assert code == 200 and body == b"one"
+    # If-Match pins to the addressed version
+    code, body, _ = _reqh("GET", url1, headers={"If-Match": etag2})
+    assert code == 412 and b"PreconditionFailed" in body
+    code, _, _ = _reqh("HEAD", url1, headers={"If-Match": etag2})
+    assert code == 412
+    code, body, _ = _reqh("GET", url1, headers={"If-Match": etag1})
+    assert code == 200 and body == b"one"
+    # Last-Modified revalidation round-trips against the version's date
+    code, _, _ = _reqh("GET", url1, headers={"If-Modified-Since": lm1})
+    assert code == 304

@@ -1,0 +1,1 @@
+"""Repo tooling (linters, watchers). Not part of the cubefs_tpu runtime."""

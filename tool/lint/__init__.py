@@ -1,0 +1,21 @@
+"""cubefs-lint: repo-specific static analysis for cubefs-tpu.
+
+Four checker families, each encoding an invariant this codebase has
+already shipped (and fixed) a bug against:
+
+  tracer-safety    Python coercions / host syncs inside jit- or
+                   Pallas-traced functions (ops/, codec/, parallel/)
+  lock-discipline  blocking or native-plane (ctypes) calls made while
+                   lexically holding a threading lock (fs/, blob/,
+                   parallel/) — the raft-heartbeat regression shape
+  rpc-idempotency  mutating rpc.call() sites must thread an op_id or
+                   carry an allowlisted justification (the transport
+                   retries stale-connection failures)
+  tier1-purity     non-slow tests must not compile the native runtime
+                   or touch TPU clients at collection time
+
+Run `python -m tool.lint --help`; see tool/lint/README.md.
+"""
+
+from .cli import main, run_lint  # noqa: F401
+from .core import Violation  # noqa: F401

@@ -1,0 +1,13 @@
+"""Checker registry: every family the suite ships, in report order."""
+
+from .lock_discipline import LockDisciplineChecker
+from .rpc_idempotency import RpcIdempotencyChecker
+from .tier1_purity import Tier1PurityChecker
+from .tracer_safety import TracerSafetyChecker
+
+ALL_CHECKERS = (
+    TracerSafetyChecker,
+    LockDisciplineChecker,
+    RpcIdempotencyChecker,
+    Tier1PurityChecker,
+)

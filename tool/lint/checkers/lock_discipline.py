@@ -1,0 +1,156 @@
+"""Lock-discipline checker (rule: lock-discipline, codes CFL0xx).
+
+The raft-heartbeat regression shape: a blocking or native-plane call
+made while LEXICALLY inside a ``with <lock>:`` block. Python-plane
+locks here guard hot paths (raft node lock, partition lock, pool
+locks); anything that can block — a sleep, a network round-trip, a
+ctypes call that takes a C++ mutex — stalls every thread queued on
+that lock for the full duration:
+
+  CFL001  time.sleep() while holding a lock
+  CFL002  blocking RPC / socket call while holding a lock
+          (rpc.call / rpc.call_replicas / pool.get(...).call(...) /
+          socket.create_connection)
+  CFL003  native-plane ctypes call (lib.ms_* / cfs_* / cs_* / ds_* /
+          es_* / kv_*) while holding a Python lock — these take the
+          C++ side's mutex (often exclusively) and block its readers
+
+The analysis is syntactic: a lock is "held" inside the body of a
+``with`` whose context expression's final name looks lock-ish
+(…lock/…mutex/…mu). Calls inside nested function definitions are NOT
+flagged (the closure may run after release); callbacks invoked under a
+lock must be audited at their definition site.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Checker, Module, Violation
+
+_LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|locks?|mu|mutex)$", re.IGNORECASE)
+_NATIVE_PREFIX_RE = re.compile(r"^(?:ms|cfs|cs|ds|es|kv|bp|gf|rt)_")
+_LIBLIKE_RE = re.compile(r"(?:^|_)lib$|^lib|_lib\b")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _final_name(node: ast.AST) -> str:
+    """`self._wal_mu` -> '_wal_mu'; `vlock` -> 'vlock'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    name = _final_name(expr)
+    return bool(name) and (_LOCK_NAME_RE.search(name) is not None
+                           or "lock" in name.lower())
+
+
+class LockDisciplineChecker(Checker):
+    rule = "lock-discipline"
+    dirs = ("cubefs_tpu/fs/", "cubefs_tpu/blob/", "cubefs_tpu/parallel/")
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+        # resolve aliases of the time module ("import time as _t")
+        time_aliases = {alias for alias, full in mod.import_aliases.items()
+                        if full == "time"}
+        time_aliases.add("time")
+        sleep_names = {alias for alias, full in mod.from_imports.items()
+                       if full == "time.sleep"}
+        rpc_aliases = {alias for alias, full in mod.import_aliases.items()
+                       if full.endswith("rpc")} | {"rpc"}
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            lock_names = [_final_name(item.context_expr)
+                          for item in node.items
+                          if _is_lockish(item.context_expr)]
+            if not lock_names:
+                continue
+            held = lock_names[0]
+            for stmt in node.body:
+                out.extend(self._scan(mod, stmt, held, time_aliases,
+                                      sleep_names, rpc_aliases))
+        return out
+
+    def _scan(self, mod: Module, root: ast.AST, held: str,
+              time_aliases: set[str], sleep_names: set[str],
+              rpc_aliases: set[str]) -> list[Violation]:
+        out: list[Violation] = []
+        for node in _walk_no_nested_defs(root):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            dotted = _dotted(func)
+            # CFL001: time.sleep under lock
+            if (dotted.endswith(".sleep")
+                    and dotted.rsplit(".", 1)[0].split(".")[-1] in time_aliases) \
+                    or (isinstance(func, ast.Name) and func.id in sleep_names):
+                out.append(self.violation(
+                    mod, "CFL001", node,
+                    f"time.sleep() while holding `{held}` stalls every "
+                    f"thread queued on the lock"))
+                continue
+            if isinstance(func, ast.Attribute):
+                attr = func.attr
+                # CFL003: ctypes native-plane call under a Python lock
+                if (_NATIVE_PREFIX_RE.match(attr)
+                        and _LIBLIKE_RE.search(_final_name(func.value) or "")):
+                    out.append(self.violation(
+                        mod, "CFL003", node,
+                        f"native-plane call {attr}() while holding "
+                        f"`{held}` — it takes the C++ mutex and blocks "
+                        f"native readers for the lock's hold time"))
+                    continue
+                # CFL002: blocking RPC / socket call under lock
+                recv_src = mod.segment(func.value)
+                if attr == "call" and (".get(" in recv_src
+                                       or "get_direct(" in recv_src
+                                       or _dotted(func.value).split(".")[-1]
+                                       in rpc_aliases):
+                    out.append(self.violation(
+                        mod, "CFL002", node,
+                        f"blocking RPC .call() while holding `{held}`"))
+                    continue
+                if (attr in ("call", "call_replicas")
+                        and _dotted(func.value) in rpc_aliases):
+                    out.append(self.violation(
+                        mod, "CFL002", node,
+                        f"blocking rpc.{attr}() while holding `{held}`"))
+                    continue
+                if dotted.endswith("socket.create_connection") or (
+                        attr == "create_connection"
+                        and _dotted(func.value).split(".")[-1] == "socket"):
+                    out.append(self.violation(
+                        mod, "CFL002", node,
+                        f"socket connect while holding `{held}`"))
+        return out
+
+
+def _walk_no_nested_defs(root: ast.AST):
+    """ast.walk, but do not descend into nested function/class bodies —
+    a closure defined under a lock is not necessarily CALLED under it."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

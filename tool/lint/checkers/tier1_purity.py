@@ -1,0 +1,132 @@
+"""Tier-1 purity checker (rule: tier1-purity, codes CFP0xx).
+
+Tier-1 runs as `pytest -m 'not slow'` on CPU with a hard timeout; a
+test module that compiles the native runtime or initializes a TPU
+client AT COLLECTION TIME (module level) drags that cost/flake into
+every tier-1 run — even when its tests would be deselected or skipped.
+Such work belongs inside fixtures or test bodies, where skips and
+marker selection still guard it:
+
+  CFP001  module-level import of a TPU-client module (initializes or
+          probes accelerator runtimes on import)
+  CFP002  module-level call into the native build/load path
+          (runtime.build.build()/load() compiles libcubefs_rt.so;
+          ctypes.CDLL of the runtime .so loads it) at collection time
+  CFP003  module-level TPU topology/client construction
+          (aot_tpu.v5e_topology(), jax.devices("tpu"),
+          get_topology_desc(...)) at collection time
+
+Modules whose top-level ``pytestmark`` marks them `slow` are exempt —
+they are not collected into tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+_TPU_IMPORTS = {
+    "jax.experimental.topologies",
+    "libtpu",
+    "torch_xla",
+}
+_NATIVE_LOAD_FUNCS = {"load", "build"}
+_NATIVE_MODULE_HINTS = {"build", "rt_build", "_build"}
+_TOPOLOGY_FUNCS = {"v5e_topology", "get_topology_desc"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _marked_slow(tree: ast.Module) -> bool:
+    """True when top-level pytestmark includes pytest.mark.slow."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "pytestmark" in targets:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Attribute) and sub.attr == "slow":
+                        return True
+    return False
+
+
+def _walk_module_level(tree: ast.Module):
+    """Every node reached at import time: descends into if/try/with
+    bodies (those run on import) but never into function/class bodies."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class Tier1PurityChecker(Checker):
+    rule = "tier1-purity"
+    dirs = ("tests/",)
+
+    def check(self, mod: Module) -> list[Violation]:
+        if _marked_slow(mod.tree):
+            return []
+        out: list[Violation] = []
+        for node in _walk_module_level(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in _TPU_IMPORTS:
+                        out.append(self.violation(
+                            mod, "CFP001", node,
+                            f"module-level import of TPU-client module "
+                            f"'{a.name}' runs at collection time; import "
+                            f"inside the fixture/test that needs it"))
+                continue
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module in _TPU_IMPORTS or any(
+                        f"{node.module}.{a.name}" in _TPU_IMPORTS
+                        for a in node.names):
+                    out.append(self.violation(
+                        mod, "CFP001", node,
+                        f"module-level import from TPU-client module "
+                        f"'{node.module}' runs at collection time"))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func)
+            tail = dotted.split(".")[-1]
+            head = dotted.split(".")[0] if "." in dotted else ""
+            if tail in _NATIVE_LOAD_FUNCS and head in _NATIVE_MODULE_HINTS:
+                out.append(self.violation(
+                    mod, "CFP002", node,
+                    f"{dotted}() at module level compiles/loads "
+                    f"libcubefs_rt.so at collection time; move it into "
+                    f"a fixture so skips still guard it"))
+            elif tail == "CDLL" and any(
+                    isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)
+                    and "libcubefs_rt" in a.value for a in node.args):
+                out.append(self.violation(
+                    mod, "CFP002", node,
+                    "ctypes.CDLL of libcubefs_rt.so at collection time"))
+            elif tail in _TOPOLOGY_FUNCS:
+                out.append(self.violation(
+                    mod, "CFP003", node,
+                    f"{dotted}() at module level constructs a TPU "
+                    f"client at collection time"))
+            elif (tail == "devices" and head == "jax" and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and node.args[0].value == "tpu"):
+                out.append(self.violation(
+                    mod, "CFP003", node,
+                    'jax.devices("tpu") at module level probes the TPU '
+                    "runtime at collection time"))
+        return out

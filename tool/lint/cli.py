@@ -1,0 +1,108 @@
+"""cubefs-tpu-lint CLI: run the repo's checker families over the tree.
+
+Usage:
+  python -m tool.lint [paths...]     lint (default roots: cubefs_tpu/,
+                                     tests/, tool/), baseline applied
+  python -m tool.lint --no-baseline  strict mode: report EVERYTHING
+  python -m tool.lint --update-baseline
+                                     re-record current findings as the
+                                     accepted baseline
+  python -m tool.lint --select CFL001,rpc-idempotency
+                                     only the named codes/rules
+
+Exit status: 0 = no non-baselined violations, 1 = findings, 2 = a file
+failed to parse (always fatal: an unparseable file is unlinted code).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import core
+from .checkers import ALL_CHECKERS
+
+DEFAULT_ROOTS = ("cubefs_tpu", "tests", "tool")
+
+
+def run_lint(paths: list[str] | None = None,
+             select: set[str] | None = None
+             ) -> tuple[list[core.Violation], list[str]]:
+    """(violations after inline suppressions, parse-error strings)."""
+    checkers = [cls() for cls in ALL_CHECKERS]
+    violations: list[core.Violation] = []
+    errors: list[str] = []
+    for relpath in core.iter_py_files(list(paths or DEFAULT_ROOTS)):
+        try:
+            with open(os.path.join(core.REPO_ROOT, relpath),
+                      encoding="utf-8") as f:
+                source = f.read()
+            mod = core.Module(relpath, source)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(f"{relpath}: {type(e).__name__}: {e}")
+            continue
+        found: list[core.Violation] = []
+        for checker in checkers:
+            if checker.applies(relpath):
+                found.extend(checker.check(mod))
+        found.extend(core.bare_allow_violations(mod))
+        violations.extend(v for v in found if not mod.suppressed(v))
+    if select:
+        violations = [v for v in violations
+                      if v.code in select or v.rule in select]
+    violations.sort(key=lambda v: (v.path, v.line, v.code))
+    return violations, errors
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="cubefs-tpu-lint",
+        description="repo-specific static analysis "
+                    "(tracer-safety, lock-discipline, rpc-idempotency, "
+                    "tier1-purity)")
+    p.add_argument("paths", nargs="*", help="files/dirs to lint "
+                   f"(default: {', '.join(DEFAULT_ROOTS)})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="strict mode: ignore baseline.json")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="record current findings as the new baseline")
+    p.add_argument("--baseline", default=None,
+                   help="alternate baseline file path")
+    p.add_argument("--select", default=None,
+                   help="comma-separated codes/rules to report")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the per-violation listing")
+    args = p.parse_args(argv)
+
+    select = (set(s.strip() for s in args.select.split(",") if s.strip())
+              if args.select else None)
+    violations, errors = run_lint(args.paths or None, select)
+
+    for err in errors:
+        print(f"PARSE ERROR {err}", file=sys.stderr)
+
+    if args.update_baseline:
+        core.save_baseline(violations, args.baseline)
+        print(f"baseline updated: {len(violations)} finding(s) recorded")
+        return 2 if errors else 0
+
+    if args.no_baseline:
+        fresh = violations
+    else:
+        fresh = core.apply_baseline(
+            violations, core.load_baseline(args.baseline))
+
+    if not args.quiet:
+        for v in fresh:
+            print(v.render())
+    baselined = len(violations) - len(fresh)
+    tail = f" ({baselined} baselined)" if baselined else ""
+    print(f"cubefs-tpu-lint: {len(fresh)} finding(s){tail}")
+    if errors:
+        return 2
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,186 @@
+"""Core infrastructure for the cubefs-tpu lint suite.
+
+One `Module` per source file (AST + source lines + import alias map),
+a `Checker` interface, inline suppressions, and the baseline store.
+
+Inline suppression: append ``# lint: allow[CODE] <justification>`` to
+the flagged line (or the line directly above it). The justification is
+MANDATORY — a bare ``allow[...]`` does not suppress and is itself
+reported (CFG001), so every intentional violation carries its why.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+# tool/lint/core.py -> repo root
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SKIP_DIRS = {".git", "__pycache__", "artifacts", "node_modules", ".claude",
+              "fixtures"}  # tests/fixtures/lint holds INTENTIONAL violations
+
+_ALLOW_RE = re.compile(
+    r"#\s*lint:\s*allow\[(?P<codes>[A-Za-z0-9_,\s-]+)\]\s*(?P<why>.*?)\s*$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    code: str  # e.g. "CFL003"
+    rule: str  # checker family, e.g. "lock-discipline"
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}:{self.path}:{self.line}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} [{self.rule}] {self.message}"
+
+
+class Module:
+    """A parsed source file as handed to checkers."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        # alias -> full module name, for "import time as _t" resolution
+        self.import_aliases: dict[str, str] = {}
+        # name -> "module.name" for "from time import sleep [as s]"
+        self.from_imports: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    # ---- suppression ----
+    def allow_at(self, line: int) -> dict[str, str] | None:
+        """{code_or_rule: justification} if the line (or the one above)
+        carries a lint: allow[...] comment with a justification."""
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = _ALLOW_RE.search(self.lines[ln - 1])
+                if m:
+                    why = m.group("why").strip()
+                    codes = [c.strip() for c in m.group("codes").split(",")]
+                    return {c: why for c in codes if c}
+        return None
+
+    def suppressed(self, v: Violation) -> bool:
+        allow = self.allow_at(v.line)
+        if not allow:
+            return False
+        for key, why in allow.items():
+            if key in (v.code, v.rule, "*") and why:
+                return True
+        return False
+
+    def segment(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.source, node) or ""
+
+
+class Checker:
+    """One checker family. Subclasses set `rule`, `dirs` (repo-relative
+    prefixes the checker applies to) and implement `check(module)`."""
+
+    rule = "base"
+    dirs: tuple[str, ...] = ()
+
+    def applies(self, relpath: str) -> bool:
+        return any(relpath.startswith(d) for d in self.dirs)
+
+    def check(self, mod: Module) -> list[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+    def violation(self, mod: Module, code: str, node_or_line,
+                  message: str) -> Violation:
+        line = (node_or_line if isinstance(node_or_line, int)
+                else node_or_line.lineno)
+        return Violation(code, self.rule, mod.relpath, line, message)
+
+
+def iter_py_files(roots: list[str]) -> list[str]:
+    """Repo-relative paths of every .py under the given roots."""
+    out: list[str] = []
+    for root in roots:
+        absroot = os.path.join(REPO_ROOT, root)
+        if os.path.isfile(absroot):
+            if absroot.endswith(".py"):
+                out.append(os.path.relpath(absroot, REPO_ROOT))
+            continue
+        for dirpath, dirnames, filenames in os.walk(absroot):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), REPO_ROOT))
+    return sorted(set(p.replace(os.sep, "/") for p in out))
+
+
+def bare_allow_violations(mod: Module) -> list[Violation]:
+    """CFG001: an allow[...] comment with no justification — it does NOT
+    suppress anything, and silently believing it does is worse."""
+    out = []
+    for i, text in enumerate(mod.lines, start=1):
+        m = _ALLOW_RE.search(text)
+        if m and not m.group("why").strip():
+            out.append(Violation(
+                "CFG001", "lint-config", mod.relpath, i,
+                "allow[...] suppression without a justification "
+                "(write `# lint: allow[CODE] <why>`)"))
+    return out
+
+
+# ---------------- baseline ----------------
+
+def baseline_path() -> str:
+    return os.path.join(REPO_ROOT, "tool", "lint", "baseline.json")
+
+
+def load_baseline(path: str | None = None) -> dict[str, int]:
+    """fingerprint -> allowed count (a multiset: two identical findings
+    on one line baseline independently)."""
+    path = path or baseline_path()
+    if not os.path.exists(path):
+        return {}
+    data = json.load(open(path))
+    counts: dict[str, int] = {}
+    for fp in data.get("violations", []):
+        counts[fp] = counts.get(fp, 0) + 1
+    return counts
+
+
+def save_baseline(violations: list[Violation], path: str | None = None) -> None:
+    path = path or baseline_path()
+    payload = {
+        "comment": "Pre-existing lint findings recorded, not blocking. "
+                   "Regenerate with: python -m tool.lint --update-baseline",
+        "violations": sorted(v.fingerprint for v in violations),
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(violations: list[Violation],
+                   baseline: dict[str, int]) -> list[Violation]:
+    """Violations not covered by the baseline multiset."""
+    budget = dict(baseline)
+    fresh = []
+    for v in violations:
+        if budget.get(v.fingerprint, 0) > 0:
+            budget[v.fingerprint] -= 1
+        else:
+            fresh.append(v)
+    return fresh

@@ -1,0 +1,123 @@
+"""Explicit allowlist for the rpc-idempotency checker (CFR001).
+
+Every entry asserts that a transport-level retry of the named mutating
+RPC is harmless WITHOUT an op_id, and says why. Keys are
+``(repo-relative caller path, method)``; the path ``"*"`` means the
+SERVER-side contract itself is idempotent, independent of who calls it.
+An empty justification is itself a violation (CFR002) — the point of
+the list is the recorded reasoning, not the exemption.
+
+Accepted justification families (cite one):
+  * absolute-value write — the op sets state to a given value
+    (set_*, kv_set); applying it twice lands on the same state.
+  * keyed / natural idempotency — the op is keyed by a caller-chosen
+    id (pid, dp_id, name, task_id); the server treats a duplicate as
+    get-or-refresh, or rejects it without re-allocating.
+  * server-side guard — the server deduplicates through other state
+    (lease expiry, snapshot re-check), so the duplicate is absorbed.
+
+Anything that MINTS an id or appends to a sequence does NOT belong
+here — thread an op_id instead (see utils/fsm.py _apply_deduped and
+fs/metanode.py MetaPartition.apply for the server-side dedup doors).
+"""
+
+ALLOWLIST: dict[tuple[str, str], str] = {
+    # ---- keyed by caller-chosen id: duplicate = get-or-refresh ----
+    ("*", "create_partition"):
+        "keyed by caller-chosen pid/dp_id; meta/datanodes treat a "
+        "duplicate create of a known partition as get-or-refresh "
+        "(fs/metanode.py create_partition, fs/datanode.py "
+        "create_partition)",
+    ("*", "drop_partition"):
+        "idempotent delete by pid/dp_id; dropping an already-dropped "
+        "partition is a no-op",
+    ("*", "create_shard"):
+        "keyed by caller-chosen shard id; duplicate create is "
+        "get-or-refresh on the shardnode",
+    ("*", "put_shard"):
+        "keyed by (vid, bid, shard index) with absolute bytes; a "
+        "retry overwrites the identical payload",
+    ("*", "delete_shard"):
+        "idempotent delete by (vid, bid)",
+    ("*", "put"):
+        "blob put is keyed by an allocated (vid, bid) location with "
+        "absolute bytes; a retry rewrites the same shards",
+    ("*", "delete"):
+        "idempotent delete by location/key",
+    ("*", "delete_extent"):
+        "idempotent delete by (dp_id, extent_id)",
+    ("*", "write_replica"):
+        "chain-replication leg keyed by (dp_id, extent_id, offset) "
+        "with absolute bytes; a retry rewrites the same range",
+    ("*", "update_shard_peers"):
+        "absolute-value write of the peer set (epoch-guarded on the "
+        "shardnode); last write wins either way",
+    ("*", "create_volume"):
+        "name-keyed; the master rejects a duplicate name "
+        "(MasterError 'exists') instead of allocating a second volume",
+    ("*", "create_user"):
+        "user-id-keyed; duplicate create returns/conflicts on the "
+        "existing user, never mints a second identity",
+    ("*", "delete_user"):
+        "idempotent delete by user id",
+    ("*", "register_group"):
+        "name-keyed registry upsert",
+    ("*", "remove_group"):
+        "idempotent delete by group name",
+
+    # ---- absolute-value writes: replay lands on the same state ----
+    ("*", "set_vol_capacity"): "absolute-value write (capacity)",
+    ("*", "set_quota"): "absolute-value write (quota record)",
+    ("*", "delete_quota"): "idempotent delete by quota id",
+    ("*", "set_disk_status"): "absolute-value write (disk status enum)",
+    ("*", "set_config"): "absolute-value write (config key)",
+    ("*", "delete_config"): "idempotent delete by config key",
+    ("*", "kv_set"): "absolute-value write (kv key)",
+    ("*", "kv_delete"): "idempotent delete by kv key",
+    ("*", "set_group_status"): "absolute-value write (group status)",
+    ("*", "set_enforcement"):
+        "absolute-value push of the advisory enforcement flag set; "
+        "recomputed by every quota sweep anyway",
+    ("*", "enforce_quotas"):
+        "triggers a recompute from current usage — rerunning it "
+        "reaches the same flags",
+    ("*", "invalidate"):
+        "cache invalidation; invalidating an already-dropped entry "
+        "is a no-op",
+
+    # ---- sticky state transitions ----
+    ("*", "decommission_datanode"):
+        "sticky transition: decommissioning an already-decommissioned "
+        "node is a no-op",
+    ("*", "offline_disk"): "sticky transition: offline is absorbing",
+    ("*", "mark_disk_broken"): "sticky transition: broken is absorbing",
+    ("*", "split_meta_partition"):
+        "snapshot-guarded: the split re-checks after_end under "
+        "_propose_lock and returns None if someone (incl. a retry's "
+        "first send) already split past it",
+
+    # ---- server-side guards ----
+    ("*", "register"):
+        "addr-keyed registry refresh (master/scheduler register): a "
+        "re-register updates the same node record",
+    ("*", "register_service"):
+        "name+addr-keyed: the addr appends only if absent",
+    ("*", "acquire_task"):
+        "lease-based: a duplicate acquisition leases a second task "
+        "whose lease expires and requeues (scheduler LEASE_SECONDS); "
+        "no task is lost or double-completed",
+    ("*", "renew_task"): "task-id-keyed lease refresh",
+    ("*", "complete_task"):
+        "task-id-keyed terminal transition; completing a completed "
+        "task is a no-op",
+
+    # ---- per-caller entries ----
+    ("cubefs_tpu/fs/client.py", "submit"):
+        "MetaWrapper._call setdefaults a uuid op_id into every submit "
+        "record before it leaves the client (fs/client.py _call); the "
+        "call sites just don't spell the token",
+    ("cubefs_tpu/blob/access.py", "alloc"):
+        "the proxy serves alloc from locally leased volume/bid ranges "
+        "(blob/proxy.py); a duplicate burns leased ids only — the "
+        "clustermgr-facing lease refills themselves carry op_ids",
+}
