@@ -163,15 +163,24 @@ class AccessHandler:
         )
 
     def _write_shard(self, vol: VolumeInfo, unit, bid: int, shard: np.ndarray):
+        addr = unit.node_addr
+        # the pool's per-address breaker: a node that keeps timing out is
+        # reported down immediately instead of stalling the quorum wait
+        if not self.nodes.breaker.allow(addr):
+            return bid, unit.index, rpc.ServiceUnavailable(
+                503, f"{addr}: circuit open")
         try:
-            self.nodes.get(unit.node_addr).call(
+            self.nodes.get(addr).call(
                 "put_shard",
                 {"disk_id": unit.disk_id, "chunk_id": unit.chunk_id, "bid": bid},
                 shard.tobytes(),
                 timeout=10.0,
             )
+            self.nodes.breaker.record_success(addr)
             return bid, unit.index, None
         except Exception as e:
+            if isinstance(e, rpc.ServiceUnavailable):
+                self.nodes.breaker.record_failure(addr)
             return bid, unit.index, e
 
     # ------------------------------ GET ------------------------------
@@ -195,14 +204,20 @@ class AccessHandler:
 
     def _read_shard(self, vol: VolumeInfo, idx: int, bid: int):
         u = vol.units[idx]
+        if not self.nodes.breaker.allow(u.node_addr):
+            return idx, None, rpc.ServiceUnavailable(
+                503, f"{u.node_addr}: circuit open")
         try:
             _, payload = self.nodes.get(u.node_addr).call(
                 "get_shard",
                 {"disk_id": u.disk_id, "chunk_id": u.chunk_id, "bid": bid},
                 timeout=10.0,
             )
+            self.nodes.breaker.record_success(u.node_addr)
             return idx, payload, None
         except Exception as e:
+            if isinstance(e, rpc.ServiceUnavailable):
+                self.nodes.breaker.record_failure(u.node_addr)
             return idx, None, e
 
     HEDGE_DELAY = 0.05  # backup-request trigger (stream_get.go hedging)

@@ -59,7 +59,7 @@ class BlobNode:
         self._hb_thread.start()
 
     def send_heartbeat(self) -> None:
-        live = [d for d in self.disk_ids if d not in self._broken]
+        live = [d for d in self.disk_ids if not self._disk_down(d)]
         if live and self.cm is not None:
             self.cm.call("heartbeat", {"disk_ids": live})
 
@@ -70,12 +70,24 @@ class BlobNode:
         self.stores.clear()
 
     def break_disk(self, disk_id: int) -> None:
-        """Fault injection: disk stops serving + stops heartbeating."""
+        """Fault injection: disk stops serving + stops heartbeating.
+
+        Kept for direct use, but scenarios that also inject transport
+        faults should use faultinject.FaultPlan.break_disk(addr, id)
+        instead — the plan-level hook (checked in _disk_down) lets disk
+        and network chaos compose in ONE seeded schedule."""
         self._broken.add(disk_id)
+
+    def _disk_down(self, disk_id: int) -> bool:
+        if disk_id in self._broken:
+            return True
+        plan = rpc._fault  # chaos hook; None in production
+        return plan is not None and plan.disk_broken(
+            self.addr or str(self.node_id), disk_id)
 
     # ---------------- data plane ----------------
     def _store(self, disk_id: int) -> ChunkStore:
-        if disk_id in self._broken:
+        if self._disk_down(disk_id):
             raise rpc.RpcError(503, f"disk {disk_id} is broken")
         try:
             return self.stores[disk_id]

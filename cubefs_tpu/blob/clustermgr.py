@@ -266,7 +266,10 @@ class ClusterMgr(ReplicatedFsm):
     # Named monotonic id ranges (scopemgr/scopemgr.go): BIDs are the
     # "bid" scope; any subsystem can carve its own id space without a
     # new FSM op. Allocation happens inside apply, so a lagging new
-    # leader can never re-issue a committed range.
+    # leader can never re-issue a committed range. The op_id rides the
+    # committed record through ReplicatedFsm._apply_deduped, so a chaos
+    # drop-after-execute on a blob put retries alloc_bids without
+    # leaking a range (tests/test_chaos.py proves this end to end).
     def alloc_bids(self, count: int, op_id: str | None = None) -> int:
         with self._propose_lock:
             rec = {"op": "alloc_bids", "count": count}

@@ -17,6 +17,11 @@ import time
 import uuid
 
 from ..utils import rpc
+from ..utils.retry import RetryPolicy
+
+# shard deletes: 2 quick retries on node-level blips, tightly bounded —
+# the kafka-style delete queue re-drives real failures later anyway
+_DELETE_POLICY = RetryPolicy(base=0.02, cap=0.2, max_retries=2, deadline=2.0)
 from .types import DiskStatus, VolumeInfo
 
 
@@ -422,13 +427,24 @@ class Scheduler:
         for k in range(count):
             bid = min_bid + k
             for u in vol.units:
-                try:
-                    self.nodes.get(u.node_addr).call(
-                        "delete_shard",
-                        {"disk_id": u.disk_id, "chunk_id": u.chunk_id, "bid": bid},
-                    )
-                except rpc.RpcError:
-                    pass
+                # a transient node blip gets a small bounded retry
+                # (RetryPolicy budget); anything else is left for the
+                # inspector sweep to re-delete — delete_shard is
+                # idempotent by key
+                r = _DELETE_POLICY.start(op="delete_shard")
+                while True:
+                    try:
+                        self.nodes.get(u.node_addr).call(
+                            "delete_shard",
+                            {"disk_id": u.disk_id, "chunk_id": u.chunk_id,
+                             "bid": bid},
+                        )
+                        break
+                    except rpc.ServiceUnavailable:
+                        if not r.tick(reason="delete-blip"):
+                            break
+                    except rpc.RpcError:
+                        break
 
     # ---------------- balance / manual migrate / inspect ----------------
     def balance(self, max_moves: int = 4, threshold: int = 2) -> int:

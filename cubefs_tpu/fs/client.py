@@ -438,7 +438,7 @@ class MetaWrapper:
         (later) moment the grant RPC returned."""
         mp = self._mp_for(1)
         tx_id = uuid.uuid4().hex
-        deadline = time.time() + timeout
+        r = rpc.FAILOVER_POLICY.start(op="dir_rename_lock", deadline=timeout)
         while True:
             ts = time.time()
             try:
@@ -449,9 +449,10 @@ class MetaWrapper:
                              "name": "__dir_rename__"}]}})
                 return tx_id, ts
             except FsError as e:
-                if e.errno != 16 or time.time() > deadline:  # EBUSY
+                # EBUSY: another rename holds the mutex; back off within
+                # the timeout instead of spinning at a fixed 50 ms
+                if e.errno != 16 or not r.tick(reason="mutex-busy"):
                     raise
-                time.sleep(0.05)
 
     def unlock_dir_rename(self, tx_id: str) -> None:
         self._call(self._mp_for(1), "submit", {"record": {

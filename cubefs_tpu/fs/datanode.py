@@ -24,6 +24,7 @@ import threading
 import time
 
 from ..utils import rpc
+from ..utils.retry import RetryPolicy
 from .extent_store import BlockCrcError, ExtentError, ExtentStore
 
 
@@ -489,8 +490,13 @@ class DataNode:
             while True:
                 with self._repair_lock:
                     gen = self.pending_repairs[key]["gen"]
-                ok, delay = False, 0.05
-                for _ in range(attempts):
+                ok = False
+                # budget-bounded (attempts), not deadline-bounded: the
+                # repair thread may legitimately outlive any fixed window
+                r = RetryPolicy(base=0.05, cap=2.0, deadline=None,
+                                max_retries=attempts - 1).start(
+                    op="sync_extent_from")
+                while True:
                     try:
                         self.nodes.get(peer).call(
                             "sync_extent_from",
@@ -499,8 +505,8 @@ class DataNode:
                         ok = True
                         break
                     except Exception:
-                        time.sleep(delay)
-                        delay = min(delay * 2, 2.0)
+                        if not r.tick(reason="leg-repair"):
+                            break
                 with self._repair_lock:
                     st = self.pending_repairs[key]
                     if ok and st["gen"] == gen:
@@ -532,8 +538,8 @@ class DataNode:
         entry = {"op": "random_write", "extent_id": extent_id,
                  "offset": offset, "data": base64.b64encode(data).decode()}
         last: Exception | None = None
-        end = time.monotonic() + deadline
-        while time.monotonic() < end:
+        r = rpc.FAILOVER_POLICY.start(op="random_write", deadline=deadline)
+        while True:
             try:
                 # wait_all: readers may hit ANY replica right after the
                 # ack (k-faster selection), so the overwrite must be
@@ -544,21 +550,29 @@ class DataNode:
             except NotLeaderError as e:
                 last = e
                 if not e.leader or e.leader == self.addr:
-                    time.sleep(0.1)  # election in progress
-                    continue
-                try:
-                    # dedicated forward: the raft leader proposes as-is,
-                    # never re-classifies (its local extent size may lag)
-                    self.nodes.get(e.leader).call(
-                        "random_write_forward",
-                        {"dp_id": dp.dp_id, "extent_id": extent_id,
-                         "offset": offset}, data, timeout=15.0)
-                    return
-                except Exception as fwd_err:
-                    last = fwd_err
-                    time.sleep(0.1)
+                    if r.tick(reason="election"):
+                        continue
+                else:
+                    try:
+                        # dedicated forward: the raft leader proposes
+                        # as-is, never re-classifies (its local extent
+                        # size may lag)
+                        self.nodes.get(e.leader).call(
+                            "random_write_forward",
+                            {"dp_id": dp.dp_id, "extent_id": extent_id,
+                             "offset": offset}, data, timeout=15.0)
+                        return
+                    except Exception as fwd_err:
+                        last = fwd_err
+                        if r.tick(reason="forward-failed"):
+                            continue
             except TimeoutError as e:
                 last = e
+                # propose() already blocked its own timeout; only check
+                # the overall deadline, no extra backoff sleep
+                if r.tick(reason="commit-timeout", sleep=False):
+                    continue
+            break
         raise rpc.RpcError(503, f"dp {dp.dp_id} random write failed: {last}")
 
     def read(self, dp_id: int, extent_id: int, offset: int, length: int,

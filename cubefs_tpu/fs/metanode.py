@@ -431,7 +431,12 @@ class MetaPartition:
         (not replicated — the ino only becomes durable via the mk_inode
         submit), but a transport retry must get the SAME ino back, or
         the lost first reservation leaks a number from the range and
-        the client may observe two different inos for one create."""
+        the client may observe two different inos for one create.
+
+        Exercised dynamically by tests/test_chaos.py: an injected
+        drop-after-execute / duplicate delivery (faultinject.FaultPlan)
+        on alloc_ino must mint exactly one ino — the _alloc_cache door
+        here is what makes the rpc.call idempotency contract hold."""
         with self._lock:
             if op_id is not None and op_id in self._alloc_cache:
                 return self._alloc_cache[op_id]

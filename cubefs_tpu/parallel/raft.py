@@ -43,6 +43,8 @@ import random
 import threading
 import time
 
+from ..utils import faultinject as _fi
+
 
 class NotLeaderError(Exception):
     def __init__(self, leader: str | None, reason: str = "not leader"):
@@ -446,12 +448,15 @@ class RaftNode:
         def ask(peer):
             nonlocal votes
             try:
-                meta, _ = self.pool.get_direct(peer).call(
-                    f"raft_{self.group_id}_vote",
-                    {"term": term, "candidate": self.me,
-                     "last_index": last_index, "last_term": last_term},
-                    timeout=1.0,
-                )
+                # declare identity so injected partitions cut BOTH
+                # directions of this node's traffic (faultinject)
+                with _fi.sender(self.me):
+                    meta, _ = self.pool.get_direct(peer).call(
+                        f"raft_{self.group_id}_vote",
+                        {"term": term, "candidate": self.me,
+                         "last_index": last_index, "last_term": last_term},
+                        timeout=1.0,
+                    )
             except Exception:
                 return
             with self._lock:
@@ -622,9 +627,11 @@ class RaftNode:
                 }
         try:
             if snapshot_args is not None:
-                meta, _ = self.pool.get_direct(peer).call(
-                    f"raft_{self.group_id}_snapshot", snapshot_args, timeout=5.0
-                )
+                with _fi.sender(self.me):
+                    meta, _ = self.pool.get_direct(peer).call(
+                        f"raft_{self.group_id}_snapshot", snapshot_args,
+                        timeout=5.0
+                    )
                 with self._lock:
                     if self._stop.is_set():
                         return
@@ -638,9 +645,10 @@ class RaftNode:
                             snapshot_args["index"])
                         self._apply_cv.notify_all()
                 return
-            meta, _ = self.pool.get_direct(peer).call(
-                f"raft_{self.group_id}_append", args, timeout=1.0
-            )
+            with _fi.sender(self.me):
+                meta, _ = self.pool.get_direct(peer).call(
+                    f"raft_{self.group_id}_append", args, timeout=1.0
+                )
         except Exception:
             return
         self._process_append_reply(peer, args, meta)
@@ -840,8 +848,9 @@ class HeartbeatMux:
         self._thread: threading.Thread | None = None
         # persistent per-address senders (latest-batch slot semantics):
         # a dead peer blocks only its own sender, and steady state spawns
-        # zero threads per tick
-        self._senders: dict[str, dict] = {}
+        # zero threads per tick. Keys are peer addrs, or (peer, sender)
+        # tuples while a FaultPlan is installed (see _loop).
+        self._senders: dict[str | tuple, dict] = {}
 
     def enroll(self, node: "RaftNode") -> None:
         with self._lock:
@@ -874,24 +883,32 @@ class HeartbeatMux:
         while not self._stop.wait(RaftNode.HEARTBEAT):
             with self._lock:
                 nodes = list(self.nodes.values())
-            batches: dict[str, list] = {}  # peer addr -> [(gid, node, args)]
+            # batches normally key on peer addr alone; under an installed
+            # FaultPlan they key on (peer, sender) so each local node's
+            # heartbeats carry ITS identity — an isolated old leader's
+            # heartbeats must be cut sender-side or followers sharing
+            # this process would never start an election
+            chaos = _fi.current() is not None
+            batches: dict = {}  # key -> [(gid, node, args)]
             for node in nodes:
                 for peer, args in node.heartbeat_args():
-                    batches.setdefault(peer, []).append(
+                    key = (peer, node.me) if chaos else peer
+                    batches.setdefault(key, []).append(
                         (node.group_id, node, args))
-            for addr, items in batches.items():
+            for key, items in batches.items():
+                addr, me = key if isinstance(key, tuple) else (key, None)
                 with self._lock:
-                    slot = self._senders.get(addr)
+                    slot = self._senders.get(key)
                     if slot is None:
-                        slot = self._senders[addr] = {
+                        slot = self._senders[key] = {
                             "ev": threading.Event(), "batch": None}
                         threading.Thread(target=self._sender_loop,
-                                         args=(addr, slot),
+                                         args=(addr, me, slot),
                                          daemon=True).start()
                 slot["batch"] = items  # latest batch wins
                 slot["ev"].set()
 
-    def _sender_loop(self, addr: str, slot: dict) -> None:
+    def _sender_loop(self, addr: str, me: str | None, slot: dict) -> None:
         while not self._stop.is_set():
             slot["ev"].wait()
             slot["ev"].clear()
@@ -899,14 +916,15 @@ class HeartbeatMux:
                 return
             items = slot["batch"]
             if items:
-                self._send(addr, items)
+                self._send(addr, me, items)
 
-    def _send(self, addr: str, items: list) -> None:
+    def _send(self, addr: str, me: str | None, items: list) -> None:
         try:
-            meta, _ = self.pool.get_direct(addr).call(
-                "raft_hb_batch",
-                {"items": [[gid, args] for gid, _, args in items]},
-                timeout=1.0)
+            with _fi.sender(me):
+                meta, _ = self.pool.get_direct(addr).call(
+                    "raft_hb_batch",
+                    {"items": [[gid, args] for gid, _, args in items]},
+                    timeout=1.0)
         except Exception:
             return
         replies = dict(map(tuple, meta.get("replies", [])))

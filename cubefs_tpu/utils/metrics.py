@@ -152,3 +152,16 @@ codec_bytes = DEFAULT.counter("cubefs_codec_bytes_total",
                               "bytes through the EC codec", ("op", "engine"))
 repair_tasks = DEFAULT.counter("cubefs_repair_tasks_total",
                                "repair tasks", ("state",))
+rpc_client_retries = DEFAULT.counter(
+    "cubefs_rpc_client_retries_total",
+    "client-side RPC retries taken through RetryPolicy", ("op", "reason"))
+breaker_state = DEFAULT.gauge(
+    "cubefs_breaker_state",
+    "per-address circuit breaker state (0=closed, 1=half-open, 2=open)",
+    ("addr",))
+breaker_skips = DEFAULT.counter(
+    "cubefs_breaker_skips_total",
+    "calls skipped because the address's breaker was open", ("addr",))
+faults_injected = DEFAULT.counter(
+    "cubefs_faults_injected_total",
+    "chaos faults injected by the installed FaultPlan", ("kind",))

@@ -19,6 +19,20 @@ import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .retry import CircuitBreaker, RetryPolicy
+
+# Chaos hook (utils/faultinject.py): None in production, a FaultPlan in
+# chaos tests. A single module-level identity check is the ONLY cost on
+# the fast path — no allocations, no locks when uninstalled.
+_fault = None
+
+# The one retry/backoff discipline (utils/retry.py) that replaced the
+# ad-hoc sleep(0.05)/sleep(0.1)/3-attempt loops: redirect chasing and
+# election waits ride ELECTION_POLICY, replica failover rides
+# FAILOVER_POLICY with the caller's deadline.
+ELECTION_POLICY = RetryPolicy(base=0.05, cap=0.4, deadline=3.0)
+FAILOVER_POLICY = RetryPolicy(base=0.05, cap=0.4, deadline=10.0)
+
 
 class RpcError(Exception):
     def __init__(self, code: int, message: str):
@@ -279,7 +293,24 @@ def call(
          with a justification in tool/lint/rpc_allowlist.py.
 
     ``python -m tool.lint`` (checker rpc-idempotency, CFR001) enforces
-    this at every call site; new unprotected mutations fail tier-1."""
+    this at every call site; new unprotected mutations fail tier-1.
+
+    The chaos harness (utils/faultinject.py) interposes here when a
+    FaultPlan is installed; drop-after-execute faults simulate exactly
+    the lost-reply case the contract above covers."""
+    if _fault is not None:
+        return _fault.around_http(addr, method, args, body, timeout,
+                                  _http_call)
+    return _http_call(addr, method, args, body, timeout)
+
+
+def _http_call(addr, method, args, body, timeout,
+               _corrupt=False, _stale=False):
+    """One HTTP invocation (the body of `call`). The keyword-only fault
+    knobs exist for faultinject: `_corrupt` flips a body byte AFTER the
+    CRC header is computed (the server's CRC door must reject it);
+    `_stale` kills the pooled idle sockets for `addr` first so the
+    reuse path hits a genuinely dead connection."""
     from . import trace as tracelib
 
     headers = {"X-Rpc-Args": json.dumps(args or {})}
@@ -290,6 +321,16 @@ def call(
     span = tracelib.current()
     if span is not None:
         headers["X-Trace"] = span.header()
+    if _corrupt and body:
+        body = bytes([body[0] ^ 0xFF]) + body[1:]
+    if _stale:
+        with _POOL._lock:
+            for conn in _POOL._idle.get(addr, []):
+                if conn.sock is not None:
+                    try:  # half-close: fd stays valid, next send EPIPEs
+                        conn.sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
     for attempt in (0, 1):
         if attempt == 0:
             conn, reused = _POOL.get(addr, timeout)
@@ -338,10 +379,15 @@ class NodePool:
         self._clients: dict[str, "Client"] = {}
         self._direct: dict[str, "Client"] = {}
         self._lock = threading.Lock()
+        # per-pool (not global) so test clusters never share state;
+        # consulted by call_replicas and the blob access SDK
+        self.breaker = CircuitBreaker()
 
     def bind(self, addr: str, target) -> None:
         with self._lock:
-            self._clients[addr] = Client(target)
+            client = Client(target)
+            client._fault_addr = addr  # addressable by FaultPlan rules
+            self._clients[addr] = client
             self._direct.pop(addr, None)
 
     def get(self, addr: str) -> "Client":
@@ -379,6 +425,12 @@ class Client:
         self._target = None
         self._addr = None
         self._follow = follow_redirects
+        self._fault_addr = None  # set by NodePool.bind for in-process
+        # learned-leader cache: written/read from many SDK threads, so
+        # every access goes through _lock (satellite fix: the cache used
+        # to be a bare attribute raced without synchronization)
+        self._leader: str | None = None
+        self._lock = threading.Lock()
         if isinstance(target, str):
             self._addr = target
         elif isinstance(target, RpcServer):
@@ -388,50 +440,67 @@ class Client:
 
     REDIRECT = 421
 
+    def _invoke_direct(self, method: str, args, body):
+        fn = resolve_route(self._target, method)
+        if fn is None:
+            raise RpcError(404, f"no such method {method!r}")
+        try:
+            return _normalize(fn(args or {}, body))
+        except RpcError:
+            raise
+        except Exception as e:
+            # transport parity with HTTP: an unexpected handler error
+            # is a 500, never a raw exception leaking into (and
+            # killing) the caller's thread
+            raise RpcError(500, f"{type(e).__name__}: {e}") from e
+
     def call(self, method: str, args: dict | None = None, body: bytes = b"",
              timeout: float = 30.0) -> tuple[dict, bytes]:
         if self._target is not None:
-            fn = resolve_route(self._target, method)
-            if fn is None:
-                raise RpcError(404, f"no such method {method!r}")
-            try:
-                return _normalize(fn(args or {}, body))
-            except RpcError:
-                raise
-            except Exception as e:
-                # transport parity with HTTP: an unexpected handler error
-                # is a 500, never a raw exception leaking into (and
-                # killing) the caller's thread
-                raise RpcError(500, f"{type(e).__name__}: {e}") from e
+            if _fault is not None:
+                addr = (self._fault_addr
+                        or f"<{type(self._target).__name__}>")
+                return _fault.around_direct(
+                    addr, method,
+                    lambda: self._invoke_direct(method, args, body))
+            return self._invoke_direct(method, args, body)
         if not self._follow:
             # point-to-point mode: the message is for THIS address, a
             # 421 is a response, not a routing instruction
             return call(self._addr, method, args, body, timeout)
         # leader redirects (421 with "leader=<addr>") are followed
         # transparently and the learned leader is preferred afterwards,
-        # so a clustermgr failover never strands access/blobnode clients
-        addr = getattr(self, "_leader", None) or self._addr
-        for _ in range(3):
+        # so a clustermgr failover never strands access/blobnode clients.
+        # Redirect hops spend no backoff; election-in-progress waits ride
+        # ELECTION_POLICY's capped backoff until its deadline expires.
+        with self._lock:
+            addr = self._leader or self._addr
+        r = ELECTION_POLICY.start(op=method)
+        while True:
             try:
                 return call(addr, method, args, body, timeout)
             except RpcError as e:
                 if e.code == self.REDIRECT:
                     leader = e.message.removeprefix("leader=").strip()
                     if leader and leader != addr:
-                        self._leader = leader
+                        with self._lock:
+                            self._leader = leader
                         addr = leader
+                        if r.tick(reason="redirect", sleep=False):
+                            continue
+                    elif r.tick(reason="election"):
                         continue
-                    import time as _t
-
-                    _t.sleep(0.1)  # election in progress
-                    continue
+                    raise RpcError(
+                        503, f"{self._addr}/{method}: leader unresolved"
+                    ) from e
                 if isinstance(e, ServiceUnavailable) and addr != self._addr:
                     # learned leader died: fall back to the configured addr
-                    self._leader = None
+                    with self._lock:
+                        self._leader = None
                     addr = self._addr
-                    continue
+                    if r.tick(reason="leader-failover", sleep=False):
+                        continue
                 raise
-        raise RpcError(503, f"{self._addr}/{method}: leader unresolved")
 
 
 def call_replicas(pool: NodePool, addrs: list[str], method: str,
@@ -444,31 +513,52 @@ def call_replicas(pool: NodePool, addrs: list[str], method: str,
     transport errors / 5xx / 404. The ONE redirect-following loop shared
     by the meta SDK (both transports — `call_fn` swaps the per-address
     call, e.g. the binary packet plane) and the metanode tx scanner —
-    raises the last error if no replica answers."""
-    import time as _t
+    raises the last error if no replica answers.
 
+    Election waits and backoff ride FAILOVER_POLICY (utils/retry.py)
+    bounded by `deadline`; the pool's per-address CircuitBreaker is
+    consulted so a replica that keeps timing out is skipped without
+    paying its timeout again (if EVERY replica is skipped, one forced
+    probe round runs so an all-open set still recovers)."""
     if call_fn is None:
         def call_fn(addr):
             return pool.get(addr).call(method, args, body, timeout)
 
+    breaker = getattr(pool, "breaker", None)
+    r = FAILOVER_POLICY.start(op=method, deadline=deadline)
     last: Exception | None = None
     tried: set[str] = set()
     queue = list(addrs)
-    end = _t.time() + deadline
-    while queue and _t.time() < end:
+    skipped: list[str] = []
+    force_probe = False
+    while (queue or skipped) and r.within_deadline():
+        if not queue:
+            # every candidate was breaker-skipped: probe them anyway
+            queue, skipped, force_probe = skipped, [], True
         addr = queue.pop(0)
         if addr in tried:
             continue
+        if (breaker is not None and not force_probe
+                and not breaker.allow(addr)):
+            skipped.append(addr)
+            if last is None:
+                last = ServiceUnavailable(
+                    503, f"{addr}/{method}: circuit open")
+            continue
         try:
-            return call_fn(addr)
+            out = call_fn(addr)
+            if breaker is not None:
+                breaker.record_success(addr)
+            return out
         except RpcError as e:
             if e.code == Client.REDIRECT:
                 leader = e.message.removeprefix("leader=").strip()
                 if leader and leader not in tried:
                     queue.insert(0, leader)
-                elif not leader:  # election in progress: retry shortly
-                    _t.sleep(0.05)
+                    r.tick(reason="redirect", sleep=False)
+                elif not leader:  # election in progress: back off briefly
                     queue.append(addr)
+                    r.tick(reason="election")
                 last = e
                 continue
             if e.code == 503 and "leader unresolved" in e.message:
@@ -477,19 +567,23 @@ def call_replicas(pool: NodePool, addrs: list[str], method: str,
                 # deadline instead of declaring the replica dead (a new
                 # 2-replica partition would otherwise 503 its first
                 # client ops for the whole election)
-                _t.sleep(0.1)
                 queue.append(addr)
+                r.tick(reason="election")
                 last = e
                 continue
             if isinstance(e, ServiceUnavailable) or e.code >= 500 or e.code == 404:
                 # 404 = method/partition not on that node (dead or stale
                 # view): fail over like a down node
                 tried.add(addr)
+                if breaker is not None and isinstance(e, ServiceUnavailable):
+                    breaker.record_failure(addr)
                 last = e
                 continue
             raise
         except OSError as e:
             tried.add(addr)
+            if breaker is not None:
+                breaker.record_failure(addr)
             last = e
             continue
     raise last if last else RpcError(

@@ -1,0 +1,23 @@
+"""Fixture: retry-discipline (CFB) true positives."""
+
+import time
+
+from cubefs_tpu.utils import rpc
+
+
+def spin_forever(client):
+    # CFB001: while True + sleep-on-failure, no deadline/budget evidence
+    while True:
+        try:
+            return client.call("stat")
+        except Exception:
+            time.sleep(0.1)
+
+
+def failover_once(addr):
+    # CFB002: bare sleep in a function handling RPC failover errors
+    try:
+        return rpc.call(addr, "get_volume")
+    except rpc.ServiceUnavailable:
+        time.sleep(0.5)
+        return rpc.call(addr, "get_volume")

@@ -1,0 +1,51 @@
+"""Fixture: retry-discipline (CFB) true negatives."""
+
+import time
+
+from cubefs_tpu.utils import rpc
+from cubefs_tpu.utils.retry import RetryPolicy
+
+POLICY = RetryPolicy(base=0.05, cap=1.0, deadline=5.0)
+
+
+def policy_bounded(client):
+    # while True, but the retry is gated on Retrier.tick — bounded
+    r = POLICY.start(op="stat")
+    while True:
+        try:
+            return client.call("stat")
+        except rpc.ServiceUnavailable:
+            if not r.tick(reason="failover"):
+                raise
+
+
+def deadline_bounded(fn):
+    # explicit wall-clock deadline in the loop test — bounded
+    end = time.monotonic() + 5.0
+    while time.monotonic() < end:
+        try:
+            return fn()
+        except ValueError:
+            time.sleep(0.05)
+    return None
+
+
+def budget_bounded(fn):
+    # for-range is a budget by construction
+    for _ in range(3):
+        try:
+            return fn()
+        except ValueError:
+            time.sleep(0.01)
+    return None
+
+
+def pacing_loop(tick_fn):
+    # periodic pacing: the sleep runs every iteration, NOT on failure —
+    # this is a heartbeat, not a retry loop
+    while True:
+        try:
+            tick_fn()
+        except Exception:
+            pass
+        time.sleep(3.0)

@@ -19,6 +19,7 @@ import pytest
 
 from tool.lint import cli, core
 from tool.lint.checkers.lock_discipline import LockDisciplineChecker
+from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
 from tool.lint.checkers.rpc_idempotency import (RpcIdempotencyChecker,
                                                 is_mutating)
 from tool.lint.checkers.tier1_purity import Tier1PurityChecker
@@ -124,6 +125,26 @@ def test_tier1_purity_true_negative():
 def test_tier1_purity_slow_modules_exempt():
     mod = _module("tier1_slow_exempt.py", "tests/test_fx.py")
     assert Tier1PurityChecker().check(mod) == []
+
+
+# ---------------- retry-discipline ----------------
+
+def test_retry_discipline_true_positives():
+    mod = _module("retry_bad.py", "cubefs_tpu/fs/fx.py")
+    found = RetryDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFB001", "CFB002"]
+
+
+def test_retry_discipline_true_negative():
+    mod = _module("retry_good.py", "cubefs_tpu/fs/fx.py")
+    assert RetryDisciplineChecker().check(mod) == []
+
+
+def test_retry_discipline_exempts_retry_module_itself():
+    c = RetryDisciplineChecker()
+    assert c.applies("cubefs_tpu/fs/datanode.py")
+    assert not c.applies("cubefs_tpu/utils/retry.py")
+    assert not c.applies("tool/bench.py")
 
 
 # ---------------- suppressions ----------------

@@ -1,6 +1,7 @@
 """Checker registry: every family the suite ships, in report order."""
 
 from .lock_discipline import LockDisciplineChecker
+from .retry_discipline import RetryDisciplineChecker
 from .rpc_idempotency import RpcIdempotencyChecker
 from .tier1_purity import Tier1PurityChecker
 from .tracer_safety import TracerSafetyChecker
@@ -9,5 +10,6 @@ ALL_CHECKERS = (
     TracerSafetyChecker,
     LockDisciplineChecker,
     RpcIdempotencyChecker,
+    RetryDisciplineChecker,
     Tier1PurityChecker,
 )

@@ -1,0 +1,167 @@
+"""Retry-discipline checker (rule: retry-discipline, codes CFB0xx).
+
+PR 3 replaced the ad-hoc ``sleep(0.05)``/``sleep(0.1)``/3-attempt loops
+in the RPC failover paths with ONE ``utils.retry.RetryPolicy`` (capped
+backoff + jitter + budget + deadline, metered through utils.metrics).
+This family keeps new code from regressing to bare sleeps:
+
+  CFB001  time.sleep inside an except handler of an unbounded retry
+          loop (``while True``-style) with no deadline/budget evidence
+          — the loop can spin forever; route it through RetryPolicy
+  CFB002  direct time.sleep in a function that handles RPC failover
+          errors (RpcError / ServiceUnavailable / NotLeaderError /
+          FsError) — backoff in failover paths belongs to RetryPolicy,
+          which bounds it and exports retry counts
+
+"Deadline/budget evidence" that exempts a ``while True`` loop: a
+``.tick(...)`` call (the Retrier API), or a comparison against a
+deadline-ish name (``deadline``/``end``/``until``/``remaining``) or the
+wall clock (time.time/time.monotonic). ``for _ in range(n)`` loops are
+budget-bounded by construction. Pacing loops whose sleep sits at loop
+level (heartbeats, pollers) are NOT flagged — only sleep-on-failure.
+
+utils/retry.py itself is exempt: its Clock.sleep IS the one sanctioned
+sleep everything else must route through.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+_RPC_ERROR_NAMES = {"RpcError", "ServiceUnavailable", "NotLeaderError",
+                    "FsError"}
+_DEADLINE_NAME_HINTS = ("deadline", "end", "until", "remaining", "due")
+_CLOCK_CALLS = {"time.time", "time.monotonic", "time.perf_counter"}
+_EXEMPT = {"cubefs_tpu/utils/retry.py"}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_time_sleep(call: ast.Call, mod: Module) -> bool:
+    dotted = _dotted(call.func)
+    if not dotted:
+        return False
+    if "." in dotted:
+        head, tail = dotted.split(".", 1)
+        return tail == "sleep" and mod.import_aliases.get(head) == "time"
+    return mod.from_imports.get(dotted) == "time.sleep"
+
+
+def _walk_no_funcs(node: ast.AST):
+    """Descend without crossing into nested function/class bodies."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _const_true(test: ast.AST) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value)
+
+
+def _mentions_deadline(node: ast.AST, mod: Module) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and any(
+                h in sub.id.lower() for h in _DEADLINE_NAME_HINTS):
+            return True
+        if isinstance(sub, ast.Call) and _dotted(sub.func) in _CLOCK_CALLS:
+            return True
+    return False
+
+
+def _loop_is_bounded(loop: ast.While, mod: Module) -> bool:
+    """Deadline/budget evidence anywhere in the loop (test or body)."""
+    if not _const_true(loop.test):
+        return True  # a real condition: assume the author bounds it
+    for node in _walk_no_funcs(loop):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "tick"):
+            return True  # Retrier.tick: RetryPolicy governs this loop
+        if isinstance(node, ast.Compare) and _mentions_deadline(node, mod):
+            return True
+    return False
+
+
+def _handler_types(handler: ast.ExceptHandler) -> set[str]:
+    names: set[str] = set()
+    if handler.type is None:
+        return names
+    for sub in ast.walk(handler.type):
+        d = _dotted(sub)
+        if d:
+            names.add(d.split(".")[-1])
+    return names
+
+
+class RetryDisciplineChecker(Checker):
+    rule = "retry-discipline"
+    dirs = ("cubefs_tpu/",)
+
+    def applies(self, relpath: str) -> bool:
+        return super().applies(relpath) and relpath not in _EXEMPT
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+        out += self._check_unbounded_loops(mod)
+        out += self._check_failover_sleeps(mod)
+        return out
+
+    # -- CFB001 --
+    def _check_unbounded_loops(self, mod: Module) -> list[Violation]:
+        out = []
+        for loop in ast.walk(mod.tree):
+            if not isinstance(loop, ast.While):
+                continue
+            if _loop_is_bounded(loop, mod):
+                continue
+            for node in _walk_no_funcs(loop):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                for sub in node.body:
+                    for call in ast.walk(sub):
+                        if (isinstance(call, ast.Call)
+                                and _is_time_sleep(call, mod)):
+                            out.append(self.violation(
+                                mod, "CFB001", call,
+                                "time.sleep in an unbounded retry loop "
+                                "(no deadline/budget): start a "
+                                "utils.retry.RetryPolicy Retrier and "
+                                "gate the retry on r.tick(...)"))
+        return out
+
+    # -- CFB002 --
+    def _check_failover_sleeps(self, mod: Module) -> list[Violation]:
+        out = []
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            handles_rpc = any(
+                isinstance(node, ast.ExceptHandler)
+                and _handler_types(node) & _RPC_ERROR_NAMES
+                for node in _walk_no_funcs(fn))
+            if not handles_rpc:
+                continue
+            for node in _walk_no_funcs(fn):
+                if isinstance(node, ast.Call) and _is_time_sleep(node, mod):
+                    out.append(self.violation(
+                        mod, "CFB002", node,
+                        f"direct time.sleep in RPC failover path "
+                        f"'{fn.name}': backoff belongs to "
+                        f"utils.retry.RetryPolicy (bounded, metered)"))
+        return out

@@ -60,7 +60,7 @@ def main(argv: list[str] | None = None) -> int:
         prog="cubefs-tpu-lint",
         description="repo-specific static analysis "
                     "(tracer-safety, lock-discipline, rpc-idempotency, "
-                    "tier1-purity)")
+                    "retry-discipline, tier1-purity)")
     p.add_argument("paths", nargs="*", help="files/dirs to lint "
                    f"(default: {', '.join(DEFAULT_ROOTS)})")
     p.add_argument("--no-baseline", action="store_true",
