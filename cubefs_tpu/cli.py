@@ -29,6 +29,82 @@ def _fs(args):
     return FileSystem(view, NodePool())
 
 
+def _fetch_metrics(addr: str) -> str:
+    import http.client
+
+    host, port = addr.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=5.0)
+    try:
+        conn.request("GET", "/metrics")
+        return conn.getresponse().read().decode()
+    finally:
+        conn.close()
+
+
+def _parse_metrics(text: str) -> list[tuple[str, dict, float]]:
+    """Prometheus exposition text -> [(name, labels, value)]."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        labels: dict = {}
+        name = head
+        if "{" in head:
+            name, _, inner = head.partition("{")
+            for pair in inner.rstrip("}").split(","):
+                if pair:
+                    k, _, v = pair.partition("=")
+                    labels[k] = v.strip('"')
+        try:
+            out.append((name, labels, float(val)))
+        except ValueError:
+            continue
+    return out
+
+
+def _write_path_view(text: str) -> dict:
+    """The group-commit write-path digest: is batching actually
+    amortizing replication rounds and fsyncs on this node?"""
+    series = _parse_metrics(text)
+
+    def total(name, **match):
+        return sum(v for n, lb, v in series if n == name
+                   and all(lb.get(k) == str(w) for k, w in match.items()))
+
+    proposals = total("cubefs_raft_proposals_total")
+    batches = total("cubefs_raft_proposal_batches_total")
+    fsyncs = total("cubefs_raft_wal_fsyncs_total")
+    apply_sum = total("cubefs_raft_batch_apply_seconds_sum")
+    apply_cnt = total("cubefs_raft_batch_apply_seconds_count")
+    coalesced_entries = total("cubefs_meta_batch_entries_total")
+    coalesced_ops = total("cubefs_meta_batched_ops_total")
+    groups = sorted({lb["group"] for n, lb, _ in series
+                     if n == "cubefs_raft_proposals_total" and "group" in lb})
+    view = {
+        "raft": {
+            "proposals": proposals,
+            "proposal_batches": batches,
+            "entries_per_batch_avg":
+                round(proposals / batches, 2) if batches else None,
+            "wal_fsyncs": fsyncs,
+            "proposals_per_fsync":
+                round(proposals / fsyncs, 2) if fsyncs else None,
+            "batch_apply_avg_ms":
+                round(1000 * apply_sum / apply_cnt, 3) if apply_cnt else None,
+            "groups": len(groups),
+        },
+        "meta_coalescer": {
+            "batch_entries": coalesced_entries,
+            "batched_ops": coalesced_ops,
+            "ops_per_batch_entry_avg":
+                round(coalesced_ops / coalesced_entries, 2)
+                if coalesced_entries else None,
+        },
+    }
+    return view
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="cubefs-tpu-cli")
     sub = ap.add_subparsers(dest="group", required=True)
@@ -139,6 +215,11 @@ def main(argv=None):
     p_flash.add_argument("--group-id", type=int)
     p_flash.add_argument("--addrs", help="comma-separated flashnode addrs")
     p_flash.add_argument("--status", help="group status (set-status)")
+
+    p_metrics = sub.add_parser("metrics")  # node observability views
+    p_metrics.add_argument("action", choices=["write-path", "raw"])
+    p_metrics.add_argument("--addr", required=True,
+                           help="any node's RPC addr (serves /metrics)")
 
     p_auth = sub.add_parser("auth")
     p_auth.add_argument("action", choices=["register", "ticket"])
@@ -381,6 +462,13 @@ def main(argv=None):
                 fgc.set_group_status(args.group_id, args.status)
                 out = {"group": args.group_id, "status": args.status}
         print(json.dumps(out, indent=2))
+
+    elif args.group == "metrics":
+        text = _fetch_metrics(args.addr)
+        if args.action == "raw":
+            print(text, end="")
+        else:
+            print(json.dumps(_write_path_view(text), indent=2))
 
     elif args.group == "auth":
         import base64

@@ -136,6 +136,19 @@ _POLICY_SIZES = (64 << 10, 256 << 10, 1 << 20, 4 << 20, 16 << 20)
 _policy: list | None = None
 
 
+def _platform() -> str:
+    """Device class this process can actually dispatch to. Stamped into
+    the persisted crossover table: a table measured on a CPU-only dev
+    box routes every size class to the native engine, which is exactly
+    wrong on a TPU-attached server."""
+    try:
+        from ..ops import pallas_gf
+
+        return "tpu" if pallas_gf.on_tpu() else "cpu"
+    except Exception:
+        return "cpu"
+
+
 def _policy_path() -> str:
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -177,7 +190,7 @@ def measure_crossover(sizes=_POLICY_SIZES, repeats: int = 3,
         try:
             os.makedirs(os.path.dirname(_policy_path()), exist_ok=True)
             with open(_policy_path(), "w") as f:
-                json.dump({"table": table}, f)
+                json.dump({"table": table, "platform": _platform()}, f)
         except OSError:
             pass
     global _policy
@@ -192,7 +205,15 @@ def _load_policy() -> list:
 
         try:
             with open(_policy_path()) as f:
-                _policy = json.load(f)["table"]
+                data = json.load(f)
+            # an unstamped (legacy) table is assumed cpu-measured; a
+            # cpu-measured table in a tpu-attached process is refused —
+            # it would pin every size class to the host engine on the
+            # one machine where the device path wins. Re-measure lazily
+            # on first use rather than trust it.
+            if data.get("platform", "cpu") != "tpu" and _platform() == "tpu":
+                return measure_crossover()
+            _policy = data["table"]
         except Exception:
             # unmeasured host: conservative static split — native CPU
             # for sub-MiB stripes, device beyond
@@ -206,10 +227,56 @@ def _load_policy() -> list:
     return _policy
 
 
+# Engines that raised a device-loss error this process; consulted by
+# engine_for so a lost accelerator degrades once, not on every call.
+_dead_engines: set[str] = set()
+
+# Degradation order on device loss: pallas kernels -> plain jax ->
+# native SIMD -> table-driven host math (always available).
+_FALLBACK_CHAIN = ("tpu-pallas", "tpu", "cpp", "numpy")
+
+
+def _fallback_for(name: str) -> str | None:
+    """Next live engine after `name` in the degradation chain."""
+    try:
+        i = _FALLBACK_CHAIN.index(name)
+    except ValueError:
+        return None
+    for nxt in _FALLBACK_CHAIN[i + 1:]:
+        if nxt in _dead_engines:
+            continue
+        try:
+            get_engine(nxt)
+        except Exception:
+            continue
+        return nxt
+    return None
+
+
+def _call_with_fallback(name: str, method: str, *args):
+    """Run an engine method, degrading down the chain on device loss.
+    Only RuntimeError/OSError trigger fallback (XLA device loss
+    surfaces as a RuntimeError subclass) — semantic errors like shape
+    mismatches would fail identically on every engine and must not
+    quarantine one."""
+    while True:
+        eng = get_engine(name)
+        try:
+            return getattr(eng, method)(*args)
+        except (RuntimeError, OSError):
+            nxt = _fallback_for(name)
+            if nxt is None:
+                raise
+            _dead_engines.add(name)
+            name = nxt
+
+
 def engine_for(nbytes: int) -> Engine:
     """The measured-best engine for a stripe of `nbytes` total."""
     for limit, name in _load_policy():
         if nbytes <= limit:
+            if name in _dead_engines:
+                name = _fallback_for(name) or name
             try:
                 return get_engine(name)
             except Exception:
@@ -219,17 +286,18 @@ def engine_for(nbytes: int) -> Engine:
 
 class AutoEngine:
     """Per-call policy dispatch: route each stripe batch to the
-    measured-best engine for its size (`engine='auto'`)."""
+    measured-best engine for its size (`engine='auto'`), degrading
+    down the fallback chain if the chosen engine's device is lost."""
 
     name = "auto"
 
     def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray) -> np.ndarray:
-        return engine_for(int(np.asarray(shards).nbytes)).matrix_apply(
-            coeff, shards)
+        eng = engine_for(int(np.asarray(shards).nbytes))
+        return _call_with_fallback(eng.name, "matrix_apply", coeff, shards)
 
     def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
-        return engine_for(int(np.asarray(data).nbytes)).encode_parity(
-            data, n_parity)
+        eng = engine_for(int(np.asarray(data).nbytes))
+        return _call_with_fallback(eng.name, "encode_parity", data, n_parity)
 
 
 _REGISTRY["auto"] = AutoEngine

@@ -120,14 +120,57 @@ class MetaPartition:
                     self.snapshot()
             return result
 
+    def submit_many(self, records: list[dict]) -> list:
+        """Standalone batch door: apply an ordered batch in sequence
+        under ONE lock acquisition and land the oplog append as one
+        write+flush. Each constituent still logs as its own record with
+        its own apply-id — a batch is a commit-door optimization, not a
+        WAL format, so crash replay is identical to N separate submits.
+        Returns per-op outcomes [[result, None] | [None, [code, msg]]]."""
+        with self._lock:
+            outs = []
+            lines = []
+            for rec in records:
+                try:
+                    outs.append([self.apply(rec), None])
+                    # failed constituents are NOT logged — same as the
+                    # single-op door, whose replay assumes every oplog
+                    # record re-applies cleanly
+                    lines.append(json.dumps({"aid": self.apply_id, **rec}))
+                except MetaError as e:
+                    outs.append([None, [e.code, str(e)]])
+            if self._oplog is not None and lines:
+                self._oplog.write("".join(ln + "\n" for ln in lines))
+                self._oplog.flush()
+                self._oplog_records += len(lines)
+                if self._oplog_records >= self.SNAPSHOT_EVERY:
+                    self.snapshot()
+            return outs
+
     OP_CACHE_SIZE = 4096
 
     def apply(self, record: dict) -> dict:
         """Apply one mutation. Records carrying an op_id are idempotent:
         a client retry of an already-applied op (lost response, replica
         failover) returns the cached outcome instead of re-applying —
-        the cache is part of the FSM, so replicas stay identical."""
+        the cache is part of the FSM, so replicas stay identical.
+
+        A `__batch__` record is an ordered batch of mutations coalesced
+        into ONE raft entry by the submit-plane group commit: every
+        constituent applies in sequence through this same door (per-op
+        op_id dedup intact — a batch boundary is invisible to replay and
+        retries), and the batch's FSM result is the per-op outcome list
+        [[result, None] | [None, [code, msg]], ...] so replicas agree
+        even when some constituents fail deterministically."""
         with self._lock:
+            if record.get("op") == "__batch__":
+                outs = []
+                for sub in record["records"]:
+                    try:
+                        outs.append([self.apply(sub), None])
+                    except MetaError as e:
+                        outs.append([None, [e.code, str(e)]])
+                return outs
             op_id = record.get("op_id")
             if op_id is not None and op_id in self._op_cache:
                 result, err = self._op_cache[op_id]
@@ -408,6 +451,13 @@ class MetaPartition:
             if zlib.crc32(state) != crc:
                 raise MetaError(5, f"snapshot crc mismatch for mp {self.pid}")
             self._load_state_dict(json.loads(state))
+        if self.start <= ROOT_INO < self.end and ROOT_INO not in self.inodes:
+            # bootstrap root BEFORE oplog replay: the first records of a
+            # checkpoint-less root partition are creates under "/", and
+            # replaying them against a rootless tree would drop them all
+            # (ENOENT reads as "failed identically at apply time" below)
+            self.apply({"op": "mk_inode", "ino": ROOT_INO, "type": DIR,
+                        "mode": 0o755})
         oplog = os.path.join(self.data_dir, "oplog.jsonl")
         if os.path.exists(oplog):
             for line in open(oplog):
@@ -928,6 +978,101 @@ class MetaPartition:
                     raise MetaError(EDQUOT, "dir quota exceeded")
 
 
+class _SubmitWaiter:
+    """One rpc_submit call parked in a partition's submit coalescer."""
+
+    __slots__ = ("record", "result", "exc", "done", "event")
+
+    def __init__(self, record: dict):
+        self.record = record
+        self.result = None
+        self.exc: BaseException | None = None
+        self.done = False
+        self.event = threading.Event()
+
+    def finish(self, result, exc: BaseException | None) -> None:
+        self.result = result
+        self.exc = exc
+        self.done = True
+        self.event.set()
+
+
+class _SubmitBatcher:
+    """Per-partition group commit for the RPC submit plane: while one
+    propose is in flight (the whole replicate→fsync→apply round),
+    concurrent mutations for the same partition queue here and the next
+    drain carries them ALL as one `__batch__` raft entry — one
+    replication round for N requests, per-op results/errors fanned back
+    to their callers. A drain of one coalesces nothing: it proposes the
+    record unwrapped, so the uncontended path is the pre-batcher
+    behavior. Batch width tracks contention — no timers, no added idle
+    latency (same first-caller-drains discipline as the raft batcher
+    and `_wal_sync` underneath)."""
+
+    def __init__(self, node: "MetaNode", pid: int):
+        self.node = node
+        self.pid = pid
+        self._mu = threading.Lock()
+        self._queue: list[_SubmitWaiter] = []
+        self._busy = False
+
+    def submit(self, record: dict, timeout: float = 30.0):
+        w = _SubmitWaiter(record)
+        with self._mu:
+            self._queue.append(w)
+            drain = not self._busy
+            if drain:
+                self._busy = True
+        if drain:
+            self._drain()
+        if not w.event.wait(timeout) and not w.done:
+            raise rpc.RpcError(503, f"submit to partition {self.pid} "
+                                    f"timed out awaiting group commit")
+        if w.exc is not None:
+            raise w.exc
+        return w.result
+
+    def _drain(self) -> None:
+        while True:
+            with self._mu:
+                batch = self._queue
+                if not batch:
+                    self._busy = False
+                    return
+                self._queue = []
+            self._land(batch)
+
+    def _land(self, batch: list[_SubmitWaiter]) -> None:
+        from ..utils import metrics
+
+        raft_node = self.node.rafts.get(self.pid)
+        try:
+            if raft_node is None:
+                raise rpc.RpcError(
+                    404, f"meta partition {self.pid} no longer replicated "
+                         f"on node {self.node.node_id}")
+            metrics.meta_ops_per_batch.observe(len(batch), pid=self.pid)
+            if len(batch) == 1:
+                batch[0].finish(raft_node.propose(batch[0].record), None)
+                return
+            outs = raft_node.propose(
+                {"op": "__batch__",
+                 "records": [w.record for w in batch]})
+            metrics.meta_batch_entries.inc(pid=self.pid)
+            metrics.meta_batched_ops.inc(len(batch), pid=self.pid)
+            for w, (result, err) in zip(batch, outs):
+                if err is not None:
+                    w.finish(None, MetaError(err[0], err[1]))
+                else:
+                    w.finish(result, None)
+        except BaseException as e:
+            # batch-level failure (NotLeaderError, timeout, apply bug):
+            # every still-unresolved waiter observes the same outcome
+            for w in batch:
+                if not w.done:
+                    w.finish(None, e)
+
+
 class MetaNode:
     """Hosts many MetaPartitions; RPC surface for the meta SDK.
 
@@ -949,6 +1094,8 @@ class MetaNode:
         self.pool = node_pool
         self.partitions: dict[int, MetaPartition] = {}
         self.rafts: dict[int, object] = {}  # pid -> RaftNode
+        self._batchers: dict[int, _SubmitBatcher] = {}  # pid -> coalescer
+        self._coalesce = os.environ.get("CUBEFS_META_COALESCE", "1") != "0"
         self.dp_view_fn = None  # set_dp_view: enables the free scan
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = threading.RLock()
@@ -1042,6 +1189,13 @@ class MetaNode:
         if mp is None:
             raise rpc.RpcError(404, f"meta partition {pid} not on node {self.node_id}")
         return mp
+
+    def _batcher(self, pid: int) -> _SubmitBatcher:
+        with self._lock:
+            b = self._batchers.get(pid)
+            if b is None:
+                b = self._batchers[pid] = _SubmitBatcher(self, pid)
+            return b
 
     def _mp_leader(self, pid: int) -> MetaPartition:
         """Leader-routed access: replicated partitions serve reads and
@@ -1280,11 +1434,16 @@ class MetaNode:
                 from ..parallel.raft import NotLeaderError
 
                 try:
-                    # raft-level group commit: concurrent proposes share
-                    # one fsync and ride one append RPC (an FSM-level
-                    # submit batcher was measured 12% SLOWER — the raft
-                    # batching already captures the win)
-                    res = raft_node.propose(args["record"])
+                    # submit-plane group commit: while one propose is in
+                    # flight, concurrent mutations for this partition
+                    # coalesce into ONE __batch__ raft entry — one
+                    # replication round carries them all, and the raft
+                    # batcher amortizes lock/WAL/fsync underneath.
+                    # CUBEFS_META_COALESCE=0 keeps per-op proposes (A/B)
+                    if self._coalesce:
+                        res = self._batcher(pid).submit(args["record"])
+                    else:
+                        res = raft_node.propose(args["record"])
                 except NotLeaderError as e:
                     raise rpc.RpcError(self.REDIRECT,
                                        f"leader={e.leader or ''}") from None

@@ -44,12 +44,37 @@ import threading
 import time
 
 from ..utils import faultinject as _fi
+from ..utils import metrics as _metrics
 
 
 class NotLeaderError(Exception):
     def __init__(self, leader: str | None, reason: str = "not leader"):
         super().__init__(f"{reason}; try {leader!r}")
         self.leader = leader
+
+
+class _ProposeWaiter:
+    """One propose() call parked in the leader's group-commit batcher.
+    Resolved exactly once — by the apply loop (result/exc), a failed
+    drain (NotLeaderError), or stop() — then its private event fires:
+    waiters never contend on a shared condition variable."""
+
+    __slots__ = ("entry", "index", "term", "result", "exc", "done", "event")
+
+    def __init__(self, entry: dict):
+        self.entry = entry
+        self.index = 0  # absolute index, assigned by the drain
+        self.term = 0
+        self.result = None
+        self.exc: BaseException | None = None
+        self.done = False
+        self.event = threading.Event()
+
+    def resolve(self, result, exc: BaseException | None) -> None:
+        self.result = result
+        self.exc = exc
+        self.done = True
+        self.event.set()
 
 
 class RaftNode:
@@ -98,8 +123,17 @@ class RaftNode:
         self._election_due = self._rand_timeout()
         self._stop = threading.Event()
         self._apply_cv = threading.Condition(self._lock)
-        self._waiting: dict[int, int] = {}  # absolute index -> proposed term
-        self._results: dict[int, tuple[object, BaseException | None]] = {}
+        self._waiters: dict[int, _ProposeWaiter] = {}  # absolute index ->
+        # proposal group commit: concurrent propose() callers enqueue
+        # here; whichever caller finds the batcher idle drains the whole
+        # queue as ONE log append / WAL write / replication round.
+        # CUBEFS_RAFT_GROUP_COMMIT=0 keeps the per-call path (A/B knob).
+        self._prop_mu = threading.Lock()
+        self._prop_queue: list[_ProposeWaiter] = []
+        self._prop_busy = False
+        self._group_commit = (
+            os.environ.get("CUBEFS_RAFT_GROUP_COMMIT", "1") != "0"
+        )
         self._wal = None
         self._wal_unclean = False
         # group-commit state: records are WRITTEN+flushed under the node
@@ -232,6 +266,7 @@ class RaftNode:
                     wal = self._wal
                     if wal is not None:
                         os.fsync(wal.fileno())
+                        _metrics.raft_wal_fsyncs.inc(group=self.group_id)
             finally:
                 with self._sync_cv:
                     self._sync_active = False
@@ -308,9 +343,13 @@ class RaftNode:
         # over the same wal/FSM can never interleave with late applies
         # from this instance
         with self._lock:
+            waiters = list(self._waiters.values())
+            self._waiters.clear()
             if self._wal is not None:
                 self._wal.close()
                 self._wal = None
+        for w in waiters:
+            w.resolve(None, NotLeaderError(None, "node stopped"))
 
     def _rand_timeout(self) -> float:
         return random.uniform(self.ELECTION_MIN, self.ELECTION_MAX)
@@ -547,6 +586,15 @@ class RaftNode:
         change that drops the entry raises NotLeaderError — never a
         false success.
 
+        Group commit: concurrent propose() callers enqueue into the
+        batcher; whichever caller finds it idle drains EVERY waiting
+        entry into one log append under one lock acquisition, one WAL
+        write feeding the shared group fsync, and one replication kick
+        — N concurrent proposals cost one replication round, not N.
+        Each caller then blocks on its own per-index event; the apply
+        loop applies a whole drained batch before waking the waiters,
+        so there is no notify_all herd re-checking a shared dict.
+
         wait_all=True additionally waits until EVERY peer has
         acknowledged replication through this entry before returning
         (all-replica ack, the chain-replication consistency contract):
@@ -558,26 +606,35 @@ class RaftNode:
         with self._lock:
             if self.role != "leader":
                 raise NotLeaderError(self.leader)
-            rec = {"term": self.term, "entry": entry}
-            self.log.append(rec)
-            index = self._last_index()
-            self._waiting[index] = self.term
-            self._persist_entries([rec], rewrote=False)
-        # leader durability precedes replication/commit: group fsync
-        # outside the lock so concurrent proposers share it
-        self._wal_sync(index)
-        self._broadcast_append()
+        w = _ProposeWaiter(entry)
+        if self._group_commit:
+            with self._prop_mu:
+                self._prop_queue.append(w)
+                drain = not self._prop_busy
+                if drain:
+                    self._prop_busy = True
+            if drain:
+                self._drain_proposals()
+        else:
+            # A/B control: per-call append round (still shares the
+            # group fsync with any concurrent caller, as before)
+            last = self._append_batch([w])
+            if last:
+                self._wal_sync(last)
+                self._broadcast_append()
         deadline = time.monotonic() + timeout
-        with self._apply_cv:
-            while index not in self._results:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 or self._stop.is_set():
-                    self._waiting.pop(index, None)
-                    raise TimeoutError(f"entry {index} not committed in time")
-                self._apply_cv.wait(remaining)
-            result, exc = self._results.pop(index)
-            self._waiting.pop(index, None)
-            if exc is None and wait_all:
+        if not w.event.wait(timeout):
+            with self._lock:
+                if w.index:
+                    self._waiters.pop(w.index, None)
+            if not w.done:  # lost the race to a concurrent resolve?
+                raise TimeoutError(
+                    f"entry {w.index or '?'} not committed in time")
+        if w.exc is not None:
+            raise w.exc
+        if wait_all:
+            index = w.index
+            with self._apply_cv:
                 while any(self.applied_index.get(p, 0) < index
                           for p in self.peers):
                     remaining = deadline - time.monotonic()
@@ -586,9 +643,56 @@ class RaftNode:
                             f"entry {index} committed but not yet applied "
                             f"on all replicas")
                     self._apply_cv.wait(remaining)
-        if exc is not None:
-            raise exc
-        return result
+        return w.result
+
+    def _drain_proposals(self) -> None:
+        """The caller that found the batcher idle drains it: repeatedly
+        swap out the queue and land each swap as one lock acquisition /
+        log append / WAL write / replication kick. Entries arriving
+        while a swap is appending or fsyncing ride the next swap — the
+        fsync window is exactly where concurrent callers pile up, so
+        batch width tracks contention with no added idle latency."""
+        while True:
+            with self._prop_mu:
+                batch = self._prop_queue
+                if not batch:
+                    self._prop_busy = False
+                    return
+                self._prop_queue = []
+            last = self._append_batch(batch)
+            if last:
+                self._wal_sync(last)
+                self._broadcast_append()
+
+    def _append_batch(self, batch: list[_ProposeWaiter]) -> int:
+        """Append every waiter's entry under ONE node-lock acquisition
+        and ONE WAL write+flush. Returns the absolute index of the last
+        appended entry, or 0 if the leadership re-check failed (every
+        waiter is then resolved with NotLeaderError)."""
+        with self._lock:
+            if self._stop.is_set() or self.role != "leader":
+                stopped = self._stop.is_set()
+                err = NotLeaderError(
+                    None if stopped else self.leader,
+                    "node stopped" if stopped else "not leader")
+                for w in batch:
+                    w.resolve(None, err)
+                return 0
+            recs = []
+            for w in batch:
+                rec = {"term": self.term, "entry": w.entry}
+                self.log.append(rec)
+                recs.append(rec)
+                w.index = self._last_index()
+                w.term = self.term
+                self._waiters[w.index] = w
+            self._persist_entries(recs, rewrote=False)
+            last = self._last_index()
+        _metrics.raft_proposals.inc(len(batch), group=self.group_id)
+        _metrics.raft_proposal_batches.inc(group=self.group_id)
+        _metrics.raft_entries_per_batch.observe(
+            len(batch), group=self.group_id)
+        return last
 
     def _broadcast_append(self) -> None:
         with self._lock:
@@ -709,11 +813,15 @@ class RaftNode:
 
     def _apply_committed(self) -> None:
         # caller holds lock
+        if self.last_applied >= self.commit_index:
+            return
+        t0 = time.perf_counter()
+        resolved: list[_ProposeWaiter] = []
         while self.last_applied < self.commit_index:
             abs_idx = self.last_applied + 1
             rec = self._entry_at(abs_idx)
             self.last_applied = abs_idx
-            waited_term = self._waiting.get(abs_idx)
+            w = self._waiters.pop(abs_idx, None)
             result, exc = None, None
             if rec["entry"].get("__raft_noop__"):
                 pass
@@ -724,14 +832,22 @@ class RaftNode:
                     # deterministic app-level failures are part of the FSM;
                     # surface to a local waiter, ignore on replicas
                     exc = e
-            if waited_term is not None:
-                if rec["term"] != waited_term:
+            if w is not None:
+                if rec["term"] != w.term:
                     # slot was overwritten by another leader's entry: the
                     # proposed entry is LOST, not committed
                     exc = NotLeaderError(self.leader, "entry lost to new leader")
                     result = None
-                self._results[abs_idx] = (result, exc)
-        self._apply_cv.notify_all()
+                w.result, w.exc, w.done = result, exc, True
+                resolved.append(w)
+        # the whole drained range is applied before ANY waiter wakes:
+        # one event per waiter, no shared-cv thundering herd
+        if resolved:
+            _metrics.raft_batch_apply_latency.observe(
+                time.perf_counter() - t0, group=self.group_id)
+            for w in resolved:
+                w.event.set()
+        self._apply_cv.notify_all()  # wait_all watchers track applied_index
 
     # ---------------- RPC handlers ----------------
     def handle_vote(self, args: dict, body: bytes) -> dict:

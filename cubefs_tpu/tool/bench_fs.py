@@ -166,6 +166,230 @@ def saturated_stat(view, procs: int = 8, threads: int = 4,
     return round(total / dt, 1)
 
 
+def _create_proc(view, parent_ino, secs, threads, q, tag):
+    """One saturation client process: `threads` threads hammering mknod
+    against the partition that owns `parent_ino` — the write-side
+    sibling of _stat_proc (all creates target ONE raft group, the shape
+    group commit amortizes)."""
+    from ..fs import metanode as mn
+    from ..fs.client import FileSystem
+    from ..utils.rpc import NodePool
+
+    fs = FileSystem(view, NodePool())
+    stop = time.perf_counter() + secs
+    counts = [0] * threads
+
+    def worker(t):
+        i = 0
+        while time.perf_counter() < stop:
+            fs.meta.mknod(parent_ino, f"c{tag}_{t}_{i}", mn.FILE)
+            i += 1
+            counts[t] += 1
+
+    pool = ThreadPoolExecutor(threads)
+    list(pool.map(worker, range(threads)))
+    pool.shutdown()
+    q.put(sum(counts))
+
+
+def saturated_create(view, procs: int = 8, threads: int = 8,
+                     secs: float = 3.0) -> float:
+    """Aggregate file-create ops/s from `procs` client processes — the
+    write-side capacity number (mdtest file-creation shape). Every
+    create is one replicated mknod commit against the same parent
+    directory, so per-op replication rounds vs group commit is exactly
+    what this measures. The bench tree is left in place: removal is as
+    expensive as creation and this runs against throwaway clusters."""
+    import multiprocessing as mp_mod
+    import uuid
+
+    from ..fs.client import FileSystem
+    from ..utils.rpc import NodePool
+
+    fs = FileSystem(view, NodePool())
+    root = f"/wr_{uuid.uuid4().hex[:6]}"
+    fs.mkdir(root)
+    parent_ino = fs.resolve(root)
+    q = mp_mod.Queue()
+    ps = [mp_mod.Process(target=_create_proc,
+                         args=(view, parent_ino, secs, threads, q, i))
+          for i in range(procs)]
+    t0 = time.perf_counter()
+    for p in ps:
+        p.start()
+    total = sum(q.get() for _ in ps)
+    for p in ps:
+        p.join()
+    dt = time.perf_counter() - t0
+    return round(total / dt, 1)
+
+
+def server_create_capacity(threads: int = 384, secs: float = 4.0) -> dict:
+    """Server-side write capacity: `threads` concurrent creates against
+    a live two-node replicated metanode over the in-process transport —
+    no HTTP, no client processes — the write-side sibling of
+    native_loadgen's ms_bench number. On a shared-core box the deployed
+    measurement is client-bound long before the commit path saturates
+    (same reason the 132k read number needed the C++ loadgen); this
+    measures what the replicated commit path itself sustains, with real
+    raft WALs and fsyncs. Honors the CUBEFS_RAFT_GROUP_COMMIT /
+    CUBEFS_META_COALESCE env knobs, so an A/B isolates group commit."""
+    import tempfile as _tf
+    import threading as _th
+
+    from ..fs.metanode import MetaNode
+    from ..utils import metrics
+    from ..utils.rpc import NodePool
+
+    wd = _tf.mkdtemp(prefix="cubefs-wcap-")
+    pool = NodePool()
+    addrs = ["wcap0", "wcap1"]
+    nodes = []
+    for i, a in enumerate(addrs):
+        node = MetaNode(300 + i, data_dir=os.path.join(wd, a),
+                        addr=a, node_pool=pool)
+        pool.bind(a, node)
+        nodes.append(node)
+    for node in nodes:
+        node.create_partition(9, 1, 1 << 20, peers=addrs)
+    leader = None
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and leader is None:
+        for node in nodes:
+            if node.rafts[9].status()["role"] == "leader":
+                leader = node
+        time.sleep(0.02)
+    if leader is None:
+        for node in nodes:
+            node.stop()
+        raise TimeoutError("capacity partition never elected a leader")
+    client = pool.get(leader.addr)
+    gid, pid = "mp9", "9"
+    base = {
+        "entries": metrics.raft_proposals.value(group=gid),
+        "fsyncs": metrics.raft_wal_fsyncs.value(group=gid),
+        "batched": metrics.meta_batched_ops.value(pid=pid),
+        "batch_entries": metrics.meta_batch_entries.value(pid=pid),
+    }
+    stop = time.perf_counter() + secs
+    counts = [0] * threads
+
+    def worker(t):
+        i = 0
+        while time.perf_counter() < stop:
+            client.call("submit", {"pid": 9, "record": {
+                "op": "mknod", "parent": 1, "name": f"n{t}_{i}",
+                "type": "file", "mode": 0o644, "ts": time.time(),
+                "op_id": f"cap{t}-{i}"}})
+            i += 1
+            counts[t] += 1
+
+    t0 = time.perf_counter()
+    ths = [_th.Thread(target=worker, args=(t,)) for t in range(threads)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    dt = time.perf_counter() - t0
+    total = sum(counts)
+    entries = metrics.raft_proposals.value(group=gid) - base["entries"]
+    fsyncs = metrics.raft_wal_fsyncs.value(group=gid) - base["fsyncs"]
+    batched = metrics.meta_batched_ops.value(pid=pid) - base["batched"]
+    bentries = (metrics.meta_batch_entries.value(pid=pid)
+                - base["batch_entries"])
+    for node in nodes:
+        node.stop()
+    return {
+        "create_ops": round(total / dt, 1),
+        "creates": total,
+        "threads": threads,
+        "raft_entries": int(entries),
+        "wal_fsyncs": int(fsyncs),
+        "coalesced_ops": int(batched),
+        "ops_per_batch_entry": round(batched / bentries, 1)
+        if bentries else None,
+    }
+
+
+def write_ab(workdir: str, procs: int = 8, threads: int = 8,
+             secs: float = 3.0, cap_threads: int = 384) -> dict:
+    """Write-side capacity A/B: with group commit + coalescing forced
+    OFF (the round-5 per-op behavior) and then ON (default), measure
+    (a) server capacity — in-process create saturation against the
+    replicated commit path (server_create_capacity) — and (b) the
+    deployed full-system number: real-socket cluster + multi-process
+    HTTP clients, which on a shared-core box is client-bound (same
+    caveat as the r05 stat numbers). The per-node /metrics write-path
+    digest is captured alongside, so the claimed batching (entries ≪
+    ops, fsyncs ≪ ops) is inspectable in the artifact, not just
+    inferred from the ratio."""
+    from ..cli import _fetch_metrics, _write_path_view
+    from ..deploy.cluster import Cluster as DeployCluster
+    from ..fs.client import FileSystem
+    from ..utils import rpc
+    from ..utils.rpc import NodePool
+
+    knobs = ("CUBEFS_RAFT_GROUP_COMMIT", "CUBEFS_META_COALESCE")
+    legs = (("baseline_per_op", "0"), ("group_commit", "1"))
+    topo = {"metanodes": 2, "datanodes": 3, "replicas": 2,
+            "volume": {"name": "bench", "mp_count": 2, "dp_count": 3}}
+    out: dict = {}
+    saved = {k: os.environ.get(k) for k in knobs}
+    try:
+        for leg, knob in legs:
+            for k in knobs:
+                os.environ[k] = knob  # read at node/raft construction
+            cap = server_create_capacity(threads=cap_threads, secs=secs)
+            c = DeployCluster(topo, os.path.join(workdir, leg))
+            try:
+                state = c.up()  # role processes inherit the knobs
+                master = state["roles"]["master"][0]
+                view = rpc.call(master, "client_view",
+                                {"name": "bench"})[0]["volume"]
+                warm = FileSystem(view, NodePool())
+                deadline = time.time() + 20
+                while time.time() < deadline:
+                    try:
+                        warm.write_file("/warmup", b"x" * 100)
+                        warm.unlink("/warmup")
+                        break
+                    except Exception:
+                        time.sleep(0.5)
+                ops = saturated_create(view, procs=procs,
+                                       threads=threads, secs=secs)
+                digests = {}
+                for addr in state["roles"].get("metanode", []):
+                    try:
+                        digests[addr] = _write_path_view(_fetch_metrics(addr))
+                    except Exception:
+                        pass
+                out[leg] = {"server_capacity": cap,
+                            "deployed": {"create_ops": ops,
+                                         "write_path": digests}}
+            finally:
+                c.down()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    cap_base = out["baseline_per_op"]["server_capacity"]["create_ops"]
+    cap_gc = out["group_commit"]["server_capacity"]["create_ops"]
+    dep_base = out["baseline_per_op"]["deployed"]["create_ops"]
+    out["summary"] = {
+        "server_capacity_speedup": round(cap_gc / cap_base, 1)
+        if cap_base else None,
+        "deployed_speedup": round(
+            out["group_commit"]["deployed"]["create_ops"] / dep_base, 1)
+        if dep_base else None,
+        # r05 dir_create_ops was 726-821 (META_PACKET_AB_r05.json) —
+        # the "~800 creates/s" write-path hole this PR targets
+        "server_capacity_vs_r05_create": round(cap_gc / 821.0, 1),
+    }
+    return out
+
+
 def native_loadgen(view, iters: int = 30_000, conns: int = 4) -> dict:
     """Server-capacity measurement with the C++ load generator
     (metaserve.cc ms_bench): serial round-trips over `conns`
@@ -270,8 +494,22 @@ def main(argv=None):
                          "vs native read plane")
     ap.add_argument("--procs", type=int, default=8,
                     help="client processes for the saturation phase")
+    ap.add_argument("--write-ab", action="store_true",
+                    help="write-side capacity A/B: create saturation "
+                         "with group commit off vs on")
+    ap.add_argument("--secs", type=float, default=3.0,
+                    help="seconds per saturation leg")
+    ap.add_argument("--cap-threads", type=int, default=384,
+                    help="concurrent creates for the in-process "
+                         "server-capacity leg")
     args = ap.parse_args(argv)
     metas = []
+    if args.write_ab:
+        workdir = tempfile.mkdtemp(prefix="cubefs-bench-writeab-")
+        print(json.dumps(write_ab(workdir, procs=args.procs,
+                                  threads=args.threads, secs=args.secs,
+                                  cap_threads=args.cap_threads)))
+        return
     if args.deploy:
         workdir = tempfile.mkdtemp(prefix="cubefs-bench-deploy-")
         print(json.dumps(deployed_ab(workdir, files=args.files,

@@ -106,7 +106,22 @@ class ReplicatedFsm:
         The cache is rebuilt from the same record stream on wal/raft
         replay, so replicas and restarts agree. `op_id` is a transport
         concern and is stripped before the host `_apply` sees the
-        record."""
+        record.
+
+        A `__batch__` record carries an ordered batch of records
+        coalesced into one raft entry (see `_commit_many`): each
+        constituent applies in sequence through this same door — per-op
+        op_id dedup intact, so batch boundaries are invisible to replay
+        and transport retries — and the batch's FSM result is the
+        per-op outcome list [[result, None] | [None, [code, msg]]]."""
+        if record.get("op") == "__batch__":
+            outs = []
+            for sub in record["records"]:
+                try:
+                    outs.append([self._apply_deduped(sub), None])
+                except Exception as e:
+                    outs.append([None, [getattr(e, "code", 500), str(e)]])
+            return outs
         op_id = record.get("op_id")
         if op_id is None:
             return self._apply(record)
@@ -148,6 +163,40 @@ class ReplicatedFsm:
 
         try:
             return self.raft.propose(record)
+        except NotLeaderError as e:
+            raise rpc.RpcError(self.REDIRECT,
+                               f"leader={e.leader or ''}") from None
+
+    def _commit_many(self, records: list[dict]) -> list:
+        """Batch commit door: ONE raft entry (or one wal-lock round in
+        standalone mode) carries an ordered batch of records, with
+        per-op outcomes [[result, None] | [None, [code, msg]], ...]
+        fanned back in order. The wal still records constituents as
+        individual lines — a batch entry replays as its constituent
+        records, so the replay contract is unchanged."""
+        if self.raft is None:
+            with self._wal_lock:
+                outs = self._apply_deduped(
+                    {"op": "__batch__",
+                     "records": [dict(r) for r in records]})
+                # only constituents that APPLIED are logged/dirtied —
+                # the single-op door's contract: wal replay assumes
+                # every record re-applies cleanly
+                ok = [r for r, (res, err) in zip(records, outs)
+                      if err is None]
+                if self._segmented:
+                    for r in ok:
+                        self._fsm_dirty.update(self._segments_of(r))
+                if self._wal is not None and ok:
+                    self._wal.write(
+                        "".join(json.dumps(r) + "\n" for r in ok))
+                    self._wal.flush()
+            return outs
+        from ..parallel.raft import NotLeaderError
+
+        try:
+            return self.raft.propose(
+                {"op": "__batch__", "records": list(records)})
         except NotLeaderError as e:
             raise rpc.RpcError(self.REDIRECT,
                                f"leader={e.leader or ''}") from None

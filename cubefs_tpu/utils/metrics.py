@@ -56,6 +56,12 @@ class Histogram(_Metric):
     TYPE = "histogram"
     BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
 
+    def __init__(self, name, help_, labels, buckets=None):
+        super().__init__(name, help_, labels)
+        # per-instance bounds: latency series keep the class default,
+        # count-shaped series (entries per batch) need integer bounds
+        self.BUCKETS = tuple(buckets) if buckets is not None else self.BUCKETS
+
     def observe(self, value: float, **labels) -> None:
         k = self._key(labels)
         with self._lock:
@@ -93,11 +99,11 @@ class Registry:
         self._metrics: dict[str, _Metric] = {}
         self._lock = threading.Lock()
 
-    def _get(self, cls, name, help_, labels):
+    def _get(self, cls, name, help_, labels, **kw):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = cls(name, help_, tuple(labels))
+                m = cls(name, help_, tuple(labels), **kw)
                 self._metrics[name] = m
             return m
 
@@ -107,8 +113,8 @@ class Registry:
     def gauge(self, name, help_="", labels=()) -> Gauge:
         return self._get(Gauge, name, help_, labels)
 
-    def histogram(self, name, help_="", labels=()) -> Histogram:
-        return self._get(Histogram, name, help_, labels)
+    def histogram(self, name, help_="", labels=(), buckets=None) -> Histogram:
+        return self._get(Histogram, name, help_, labels, buckets=buckets)
 
     def render_text(self) -> str:
         """Prometheus exposition format."""
@@ -165,3 +171,35 @@ breaker_skips = DEFAULT.counter(
 faults_injected = DEFAULT.counter(
     "cubefs_faults_injected_total",
     "chaos faults injected by the installed FaultPlan", ("kind",))
+
+# write-path group commit (raft proposal batching + meta submit coalescing)
+raft_proposals = DEFAULT.counter(
+    "cubefs_raft_proposals_total",
+    "entries proposed through the leader group-commit batcher", ("group",))
+raft_proposal_batches = DEFAULT.counter(
+    "cubefs_raft_proposal_batches_total",
+    "batcher drains: each is one log append + one WAL write + one "
+    "replication round", ("group",))
+raft_entries_per_batch = DEFAULT.histogram(
+    "cubefs_raft_entries_per_batch",
+    "entries carried per proposal-batcher drain", ("group",),
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+raft_wal_fsyncs = DEFAULT.counter(
+    "cubefs_raft_wal_fsyncs_total",
+    "actual fsync(2) calls on the raft WAL (group fsync shares one "
+    "flush across concurrent acks)", ("group",))
+raft_batch_apply_latency = DEFAULT.histogram(
+    "cubefs_raft_batch_apply_seconds",
+    "latency of applying one drained batch of committed entries before "
+    "waking waiters", ("group",))
+meta_batch_entries = DEFAULT.counter(
+    "cubefs_meta_batch_entries_total",
+    "__batch__ raft entries proposed by the metanode submit coalescer",
+    ("pid",))
+meta_batched_ops = DEFAULT.counter(
+    "cubefs_meta_batched_ops_total",
+    "mutations carried inside coalesced __batch__ entries", ("pid",))
+meta_ops_per_batch = DEFAULT.histogram(
+    "cubefs_meta_ops_per_batch_entry",
+    "mutations carried per coalesced submit (1 = uncontended fast path)",
+    ("pid",), buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))

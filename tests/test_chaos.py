@@ -323,6 +323,94 @@ def test_raft_leader_isolated_mid_write_no_double_apply():
             n.stop()
 
 
+# ---------------- raft: leader killed mid-BATCH ----------------
+
+def test_raft_leader_killed_mid_batch_no_partial_apply():
+    """Group-commit failure atomicity: a coalesced `__batch__` entry is
+    ONE raft entry, so a leader isolated mid-batch must lose the whole
+    batch (no constituent may leak), and the retried batch on the new
+    leader — same op_ids — applies every constituent exactly once, on
+    every replica, including the healed old leader."""
+    from cubefs_tpu.fs import metanode as mn
+    from cubefs_tpu.fs.metanode import MetaPartition
+    from cubefs_tpu.parallel import raft as raftlib
+
+    pool = rpc.NodePool()
+    addrs = ["ba", "bb", "bc"]
+    hosts = {a: _Host() for a in addrs}
+    mps = {a: MetaPartition(1, 1, 1 << 20) for a in addrs}
+    nodes = {}
+    for a in addrs:
+        pool.bind(a, hosts[a])
+        n = raftlib.RaftNode("gb", a, addrs, mps[a].apply, pool)
+        raftlib.register_routes(hosts[a].extra_routes, n)
+        nodes[a] = n
+    for n in nodes.values():
+        n.start()
+
+    def rec(name, op_id):
+        return {"op": "mknod", "parent": mn.ROOT_INO, "name": name,
+                "type": mn.FILE, "mode": 0o644, "ts": 1.0, "op_id": op_id}
+
+    batch2 = {"op": "__batch__", "records": [
+        rec("c", "bc-1"), rec("d", "bd-1"), rec("e", "be-1")]}
+    try:
+        def leader_of():
+            for a, n in nodes.items():
+                if n.status()["role"] == "leader":
+                    return a
+            return None
+
+        _wait_for(lambda: leader_of() is not None, what="initial leader")
+        old = leader_of()
+        outs = nodes[old].propose({"op": "__batch__", "records": [
+            rec("a", "ba-1"), rec("b", "bb-1")]}, timeout=5.0)
+        assert [o[1] for o in outs] == [None, None]
+
+        plan = FaultPlan(seed=33)
+        with fi.installed(plan):
+            plan.isolate(old)
+            # mid-batch: the batch entry lands in the old leader's log
+            # but can never commit — and must never HALF-commit
+            with pytest.raises((TimeoutError, raftlib.NotLeaderError)):
+                nodes[old].propose(batch2, timeout=1.0)
+            others = [a for a in addrs if a != old]
+            # the isolated batch leaked nothing into the majority side
+            for a in others:
+                assert not ({"c", "d", "e"}
+                            & set(mps[a].dentries[mn.ROOT_INO])), \
+                    f"partial batch application on {a}"
+            _wait_for(
+                lambda: any(nodes[a].status()["role"] == "leader"
+                            for a in others),
+                what="re-election among the remaining majority")
+            new = next(a for a in others
+                       if nodes[a].status()["role"] == "leader")
+            # client retry of the WHOLE batch, same op_ids, new leader
+            outs2 = nodes[new].propose(batch2, timeout=5.0)
+            assert [o[1] for o in outs2] == [None, None, None]
+            inos = [o[0]["ino"] for o in outs2]
+            # and a duplicate retry (stale transport) replays cached
+            # outcomes per constituent instead of re-applying
+            outs3 = nodes[new].propose(batch2, timeout=5.0)
+            assert [o[0]["ino"] for o in outs3] == inos
+            plan.heal()
+            _wait_for(
+                lambda: all(set(mps[a].dentries[mn.ROOT_INO])
+                            == {"a", "b", "c", "d", "e"} for a in addrs),
+                what="post-heal convergence")
+        for a in addrs:
+            d = mps[a].dentries[mn.ROOT_INO]
+            assert [d[k] for k in ("a", "b", "c", "d", "e")] \
+                == [mps[old].dentries[mn.ROOT_INO][k]
+                    for k in ("a", "b", "c", "d", "e")]
+            # exactly-once: one inode per name, no double-minted inos
+            assert len(mps[a].inodes) == 6, f"double apply on {a}"
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
 # ---------------- replica failover + breaker ----------------
 
 class _PingSvc:

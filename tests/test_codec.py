@@ -182,6 +182,95 @@ def test_non_uint8_rejected():
         enc.encode(np.zeros((12, 16), dtype=np.int64))
 
 
+# ---------------- crossover policy + device-loss degradation ----------
+
+
+def test_policy_refuses_cpu_table_in_tpu_process(tmp_path, monkeypatch):
+    """A crossover table measured on a CPU-only host must not be
+    trusted by a TPU-attached process: it pins every size class to the
+    host engine exactly where the device path wins. The policy loader
+    re-measures lazily instead."""
+    import json
+
+    from cubefs_tpu.codec import engine as eng
+
+    path = tmp_path / "CROSSOVER.json"
+    path.write_text(json.dumps(
+        {"table": [[1 << 62, "cpp"]], "platform": "cpu"}))
+    monkeypatch.setattr(eng, "_policy_path", lambda: str(path))
+    monkeypatch.setattr(eng, "_platform", lambda: "tpu")
+    monkeypatch.setattr(eng, "_policy", None)
+    remeasured = [[1 << 62, "tpu"]]
+    calls = []
+
+    def fake_measure(*a, **kw):
+        calls.append(1)
+        eng._policy = remeasured
+        return remeasured
+
+    monkeypatch.setattr(eng, "measure_crossover", fake_measure)
+    assert eng._load_policy() == remeasured
+    assert calls == [1]
+    # the re-measured table is cached — no repeat measurement
+    assert eng._load_policy() == remeasured
+    assert calls == [1]
+
+    # same table, tpu-stamped: trusted as-is in a tpu process
+    path.write_text(json.dumps(
+        {"table": [[1 << 62, "cpp"]], "platform": "tpu"}))
+    monkeypatch.setattr(eng, "_policy", None)
+    assert eng._load_policy() == [[1 << 62, "cpp"]]
+    assert calls == [1]
+
+
+def test_measure_crossover_stamps_platform(tmp_path, monkeypatch):
+    import json
+
+    from cubefs_tpu.codec import engine as eng
+
+    path = tmp_path / "CROSSOVER.json"
+    monkeypatch.setattr(eng, "_policy_path", lambda: str(path))
+    monkeypatch.setattr(eng, "_policy", None)
+    table = eng.measure_crossover(sizes=(4096,), repeats=1)
+    saved = json.loads(path.read_text())
+    assert saved["table"] == table
+    assert saved["platform"] == eng._platform()
+
+
+def test_autoengine_degrades_on_device_loss(monkeypatch, rng):
+    """Device loss mid-call: the auto engine falls down the
+    pallas→jax→cpp→numpy chain, quarantines the dead engine, and the
+    answer stays bit-identical to the host golden."""
+    from cubefs_tpu.codec import engine as eng
+
+    class DyingEngine:
+        name = "tpu"
+
+        def matrix_apply(self, coeff, shards):
+            raise RuntimeError("DEVICE_LOST: accelerator went away")
+
+        def encode_parity(self, data, n_parity):
+            raise RuntimeError("DEVICE_LOST: accelerator went away")
+
+    monkeypatch.setattr(eng, "_dead_engines", set())
+    monkeypatch.setattr(eng, "_instances", {"tpu": DyingEngine()})
+    monkeypatch.setattr(eng, "_policy", [[1 << 62, "tpu"]])
+    auto = eng.AutoEngine()
+    data = rng.integers(0, 256, (6, 64)).astype(np.uint8)
+    parity = auto.encode_parity(data, 3)
+    assert np.array_equal(parity, eng.NumpyEngine().encode_parity(data, 3))
+    # the dead engine is quarantined: the router skips it from now on
+    assert "tpu" in eng._dead_engines
+    assert eng.engine_for(64).name != "tpu"
+    # a semantic error must NOT trigger fallback/quarantine
+    monkeypatch.setattr(eng, "_dead_engines", set())
+    with pytest.raises(ValueError):
+        eng._call_with_fallback(
+            "cpp" if "cpp" in eng._REGISTRY else "numpy", "matrix_apply",
+            np.zeros((3, 9), dtype=np.uint8), data)
+    assert not eng._dead_engines
+
+
 def test_lrc_local_reconstruct_edge_cases(rng):
     enc = make_encoder(cm.CodeMode.EC6P10L2)
     t = enc.t

@@ -143,6 +143,35 @@ def test_pallas_crc_matches_jnp_path_inside_jit():
     assert np.array_equal(np.asarray(f_pl2(a)), np.asarray(f_np(a)))
 
 
+@pytest.mark.parametrize("b,block_len,chunk,tb", [
+    (7, 8192, 1024, 32),     # odd batch: 56 chunk rows pad to 64
+    (13, 4096, 4096, 8),     # odd batch, single-chunk blocks
+    (3, 131072, 1000, 64),   # 128 KiB extent blocks, non-divisor target
+    (5, 131072, 4096, 128),  # 128 KiB, divisor chunk, production tile
+    (2, 4 << 20, 3000, 512), # 4 MiB blob-frame blocks, non-divisor target
+])
+def test_pallas_crc_wide_geometries(b, block_len, chunk, tb):
+    """Interpret-mode sweep over the extent/blob production block sizes
+    (128 KiB datanode blocks, 4 MiB blob frames), odd block counts that
+    force tile padding, and chunk targets that are NOT divisors of the
+    block (fit_chunk_len must refit, e.g. 1000 -> 512, 3000 -> 2048)."""
+    import zlib
+
+    from cubefs_tpu.ops import crc32_kernel, pallas_crc
+
+    rng = np.random.default_rng(b * 1000 + tb)
+    fitted = crc32_kernel.fit_chunk_len(chunk, block_len)
+    assert block_len % fitted == 0
+    if chunk not in (1024, 4096):
+        assert fitted != chunk  # the non-divisor targets really refit
+    blocks = rng.integers(0, 256, (b, block_len), dtype=np.uint8)
+    got = np.asarray(pallas_crc.crc32_blocks_pallas(
+        blocks, chunk_len=chunk, tile_blocks=tb))
+    want = np.array([zlib.crc32(r.tobytes()) for r in blocks],
+                    dtype=np.uint32)
+    assert np.array_equal(got, want), (b, block_len, chunk, tb)
+
+
 def test_pallas_crc_verify_tile_interpret():
     from cubefs_tpu.ops import pallas_crc
 

@@ -459,3 +459,67 @@ def test_role_listener_fires_on_change_only():
         assert calls == [("follower", leader.node.me)]
     finally:
         stop_all(members)
+
+
+def test_concurrent_proposes_group_commit(tmp_path):
+    """The proposal batcher: many concurrent propose() callers all
+    succeed with their own results, entries apply in log order, and the
+    drain count stays well below the proposal count (one replication
+    round carries many entries). Also covers the per-index waiter path
+    replacing the shared notify_all herd."""
+    from cubefs_tpu.utils import metrics
+
+    members, _ = make_cluster(2, tmp=tmp_path)
+    try:
+        leader = wait_leader(members)
+        gid = leader.node.group_id
+        p0 = metrics.raft_proposals.value(group=gid)
+        b0 = metrics.raft_proposal_batches.value(group=gid)
+        n_threads, per_thread = 12, 8
+        results = {}
+        gate = threading.Barrier(n_threads)
+
+        def worker(t):
+            gate.wait(timeout=10)
+            for i in range(per_thread):
+                results[(t, i)] = leader.node.propose(
+                    {"seq": t * 1000 + i}, timeout=10.0)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        n = n_threads * per_thread
+        assert len(results) == n
+        # apply_fn here is list.append -> returns None; every propose
+        # resolved (no exception) and the leader applied all entries
+        seqs = sorted(e["seq"] for e in leader.applied if "seq" in e)
+        assert seqs == sorted(t * 1000 + i for t in range(n_threads)
+                              for i in range(per_thread))
+        proposals = metrics.raft_proposals.value(group=gid) - p0
+        drains = metrics.raft_proposal_batches.value(group=gid) - b0
+        assert proposals == n
+        assert drains < n, "no batching happened under contention"
+    finally:
+        stop_all(members)
+
+
+def test_propose_timeout_cleans_up_waiter():
+    """A timed-out proposer withdraws its waiter; the entry may still
+    commit later without anyone to wake (no leak, no crash)."""
+    members, pool = make_cluster(3, pool=FlakyPool())
+    try:
+        leader = wait_leader(members)
+        for m in members.values():
+            if m is not leader:
+                pool.down.add(m.node.me)
+        with pytest.raises(TimeoutError):
+            leader.node.propose({"seq": 1}, timeout=0.3)
+        assert not leader.node._waiters, "timed-out waiter leaked"
+        pool.down.clear()
+        leader2 = wait_leader(members)
+        leader2.node.propose({"seq": 2}, timeout=5.0)
+    finally:
+        stop_all(members)
