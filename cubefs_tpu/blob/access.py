@@ -24,7 +24,7 @@ import numpy as np
 
 from ..codec import codemode as cm
 from ..codec.encoder import CodecConfig, new_encoder
-from ..utils import rpc
+from ..utils import metrics, rpc
 from .types import Location, Slice, VolumeInfo
 
 
@@ -52,6 +52,9 @@ class AccessConfig:
     policies: list = field(default_factory=lambda: list(DEFAULT_POLICIES))
     max_workers: int = 16
     put_quorum_override: int | None = None  # tests
+    # failure-domain locality: with an AZ label, degraded LRC reads try
+    # this AZ's local stripe first (blob/topology.py contract)
+    client_az: str | None = None
 
 
 class AccessHandler:
@@ -267,6 +270,16 @@ class AccessHandler:
                     got[i] = p
                 else:
                     errs[i] = err
+            # LRC: before widening to the global stripe, try repairing
+            # each missing data shard inside its local stripe — reads
+            # stay within one AZ (the client's first, when labeled)
+            if t.l and any(i not in got for i in range(t.n)):
+                self._local_reconstruct(enc, vol, bid, got, errs)
+                if all(i in got for i in range(t.n)):
+                    self._file_repairs(vol, bid, got, errs, t.n)
+                    metrics.reconstruct_reads.inc(path="local")
+                    data = b"".join(got[i] for i in range(t.n))
+                    return data[:payload_len]
             extra_idx = [i for i in range(t.n, t.n + t.m)
                          if i not in got and i not in errs]
             for i, p, err in self._map(
@@ -275,20 +288,13 @@ class AccessHandler:
                 if err is None:
                     got[i] = p
         missing = [i for i in range(t.n) if i not in got]
-        present = sorted(got)
+        present = sorted(i for i in got if i < t.n + t.m)
         if len(present) < t.n:
             raise GetError(
                 f"bid {bid}: only {len(present)} of {t.n} shards readable"
             )
-        if self.repair_queue is not None:
-            # repair only shards whose reads actually FAILED — a merely
-            # slow healthy shard must not trigger data movement
-            for i in missing:
-                if i in errs:
-                    self.repair_queue.put(
-                        {"type": "shard_repair", "vid": vol.vid, "bid": bid,
-                         "bad_index": i}
-                    )
+        self._file_repairs(vol, bid, got, errs, t.n)
+        metrics.reconstruct_reads.inc(path="global")
         shard_size = len(next(iter(got.values())))
         stripe = np.zeros((t.n + t.m, shard_size), dtype=np.uint8)
         for i in present:
@@ -301,6 +307,70 @@ class AccessHandler:
         enc.reconstruct_data(stripe, all_bad)
         data = np.ascontiguousarray(stripe[: t.n]).reshape(-1)[:payload_len]
         return data.tobytes()
+
+    def _file_repairs(self, vol: VolumeInfo, bid: int, got: dict,
+                      errs: dict, n: int) -> None:
+        """Queue repair for data shards whose reads actually FAILED — a
+        merely slow healthy shard must not trigger data movement."""
+        if self.repair_queue is None:
+            return
+        for i in range(n):
+            if i not in got and i in errs:
+                self.repair_queue.put(
+                    {"type": "shard_repair", "vid": vol.vid, "bid": bid,
+                     "bad_index": i}
+                )
+
+    def _local_reconstruct(self, enc, vol: VolumeInfo, bid: int,
+                           got: dict, errs: dict) -> None:
+        """AZ-local degraded read: repair missing data shards inside
+        their LRC local stripes (tentpole consumer 3). Each stripe is
+        one AZ's shards + local parity, so the extra reads never leave
+        that AZ; stripes in the client's AZ (cfg.client_az vs the
+        units' placement labels) go first. Mutates got in place; any
+        stripe it cannot solve is left for the global fallback."""
+        t = enc.t
+        groups: dict[tuple, tuple[int, int]] = {}  # indices -> (ln, lm)
+        for i in range(t.n):
+            if i in got:
+                continue
+            indices, ln, lm = t.local_stripe(i)
+            if not indices:
+                return
+            groups[tuple(indices)] = (ln, lm)
+
+        def az_rank(indices: tuple) -> int:
+            if not self.cfg.client_az:
+                return 0
+            azs = {vol.units[j].az for j in indices if j < len(vol.units)}
+            return 0 if self.cfg.client_az in azs else 1
+
+        for indices in sorted(groups, key=lambda ix: (az_rank(ix), ix)):
+            ln, lm = groups[indices]
+            fetch = [j for j in indices if j not in got and j not in errs]
+            for j, p, err in self._map(
+                lambda j: self._read_shard(vol, j, bid), fetch
+            ):
+                if err is None:
+                    got[j] = p
+                else:
+                    errs[j] = err
+            sub_bad = [pos for pos, j in enumerate(indices) if j not in got]
+            if not sub_bad or len(sub_bad) > lm or not got:
+                continue  # unsolvable locally -> global stripe's problem
+            size = len(next(iter(got.values())))
+            local = np.zeros((ln + lm, size), dtype=np.uint8)
+            for pos, j in enumerate(indices):
+                if j in got:
+                    local[pos] = np.frombuffer(got[j], dtype=np.uint8)
+            try:
+                # bare local stripe: LrcEncoder solves (ln+lm) intra-AZ
+                enc.reconstruct(local, sub_bad)
+            except Exception:
+                continue
+            for pos, j in enumerate(indices):
+                if j not in got:  # solved rows (incl. parity) all count
+                    got[j] = local[pos].tobytes()
 
     # ----------------------------- DELETE -----------------------------
     def delete(self, loc: Location) -> None:

@@ -22,9 +22,11 @@ from .chunkstore import ChunkStore, ChunkStoreError, CrcMismatchError, ShardNotF
 
 class BlobNode:
     def __init__(self, node_id: int, disk_paths: list[str], cm_client: rpc.Client | None = None,
-                 addr: str = ""):
+                 addr: str = "", az: str = "", rack: str = ""):
         self.node_id = node_id
         self.addr = addr
+        self.az = az  # failure-domain labels; carried on register + heartbeat
+        self.rack = rack
         self.cm = cm_client
         self.stores: dict[int, ChunkStore] = {}  # disk_id -> store
         self._disk_paths = list(disk_paths)
@@ -39,6 +41,7 @@ class BlobNode:
         for path in self._disk_paths:
             meta, _ = self.cm.call(
                 "register_disk", {"node_addr": self.addr, "path": path,
+                                  "az": self.az, "rack": self.rack,
                                   "op_id": uuid.uuid4().hex}
             )
             disk_id = meta["disk_id"]
@@ -61,7 +64,13 @@ class BlobNode:
     def send_heartbeat(self) -> None:
         live = [d for d in self.disk_ids if not self._disk_down(d)]
         if live and self.cm is not None:
-            self.cm.call("heartbeat", {"disk_ids": live})
+            hb = {"disk_ids": live}
+            if self.az:
+                # heartbeats re-assert labels so a relabeled node
+                # converges without re-registering its disks
+                hb["az"] = self.az
+                hb["rack"] = self.rack
+            self.cm.call("heartbeat", hb)
 
     def stop(self) -> None:
         self._hb_stop.set()

@@ -14,14 +14,14 @@ import os
 import threading
 import time
 
+import sys
+
 from ..codec import codemode as cm
-from ..utils import rpc
+from ..utils import metrics, rpc
 from ..utils.fsm import ReplicatedFsm
+from . import topology
+from .topology import NoAvailableDisks  # noqa: F401  (re-export: legacy import site)
 from .types import DiskInfo, DiskStatus, VolumeInfo, VolumeStatus, VolumeUnit
-
-
-class NoAvailableDisks(Exception):
-    pass
 
 
 class ClusterMgr(ReplicatedFsm):
@@ -47,6 +47,7 @@ class ClusterMgr(ReplicatedFsm):
         # [{shard_id, start, end, addrs}] range map
         self.spaces: dict[str, list[dict]] = {}
         self._sn_heartbeat: dict[str, float] = {}  # volatile, leader-local
+        self._placement_warned: set[str] = set()  # once-per-kind stderr note
         self._next_disk = 1
         self._next_vid = 1
         self._next_bid = 1
@@ -102,7 +103,8 @@ class ClusterMgr(ReplicatedFsm):
 
     # ---------------- disks & nodes ----------------
     def register_disk(self, node_addr: str, path: str,
-                      op_id: str | None = None) -> int:
+                      op_id: str | None = None,
+                      az: str = "", rack: str = "") -> int:
         # ids allocate INSIDE apply: a new leader whose apply stream lags
         # must never re-issue an id another leader already committed.
         # op_id dedups transport retries — without it a retried register
@@ -110,25 +112,61 @@ class ClusterMgr(ReplicatedFsm):
         with self._propose_lock:
             rec = {"op": "register_disk", "node_addr": node_addr,
                    "path": path}
+            if az:
+                rec["az"] = az
+            if rack:
+                rec["rack"] = rack
             if op_id is not None:
                 rec["op_id"] = op_id
             return self._commit(rec)
 
-    def _apply_register_disk(self, node_addr: str, path: str) -> int:
+    def _apply_register_disk(self, node_addr: str, path: str,
+                             az: str = "", rack: str = "") -> int:
         disk_id = self._next_disk
         self._next_disk += 1
         self.disks[disk_id] = DiskInfo(disk_id, node_addr, path,
-                                       last_heartbeat=time.time())
+                                       last_heartbeat=time.time(),
+                                       az=az, rack=rack)
         return disk_id
 
-    def heartbeat(self, disk_ids: list[int], chunk_counts: dict | None = None) -> None:
+    def heartbeat(self, disk_ids: list[int], chunk_counts: dict | None = None,
+                  az: str | None = None, rack: str | None = None) -> None:
         now = time.time()
+        relabel = []
         with self._lock:
             for d in disk_ids:
                 if d in self.disks:
                     self.disks[d].last_heartbeat = now
                     if chunk_counts and str(d) in chunk_counts:
                         self.disks[d].chunk_count = chunk_counts[str(d)]
+                    if az is not None and (
+                            self.disks[d].az != az
+                            or (rack is not None and self.disks[d].rack != rack)):
+                        relabel.append(d)
+        # label changes are replicated state — go through the FSM door,
+        # never mutated in the volatile heartbeat path above. Best
+        # effort: a follower receiving a stray heartbeat drops the
+        # relabel (the node retries against the leader on its next beat)
+        for d in relabel:
+            try:
+                self.relabel_disk(d, az, rack)
+            except Exception:
+                break
+
+    def relabel_disk(self, disk_id: int, az: str,
+                     rack: str | None = None) -> None:
+        with self._propose_lock:
+            self._commit({"op": "relabel_disk", "disk_id": disk_id,
+                          "az": az, "rack": rack})
+
+    def _apply_relabel_disk(self, disk_id: int, az: str,
+                            rack: str | None = None) -> None:
+        d = self.disks.get(disk_id)
+        if d is None:
+            return
+        d.az = az
+        if rack is not None:
+            d.rack = rack
 
     def set_disk_status(self, disk_id: int, status: int) -> None:
         # validate BEFORE the commit: a nonsense status in the replicated
@@ -156,29 +194,31 @@ class ClusterMgr(ReplicatedFsm):
     # ---------------- volumes ----------------
     def alloc_volume(self, codemode: int,
                      op_id: str | None = None) -> VolumeInfo:
-        """Create a volume: place its N+M+L chunks on distinct normal
-        disks (distinctness waived only for single-node dev clusters)."""
+        """Create a volume: the topology selector maps each unit slot to
+        its codemode-assigned AZ (LRC local stripes stay AZ-local) and
+        spreads within an AZ across racks/hosts/disks. Colocation and
+        AZ shortfalls degrade explicitly: warning under
+        allow_colocated_units, NoAvailableDisks otherwise."""
         t = cm.tactic(codemode)
         with self._propose_lock:
             with self._lock:
-                normal = [d for d in self.disks.values()
-                          if d.status == DiskStatus.NORMAL]
-                if not normal:
-                    raise NoAvailableDisks("no registered disks")
-                if len(normal) < t.total and not self.allow_colocated_units:
-                    raise NoAvailableDisks(
-                        f"{len(normal)} disks < {t.total} units for "
-                        f"{cm.CodeMode(codemode).name}"
-                    )
-                # least-loaded placement (disk_id tiebreak: deterministic)
-                normal.sort(key=lambda d: (d.chunk_count, d.disk_id))
-                picks = [normal[i % len(normal)] for i in range(t.total)]
+                disks = list(self.disks.values())
+            picks, warnings = topology.place_volume(
+                t, disks, self.allow_colocated_units,
+                label=cm.CodeMode(codemode).name)
+            for w in warnings:
+                kind = w.split(":", 1)[0]
+                metrics.placement_colocated.inc(kind=kind)
+                if kind not in self._placement_warned:
+                    self._placement_warned.add(kind)
+                    print(f"[clustermgr] placement degraded: {w}",
+                          file=sys.stderr)
             # placement decided leader-side; vid/chunk ids allocate in apply
             rec = {
                 "op": "create_volume",
                 "codemode": int(codemode),
-                "picks": [{"disk_id": p.disk_id, "node_addr": p.node_addr}
-                          for p in picks],
+                "picks": [{"disk_id": p.disk_id, "node_addr": p.node_addr,
+                           "az": topology.az_of(p)} for p in picks],
             }
             if op_id is not None:
                 rec["op_id"] = op_id
@@ -190,8 +230,13 @@ class ClusterMgr(ReplicatedFsm):
         self._next_vid += 1
         units = []
         for i, p in enumerate(picks):
+            az = p.get("az", "")
+            if not az:
+                # pre-topology WAL records: derive from the disk table
+                d = self.disks.get(p["disk_id"])
+                az = topology.az_of(d) if d is not None else ""
             units.append(VolumeUnit(i, p["disk_id"], self._next_chunk,
-                                    p["node_addr"]))
+                                    p["node_addr"], az=az))
             self._next_chunk += 1
         vol = VolumeInfo(vid=vid, codemode=codemode, units=units,
                          status=VolumeStatus.ACTIVE)
@@ -218,7 +263,11 @@ class ClusterMgr(ReplicatedFsm):
     def _apply_update_unit(self, vid: int, index: int, disk_id: int,
                            chunk_id: int, node_addr: str) -> None:
         vol = self.volumes[vid]
-        vol.units[index] = VolumeUnit(index, disk_id, chunk_id, node_addr)
+        # az derives from the disk table, not the proposal: every
+        # replica resolves the same label for the same committed disk_id
+        d = self.disks.get(disk_id)
+        vol.units[index] = VolumeUnit(index, disk_id, chunk_id, node_addr,
+                                      az=topology.az_of(d) if d else "")
         vol.epoch += 1
 
     def volumes_on_disk(self, disk_id: int) -> list[tuple[int, int]]:
@@ -233,25 +282,25 @@ class ClusterMgr(ReplicatedFsm):
             return out
 
     def pick_destination(self, exclude_disks: set[int],
-                         hard_exclude: set[int] | None = None) -> DiskInfo:
-        """Least-loaded NORMAL disk, preferring disks outside
-        exclude_disks (the volume's current homes). When the volume
-        already spans every disk, colocating two units beats leaving the
-        stripe degraded — only hard_exclude (broken/source disks) is
-        absolute."""
-        hard = hard_exclude or set()
+                         hard_exclude: set[int] | None = None,
+                         prefer_az: str | None = None,
+                         require_az: bool = False,
+                         avoid_hosts=(),
+                         require_new_host: bool = False) -> DiskInfo:
+        """Topology-routed repair/rebalance destination: prefers a disk
+        in prefer_az (the failed slot's AZ), then any NORMAL disk
+        outside exclude_disks, then — only with allow_colocated_units —
+        disks the volume already uses (colocating beats staying
+        degraded). Only hard_exclude (broken/source disks) is absolute;
+        require_az/require_new_host harden the soft preferences for
+        rebalance moves that must strictly improve spread."""
         with self._lock:
-            normal = [d for d in self.disks.values()
-                      if d.status == DiskStatus.NORMAL and d.disk_id not in hard]
-            cands = [d for d in normal if d.disk_id not in exclude_disks]
-            if not cands and self.allow_colocated_units:
-                # operator opted in: colocating beats staying degraded
-                cands = normal
-            if not cands:
-                raise NoAvailableDisks(
-                    "no destination disk outside the volume's failure domains"
-                )
-            return min(cands, key=lambda d: d.chunk_count)
+            disks = list(self.disks.values())
+        return topology.pick_destination(
+            disks, exclude_disks, hard_exclude,
+            prefer_az=prefer_az, require_az=require_az,
+            avoid_hosts=avoid_hosts, require_new_host=require_new_host,
+            allow_colocated=self.allow_colocated_units)
 
     def alloc_chunk_id(self) -> int:
         with self._propose_lock:
@@ -500,6 +549,16 @@ class ClusterMgr(ReplicatedFsm):
             return {name: [dict(s) for s in shards]
                     for name, shards in self.spaces.items()}
 
+    def topology_view(self) -> dict:
+        """AZ->rack->host->disk tree + misplacement/skew summary for
+        `cubefs-cli topology blob` (snapshotted under the lock)."""
+        with self._lock:
+            disks = [DiskInfo.from_dict(d.to_dict())
+                     for d in self.disks.values()]
+            vols = [VolumeInfo.from_dict(v.to_dict())
+                    for v in self.volumes.values()]
+        return topology.cluster_view(disks, vols)
+
     def stat(self) -> dict:
         with self._lock:
             return {
@@ -515,11 +574,18 @@ class ClusterMgr(ReplicatedFsm):
     def rpc_register_disk(self, args, body):
         self._leader_gate()
         return {"disk_id": self.register_disk(args["node_addr"], args["path"],
-                                              op_id=args.get("op_id"))}
+                                              op_id=args.get("op_id"),
+                                              az=args.get("az", ""),
+                                              rack=args.get("rack", ""))}
 
     def rpc_heartbeat(self, args, body):
-        self.heartbeat(args["disk_ids"], args.get("chunk_counts"))
+        self.heartbeat(args["disk_ids"], args.get("chunk_counts"),
+                       az=args.get("az"), rack=args.get("rack"))
         return {}
+
+    def rpc_topology_view(self, args, body):
+        self._leader_gate()
+        return self.topology_view()
 
     def rpc_alloc_volume(self, args, body):
         self._leader_gate()

@@ -16,12 +16,14 @@ import threading
 import time
 import uuid
 
-from ..utils import rpc
+from ..utils import metrics, rpc
 from ..utils.retry import RetryPolicy
 
 # shard deletes: 2 quick retries on node-level blips, tightly bounded —
 # the kafka-style delete queue re-drives real failures later anyway
 _DELETE_POLICY = RetryPolicy(base=0.02, cap=0.2, max_retries=2, deadline=2.0)
+from . import topology
+from .topology import NoAvailableDisks
 from .types import DiskStatus, VolumeInfo
 
 
@@ -216,9 +218,17 @@ class Scheduler:
 
     def _queue_unit_repair(self, vid: int, unit_index: int, reason: str,
                            src_disk: int | None = None,
-                           created_flag: list | None = None) -> str:
+                           created_flag: list | None = None,
+                           prefer_az: str | None = None,
+                           require_az: bool = False,
+                           require_new_host: bool = False) -> str:
         """Queue (or dedup to) a unit-repair task. created_flag, if
-        given, receives True only when a NEW task was created."""
+        given, receives True only when a NEW task was created.
+
+        prefer_az defaults to the failed slot's current AZ so repairs
+        stay AZ-local when the AZ has capacity; rebalance moves pass the
+        stripe's home AZ with require_az (a move that lands in yet
+        another wrong AZ is churn, not progress)."""
         with self._lock:
             for t in self.tasks.values():
                 if (t.get("vid") == vid and t.get("unit_index") == unit_index
@@ -229,7 +239,14 @@ class Scheduler:
             # pick_destination already filters to NORMAL disks; only a
             # still-NORMAL source (the balance path) needs hard exclusion
             hard = {src_disk} if src_disk is not None else set()
-            dest = self.cm.pick_destination(exclude, hard_exclude=hard)
+            if prefer_az is None and not require_az:
+                prefer_az = vol.units[unit_index].az or None
+            avoid = {u.node_addr for u in vol.units
+                     if u.index != unit_index}
+            dest = self.cm.pick_destination(
+                exclude, hard_exclude=hard, prefer_az=prefer_az,
+                require_az=require_az, avoid_hosts=avoid,
+                require_new_host=require_new_host)
             task = {
                 "task_id": uuid.uuid4().hex[:16],
                 "type": "unit_repair",
@@ -458,7 +475,7 @@ class Scheduler:
                       if d.status == DiskStatus.NORMAL]
             if len(normal) < 2:
                 return 0
-            normal.sort(key=lambda d: d.chunk_count)
+            normal = topology.order_by_load(normal)
             # account planned moves locally — never mutate clustermgr's
             # records outside its apply door, and never count deduped
             # re-queues as movement
@@ -481,6 +498,60 @@ class Scheduler:
                     planned[hot.disk_id] = planned.get(hot.disk_id, 0) + 1
                     moves += 1
             return moves
+
+    REBALANCE_MAX_MOVES = 4  # per sweep: converge without a move storm
+
+    def rebalance_sweep(self, max_moves: int | None = None) -> dict:
+        """Failure-domain rebalance (tentpole consumer 2): score every
+        volume for misplacement — wrong-AZ units first, then intra-AZ
+        host colocation — and queue rate-limited unit migrations through
+        the ordinary repair machinery until the cluster converges.
+        Sets the cubefs_placement_* gauges on every pass, so the scoring
+        runs (and the gauges stay fresh) even when nothing moves."""
+        if max_moves is None:
+            max_moves = self.REBALANCE_MAX_MOVES
+        empty = {"moves": 0, "misplaced_units": None, "colocated_units": None,
+                 "az_skew": None}
+        if not self.switch.enabled("rebalance"):
+            return empty
+        if not self._leader_grace_ok():
+            return empty
+        with self._lock:
+            disk_map = {d.disk_id: d for d in self.cm.disks.values()}
+            vols = [self.cm.get_volume(v) for v in sorted(self.cm.volumes)]
+        rep = topology.cluster_misplacement(vols, disk_map)
+        metrics.placement_misplaced.set(rep["misplaced_units"])
+        metrics.placement_az_skew.set(rep["az_skew"])
+        moves = 0
+        # wrong-AZ slots move home (require_az: landing in a third AZ is
+        # churn); colocated slots move to a fresh host in their own AZ
+        # (require_new_host: a move that stays stacked is churn too)
+        plan = ([("wrong_az", m, m["want"], True) for m in rep["wrong_az"]]
+                + [("colocated", m, m["az"] or None, bool(m["az"]))
+                   for m in rep["colocated"]])
+        for kind, m, want_az, require_az in plan:
+            if moves >= max_moves:
+                break
+            created: list = []
+            try:
+                self._queue_unit_repair(
+                    m["vid"], m["slot"],
+                    reason=f"rebalance {kind} -> {want_az or 'spread'}",
+                    prefer_az=want_az, require_az=require_az,
+                    require_new_host=(kind == "colocated"),
+                    created_flag=created)
+            except NoAvailableDisks:
+                continue  # no strictly-better home yet; next sweep retries
+            if created:
+                moves += 1
+                metrics.rebalance_moves.inc(reason=kind)
+        return {"moves": moves, "misplaced_units": rep["misplaced_units"],
+                "colocated_units": rep["colocated_units"],
+                "az_skew": rep["az_skew"]}
+
+    def rpc_rebalance(self, args, body):
+        mm = args.get("max_moves")
+        return self.rebalance_sweep(int(mm) if mm is not None else None)
 
     def manual_migrate(self, vid: int, unit_index: int) -> str:
         """Operator-requested unit migration (manual_migrater.go role)."""
@@ -717,6 +788,8 @@ class Scheduler:
                     self.consume_repair_msgs()
                     self.consume_delete_msgs()
                     self._ticks = getattr(self, "_ticks", 0) + 1
+                    if self._ticks % 30 == 0:  # failure-domain convergence
+                        self.rebalance_sweep()
                     if self._ticks % 60 == 0:  # periodic space reclaim
                         self.compact_chunks()
                 except Exception:
@@ -746,7 +819,7 @@ class Scheduler:
         return {}
 
     TASK_KINDS = ("disk_repair", "shard_repair", "blob_delete", "balance",
-                  "volume_inspect", "compact")
+                  "rebalance", "volume_inspect", "compact")
 
     def rpc_task_switch(self, args, body):
         """Runtime kill-switches per background task kind (taskswitch

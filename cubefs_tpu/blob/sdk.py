@@ -19,12 +19,17 @@ class BlobClient:
     """In-process blob put/get/delete (the embedded access client)."""
 
     def __init__(self, clustermgr, node_pool, cfg: AccessConfig | None = None,
-                 proxy=None):
+                 proxy=None, client_az: str | None = None):
         cm_client = (clustermgr if isinstance(clustermgr, rpc.Client)
                      else rpc.Client(clustermgr))
         proxy_client = (None if proxy is None else
                         proxy if isinstance(proxy, rpc.Client)
                         else rpc.Client(proxy))
+        if client_az is not None:
+            # embedded clients declare their AZ so degraded LRC reads
+            # prefer the local stripe (blob/topology.py contract)
+            cfg = cfg or AccessConfig()
+            cfg.client_az = client_az
         self._h = AccessHandler(cm_client, node_pool, cfg,
                                 proxy_client=proxy_client)
 

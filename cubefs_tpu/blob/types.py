@@ -34,6 +34,10 @@ class DiskInfo:
     chunk_count: int = 0
     free_chunks: int = 1 << 20
     last_heartbeat: float = 0.0
+    # failure-domain labels (blob/topology.py): empty az means the
+    # default AZ, empty rack means the host is its own rack
+    az: str = ""
+    rack: str = ""
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -51,6 +55,7 @@ class VolumeUnit:
     disk_id: int
     chunk_id: int
     node_addr: str
+    az: str = ""  # AZ of the disk at placement time (topology scoring)
 
     def to_dict(self) -> dict:
         return asdict(self)

@@ -112,32 +112,45 @@ class RepairWorker:
             return  # empty chunk: nothing to rebuild
 
         # choose the read set: prefer the bad unit's local stripe peers
-        # when an LRC local repair is possible (intra-AZ bandwidth), else
-        # the global stripe. code_pos maps unit index -> index within the
-        # solving code's shard space.
+        # when an LRC local repair is possible (intra-AZ bandwidth). A
+        # dark AZ (blackout) starves the local read set entirely — fall
+        # back to the global stripe, which can also re-encode a lost
+        # LOCAL PARITY through its stripe members (lrc_reconstruct_rows).
+        # code_pos maps unit index -> index within the solving code's
+        # shard space.
         local_idx, ln, lm = t.local_stripe(bad) if t.l else ([], 0, 0)
-        if local_idx and bad in local_idx:
-            read_set = [i for i in local_idx if i != bad]
-            n_solve, total_code = ln, ln + lm
-            code_pos = {u: s for s, u in enumerate(local_idx)}
-            bad_sub = code_pos[bad]
-        else:
-            read_set = [i for i in range(t.n + t.m) if i != bad]
-            n_solve, total_code = t.n, t.n + t.m
-            code_pos = {u: u for u in read_set}
-            bad_sub = bad
+        sources = (["local", "global"] if local_idx and bad in local_idx
+                   else ["global"])
+        for source in sources:
+            if source == "local":
+                read_set = [i for i in local_idx if i != bad]
+                n_solve, total_code = ln, ln + lm
+                code_pos = {u: s for s, u in enumerate(local_idx)}
+                bad_sub = code_pos[bad]
+            else:
+                read_set = [i for i in range(t.n + t.m) if i != bad]
+                n_solve, total_code = t.n, t.n + t.m
+                code_pos = {u: u for u in read_set}
+                bad_sub = bad
 
-        # per-bid survivor reads (one EXTRA when available: the extra is
-        # reconstructed from the first n and compared, the pre-writeback
-        # consistency check — a corrupted download must not become the
-        # new truth). The ACTUALLY-read survivor set selects the decode
-        # matrix, so per-shard read failures mid-task are fine.
-        want = min(n_solve + 1, len(read_set))
-        by_key: dict[tuple, list] = defaultdict(list)
-        for bid in bids:
-            subs, shards = self._read_survivors(vol, read_set, code_pos, bid,
-                                                need=n_solve, want=want)
-            by_key[(len(shards[0]), tuple(subs))].append((bid, shards))
+            # per-bid survivor reads (one EXTRA when available: the
+            # extra is reconstructed from the first n and compared, the
+            # pre-writeback consistency check — a corrupted download
+            # must not become the new truth). The ACTUALLY-read survivor
+            # set selects the decode matrix, so per-shard read failures
+            # mid-task are fine.
+            want = min(n_solve + 1, len(read_set))
+            by_key: dict[tuple, list] = defaultdict(list)
+            try:
+                for bid in bids:
+                    subs, shards = self._read_survivors(
+                        vol, read_set, code_pos, bid, need=n_solve, want=want)
+                    by_key[(len(shards[0]), tuple(subs))].append((bid, shards))
+            except RuntimeError:
+                if source != sources[-1]:
+                    continue  # local stripe unreadable: widen to global
+                raise
+            break
 
         for (size, subs), group in by_key.items():
             solve_subs = list(subs[:n_solve])
@@ -145,9 +158,18 @@ class RepairWorker:
             if len(subs) > n_solve:  # reconstruct bad + the extra survivor
                 wanted_out = sorted({bad_sub, subs[n_solve]})
                 verify_pos = wanted_out.index(subs[n_solve])
-            rows = rs_kernel.reconstruct_rows(
-                n_solve, total_code, solve_subs, wanted_out
-            )
+            if bad_sub >= total_code:
+                # global fallback for a LOCAL PARITY unit: its row lives
+                # outside the global code space, so compose the local
+                # encode row with the global solve
+                rows = rs_kernel.lrc_reconstruct_rows(
+                    n_solve, total_code, t.ec_layout_by_az(),
+                    (t.n + t.m) // t.az_count, solve_subs, wanted_out
+                )
+            else:
+                rows = rs_kernel.reconstruct_rows(
+                    n_solve, total_code, solve_subs, wanted_out
+                )
             out_pos = wanted_out.index(bad_sub)
             for start in range(0, len(group), self.batch_stripes):
                 chunk = group[start : start + self.batch_stripes]

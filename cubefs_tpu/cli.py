@@ -10,6 +10,8 @@ datapartition, user...) and blobstore/cli. Usage:
   python -m cubefs_tpu.cli fs ls /dir  | rm | stat | mkdir
   python -m cubefs_tpu.cli blob put LOCAL --access HOST:PORT
   python -m cubefs_tpu.cli blob get LOCATION.json LOCAL --access ...
+  python -m cubefs_tpu.cli topology blob --clustermgr HOST:PORT
+  python -m cubefs_tpu.cli topology rebalance --scheduler HOST:PORT
 """
 
 from __future__ import annotations
@@ -215,6 +217,14 @@ def main(argv=None):
     p_flash.add_argument("--group-id", type=int)
     p_flash.add_argument("--addrs", help="comma-separated flashnode addrs")
     p_flash.add_argument("--status", help="group status (set-status)")
+
+    p_topo = sub.add_parser("topology")  # failure-domain views
+    p_topo.add_argument("action", choices=["fs", "blob", "rebalance"])
+    p_topo.add_argument("--master", help="fs master addr (fs)")
+    p_topo.add_argument("--clustermgr", help="clustermgr addr (blob)")
+    p_topo.add_argument("--scheduler", help="scheduler addr (rebalance)")
+    p_topo.add_argument("--max-moves", type=int,
+                        help="cap unit migrations queued this sweep")
 
     p_metrics = sub.add_parser("metrics")  # node observability views
     p_metrics.add_argument("action", choices=["write-path", "raw"])
@@ -461,6 +471,22 @@ def main(argv=None):
                     sys.exit("needs --group-id and --status")
                 fgc.set_group_status(args.group_id, args.status)
                 out = {"group": args.group_id, "status": args.status}
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "topology":
+        if args.action == "fs":
+            if not args.master:
+                sys.exit("topology fs needs --master")
+            out = rpc.call(args.master, "topology_view")[0]
+        elif args.action == "blob":
+            if not args.clustermgr:
+                sys.exit("topology blob needs --clustermgr")
+            out = rpc.call(args.clustermgr, "topology_view")[0]
+        else:  # rebalance: one rate-limited sweep, prints the move count
+            if not args.scheduler:
+                sys.exit("topology rebalance needs --scheduler")
+            q = {} if args.max_moves is None else {"max_moves": args.max_moves}
+            out = rpc.call(args.scheduler, "rebalance", q)[0]
         print(json.dumps(out, indent=2))
 
     elif args.group == "metrics":

@@ -209,7 +209,8 @@ def run_role(cfg: dict):
         from .blob.blobnode import BlobNode
 
         svc = BlobNode(int(cfg.get("node_id", 0)), cfg["data_dirs"],
-                       rpc.Client(cfg["clustermgr_addr"]), addr="")
+                       rpc.Client(cfg["clustermgr_addr"]), addr="",
+                       az=cfg.get("az", ""), rack=cfg.get("rack", ""))
         srv = _serve(rpc.expose(svc), cfg)
         svc.addr = srv.addr
         svc.register()
@@ -239,7 +240,8 @@ def run_role(cfg: dict):
         svc = AccessHandler(
             rpc.Client(cfg["clustermgr_addr"]), pool,
             AccessConfig(blob_size=int(cfg.get("blob_size", 8 << 20)),
-                         engine=cfg.get("ec_engine", "auto")),
+                         engine=cfg.get("ec_engine", "auto"),
+                         client_az=cfg.get("az")),
             repair_queue=rq,
             delete_queue=dq,
             proxy_client=rpc.Client(cfg["proxy_addr"]) if cfg.get("proxy_addr") else None,

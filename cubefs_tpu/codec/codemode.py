@@ -55,6 +55,19 @@ class Tactic:
     get_quorum: int = 0
     min_shard_size: int = 0
 
+    def __post_init__(self):
+        # ec_layout_by_az slices with integer division: a shard count
+        # not divisible by az_count would silently drop shards from
+        # every stripe map, so reject the geometry at construction
+        if self.az_count < 1:
+            raise ValueError(f"az_count must be >= 1, got {self.az_count}")
+        for name, v in (("n", self.n), ("m", self.m), ("l", self.l)):
+            if v % self.az_count:
+                raise ValueError(
+                    f"Tactic {name}={v} is not divisible by "
+                    f"az_count={self.az_count}: ec_layout_by_az would "
+                    f"silently truncate shards")
+
     @property
     def total(self) -> int:
         return self.n + self.m + self.l

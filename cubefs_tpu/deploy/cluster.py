@@ -12,7 +12,13 @@ initial volume, and writes a state file with all addresses.
 Topology JSON (all counts optional):
   {"metanodes": 3, "datanodes": 4, "blobnodes": 1, "disks_per_blobnode": 9,
    "objectnode": true, "access": true, "scheduler": false, "codec": false,
-   "volume": {"name": "vol1", "mp_count": 3, "dp_count": 4}}
+   "volume": {"name": "vol1", "mp_count": 3, "dp_count": 4},
+   "blob_azs": 3}
+
+blob_azs spreads blobnodes across failure domains round-robin: an int
+yields AZ names az0..azN-1, a list supplies the names. Multi-AZ LRC
+codemodes then place each local stripe inside one AZ
+(cubefs_tpu/blob/topology.py).
 """
 
 from __future__ import annotations
@@ -110,14 +116,25 @@ class Cluster:
             cm = self._spawn("clustermgr", {
                 "allow_colocated_units": t.get("blobnodes", 1) == 1,
                 "data_dir": os.path.join(self.workdir, "cm")})
+            azs = t.get("blob_azs")
+            az_names = ([f"az{j}" for j in range(azs)]
+                        if isinstance(azs, int) else list(azs or ()))
             for i in range(t["blobnodes"]):
                 dirs = [os.path.join(self.workdir, f"bn{i}d{d}")
                         for d in range(t.get("disks_per_blobnode", 9))]
-                self._spawn("blobnode", {"name": f"blobnode{i}", "node_id": i,
-                                         "clustermgr_addr": cm, "data_dirs": dirs})
+                bn_cfg = {"name": f"blobnode{i}", "node_id": i,
+                          "clustermgr_addr": cm, "data_dirs": dirs}
+                if az_names:
+                    # round-robin AZ assignment; each node is its own rack
+                    bn_cfg["az"] = az_names[i % len(az_names)]
+                    bn_cfg["rack"] = f"{bn_cfg['az']}-r{i // len(az_names)}"
+                self._spawn("blobnode", bn_cfg)
             if t.get("access", True):
-                self._spawn("access", {"clustermgr_addr": cm,
-                                       "blob_size": t.get("blob_size", 8 << 20)})
+                access_cfg = {"clustermgr_addr": cm,
+                              "blob_size": t.get("blob_size", 8 << 20)}
+                if az_names:
+                    access_cfg["az"] = az_names[0]
+                self._spawn("access", access_cfg)
         if t.get("objectnode"):
             self._spawn("objectnode", {
                 "master_addr": master,

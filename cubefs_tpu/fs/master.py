@@ -539,6 +539,32 @@ class Master(ReplicatedFsm):
         return [members[i:i + self.NODESET_SIZE]
                 for i in range(0, len(members), self.NODESET_SIZE)]
 
+    def topology_view(self) -> dict:
+        """Zone -> nodeset -> node tree for both node kinds, including
+        dead/decommissioned nodes (flagged) so operators see the whole
+        failure-domain picture (`cubefs-cli topology fs`)."""
+        with self._lock:
+            out = {}
+            for kind, reg in (("datanodes", self.datanodes),
+                              ("metanodes", self.metanodes)):
+                live = set(self._live(reg))
+                zones = self._zones_of(reg, list(reg))
+                out[kind] = {
+                    z: {
+                        "nodesets": self._nodesets(members),
+                        "nodes": {
+                            a: {"live": a in live,
+                                "decommissioned": a in self.decommissioned}
+                            for a in sorted(members)
+                        },
+                    }
+                    for z, members in sorted(zones.items())
+                }
+            return out
+
+    def rpc_topology_view(self, args, body):
+        return self.topology_view()
+
     def _pick(self, cands: list[str], k: int, load: dict) -> list[str]:
         fn = SELECTORS[self.selector]
         return fn(cands, k, load, self._selector_state)

@@ -195,6 +195,34 @@ def reconstruct_rows(
     return gf256.gf_matmul(enc[np.asarray(wanted)], dec)
 
 
+def lrc_reconstruct_rows(
+    n_data: int, n_total: int, stripes: list[list[int]], ln: int,
+    present: list[int], wanted: list[int],
+) -> np.ndarray:
+    """reconstruct_rows over the FULL two-level LRC shard space.
+
+    `present` must index the global stripe (< n_total: data + global
+    parity), but `wanted` may include local-parity indices (>= n_total).
+    A local parity is the local code's re-encode of its stripe's first
+    `ln` members — all global-space indices — so its row is the local
+    encode row composed with the global solve: one matrix, same batched
+    apply as every other repair. This is what lets a repair rebuild a
+    local parity when its entire stripe's AZ is dark."""
+    present = sorted(present)[:n_data]
+    dec = gf256.decode_matrix(n_data, n_total, present)
+    enc = gf256.encode_matrix(n_data, n_total)
+    rows = np.zeros((len(wanted), n_data), dtype=np.uint8)
+    for r, w in enumerate(wanted):
+        if w < n_total:
+            rows[r] = enc[w]
+            continue
+        stripe = next(s for s in stripes if w in s)
+        local = gf256.encode_matrix(ln, len(stripe))
+        members = enc[np.asarray(stripe[:ln])]
+        rows[r] = gf256.gf_matmul(local[[stripe.index(w)]], members)[0]
+    return gf256.gf_matmul(rows, dec)
+
+
 def reconstruct_stripes(
     surviving: jax.Array,
     present: list[int],

@@ -203,3 +203,24 @@ meta_ops_per_batch = DEFAULT.histogram(
     "cubefs_meta_ops_per_batch_entry",
     "mutations carried per coalesced submit (1 = uncontended fast path)",
     ("pid",), buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+
+# failure-domain topology (blob/topology.py): placement + rebalance
+placement_az_skew = DEFAULT.gauge(
+    "cubefs_placement_az_skew",
+    "volume-unit count spread across AZs (max - min), set by the "
+    "rebalance sweep's scoring pass")
+placement_misplaced = DEFAULT.gauge(
+    "cubefs_placement_misplaced_units",
+    "volume units living outside their local stripe's home AZ; zero "
+    "means every LRC stripe is physically AZ-local")
+placement_colocated = DEFAULT.counter(
+    "cubefs_placement_colocated_total",
+    "volume allocations that degraded the failure-domain contract "
+    "under allow_colocated_units", ("kind",))
+rebalance_moves = DEFAULT.counter(
+    "cubefs_rebalance_moves_total",
+    "unit migrations queued by the rebalance sweep", ("reason",))
+reconstruct_reads = DEFAULT.counter(
+    "cubefs_reconstruct_total",
+    "degraded-read reconstructions by stripe scope (local = intra-AZ "
+    "LRC stripe, global = full-width RS)", ("path",))

@@ -1,0 +1,14 @@
+"""Known-bad placement-discipline fixture: CFZ001 fires twice.
+
+Never imported — read as text by tests/test_lint.py and handed to the
+checker under a cubefs_tpu/blob/ relpath.
+"""
+
+
+def pick_least_loaded(disks):
+    disks.sort(key=lambda d: (d.chunk_count, d.disk_id))     # CFZ001
+    return disks[0]
+
+
+def pick_freest(cands):
+    return min(cands, key=lambda d: d.free_chunks)           # CFZ001

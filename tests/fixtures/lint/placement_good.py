@@ -1,0 +1,18 @@
+"""Known-good placement-discipline fixture: selection goes through
+blob/topology.py, and bare arithmetic on load fields is not a sort.
+"""
+
+from cubefs_tpu.blob import topology
+
+
+def pick_least_loaded(disks):
+    return topology.order_by_load(disks)[0]
+
+
+def skew(hot, cold, threshold):
+    # arithmetic over load fields is a threshold, not a selection
+    return hot.chunk_count - cold.chunk_count >= threshold
+
+
+def order_by_id(disks):
+    return sorted(disks, key=lambda d: d.disk_id)

@@ -1,0 +1,383 @@
+"""Failure-domain topology subsystem (blob/topology.py) end to end:
+AZ-aware placement keeps every LRC local stripe inside one AZ, repair
+destinations prefer the failed slot's AZ, the rebalance sweep drives a
+seeded misplaced cluster back to zero, and degraded reads count local
+vs global reconstructions.
+
+All clusters here are small, in-process and deterministic (tier-1)."""
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob import topology
+from cubefs_tpu.blob.access import AccessConfig, AccessHandler, NodePool
+from cubefs_tpu.blob.blobnode import BlobNode
+from cubefs_tpu.blob.clustermgr import ClusterMgr
+from cubefs_tpu.blob.mq import MessageQueue
+from cubefs_tpu.blob.scheduler import Scheduler
+from cubefs_tpu.blob.topology import NoAvailableDisks
+from cubefs_tpu.blob.types import DiskInfo
+from cubefs_tpu.blob.worker import RepairWorker
+from cubefs_tpu.codec import codemode as cmode
+from cubefs_tpu.codec.codemode import Tactic
+from cubefs_tpu.utils import metrics, rpc
+
+AZS = ("az-a", "az-b", "az-c")
+LRC = cmode.CodeMode.EC6P3L3  # n=6 m=3 l=3 over 3 AZs: 4 units per AZ
+
+
+class AZCluster:
+    """Labeled in-process blob cluster: len(azs) x nodes_per_az nodes."""
+
+    def __init__(self, tmp_path, azs=AZS, nodes_per_az=2, disks_per_node=2,
+                 client_az=None, allow_colocated=False, max_workers=None):
+        self.cm = ClusterMgr(allow_colocated_units=allow_colocated)
+        self.cm_client = rpc.Client(self.cm)
+        self.pool = NodePool()
+        self.nodes: dict[str, BlobNode] = {}
+        nid = 0
+        for az in azs:
+            for r in range(nodes_per_az):
+                addr = f"{az}-n{r}"
+                node = BlobNode(
+                    node_id=nid,
+                    disk_paths=[str(tmp_path / f"{addr}d{d}")
+                                for d in range(disks_per_node)],
+                    cm_client=self.cm_client, addr=addr,
+                    az=az, rack=f"{az}-r{r}",
+                )
+                node.register()
+                node.send_heartbeat()
+                self.pool.bind(addr, node)
+                self.nodes[addr] = node
+                nid += 1
+        self.repair_q = MessageQueue()
+        self.delete_q = MessageQueue()
+        cfg = AccessConfig(blob_size=64 << 10)
+        if client_az is not None:
+            cfg.client_az = client_az
+        if max_workers is not None:  # 1 = sequential reads (determinism)
+            cfg.max_workers = max_workers
+        self.access = AccessHandler(self.cm_client, self.pool, cfg,
+                                    repair_queue=self.repair_q,
+                                    delete_queue=self.delete_q)
+        self.sched = Scheduler(self.cm, repair_queue=self.repair_q,
+                               delete_queue=self.delete_q,
+                               node_pool=self.pool)
+        self.worker = RepairWorker(rpc.Client(self.sched), self.cm_client,
+                                   self.pool)
+
+    def node_of(self, addr: str) -> BlobNode:
+        return self.nodes[addr]
+
+    def drain_worker(self, max_tasks=100):
+        for _ in range(max_tasks):
+            if not self.worker.run_once():
+                return
+        raise AssertionError("worker did not drain")
+
+
+def payload(rng, n):
+    return rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+# ---------------- tactic validation ----------------
+
+def test_tactic_rejects_geometry_not_divisible_by_az_count():
+    with pytest.raises(ValueError):
+        Tactic(6, 3, 3, az_count=2)   # m=3 not divisible
+    with pytest.raises(ValueError):
+        Tactic(5, 4, 0, az_count=2)   # n=5 not divisible
+    with pytest.raises(ValueError):
+        Tactic(6, 3, 0, az_count=0)
+    Tactic(6, 4, 2, az_count=2)       # divisible geometry constructs
+
+
+# ---------------- placement ----------------
+
+def test_lrc_local_stripes_are_az_local(tmp_path, rng):
+    c = AZCluster(tmp_path)  # 3 AZ x 2 nodes x 2 disks = exactly 12 slots
+    data = payload(rng, 50_000)
+    loc = c.access.put(data, codemode=LRC)
+    assert c.access.get(loc) == data
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    t = cmode.tactic(LRC)
+    stripe_azs = []
+    for stripe in t.ec_layout_by_az():
+        azs = {vol.units[s].az for s in stripe}
+        assert len(azs) == 1, f"stripe {stripe} straddles AZs: {azs}"
+        stripe_azs.append(azs.pop())
+        # within the AZ: every unit on its own disk, both hosts used
+        assert len({vol.units[s].disk_id for s in stripe}) == len(stripe)
+        assert len({vol.units[s].node_addr for s in stripe}) == 2
+    assert sorted(stripe_azs) == sorted(AZS)
+    disk_map = {d.disk_id: d for d in c.cm.disks.values()}
+    rep = topology.cluster_misplacement([vol], disk_map)
+    assert rep["misplaced_units"] == 0 and rep["colocated_units"] == 0
+    assert rep["az_skew"] == 0 and rep["unit_counts"] == {a: 4 for a in AZS}
+
+
+def test_labeled_cluster_short_of_azs_hard_errors(tmp_path):
+    c = AZCluster(tmp_path, azs=("az-a", "az-b"), disks_per_node=4)
+    with pytest.raises(NoAvailableDisks):
+        c.cm.alloc_volume(LRC)  # wants 3 AZs, cluster spans 2
+    # allow_colocated degrades explicitly instead: warning counter ticks
+    c2 = AZCluster(tmp_path, azs=("az-d", "az-e"), nodes_per_az=3,
+                   disks_per_node=4, allow_colocated=True)
+    before = metrics.placement_colocated.value(kind="cross_az")
+    vol = c2.cm.alloc_volume(LRC)
+    assert len(vol.units) == 12
+    assert metrics.placement_colocated.value(kind="cross_az") == before + 1
+
+
+def test_place_volume_colocation_warning_on_tiny_cluster():
+    disks = [DiskInfo(i, "h1", f"/d{i}") for i in range(3)]
+    t = cmode.tactic(cmode.CodeMode.EC6P3)  # 9 units, single-AZ mode
+    with pytest.raises(NoAvailableDisks):
+        topology.place_volume(t, disks, allow_colocated=False)
+    picks, warnings = topology.place_volume(t, disks, allow_colocated=True)
+    assert len(picks) == 9
+    assert any(w.startswith("intra_az:") for w in warnings)
+
+
+def test_colocation_scored_beyond_fair_share_only():
+    """4 units over a 3-host AZ: fair share is ceil(4/3)=2 per host, so
+    a 3-1 stacking flags exactly one slot and a 2-1-1 spread flags none."""
+    from cubefs_tpu.blob.types import VolumeInfo, VolumeUnit
+
+    disks = {}
+    for i, host in enumerate(["h0", "h0", "h1", "h2"] * 3):
+        az = AZS[i // 4]
+        disks[i] = DiskInfo(i, f"{az}-{host}", f"/d{i}", az=az)
+    t_disks = list(disks.values())
+    units = []
+    for slot in range(12):
+        # stripe 0 (slots 0,1,6,9) stacked 3-on-one-host in az-a
+        stripe_az = AZS[[0, 0, 1, 1, 2, 2, 0, 1, 2, 0, 1, 2][slot]]
+        base = AZS.index(stripe_az) * 4
+        host = "h0" if slot in (0, 1, 6) else ["h1", "h2"][slot % 2]
+        d = next(d for d in t_disks if d.az == stripe_az
+                 and d.node_addr == f"{stripe_az}-{host}"
+                 and d.disk_id >= base)
+        units.append(VolumeUnit(slot, d.disk_id, slot, d.node_addr,
+                                az=stripe_az))
+    vol = VolumeInfo(vid=1, codemode=int(LRC), units=units)
+    rep = topology.volume_misplacement(vol, disks, AZS)
+    assert rep["wrong_az"] == []
+    flagged = [c for c in rep["colocated"]]
+    assert len(flagged) == 1 and flagged[0]["host"] == "az-a-h0"
+    assert flagged[0]["slot"] in (0, 1, 6)
+
+
+# ---------------- repair destinations ----------------
+
+def test_pick_destination_prefers_failed_slots_az(tmp_path, rng):
+    c = AZCluster(tmp_path, disks_per_node=3)  # 6 disks per AZ, 2 spare
+    data = payload(rng, 40_000)
+    loc = c.access.put(data, codemode=LRC)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    victim = vol.units[0]
+    c.node_of(victim.node_addr).break_disk(victim.disk_id)
+    assert c.sched.mark_disk_broken(victim.disk_id) == 1
+    task = next(iter(c.sched.tasks.values()))
+    assert c.cm.disks[task["dest_disk"]].az == victim.az  # stayed home
+    c.drain_worker()
+    vol_after = c.cm.get_volume(vol.vid)
+    assert vol_after.units[0].az == victim.az
+    assert vol_after.units[0].disk_id != victim.disk_id
+    disk_map = {d.disk_id: d for d in c.cm.disks.values()}
+    assert topology.cluster_misplacement(
+        [vol_after], disk_map)["misplaced_units"] == 0
+    assert c.access.get(loc) == data
+
+
+def test_pick_destination_falls_back_cross_az(tmp_path, rng):
+    c = AZCluster(tmp_path, disks_per_node=3)
+    loc = c.access.put(payload(rng, 30_000), codemode=LRC)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    az_a_ids = {d.disk_id for d in c.cm.disks.values() if d.az == "az-a"}
+    exclude = {u.disk_id for u in vol.units} | az_a_ids
+    # soft preference: no az-a disk left -> any other AZ serves
+    dest = c.cm.pick_destination(exclude, prefer_az="az-a")
+    assert c.cm.disks[dest.disk_id].az != "az-a"
+    # hardened (rebalance) mode refuses to land in the wrong AZ
+    with pytest.raises(NoAvailableDisks):
+        c.cm.pick_destination(exclude, prefer_az="az-a", require_az=True)
+
+
+def test_lrc_reconstruct_rows_composes_local_parity(rng):
+    """The global-fallback algebra: any full-LRC row — including local
+    parities outside the global code space — is a GF-linear map of six
+    global survivors (blackout repair relies on this)."""
+    from cubefs_tpu.codec.encoder import CodecConfig, new_encoder
+    from cubefs_tpu.ops import rs_kernel
+
+    enc = new_encoder(CodecConfig(mode=LRC))
+    t = enc.t
+    stripe = np.zeros((t.total, 64), dtype=np.uint8)
+    stripe[: t.n] = rng.integers(0, 256, (t.n, 64), dtype=np.uint8)
+    enc.encode(stripe)
+    present = [0, 1, 2, 3, 6, 7]          # what survives an az-c blackout
+    wanted = [4, 5, 8, 9, 10, 11]         # data, global AND local parity
+    rows = rs_kernel.lrc_reconstruct_rows(
+        t.n, t.n + t.m, t.ec_layout_by_az(), (t.n + t.m) // t.az_count,
+        present, wanted)
+    rebuilt = np.zeros((len(wanted), 64), dtype=np.uint8)
+    from cubefs_tpu.ops import gf256
+    rebuilt = gf256.gf_matmul(rows, stripe[present])
+    assert np.array_equal(rebuilt, stripe[wanted])
+
+
+def test_repair_rebuilds_local_parity_via_global_when_stripe_dark(
+        tmp_path, rng):
+    """Worker fallback: when a bad unit's ENTIRE local stripe is
+    unreadable, repair widens to the global stripe — even for a local
+    parity, whose row is re-encoded through the stripe members."""
+    c = AZCluster(tmp_path, disks_per_node=3)
+    loc = c.access.put(payload(rng, 40_000), codemode=LRC)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    bad = vol.units[11]                   # az-c local parity
+    peers = [vol.units[s] for s in (4, 5, 8)]
+    client = c.pool.get(bad.node_addr)
+    meta, _ = client.call("list_chunk", {"disk_id": bad.disk_id,
+                                         "chunk_id": bad.chunk_id})
+    bid = meta["shards"][0][0]
+    _, original = client.call("get_shard", {
+        "disk_id": bad.disk_id, "chunk_id": bad.chunk_id, "bid": bid})
+    # the whole az-c stripe goes dark at the node layer
+    for u in [bad] + peers:
+        c.node_of(u.node_addr).break_disk(u.disk_id)
+    assert c.sched.mark_disk_broken(bad.disk_id) == 1
+    c.drain_worker()
+    after = c.cm.get_volume(vol.vid).units[11]
+    assert after.disk_id != bad.disk_id and after.az == "az-c"
+    _, rebuilt = c.pool.get(after.node_addr).call("get_shard", {
+        "disk_id": after.disk_id, "chunk_id": after.chunk_id, "bid": bid})
+    assert rebuilt == original            # byte-identical re-encode
+
+
+# ---------------- rebalance sweep ----------------
+
+def _misplace(c, vol, slot, to_az):
+    """Repoint one unit at an empty disk in the wrong AZ (simulating a
+    legacy/operator placement the sweep must chase home)."""
+    used = {u.disk_id for u in vol.units}
+    spare = next(d for d in topology.order_by_load(c.cm.disks.values())
+                 if d.az == to_az and d.disk_id not in used)
+    c.cm.update_volume_unit(vol.vid, slot, spare.disk_id,
+                            c.cm.alloc_chunk_id(), spare.node_addr)
+    return spare
+
+
+def test_rebalance_sweep_converges_to_zero_misplaced(tmp_path, rng):
+    c = AZCluster(tmp_path, disks_per_node=3)
+    data = payload(rng, 45_000)
+    loc = c.access.put(data, codemode=LRC)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    home = vol.units[0].az
+    wrong = next(a for a in AZS if a != home)
+    _misplace(c, vol, 0, wrong)
+
+    rep1 = c.sched.rebalance_sweep()
+    assert rep1["misplaced_units"] == 1 and rep1["moves"] == 1
+    assert metrics.placement_misplaced.value() == 1
+    c.drain_worker()
+
+    rep2 = c.sched.rebalance_sweep()
+    assert rep2["misplaced_units"] == 0 and rep2["moves"] == 0
+    assert metrics.placement_misplaced.value() == 0
+    vol_after = c.cm.get_volume(vol.vid)
+    assert vol_after.units[0].az == home
+    # converged means STOPPED: another sweep neither moves nor bumps epoch
+    epoch = vol_after.epoch
+    assert c.sched.rebalance_sweep()["moves"] == 0
+    assert c.cm.get_volume(vol.vid).epoch == epoch
+    assert c.access.get(loc) == data  # bytes survived the round trip
+
+
+def test_rebalance_sweep_is_rate_limited(tmp_path, rng):
+    c = AZCluster(tmp_path, disks_per_node=3)
+    loc = c.access.put(payload(rng, 20_000), codemode=LRC)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    # two wrong-AZ units in different stripes
+    _misplace(c, vol, 0, "az-b")
+    vol = c.cm.get_volume(vol.vid)
+    _misplace(c, vol, 2, "az-c")
+    rep = c.sched.rebalance_sweep(max_moves=1)
+    assert rep["misplaced_units"] == 2 and rep["moves"] == 1
+    c.drain_worker()
+    for _ in range(3):  # bounded sweeps to convergence
+        if c.sched.rebalance_sweep()["misplaced_units"] == 0:
+            break
+        c.drain_worker()
+    c.drain_worker()
+    assert c.sched.rebalance_sweep()["misplaced_units"] == 0
+
+
+def test_rebalance_respects_task_switch(tmp_path):
+    c = AZCluster(tmp_path)
+    c.sched.switch.disable("rebalance")
+    rep = c.sched.rebalance_sweep()
+    assert rep == {"moves": 0, "misplaced_units": None,
+                   "colocated_units": None, "az_skew": None}
+    c.sched.switch.enable("rebalance")
+    assert c.sched.rebalance_sweep()["misplaced_units"] == 0
+
+
+# ---------------- AZ-local degraded reads ----------------
+
+def test_degraded_read_counts_local_then_global(tmp_path, rng):
+    c = AZCluster(tmp_path, client_az="az-a")
+    # a long hedge window keeps the read ladder deterministic: a slow
+    # in-process read must not trigger backup parity fetches that
+    # satisfy n-of-N before the local stripe gets its turn
+    c.access.HEDGE_DELAY = 60.0
+    data = payload(rng, 50_000)
+    loc = c.access.put(data, codemode=LRC)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    # one data shard lost: the LRC local stripe repairs it in-AZ
+    u0 = vol.units[0]
+    c.node_of(u0.node_addr).break_disk(u0.disk_id)
+    local0 = metrics.reconstruct_reads.value(path="local")
+    assert c.access.get(loc) == data
+    assert metrics.reconstruct_reads.value(path="local") == local0 + 1
+    # two data shards lost in ONE stripe (> lm=1): global fallback
+    for s in (2, 3):
+        u = vol.units[s]
+        c.node_of(u.node_addr).break_disk(u.disk_id)
+    global0 = metrics.reconstruct_reads.value(path="global")
+    assert c.access.get(loc) == data
+    assert metrics.reconstruct_reads.value(path="global") == global0 + 1
+
+
+# ---------------- labels & views ----------------
+
+def test_heartbeat_relabels_disks_through_the_fsm(tmp_path):
+    cm = ClusterMgr()
+    did = cm.register_disk("h1", "/d0")
+    assert cm.disks[did].az == ""
+    assert topology.az_of(cm.disks[did]) == topology.DEFAULT_AZ
+    cm.heartbeat([did], az="az-x", rack="az-x-r0")
+    assert cm.disks[did].az == "az-x"
+    assert cm.disks[did].rack == "az-x-r0"
+    # a matching heartbeat is a no-op; labels stick
+    cm.heartbeat([did], az="az-x", rack="az-x-r0")
+    assert (cm.disks[did].az, cm.disks[did].rack) == ("az-x", "az-x-r0")
+
+
+def test_clustermgr_topology_view(tmp_path, rng):
+    c = AZCluster(tmp_path)
+    c.access.put(payload(rng, 30_000), codemode=LRC)
+    view = c.cm.topology_view()
+    assert sorted(view["tree"]) == sorted(AZS)
+    assert view["azs"] == sorted(AZS)
+    assert view["unit_counts"] == {a: 4 for a in AZS}
+    assert view["az_skew"] == 0 and view["misplaced_units"] == 0
+    assert view["volumes"] == 1 and view["disks"] == 12
+    # tree: az -> rack -> host -> disks, with unit counts attached
+    az = view["tree"]["az-a"]
+    assert sorted(az) == ["az-a-r0", "az-a-r1"]
+    disks = az["az-a-r0"]["az-a-n0"]
+    assert len(disks) == 2
+    assert sum(d["units"] for rack in view["tree"]["az-a"].values()
+               for host in rack.values() for d in host) == 4

@@ -509,6 +509,102 @@ def test_plan_disk_fault_composes_with_transport_fault(tmp_path, rng):
         c.nodes[1].get_shard(disk, 1, 1)
 
 
+# ---------------- single-AZ blackout (failure-domain topology) ----------------
+
+def _blackout_scenario(base, seed):
+    """One seeded end-to-end pass: black out az-c, serve reads from the
+    surviving AZs, fence the dark AZ (repairs exile its units cross-AZ
+    by necessity), heal, and let the rebalance sweep chase every unit
+    back home. Returns (digest, facts) for cross-run comparison."""
+    from test_blob_topology import AZCluster, LRC
+
+    from cubefs_tpu.blob.types import DiskStatus
+
+    base.mkdir()
+    rng = np.random.default_rng(0xB1AC)
+    c = AZCluster(base, disks_per_node=3, client_az="az-a", max_workers=1)
+    # determinism: sequential shard reads, no timing-driven hedges, and
+    # a breaker on a fake clock (state moves only with failure counts)
+    c.access.HEDGE_DELAY = 60.0
+    bclock = FakeClock()
+    c.pool.breaker = CircuitBreaker(threshold=3, cooldown=60.0,
+                                    clock=bclock)
+    data = rng.integers(0, 256, 48_000, dtype=np.uint8).tobytes()
+    loc = c.access.put(data, codemode=LRC)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    az_c = [d.disk_id for d in c.cm.disks.values() if d.az == "az-c"]
+    holders = {u.disk_id for u in vol.units}
+    facts = {}
+
+    plan = FaultPlan(seed=seed)
+    with fi.installed(plan):
+        plan.isolate("az-c-n0", "az-c-n1")
+        # az-c held stripe slots 4,5,8,11: two data shards of one local
+        # stripe are dark (> lm), so the read must widen to the global
+        # stripe — and still serve the exact bytes
+        g0 = metrics.reconstruct_reads.value(path="global")
+        l0 = metrics.reconstruct_reads.value(path="local")
+        assert c.access.get(loc) == data
+        assert metrics.reconstruct_reads.value(path="global") == g0 + 1
+        # fence the dark AZ, spares first: once no az-c disk is NORMAL,
+        # no repair can be pointed at an unreachable destination
+        fence = sorted(az_c, key=lambda d: d in holders)
+        facts["queued"] = sum(c.sched.mark_disk_broken(d) for d in fence)
+        c.drain_worker()
+        vol_mid = c.cm.get_volume(vol.vid)
+        facts["exile_azs"] = sorted(vol_mid.units[s].az
+                                    for s in (4, 5, 8, 11))
+        assert "az-c" not in facts["exile_azs"]
+        # with az-c dark there is nowhere to move them home: the sweep
+        # reports the skew but refuses to churn into yet another wrong AZ
+        rep = c.sched.rebalance_sweep()
+        assert rep["misplaced_units"] == 4 and rep["moves"] == 0
+
+        plan.heal()
+        for d in az_c:  # REPAIRED disks are invisible to placement:
+            c.cm.set_disk_status(d, DiskStatus.NORMAL)  # operator re-adds
+        sweeps = []
+        for _ in range(6):  # bounded sweeps to convergence
+            rep = c.sched.rebalance_sweep()
+            sweeps.append((rep["misplaced_units"], rep["moves"]))
+            if rep["misplaced_units"] == 0 and rep["moves"] == 0:
+                break
+            c.drain_worker()
+        facts["sweeps"] = tuple(sweeps)
+        assert sweeps[-1] == (0, 0)
+        assert metrics.placement_misplaced.value() == 0
+        vol_end = c.cm.get_volume(vol.vid)
+        assert all(vol_end.units[s].az == "az-c" for s in (4, 5, 8, 11))
+        # no double-applied migrations after heal: another sweep finds
+        # nothing, the worker has nothing, the volume epoch stays put
+        epoch = vol_end.epoch
+        assert c.sched.rebalance_sweep()["moves"] == 0
+        assert not c.worker.run_once()
+        assert c.cm.get_volume(vol.vid).epoch == epoch
+        facts["epoch"] = epoch
+        assert all(t["state"] == "done" for t in c.sched.tasks.values())
+        # past the breaker cooldown the healed AZ serves again — a clean
+        # fast-path read, no reconstruction on either path
+        bclock.advance(61.0)
+        g1 = metrics.reconstruct_reads.value(path="global")
+        l1 = metrics.reconstruct_reads.value(path="local")
+        assert c.access.get(loc) == data
+        assert metrics.reconstruct_reads.value(path="global") == g1
+        assert metrics.reconstruct_reads.value(path="local") == l1
+        facts["local_reads"] = l1 - l0
+    assert any(e[1] == "partition" and e[2] in ("az-c-n0", "az-c-n1")
+               for e in plan.schedule())
+    return plan.schedule_digest(), facts
+
+
+def test_single_az_blackout_serves_reads_then_rebalances_home(tmp_path):
+    d1, f1 = _blackout_scenario(tmp_path / "r1", seed=91)
+    d2, f2 = _blackout_scenario(tmp_path / "r2", seed=91)
+    # byte-for-byte reproducible schedule, identical facts
+    assert d1 == d2 and f1 == f2
+    assert f1["queued"] == 4  # one task per az-c stripe slot
+
+
 # ---------------- dial prober failure paths ----------------
 
 def test_dial_prober_records_failed_legs(tmp_path, rng):

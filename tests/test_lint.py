@@ -19,6 +19,7 @@ import pytest
 
 from tool.lint import cli, core
 from tool.lint.checkers.lock_discipline import LockDisciplineChecker
+from tool.lint.checkers.placement_discipline import PlacementDisciplineChecker
 from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
 from tool.lint.checkers.rpc_idempotency import (RpcIdempotencyChecker,
                                                 is_mutating)
@@ -145,6 +146,26 @@ def test_retry_discipline_exempts_retry_module_itself():
     assert c.applies("cubefs_tpu/fs/datanode.py")
     assert not c.applies("cubefs_tpu/utils/retry.py")
     assert not c.applies("tool/bench.py")
+
+
+# ---------------- placement-discipline ----------------
+
+def test_placement_discipline_true_positives():
+    mod = _module("placement_bad.py", "cubefs_tpu/blob/fx.py")
+    found = PlacementDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFZ001", "CFZ001"]
+
+
+def test_placement_discipline_true_negative():
+    mod = _module("placement_good.py", "cubefs_tpu/blob/fx.py")
+    assert PlacementDisciplineChecker().check(mod) == []
+
+
+def test_placement_discipline_exempts_topology_itself():
+    c = PlacementDisciplineChecker()
+    assert c.applies("cubefs_tpu/blob/scheduler.py")
+    assert not c.applies("cubefs_tpu/blob/topology.py")
+    assert not c.applies("cubefs_tpu/fs/master.py")
 
 
 # ---------------- suppressions ----------------

@@ -130,3 +130,34 @@ def test_meta_partition_split_without_interruption(tmp_path):
             m.stop()
         for d in datas:
             d.stop()
+
+
+def test_topology_view_exposes_zones_nodesets_and_flags(tmp_path):
+    """The fs side of `cubefs-cli topology`: zone -> nodeset -> node
+    tree for both node kinds, with dead/decommissioned nodes kept
+    visible and flagged instead of silently dropped."""
+    pool, master, metas, datas = _cluster(tmp_path, {"z0": 4, "z1": 2})
+    try:
+        view = master.topology_view()
+        dv = view["datanodes"]
+        assert sorted(dv) == ["z0", "z1"]
+        assert sorted(dv["z0"]["nodes"]) == ["data0", "data1", "data2",
+                                            "data3"]
+        # nodesets chunk deterministically by address order
+        assert dv["z0"]["nodesets"] == [["data0", "data1", "data2"],
+                                        ["data3"]]
+        assert all(n["live"] and not n["decommissioned"]
+                   for z in dv.values() for n in z["nodes"].values())
+        # metanodes registered without a zone land in "default"
+        assert list(view["metanodes"]) == ["default"]
+        assert sorted(view["metanodes"]["default"]["nodes"]) == [
+            "meta0", "meta1"]
+        # a drained node stays in the tree, flagged and not live
+        master.decommission_datanode("data5")
+        n = master.topology_view()["datanodes"]["z1"]["nodes"]["data5"]
+        assert n["decommissioned"] and not n["live"]
+    finally:
+        for m in metas:
+            m.stop()
+        for d in datas:
+            d.stop()

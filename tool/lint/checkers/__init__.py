@@ -1,6 +1,7 @@
 """Checker registry: every family the suite ships, in report order."""
 
 from .lock_discipline import LockDisciplineChecker
+from .placement_discipline import PlacementDisciplineChecker
 from .retry_discipline import RetryDisciplineChecker
 from .rpc_idempotency import RpcIdempotencyChecker
 from .tier1_purity import Tier1PurityChecker
@@ -12,4 +13,5 @@ ALL_CHECKERS = (
     RpcIdempotencyChecker,
     RetryDisciplineChecker,
     Tier1PurityChecker,
+    PlacementDisciplineChecker,
 )

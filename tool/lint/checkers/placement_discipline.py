@@ -1,0 +1,61 @@
+"""Placement-discipline checker (rule: placement-discipline, CFZ0xx).
+
+blob/topology.py is the single authority for failure-domain-aware
+disk selection: every "which disk is least loaded" decision must go
+through its selectors (``order_by_load`` / ``place_volume`` /
+``pick_destination``) so AZ/rack/host constraints are never silently
+dropped by an ad-hoc sort. The regression shape this catches is a
+quick ``min(disks, key=lambda d: d.chunk_count)`` added to a blob-plane
+module — correct-looking, load-balanced, and completely blind to the
+volume's failure domains:
+
+  CFZ001  sorted()/min()/max()/.sort() over disk load fields
+          (.chunk_count / .free_chunks) outside blob/topology.py
+
+The analysis is syntactic: any of those call forms whose source
+segment mentions a load field is flagged. Plain arithmetic on the
+fields (skew thresholds, deltas) is not a selection and is not
+flagged. topology.py itself is exempt — it is where the sorts belong.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+_LOAD_FIELDS = (".chunk_count", ".free_chunks")
+_EXEMPT = ("cubefs_tpu/blob/topology.py",)
+
+
+class PlacementDisciplineChecker(Checker):
+    rule = "placement-discipline"
+    dirs = ("cubefs_tpu/blob/",)
+
+    def applies(self, relpath: str) -> bool:
+        return super().applies(relpath) and relpath not in _EXEMPT
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in ("sorted", "min",
+                                                          "max"):
+                what = f"{func.id}()"
+            elif isinstance(func, ast.Attribute) and func.attr == "sort":
+                what = ".sort()"
+            else:
+                continue
+            seg = mod.segment(node)
+            field = next((f for f in _LOAD_FIELDS if f in seg), None)
+            if field is None:
+                continue
+            out.append(self.violation(
+                mod, "CFZ001", node,
+                f"{what} over disk load field `{field[1:]}` outside "
+                f"blob/topology.py — route the selection through "
+                f"topology.order_by_load / pick_destination so "
+                f"failure-domain constraints apply"))
+        return out
