@@ -120,7 +120,11 @@ class AccessHandler:
         for i, blob in enumerate(blobs):
             buf = np.frombuffer(blob, dtype=np.uint8)
             stripes[i].reshape(-1)[: buf.size] = buf
-        enc.encode(stripes)  # ONE batched kernel call for all blobs
+        # ONE batched submission for all this PUT's blobs; the encoder's
+        # admission surface (codec/batcher.py) additionally coalesces it
+        # with CONCURRENT PUTs and repair legs of the same geometry, so
+        # the device sees device-sized steps even at request granularity
+        enc.encode(stripes)
 
         # ---- quorum writes ----
         quorum = self.cfg.put_quorum_override or t.put_quorum

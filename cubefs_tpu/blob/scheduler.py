@@ -580,7 +580,11 @@ class Scheduler:
             self._inspect_cursor = (start + len(vids)) % len(all_vids)
         for vid in vids:
             vol = self.cm.get_volume(vid)
-            enc = new_encoder(CodecConfig(mode=cmode.CodeMode(vol.codemode)))
+            # 'auto': the scrub sweep inherits the measured crossover
+            # policy and its batched parity recompute coalesces with
+            # foreground PUT/repair work in the admission layer
+            enc = new_encoder(CodecConfig(mode=cmode.CodeMode(vol.codemode),
+                                          engine="auto"))
             t = enc.t
             listings: dict[int, dict[int, tuple[int, int]]] = {}
             for u in vol.units:
@@ -613,7 +617,7 @@ class Scheduler:
                             missing.setdefault(gi, set()).add(u.index)
                 checked += len(group)
                 # one batched device parity recompute, per-stripe verdicts
-                parity = enc.engine.encode_parity(stripes[:, : t.n], t.m)
+                parity = enc.codec.encode_parity(stripes[:, : t.n], t.m)
                 mismatch = (parity != stripes[:, t.n : t.n + t.m]).any(axis=-1)
                 for gi, bid in enumerate(group):
                     miss = missing.get(gi, set())
@@ -649,10 +653,10 @@ class Scheduler:
         for c in range(total):
             present = [i for i in range(total) if i != c]
             rows = rs_kernel.reconstruct_rows(n, total, present, [c])
-            rebuilt = enc.engine.matrix_apply(rows, stripe[present[:n]])[0]
+            rebuilt = enc.codec.matrix_apply(rows, stripe[present[:n]])[0]
             candidate = stripe.copy()
             candidate[c] = rebuilt
-            par = enc.engine.encode_parity(candidate[None, :n], t.m)[0]
+            par = enc.codec.encode_parity(candidate[None, :n], t.m)[0]
             if np.array_equal(par, candidate[n:total]):
                 culprits.append(c)
         return culprits[0] if len(culprits) == 1 else None

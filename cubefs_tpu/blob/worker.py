@@ -21,19 +21,22 @@ import numpy as np
 
 from ..ops import rs_kernel
 from ..codec import codemode as cm
-from ..codec.engine import get_engine
+from ..codec.batcher import admit
 from ..utils import rpc
 from .types import VolumeInfo
 
 
 class RepairWorker:
     def __init__(self, scheduler_client: rpc.Client, cm_client: rpc.Client,
-                 node_pool, engine: str | None = None,
+                 node_pool, engine: str | None = "auto",
                  worker_id: str | None = None, batch_stripes: int = 64):
         self.sched = scheduler_client
         self.cm = cm_client
         self.nodes = node_pool
-        self.engine = get_engine(engine)
+        # 'auto' + admission: repair legs inherit the measured
+        # crossover policy AND coalesce with concurrent PUT encodes
+        # into shared device steps (codec/batcher.py)
+        self.codec = admit(engine)
         self.worker_id = worker_id or uuid.uuid4().hex[:12]
         self.batch_stripes = batch_stripes
         self._stop = threading.Event()
@@ -178,7 +181,7 @@ class RepairWorker:
                               for s in shards[:n_solve]])
                     for _, shards in chunk
                 ])  # (B, n_solve, size)
-                recovered = self.engine.matrix_apply(rows, batch)
+                recovered = self.codec.matrix_apply(rows, batch)
                 for (bid, shards), rec in zip(chunk, recovered):
                     if len(subs) > n_solve:
                         expect = np.frombuffer(shards[n_solve], dtype=np.uint8)

@@ -107,6 +107,52 @@ def _write_path_view(text: str) -> dict:
     return view
 
 
+def _codec_view(text: str) -> dict:
+    """The codec-admission digest: is the batcher actually coalescing
+    concurrent submissions into device-sized steps on this node?"""
+    series = _parse_metrics(text)
+
+    def total(name, **match):
+        return sum(v for n, lb, v in series if n == name
+                   and all(lb.get(k) == str(w) for k, w in match.items()))
+
+    view: dict = {}
+    for op in ("encode", "apply"):
+        subs = total("cubefs_codec_batch_submissions_total", op=op)
+        steps = total("cubefs_codec_batch_steps_total", op=op)
+        stripes = total("cubefs_codec_batch_stripes_per_step_sum", op=op)
+        step_cnt = total("cubefs_codec_batch_stripes_per_step_count", op=op)
+        wait_sum = total("cubefs_codec_batch_wait_seconds_sum", op=op)
+        wait_cnt = total("cubefs_codec_batch_wait_seconds_count", op=op)
+        if not (subs or steps):
+            continue
+        view[op] = {
+            "stripes_submitted": subs,
+            "device_steps": steps,
+            "stripes_per_step_avg":
+                round(stripes / step_cnt, 2) if step_cnt else None,
+            "admission_wait_avg_ms":
+                round(1000 * wait_sum / wait_cnt, 3) if wait_cnt else None,
+            "backpressure_blocks":
+                total("cubefs_codec_batch_backpressure_total", op=op),
+            "errors_fanned_back":
+                total("cubefs_codec_batch_errors_total", op=op),
+        }
+    engines = sorted({lb.get("engine") for n, lb, _ in series
+                      if n == "cubefs_codec_batch_steps_total"} - {None})
+    view["steps_by_engine"] = {
+        e: total("cubefs_codec_batch_steps_total", engine=e)
+        for e in engines}
+    dp = [(lb.get("dp"), v) for n, lb, v in series
+          if n == "cubefs_codec_batch_dp_steps_total"]
+    view["dp_sharded_steps"] = {k: v for k, v in dp}
+    view["codec_bytes_by_engine"] = {
+        e: total("cubefs_codec_bytes_total", engine=e)
+        for e in sorted({lb.get("engine") for n, lb, _ in series
+                         if n == "cubefs_codec_bytes_total"} - {None})}
+    return view
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="cubefs-tpu-cli")
     sub = ap.add_subparsers(dest="group", required=True)
@@ -227,7 +273,7 @@ def main(argv=None):
                         help="cap unit migrations queued this sweep")
 
     p_metrics = sub.add_parser("metrics")  # node observability views
-    p_metrics.add_argument("action", choices=["write-path", "raw"])
+    p_metrics.add_argument("action", choices=["write-path", "codec", "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
 
@@ -493,6 +539,8 @@ def main(argv=None):
         text = _fetch_metrics(args.addr)
         if args.action == "raw":
             print(text, end="")
+        elif args.action == "codec":
+            print(json.dumps(_codec_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
 

@@ -1,0 +1,546 @@
+"""Batched codec admission: coalesce concurrent submissions into
+device-sized steps.
+
+The encode kernel sustains its headline throughput only at large batch
+dimensions (BENCH_r05 `encode_1024stripes_gibs`), but the blob plane
+batches only *within* one PUT — concurrent PUTs and repair legs each
+dispatch their own tiny device step, feeding the accelerator at request
+granularity. This module is the admission layer in between: every
+`encode_parity` / `matrix_apply` submission with compatible geometry
+``(op, n, m, shard_size)`` parks in a per-geometry queue, and whichever
+submitter finds the queue idle drains it as ONE device call — the same
+first-caller-drains pattern the raft proposal batcher uses for group
+commit (parallel/raft.py): the device-step duration itself is the
+batching window, so uncontended callers pay no added idle latency and
+batch width tracks contention.
+
+Per-submission results and errors fan back through private events (a
+malformed submission mid-batch is rejected alone; its batch-mates
+proceed). A bounded pending-stripe queue provides backpressure, a
+max-batch / max-wait pair bounds step size and adds an optional linger
+window, and drained batches are split dp-wise across the device mesh
+(parallel/sharded_codec.py) when multiple devices are visible — the
+dp=16/32 dryruns (MULTICHIP_r06.json) prove 1/n per-device splits stay
+bit-identical.
+
+Knobs (env, read at construction):
+  CUBEFS_CODEC_BATCH=0           A/B door: submissions call the engine
+                                 directly, no coalescing
+  CUBEFS_CODEC_BATCH_MAX         max stripes per device step (1024)
+  CUBEFS_CODEC_BATCH_WAIT_MS     drainer linger before the first swap
+                                 (0: the device step is the window)
+  CUBEFS_CODEC_BATCH_PENDING     pending-stripe bound before submitters
+                                 block (4096)
+  CUBEFS_CODEC_DP=0              disable dp-wise sharding of drained
+                                 batches
+  CUBEFS_CODEC_DP_MIN_BYTES      smallest step worth sharding (1 MiB)
+
+Bit-identity: GF(2^8) math has no rounding, every engine is
+bit-identical per stripe, and the dp split is along the independent
+batch axis — a batched step's output equals the unbatched path's
+byte for byte (asserted in tests/test_codec_batch.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..utils import metrics
+from .engine import Engine, _call_with_fallback, engine_for, get_engine
+
+
+class CodecAdmissionError(Exception):
+    """Submission rejected or lost by the admission layer itself."""
+
+
+class BackpressureError(CodecAdmissionError):
+    """The bounded pending queue stayed full past the deadline."""
+
+
+class CodecFuture:
+    """One caller's stripes parked in a geometry queue. Resolved exactly
+    once by the drainer — result or error — then its private event
+    fires (no shared condition herd; the raft _ProposeWaiter shape).
+
+    `submit_*_async` returns this handle so a caller can pipeline:
+    submit several stripes, then collect. A collector whose queue has
+    no drain in flight becomes the drainer itself (collector-drains,
+    the async face of first-caller-drains) — there is no dedicated
+    drainer thread to fall behind or die. One collector per future:
+    the wake-up event is allocated lazily by that collector, because in
+    pipelined use most futures are already resolved when collected and
+    never need one (Event allocation and signalling are the admission
+    layer's hottest per-submission costs)."""
+
+    __slots__ = ("arr", "stripes", "value", "exc", "done", "event",
+                 "enq_t", "_batcher", "_key")
+
+    def __init__(self, batcher: "BatchCodec", key: tuple, arr: np.ndarray):
+        self.arr = arr
+        self.stripes = int(arr.shape[0])
+        self.value = None
+        self.exc: BaseException | None = None
+        self.done = False
+        self.event: threading.Event | None = None
+        self.enq_t = time.perf_counter()
+        self._batcher = batcher
+        self._key = key
+
+    def resolve(self, value, exc: BaseException | None) -> None:
+        self.value = value
+        self.exc = exc
+        # write order matters (Dekker with result()): done first, then
+        # read the event slot — the GIL makes each step atomic and
+        # sequentially consistent, so either the collector sees done or
+        # we see its event
+        self.done = True
+        ev = self.event
+        if ev is not None:
+            ev.set()
+
+    def result(self, timeout: float = 120.0) -> np.ndarray:
+        """Block until resolved; return the stripes or raise the
+        per-submission error. Drains the queue first if nobody is."""
+        if not self.done:
+            self._batcher._drain_if_idle(self._key)
+            if not self.done:
+                ev = self.event
+                if ev is None:
+                    ev = self.event = threading.Event()
+                if not self.done and not ev.wait(timeout):
+                    # the drainer still owns the submission and will
+                    # resolve it; this caller just stops waiting
+                    raise CodecAdmissionError(
+                        f"{self._key[0]}: submission not drained within "
+                        f"{timeout:.1f}s")
+        if self.exc is not None:
+            raise self.exc
+        return self.value
+
+
+class _GeometryQueue:
+    """Pending submissions for one (op, engine, geometry) key."""
+
+    __slots__ = ("subs", "busy", "coeff")
+
+    def __init__(self, coeff: np.ndarray | None):
+        self.subs: list[CodecFuture] = []
+        self.busy = False
+        self.coeff = coeff  # identical for every submission in the key
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class BatchCodec:
+    """The submit surface. One instance per process is the norm
+    (module-level DEFAULT below); tests construct private ones."""
+
+    def __init__(self, enabled: bool | None = None,
+                 max_batch: int | None = None,
+                 max_wait_ms: float | None = None,
+                 max_pending: int | None = None,
+                 max_step_bytes: int | None = None):
+        self.enabled = (os.environ.get("CUBEFS_CODEC_BATCH", "1") != "0"
+                        if enabled is None else enabled)
+        self.max_batch = (max_batch if max_batch is not None
+                          else _env_int("CUBEFS_CODEC_BATCH_MAX", 1024))
+        self.max_wait = (max_wait_ms if max_wait_ms is not None
+                         else _env_float("CUBEFS_CODEC_BATCH_WAIT_MS",
+                                         0.0)) / 1e3
+        self.max_pending = (max_pending if max_pending is not None
+                            else _env_int("CUBEFS_CODEC_BATCH_PENDING",
+                                          4096))
+        # byte bound per device step: keeps 'auto' inside the measured
+        # crossover sizes and bounds step working-set memory
+        self.max_step_bytes = (max_step_bytes if max_step_bytes is not None
+                               else _env_int("CUBEFS_CODEC_STEP_BYTES",
+                                             64 << 20))
+        self.dp_enabled = os.environ.get("CUBEFS_CODEC_DP", "1") != "0"
+        self.dp_min_bytes = _env_int("CUBEFS_CODEC_DP_MIN_BYTES", 1 << 20)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: dict[tuple, _GeometryQueue] = {}
+        self._pending = 0  # stripes parked across all queues
+        self._n_busy = 0  # queues with a drain in flight
+        self._dp_fns: dict[tuple, object] = {}  # (digest, n_in, dp) ->
+        self._dp_meshes: dict[int, object] = {}
+
+    # ---------------- public submit surface ----------------
+    def submit_encode(self, engine: str | None, data: np.ndarray,
+                      n_parity: int, timeout: float = 120.0) -> np.ndarray:
+        """(B, N, S) data -> (B, M, S) parity, coalesced with every
+        concurrent submission of the same (N, M, S, engine)."""
+        key, coeff, arr = self._prep_encode(engine, data, n_parity)
+        if not self.enabled:  # A/B door: the unbatched control path
+            return self._engine_call(key, coeff, arr)
+        return self._enqueue(key, coeff, arr, timeout).result(timeout)
+
+    def submit_apply(self, engine: str | None, coeff: np.ndarray,
+                     shards: np.ndarray, timeout: float = 120.0
+                     ) -> np.ndarray:
+        """(R, C) GF matrix x (B, C, S) shards -> (B, R, S), coalesced
+        with concurrent submissions sharing the identical matrix."""
+        key, coeff, arr = self._prep_apply(engine, coeff, shards)
+        if not self.enabled:
+            return self._engine_call(key, coeff, arr)
+        return self._enqueue(key, coeff, arr, timeout).result(timeout)
+
+    def submit_encode_async(self, engine: str | None, data: np.ndarray,
+                            n_parity: int, timeout: float = 120.0
+                            ) -> CodecFuture:
+        """submit_encode that parks and returns immediately: collect
+        with .result(). A caller pipelining K submissions before its
+        first collect keeps K stripes continuously admitted — the
+        sleep/wake cycle per stripe disappears and step width rises."""
+        key, coeff, arr = self._prep_encode(engine, data, n_parity)
+        if not self.enabled:
+            return self._inline(key, coeff, arr)
+        return self._enqueue(key, coeff, arr, timeout)
+
+    def submit_apply_async(self, engine: str | None, coeff: np.ndarray,
+                           shards: np.ndarray, timeout: float = 120.0
+                           ) -> CodecFuture:
+        """submit_apply that parks and returns immediately."""
+        key, coeff, arr = self._prep_apply(engine, coeff, shards)
+        if not self.enabled:
+            return self._inline(key, coeff, arr)
+        return self._enqueue(key, coeff, arr, timeout)
+
+    # ---------------- admission ----------------
+    def _prep_encode(self, engine, data, n_parity):
+        data = np.asarray(data)
+        if data.ndim != 3:
+            raise ValueError(f"submit_encode takes (B, N, S), got "
+                             f"{data.shape}")
+        n, s = int(data.shape[1]), int(data.shape[2])
+        return ("encode", engine or "", n, int(n_parity), s), None, data
+
+    def _prep_apply(self, engine, coeff, shards):
+        shards = np.asarray(shards)
+        if shards.ndim != 3:
+            raise ValueError(f"submit_apply takes (B, C, S), got "
+                             f"{shards.shape}")
+        coeff = np.ascontiguousarray(coeff, dtype=np.uint8)
+        c, s = int(shards.shape[1]), int(shards.shape[2])
+        return ("apply", engine or "", coeff.tobytes(), c, s), coeff, shards
+
+    def _inline(self, key: tuple, coeff, arr) -> CodecFuture:
+        """Disabled-door async submit: execute now, return resolved."""
+        fut = CodecFuture(self, key, arr)
+        try:
+            fut.resolve(self._engine_call(key, coeff, arr), None)
+        except BaseException as e:
+            fut.resolve(None, e)
+        return fut
+
+    def _enqueue(self, key: tuple, coeff: np.ndarray | None,
+                 arr: np.ndarray, timeout: float) -> CodecFuture:
+        sub = CodecFuture(self, key, arr)
+        with self._lock:
+            # backpressure: block only while a drain in flight will
+            # free space — the submitter who finds everything idle
+            # becomes the drainer and must never park itself
+            deadline = None
+            while (self._pending + sub.stripes > self.max_pending
+                   and self._n_busy > 0):
+                op = key[0]
+                if deadline is None:
+                    metrics.codec_batch_backpressure.inc(op=op)
+                    deadline = time.monotonic() + timeout
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(remaining):
+                    raise BackpressureError(
+                        f"{op}: {self._pending} stripes pending > bound "
+                        f"{self.max_pending} for {timeout:.1f}s")
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = _GeometryQueue(coeff)
+            q.subs.append(sub)
+            self._pending += sub.stripes
+        return sub
+
+    def _drain_if_idle(self, key: tuple) -> None:
+        """Become the drainer for `key` unless one is already running
+        (collector-drains; called from CodecFuture.result)."""
+        q = self._queues.get(key)
+        # unlocked peek: a True `busy` is authoritative enough — the
+        # running drainer only exits once the queue is empty, so any
+        # parked submission it hasn't taken yet, it will. Skipping the
+        # lock here keeps collectors off the drainer's neck.
+        if q is not None and q.busy:
+            return
+        with self._lock:
+            q = self._queues.get(key)
+            if q is None or q.busy or not q.subs:
+                return
+            q.busy = True
+            self._n_busy += 1
+        if self.max_wait > 0:
+            # optional linger: trade first-collector latency for width
+            # when arrivals are sparse but steady
+            time.sleep(self.max_wait)
+        self._drain(key, q)
+
+    def _drain(self, key: tuple, q: _GeometryQueue) -> None:
+        """First-caller-drains loop: swap the queue out and land each
+        swap as one (or a few, size-bounded) device steps. Submissions
+        arriving during a step ride the next swap — the step duration
+        is the batching window."""
+        try:
+            while True:
+                with self._lock:
+                    batch = q.subs
+                    if not batch:
+                        q.busy = False
+                        self._n_busy -= 1
+                        self._cond.notify_all()
+                        return
+                    q.subs = []
+                total = sum(s.stripes for s in batch)
+                try:
+                    self._run_steps(key, q.coeff, batch, total)
+                finally:
+                    with self._lock:
+                        self._pending -= total
+                        self._cond.notify_all()
+        except BaseException as e:
+            # a dying drainer (MemoryError, interrupt) must not strand
+            # the queue busy forever: fail whatever is still parked and
+            # reopen the queue so later submissions can self-drain
+            with self._lock:
+                orphans = q.subs
+                q.subs = []
+                self._pending -= sum(s.stripes for s in orphans)
+                q.busy = False
+                self._n_busy -= 1
+                self._cond.notify_all()
+            for sub in orphans:
+                if not sub.done:
+                    sub.resolve(None, CodecAdmissionError(
+                        f"{key[0]}: drainer died: {e!r}"))
+            raise
+
+    def _run_steps(self, key: tuple, coeff: np.ndarray | None,
+                   batch: list[CodecFuture], total: int) -> None:
+        """Validate, chunk, execute, and fan results back. Every
+        submission is resolved exactly once, even when the device call
+        fails or a batch-mate is malformed. One fused pass — this loop
+        runs per submission at full admission rate."""
+        op = key[0]
+        # admitted-stripe accounting lands here, once per swap — per-
+        # submission counter locks are measurable at this call rate
+        metrics.codec_batch_submissions.inc(total, op=op)
+        # input bytes per stripe are constant across the key (geometry
+        # is the key): encode (.., n, m, s) reads n*s, apply
+        # (.., coeff, c, s) reads c*s
+        per_stripe = (int(key[3]) if op == "apply" else int(key[2])) \
+            * int(key[4])
+        stripe_cap = min(self.max_batch,
+                         max(1, self.max_step_bytes // max(1, per_stripe)))
+        try:
+            step: list[CodecFuture] = []
+            stripes = 0
+            for sub in batch:
+                # drain-time validation: key geometry comes from the
+                # shape, so the remaining per-submission failure is
+                # dtype — reject it alone (concatenate would silently
+                # upcast the step)
+                if sub.arr.dtype != np.uint8:
+                    metrics.codec_batch_errors.inc(op=op, kind="dtype")
+                    sub.resolve(None, CodecAdmissionError(
+                        f"{op}: stripe dtype must be uint8, got "
+                        f"{sub.arr.dtype}"))
+                    continue
+                if step and stripes + sub.stripes > stripe_cap:
+                    self._one_step(key, coeff, step)
+                    step, stripes = [], 0
+                step.append(sub)
+                stripes += sub.stripes
+            if step:
+                self._one_step(key, coeff, step)
+        finally:
+            for sub in batch:  # belt-and-braces: nobody waits forever
+                if not sub.done:
+                    sub.resolve(None, CodecAdmissionError(
+                        f"{op}: drain failed before this submission"))
+
+    def _one_step(self, key: tuple, coeff: np.ndarray | None,
+                  step: list[CodecFuture]) -> None:
+        op = key[0]
+        arr = (step[0].arr if len(step) == 1
+               else np.concatenate([s.arr for s in step], axis=0))
+        n_stripes = int(arr.shape[0])
+        wait_now = time.perf_counter()
+        metrics.codec_batch_wait.observe_many(
+            [wait_now - sub.enq_t for sub in step], op=op)
+        try:
+            out = self._engine_call(key, coeff, arr)
+        except BaseException as e:  # fan the step's failure back
+            for sub in step:
+                sub.resolve(None, e)
+            return
+        metrics.codec_batch_stripes.observe(n_stripes, op=op)
+        off = 0
+        for sub in step:  # resolve inlined: this is the hottest loop
+            end = off + sub.stripes
+            sub.value = out[off:end]
+            sub.done = True  # write order: done before the event read
+            ev = sub.event
+            if ev is not None:
+                ev.set()
+            off = end
+
+    # ---------------- device step ----------------
+    def _engine_call(self, key: tuple, coeff: np.ndarray | None,
+                     arr: np.ndarray) -> np.ndarray:
+        op, label = key[0], key[1]
+        name = label or os.environ.get("CUBEFS_TPU_EC_ENGINE", "tpu")
+        if name == "auto":
+            # the whole point of admission: the crossover policy sees
+            # the COALESCED size, so concurrent tiny submissions ride
+            # the engine measured best for the batch they became
+            name = engine_for(int(arr.nbytes)).name
+        if op == "encode":
+            m = int(key[3])
+            dp_out = self._maybe_dp(name, None, arr, m)
+            if dp_out is not None:
+                out = dp_out
+            else:
+                out = _call_with_fallback(name, "encode_parity", arr, m)
+        else:
+            dp_out = self._maybe_dp(name, coeff, arr, None)
+            if dp_out is not None:
+                out = dp_out
+            else:
+                out = _call_with_fallback(name, "matrix_apply", coeff, arr)
+        metrics.codec_batch_steps.inc(op=op, engine=name)
+        return out
+
+    def _maybe_dp(self, name: str, coeff: np.ndarray | None,
+                  arr: np.ndarray, n_parity: int | None
+                  ) -> np.ndarray | None:
+        """Shard a drained step dp-wise over the visible devices (the
+        MULTICHIP_r06 dryrun recipe: batch axis split 1/n per device,
+        bit-identical). Returns None when not profitable/applicable."""
+        if not self.dp_enabled or name not in ("tpu", "tpu-pallas"):
+            return None
+        if int(arr.nbytes) < self.dp_min_bytes or arr.shape[0] < 2:
+            return None
+        try:
+            import jax
+
+            devs = jax.devices()
+            if len(devs) < 2:
+                return None
+            if coeff is None:
+                from ..ops import gf256
+
+                coeff = gf256.parity_matrix(int(arr.shape[1]),
+                                            int(n_parity))
+            dp = min(len(devs), int(arr.shape[0]))
+            fn = self._dp_fn(coeff, int(arr.shape[1]), dp)
+            b = int(arr.shape[0])
+            pad = (-b) % dp
+            if pad:
+                arr = np.concatenate(
+                    [arr, np.zeros((pad,) + arr.shape[1:],
+                                   dtype=np.uint8)], axis=0)
+            out = np.asarray(fn(arr))
+            metrics.codec_batch_dp_steps.inc(dp=dp)
+            return out[:b]
+        except Exception:
+            # any mesh/compile hiccup degrades to the single-device
+            # engine path — never fail a step for a sharding miss
+            return None
+
+    def _dp_fn(self, coeff: np.ndarray, n_in: int, dp: int):
+        digest = (coeff.tobytes(), coeff.shape, n_in, dp)
+        fn = self._dp_fns.get(digest)
+        if fn is None:
+            import jax
+
+            from ..parallel import mesh as meshlib
+            from ..parallel import sharded_codec
+
+            mesh = self._dp_meshes.get(dp)
+            if mesh is None:
+                mesh = meshlib.make_mesh(
+                    devices=jax.devices()[:dp],
+                    dims={"dp": dp, "tp": 1, "sp": 1})
+                self._dp_meshes[dp] = mesh
+            fn = sharded_codec.gf_matrix_apply_sharded(mesh, coeff, n_in)
+            self._dp_fns[digest] = fn
+        return fn
+
+
+class AdmittedEngine:
+    """Engine-protocol facade over the admission layer: the ONLY way
+    blob-plane code reaches device math (lint family CFC). Accepts the
+    same (..., C, S) shapes as a raw engine, flattening leading axes
+    into the batch dimension for submission."""
+
+    def __init__(self, batcher: BatchCodec, label: str | None):
+        self.batcher = batcher
+        self.label = label
+        self.name = label or os.environ.get("CUBEFS_TPU_EC_ENGINE", "tpu")
+
+    def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
+        data = np.asarray(data)
+        if data.ndim < 2:
+            raise ValueError(f"shards must be (..., N, S), got {data.shape}")
+        if data.ndim == 2:
+            return self.batcher.submit_encode(
+                self.label, data[None], n_parity)[0]
+        if data.ndim == 3:
+            return self.batcher.submit_encode(self.label, data, n_parity)
+        lead = data.shape[:-2]
+        out = self.batcher.submit_encode(
+            self.label, data.reshape(-1, *data.shape[-2:]), n_parity)
+        return out.reshape(*lead, *out.shape[-2:])
+
+    def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray
+                     ) -> np.ndarray:
+        shards = np.asarray(shards)
+        if shards.ndim < 2:
+            raise ValueError(
+                f"shards must be (..., C, S), got {shards.shape}")
+        if shards.ndim == 2:
+            return self.batcher.submit_apply(
+                self.label, coeff, shards[None])[0]
+        if shards.ndim == 3:
+            return self.batcher.submit_apply(self.label, coeff, shards)
+        lead = shards.shape[:-2]
+        out = self.batcher.submit_apply(
+            self.label, coeff, shards.reshape(-1, *shards.shape[-2:]))
+        return out.reshape(*lead, *out.shape[-2:])
+
+
+DEFAULT = BatchCodec()
+
+
+def admit(engine: str | None = None,
+          batcher: BatchCodec | None = None) -> AdmittedEngine:
+    """The admission surface: an Engine-shaped handle whose calls
+    coalesce with every other admitted caller in the process. `engine`
+    pins a named engine (same contract as get_engine); None follows
+    CUBEFS_TPU_EC_ENGINE and 'auto' applies the measured size-class
+    crossover to each DRAINED batch."""
+    if engine is not None and engine != "auto":
+        get_engine(engine)  # fail fast on unknown names, as before
+    return AdmittedEngine(batcher or DEFAULT, engine)

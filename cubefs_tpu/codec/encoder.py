@@ -17,7 +17,8 @@ import numpy as np
 
 from ..ops import rs_kernel
 from . import codemode as cm
-from .engine import Engine, get_engine
+from .batcher import admit
+from .engine import Engine
 
 
 class ECError(Exception):
@@ -43,7 +44,10 @@ class CodecConfig:
 
 def new_encoder(cfg: CodecConfig) -> "Encoder":
     t = cm.tactic(cfg.mode)
-    eng = get_engine(cfg.engine)
+    # every encoder reaches device math through the batched admission
+    # surface (codec/batcher.py): concurrent PUT/repair/verify callers
+    # sharing a geometry coalesce into one device step, bit-identically
+    eng = admit(cfg.engine)
     if t.l != 0:
         return LrcEncoder(cfg, t, eng)
     return Encoder(cfg, t, eng)
@@ -56,6 +60,13 @@ class Encoder:
         self.cfg = cfg
         self.t = t
         self.engine = engine
+
+    @property
+    def codec(self) -> Engine:
+        """The encoder's admission-surface handle, for callers that
+        need raw shard math (batched verify sweeps, culprit isolation)
+        without bypassing coalescing (lint family CFC)."""
+        return self.engine
 
     # -- shape helpers ---------------------------------------------------
     def _check(self, shards: np.ndarray, total: int | None = None) -> np.ndarray:

@@ -22,6 +22,7 @@ import numpy as np
 
 from ..ops import crc32_kernel, gf256, rs_kernel
 from ..utils import metrics, rpc
+from .batcher import admit
 from .engine import get_engine
 
 codec_bytes = metrics.codec_bytes
@@ -30,9 +31,43 @@ codec_bytes = metrics.codec_bytes
 SHM_PREFIX = "/dev/shm/cubefs-codec-"
 
 
+def _pos_int(args, name: str, default: int | None = None) -> int:
+    """RPC arg as a positive int, or a 400 — a non-positive n/m/
+    shard_size/batch must fail at the boundary, not as a downstream
+    reshape/index error deep in the engine."""
+    try:
+        v = int(args.get(name, default) if default is not None
+                else args[name])
+    except (KeyError, TypeError, ValueError):
+        raise rpc.RpcError(400, f"missing/non-integer arg {name!r}") \
+            from None
+    if v < 1:
+        raise rpc.RpcError(400, f"{name}={v} must be >= 1")
+    return v
+
+
+def _index_list(args, name: str, total: int) -> list[int]:
+    """RPC arg as a list of in-range [0, total) shard indices, or 400."""
+    try:
+        idx = [int(i) for i in args[name]]
+    except (KeyError, TypeError, ValueError):
+        raise rpc.RpcError(400, f"missing/non-integer arg {name!r}") \
+            from None
+    bad = [i for i in idx if not 0 <= i < total]
+    if bad:
+        raise rpc.RpcError(
+            400, f"{name} indices {bad} out of range [0, {total})")
+    if len(set(idx)) != len(idx):
+        raise rpc.RpcError(400, f"{name} carries duplicate indices")
+    return idx
+
+
 class CodecService:
     def __init__(self, engine: str | None = None):
         self.engine = get_engine(engine)
+        # all shard math rides the batched admission surface: stripes
+        # from concurrent RPC callers coalesce into device-sized steps
+        self.codec = admit(engine)
 
     # ---------------- RPC surface ----------------
     def rpc_engine(self, args, body):
@@ -68,12 +103,13 @@ class CodecService:
         """Shared-memory encode for co-located native clients: shards
         live in a /dev/shm file (input at offset 0, parity written
         right after), only shapes ride the RPC."""
-        n, m = int(args["n"]), int(args["m"])
-        s, b = int(args["shard_size"]), int(args.get("batch", 1))
+        n, m = _pos_int(args, "n"), _pos_int(args, "m")
+        s = _pos_int(args, "shard_size")
+        b = _pos_int(args, "batch", default=1)
         in_bytes, out_bytes = b * n * s, b * m * s
         mm = self._shm_map(args, in_bytes + out_bytes)
         data = np.asarray(mm[:in_bytes]).reshape(b, n, s)
-        parity = self.engine.encode_parity(data, m)
+        parity = self.codec.encode_parity(data, m)
         mm[in_bytes:in_bytes + out_bytes] = \
             np.ascontiguousarray(parity).reshape(-1)
         mm.flush()
@@ -84,18 +120,24 @@ class CodecService:
         """Shared-memory reconstruct: survivors at offset 0 (rows in
         ascending `present` order), recovered `wanted` rows written
         after them."""
-        n, total = int(args["n"]), int(args["total"])
-        present = [int(i) for i in args["present"]]
-        wanted = [int(i) for i in args["wanted"]]
+        n, total = _pos_int(args, "n"), _pos_int(args, "total")
+        if total < n:
+            raise rpc.RpcError(400, f"total {total} < n {n}")
+        present = _index_list(args, "present", total)
+        wanted = _index_list(args, "wanted", total)
         if present != sorted(present):
             raise rpc.RpcError(400, "present must be sorted ascending")
-        s, b = int(args["shard_size"]), int(args.get("batch", 1))
+        if len(present) < n:
+            raise rpc.RpcError(
+                400, f"only {len(present)} survivors < n {n}")
+        s = _pos_int(args, "shard_size")
+        b = _pos_int(args, "batch", default=1)
         k = len(present[:n])
         in_bytes, out_bytes = b * k * s, b * len(wanted) * s
         mm = self._shm_map(args, in_bytes + out_bytes)
         surv = np.asarray(mm[:in_bytes]).reshape(b, k, s)[:, :n]
         rows = rs_kernel.reconstruct_rows(n, total, present, wanted)
-        rec = self.engine.matrix_apply(rows, surv)
+        rec = self.codec.matrix_apply(rows, surv)
         mm[in_bytes:in_bytes + out_bytes] = \
             np.ascontiguousarray(rec).reshape(-1)
         mm.flush()
@@ -104,32 +146,39 @@ class CodecService:
         return {"shape": [b, len(wanted), s], "offset": in_bytes}
 
     def rpc_encode(self, args, body):
-        n, m = int(args["n"]), int(args["m"])
-        s, b = int(args["shard_size"]), int(args.get("batch", 1))
+        n, m = _pos_int(args, "n"), _pos_int(args, "m")
+        s = _pos_int(args, "shard_size")
+        b = _pos_int(args, "batch", default=1)
         expect = b * n * s
         if len(body) != expect:
             raise rpc.RpcError(400, f"body {len(body)}B != batch*n*shard {expect}B")
         data = np.frombuffer(body, dtype=np.uint8).reshape(b, n, s)
-        parity = self.engine.encode_parity(data, m)
+        parity = self.codec.encode_parity(data, m)
         codec_bytes.inc(len(body), op="encode", engine=self.engine.name)
         return {"shape": [b, m, s]}, np.ascontiguousarray(parity).tobytes()
 
     def rpc_reconstruct(self, args, body):
-        n, total = int(args["n"]), int(args["total"])
-        present = [int(i) for i in args["present"]]
-        wanted = [int(i) for i in args["wanted"]]
+        n, total = _pos_int(args, "n"), _pos_int(args, "total")
+        if total < n:
+            raise rpc.RpcError(400, f"total {total} < n {n}")
+        present = _index_list(args, "present", total)
+        wanted = _index_list(args, "wanted", total)
         if present != sorted(present):
             # decode rows are built for ascending shard order; silently
             # accepting a different body order would corrupt the output
             raise rpc.RpcError(400, "present must be sorted ascending and "
                                     "body rows must follow that order")
-        s, b = int(args["shard_size"]), int(args.get("batch", 1))
+        if len(present) < n:
+            raise rpc.RpcError(
+                400, f"only {len(present)} survivors < n {n}")
+        s = _pos_int(args, "shard_size")
+        b = _pos_int(args, "batch", default=1)
         k = len(present[:n])
         if len(body) != b * k * s:
             raise rpc.RpcError(400, "body size mismatch")
         surv = np.frombuffer(body, dtype=np.uint8).reshape(b, k, s)[:, :n]
         rows = rs_kernel.reconstruct_rows(n, total, present, wanted)
-        rec = self.engine.matrix_apply(rows, surv)
+        rec = self.codec.matrix_apply(rows, surv)
         codec_bytes.inc(len(body), op="reconstruct", engine=self.engine.name)
         return {"shape": [b, len(wanted), s]}, np.ascontiguousarray(rec).tobytes()
 
@@ -149,12 +198,13 @@ class CodecService:
         return {"count": len(crcs)}, crcs.tobytes()
 
     def rpc_verify(self, args, body):
-        n, m = int(args["n"]), int(args["m"])
-        s, b = int(args["shard_size"]), int(args.get("batch", 1))
+        n, m = _pos_int(args, "n"), _pos_int(args, "m")
+        s = _pos_int(args, "shard_size")
+        b = _pos_int(args, "batch", default=1)
         if len(body) != b * (n + m) * s:
             raise rpc.RpcError(400, "body size mismatch")
         stripes = np.frombuffer(body, dtype=np.uint8).reshape(b, n + m, s)
-        parity = self.engine.encode_parity(stripes[:, :n], m)
+        parity = self.codec.encode_parity(stripes[:, :n], m)
         ok = (parity == stripes[:, n:]).all(axis=(1, 2))
         codec_bytes.inc(len(body), op="verify", engine=self.engine.name)
         return {"ok": [bool(x) for x in ok]}

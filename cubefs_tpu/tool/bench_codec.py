@@ -1,0 +1,211 @@
+"""Concurrent-submitter A/B benchmark for the batched codec admission
+layer (codec/batcher.py).
+
+Synthetic PUT/repair submitters — each the shape of one access-PUT
+encode or one worker repair matrix_apply — hammer the admission surface
+concurrently. Leg A coalesces (CUBEFS_CODEC_BATCH on), leg B is the
+unbatched control (every submission its own device dispatch). Reports
+aggregate encode throughput, latency percentiles, mean stripes per
+drained device step, and asserts the batched outputs are bit-identical
+to the unbatched golden.
+
+Run: `python -m cubefs_tpu.tool.bench_codec --out
+artifacts/CODEC_BATCH_AB_r07.json` (knobs below; defaults sized for the
+ISSUE 6 acceptance gate: >= 32 submitters, stripes/step >= 8,
+batched/unbatched >= 3x).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..codec.batcher import BatchCodec
+from ..ops import rs_kernel
+from ..utils import metrics
+
+
+def _pct(xs, p):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p / 100 * len(xs)))] if xs else 0.0
+
+
+def _run_leg(batched: bool, submitters: int, iters: int, n: int, m: int,
+             shard_size: int, engine: str, seed: int,
+             wait_ms: float, depth: int) -> dict:
+    """One leg: `submitters` threads, each submitting `iters` stripes
+    (even threads PUT-shaped encodes, odd threads repair-shaped
+    matrix_applys) through a private BatchCodec. Each keeps `depth`
+    submissions in flight (submit_*_async then collect) — the async
+    admission pattern a pipelined PUT/repair caller uses."""
+    codec = BatchCodec(enabled=batched, max_wait_ms=wait_ms)
+    rng = np.random.default_rng(seed)
+    total = n + m
+    # repair shape: unit 0 lost, decode row over the next n survivors
+    rows = rs_kernel.reconstruct_rows(n, total, list(range(1, n + 1)), [0])
+    stripes = [rng.integers(0, 256, (1, n, shard_size), dtype=np.uint8)
+               for _ in range(8)]
+    # warm up outside the timed window: first-use costs (engine lib
+    # load, crossover table read) must not land in either leg's wall
+    codec.submit_encode(engine, stripes[0], m)
+    codec.submit_apply(engine, rows, stripes[0])
+    lat: list[float] = []
+    lat_mu = threading.Lock()
+    outs: dict[int, np.ndarray] = {}
+    errs: list[BaseException] = []
+    start = threading.Barrier(submitters + 1)
+
+    def submitter(tid: int):
+        my_lat = []
+        my_out = None
+        data = stripes[tid % len(stripes)]
+        inflight: list = []
+
+        def submit():
+            t0 = time.perf_counter()
+            if tid % 2 == 0:  # PUT-shaped: encode parity
+                fut = codec.submit_encode_async(engine, data, m)
+            else:  # repair-shaped: decode the lost unit
+                fut = codec.submit_apply_async(engine, rows, data)
+            inflight.append((t0, fut))
+
+        try:
+            start.wait()
+            for _ in range(iters):
+                if len(inflight) >= depth:
+                    t0, fut = inflight.pop(0)
+                    my_out = fut.result()
+                    my_lat.append(time.perf_counter() - t0)
+                submit()
+            for t0, fut in inflight:
+                my_out = fut.result()
+                my_lat.append(time.perf_counter() - t0)
+        except BaseException as e:  # pragma: no cover - bench guard
+            errs.append(e)
+        with lat_mu:
+            lat.extend(my_lat)
+            outs[tid] = my_out
+
+    threads = [threading.Thread(target=submitter, args=(i,))
+               for i in range(submitters)]
+    for t in threads:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errs:
+        raise errs[0]
+    n_stripes = submitters * iters
+    data_bytes = n_stripes * n * shard_size
+    return {
+        "batched": batched,
+        "wall_s": round(wall, 3),
+        "stripes": n_stripes,
+        "throughput_gibs": round(data_bytes / wall / 2**30, 4),
+        "submit_p50_ms": round(_pct(lat, 50) * 1e3, 3),
+        "submit_p99_ms": round(_pct(lat, 99) * 1e3, 3),
+        "outputs": outs,  # stripped before serialization
+    }
+
+
+def _occupancy_totals() -> tuple[float, int]:
+    """(sum, count) across all label series of the stripes-per-step
+    histogram — metrics are the bench's only occupancy bookkeeping."""
+    s = c = 0
+    for _, row in metrics.codec_batch_stripes.samples():
+        s += row["sum"]
+        c += row["count"]
+    return s, c
+
+
+def _median(xs):
+    xs = sorted(xs)
+    mid = len(xs) // 2
+    return xs[mid] if len(xs) % 2 else (xs[mid - 1] + xs[mid]) / 2
+
+
+def run_ab(submitters: int = 32, iters: int = 200, n: int = 6, m: int = 3,
+           shard_size: int = 2048, engine: str = "auto",
+           seed: int = 0xBA7C4, wait_ms: float = 0.25,
+           depth: int = 4, rounds: int = 3) -> dict:
+    """Alternating batched/unbatched rounds; per-leg medians (the host
+    is a shared core — single runs swing 2x), bit-identity cross-check,
+    and step occupancy from the metrics registry."""
+    b_rounds, u_rounds = [], []
+    b_out = u_out = None
+    steps = coalesced = 0
+    for _ in range(rounds):
+        sum0, cnt0 = _occupancy_totals()
+        b = _run_leg(True, submitters, iters, n, m, shard_size,
+                     engine, seed, wait_ms, depth)
+        sum1, cnt1 = _occupancy_totals()
+        u = _run_leg(False, submitters, iters, n, m, shard_size,
+                     engine, seed, wait_ms, depth)
+        steps += cnt1 - cnt0
+        coalesced += sum1 - sum0
+        b_out, u_out = b.pop("outputs"), u.pop("outputs")
+        b_rounds.append(b)
+        u_rounds.append(u)
+
+    # bit-identity: same tid => same input; outputs must match exactly
+    bit_identical = all(np.array_equal(b_out[tid], u_out[tid])
+                        for tid in b_out)
+    med_b = _median([r["throughput_gibs"] for r in b_rounds])
+    med_u = _median([r["throughput_gibs"] for r in u_rounds])
+    out = {
+        "submitters": submitters,
+        "iters_per_submitter": iters,
+        "rounds": rounds,
+        "rs": f"{n}+{m}",
+        "shard_size": shard_size,
+        "engine": engine,
+        "max_wait_ms": wait_ms,
+        "pipeline_depth": depth,
+        "batched": {"median_throughput_gibs": med_b, "rounds": b_rounds},
+        "unbatched": {"median_throughput_gibs": med_u, "rounds": u_rounds},
+        "speedup": round(med_b / med_u, 2) if med_u else None,
+        "device_steps": steps,
+        "mean_stripes_per_device_step":
+            round(coalesced / steps, 2) if steps else None,
+        "bit_identical": bit_identical,
+    }
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="cubefs-tpu-bench-codec")
+    ap.add_argument("--submitters", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--n", type=int, default=6)
+    ap.add_argument("--m", type=int, default=3)
+    ap.add_argument("--shard-size", type=int, default=2048)
+    ap.add_argument("--engine", default="auto")
+    ap.add_argument("--wait-ms", type=float, default=0.25,
+                    help="admission max-wait (latency/occupancy knob)")
+    ap.add_argument("--depth", type=int, default=4,
+                    help="per-submitter async pipeline depth")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="alternating leg rounds; medians reported")
+    ap.add_argument("--out", default=None,
+                    help="write the artifact JSON here")
+    args = ap.parse_args(argv)
+    result = run_ab(args.submitters, args.iters, args.n, args.m,
+                    args.shard_size, args.engine, wait_ms=args.wait_ms,
+                    depth=args.depth, rounds=args.rounds)
+    text = json.dumps(result, indent=2)
+    print(text)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()

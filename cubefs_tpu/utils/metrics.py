@@ -75,6 +75,25 @@ class Histogram(_Metric):
             for j in range(i, len(self.BUCKETS)):
                 s["buckets"][j] += 1
 
+    def observe_many(self, values, **labels) -> None:
+        """Record a burst of samples under one lock acquisition — for
+        hot paths that fan one event out to many members (e.g. per-
+        submission waits of one drained codec step)."""
+        if not values:
+            return
+        k = self._key(labels)
+        with self._lock:
+            s = self._series.get(k)
+            if s is None:
+                s = {"count": 0, "sum": 0.0, "buckets": [0] * len(self.BUCKETS)}
+                self._series[k] = s
+            for value in values:
+                s["count"] += 1
+                s["sum"] += value
+                i = bisect.bisect_left(self.BUCKETS, value)
+                for j in range(i, len(self.BUCKETS)):
+                    s["buckets"][j] += 1
+
     def time(self, **labels):
         metric = self
 
@@ -224,3 +243,28 @@ reconstruct_reads = DEFAULT.counter(
     "cubefs_reconstruct_total",
     "degraded-read reconstructions by stripe scope (local = intra-AZ "
     "LRC stripe, global = full-width RS)", ("path",))
+
+# batched codec admission (codec/batcher.py): device-sized steps
+codec_batch_submissions = DEFAULT.counter(
+    "cubefs_codec_batch_submissions_total",
+    "stripes submitted through the codec admission surface", ("op",))
+codec_batch_steps = DEFAULT.counter(
+    "cubefs_codec_batch_steps_total",
+    "drained device steps (each is ONE engine dispatch)",
+    ("op", "engine"))
+codec_batch_stripes = DEFAULT.histogram(
+    "cubefs_codec_batch_stripes_per_step",
+    "stripes coalesced per drained device step (1 = uncontended)",
+    ("op",), buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+codec_batch_wait = DEFAULT.histogram(
+    "cubefs_codec_batch_wait_seconds",
+    "submit-to-device-step admission wait", ("op",))
+codec_batch_backpressure = DEFAULT.counter(
+    "cubefs_codec_batch_backpressure_total",
+    "submissions that blocked on the bounded pending queue", ("op",))
+codec_batch_errors = DEFAULT.counter(
+    "cubefs_codec_batch_errors_total",
+    "per-submission errors fanned back by the drainer", ("op", "kind"))
+codec_batch_dp_steps = DEFAULT.counter(
+    "cubefs_codec_batch_dp_steps_total",
+    "device steps sharded dp-wise across the mesh", ("dp",))

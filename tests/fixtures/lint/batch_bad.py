@@ -1,0 +1,15 @@
+"""Known-bad fixture for the batch-discipline checker (CFC001/CFC002).
+
+Parsed by tests under a cubefs_tpu/blob/ relpath; never imported."""
+
+from ..codec.engine import get_engine  # CFC001: raw engine import
+from ..codec import engine  # CFC001: engine module import
+
+
+def repair_stripe(rows, batch):
+    eng = get_engine("cpp")
+    # CFC002: device math on a raw engine handle — no coalescing,
+    # no occupancy metrics, no backpressure
+    recovered = eng.matrix_apply(rows, batch)
+    parity = engine.get_engine("auto").encode_parity(batch, 3)  # CFC002
+    return recovered, parity

@@ -1,0 +1,26 @@
+"""Known-good fixture for the batch-discipline checker.
+
+Device math reaches the engines through the admission surface only."""
+
+from ..codec import codemode as cm
+from ..codec.batcher import BatchCodec, admit
+from ..codec.encoder import CodecConfig, new_encoder
+
+
+class Worker:
+    def __init__(self, engine=None):
+        self.codec = admit(engine)
+
+    def repair(self, rows, batch):
+        # admitted facade: coalesces with concurrent submitters
+        return self.codec.matrix_apply(rows, batch)
+
+    def encode(self, enc, stripes, m):
+        return enc.codec.encode_parity(stripes, m)
+
+    def submit(self, batcher: BatchCodec, data, m):
+        return batcher.submit_encode("auto", data, m)
+
+
+def mode_width(mode):
+    return cm.get_tactic(mode).n + cm.get_tactic(mode).m

@@ -271,6 +271,53 @@ def test_autoengine_degrades_on_device_loss(monkeypatch, rng):
     assert not eng._dead_engines
 
 
+def test_fallback_lands_on_numpy_when_cpp_unavailable(monkeypatch, rng):
+    """A host without the native .so (or with a broken one) degrades
+    cpp -> numpy; the healthy engines are NOT quarantined along the
+    way — only the engine that actually failed is."""
+    from cubefs_tpu.codec import engine as eng
+
+    class BrokenCpp:
+        name = "cpp"
+
+        def encode_parity(self, data, n_parity):
+            raise OSError("libgfcpu.so: cannot open shared object file")
+
+        def matrix_apply(self, coeff, shards):
+            raise OSError("libgfcpu.so: cannot open shared object file")
+
+    monkeypatch.setattr(eng, "_dead_engines", set())
+    monkeypatch.setattr(eng, "_instances", {"cpp": BrokenCpp()})
+    data = rng.integers(0, 256, (6, 64)).astype(np.uint8)
+    parity = eng._call_with_fallback("cpp", "encode_parity", data, 3)
+    assert np.array_equal(parity, eng.NumpyEngine().encode_parity(data, 3))
+    assert eng._dead_engines == {"cpp"}  # tpu/numpy stay in rotation
+    # the router now routes around the dead native engine too
+    monkeypatch.setattr(eng, "_policy", [[1 << 62, "cpp"]])
+    assert eng.engine_for(64).name in ("tpu", "numpy")
+
+
+def test_crossover_policy_routes_by_size(monkeypatch, rng):
+    """engine_for honors the measured table's size classes exactly at
+    the boundary, and 'auto' dispatch through it stays bit-identical
+    to the host engine."""
+    from cubefs_tpu.codec import engine as eng
+
+    monkeypatch.setattr(eng, "_dead_engines", set())
+    monkeypatch.setattr(eng, "_policy",
+                        [[1024, "numpy"], [1 << 62, "tpu"]])
+    assert eng.engine_for(1024).name == "numpy"  # inclusive upper bound
+    assert eng.engine_for(1025).name == "tpu"
+    auto = eng.AutoEngine()
+    small = rng.integers(0, 256, (4, 64)).astype(np.uint8)   # 256 B
+    big = rng.integers(0, 256, (4, 2048)).astype(np.uint8)   # 8 KiB
+    golden = eng.NumpyEngine()
+    assert np.array_equal(auto.encode_parity(small, 2),
+                          golden.encode_parity(small, 2))
+    assert np.array_equal(auto.encode_parity(big, 2),
+                          golden.encode_parity(big, 2))
+
+
 def test_lrc_local_reconstruct_edge_cases(rng):
     enc = make_encoder(cm.CodeMode.EC6P10L2)
     t = enc.t

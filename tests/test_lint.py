@@ -18,6 +18,7 @@ import sys
 import pytest
 
 from tool.lint import cli, core
+from tool.lint.checkers.batch_discipline import BatchDisciplineChecker
 from tool.lint.checkers.lock_discipline import LockDisciplineChecker
 from tool.lint.checkers.placement_discipline import PlacementDisciplineChecker
 from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
@@ -165,6 +166,27 @@ def test_placement_discipline_exempts_topology_itself():
     c = PlacementDisciplineChecker()
     assert c.applies("cubefs_tpu/blob/scheduler.py")
     assert not c.applies("cubefs_tpu/blob/topology.py")
+    assert not c.applies("cubefs_tpu/fs/master.py")
+
+
+# ---------------- batch-discipline ----------------
+
+def test_batch_discipline_true_positives():
+    mod = _module("batch_bad.py", "cubefs_tpu/blob/fx.py")
+    found = BatchDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFC001", "CFC001", "CFC002", "CFC002"]
+
+
+def test_batch_discipline_true_negative():
+    mod = _module("batch_good.py", "cubefs_tpu/blob/fx.py")
+    assert BatchDisciplineChecker().check(mod) == []
+
+
+def test_batch_discipline_scoped_to_blob_plane():
+    c = BatchDisciplineChecker()
+    assert c.applies("cubefs_tpu/blob/worker.py")
+    # the codec package itself holds raw engines by design
+    assert not c.applies("cubefs_tpu/codec/batcher.py")
     assert not c.applies("cubefs_tpu/fs/master.py")
 
 

@@ -1,5 +1,6 @@
 """Checker registry: every family the suite ships, in report order."""
 
+from .batch_discipline import BatchDisciplineChecker
 from .lock_discipline import LockDisciplineChecker
 from .placement_discipline import PlacementDisciplineChecker
 from .retry_discipline import RetryDisciplineChecker
@@ -14,4 +15,5 @@ ALL_CHECKERS = (
     RetryDisciplineChecker,
     Tier1PurityChecker,
     PlacementDisciplineChecker,
+    BatchDisciplineChecker,
 )

@@ -1,0 +1,94 @@
+"""Batch-discipline checker (rule: batch-discipline, codes CFC0xx).
+
+codec/batcher.py is the single admission surface for device math: it
+coalesces concurrent stripes into device-sized steps, meters occupancy
+and admission wait, applies bounded-queue backpressure, and keeps the
+CUBEFS_CODEC_BATCH A/B door honest. A blob-plane module that grabs a
+raw engine handle and dispatches on it silently opts its stripes out of
+all of that — each call is its own device step, invisible to the codec
+metrics and to backpressure. The regression shape:
+
+  CFC001  blob-plane import of the raw engine layer (codec.engine /
+          get_engine / engine_for) — holding a raw handle is how the
+          bypass starts
+  CFC002  .encode_parity() / .matrix_apply() dispatched on a receiver
+          that is not the admitted facade — blob code must call these
+          on an ``admit()``-returned handle (held as ``.codec`` by
+          convention) or through BatchCodec.submit_*
+
+The analysis is syntactic. The admitted receiver convention is a final
+attribute/name of ``codec`` (``self.codec``, ``enc.codec``) or an
+obvious batcher handle (``batcher``/``admitted``); anything else that
+dispatches device math from cubefs_tpu/blob/ is flagged. codemode /
+encoder config imports are fine — only the engine layer is fenced.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+# names whose import from the codec package hands out raw engine access
+_ENGINE_NAMES = {"get_engine", "engine_for", "Engine", "NumpyEngine",
+                 "CppEngine", "TpuEngine"}
+# receiver final names allowed to dispatch device math in the blob plane
+_ADMITTED_RECV = {"codec", "batcher", "admitted"}
+_DEVICE_CALLS = {"encode_parity", "matrix_apply"}
+
+
+def _final_name(node: ast.AST) -> str:
+    """`self.codec` -> 'codec'; `eng` -> 'eng'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+class BatchDisciplineChecker(Checker):
+    rule = "batch-discipline"
+    dirs = ("cubefs_tpu/blob/",)
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if "codec.engine" in a.name:
+                        out.append(self.violation(
+                            mod, "CFC001", node,
+                            f"import of `{a.name}` from the blob plane — "
+                            f"raw engine handles bypass the codec "
+                            f"admission surface (codec/batcher.py)"))
+            elif isinstance(node, ast.ImportFrom):
+                modname = node.module or ""
+                if modname.endswith("codec.engine"):
+                    out.append(self.violation(
+                        mod, "CFC001", node,
+                        "import from codec.engine in the blob plane — "
+                        "route device math through codec.batcher.admit() "
+                        "so stripes coalesce, meter, and backpressure"))
+                elif modname.endswith("codec") or ".codec." in modname \
+                        or modname == "codec":
+                    for a in node.names:
+                        if a.name == "engine" or a.name in _ENGINE_NAMES:
+                            out.append(self.violation(
+                                mod, "CFC001", node,
+                                f"import of `{a.name}` from the codec "
+                                f"package in the blob plane — raw engine "
+                                f"access bypasses the admission surface"))
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in _DEVICE_CALLS
+                        and _final_name(func.value) not in _ADMITTED_RECV):
+                    recv = _final_name(func.value) or mod.segment(func.value)
+                    out.append(self.violation(
+                        mod, "CFC002", node,
+                        f".{func.attr}() on raw receiver `{recv}` — blob "
+                        f"code must dispatch device math through the "
+                        f"admitted facade (codec.batcher.admit(), held "
+                        f"as `.codec`) so submissions coalesce into "
+                        f"device-sized steps"))
+        return out
