@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextvars
 import threading
+import time
 import uuid
 import zlib
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
@@ -72,6 +73,10 @@ class AccessHandler:
         self._pool = ThreadPoolExecutor(max_workers=self.cfg.max_workers)
         self._encoders: dict[int, object] = {}
         self._lock = threading.Lock()
+        # phase timestamps of the most recent put() on this handler
+        # (encode_admitted / alloc_done / encode_done / quorum_done),
+        # observable by tests asserting the encode overlaps allocation
+        self.last_put_timeline: dict = {}
 
     def _submit(self, fn, *args):
         # carry the request's trace context into pool workers, else the
@@ -101,6 +106,20 @@ class AccessHandler:
 
         blob_size = self.cfg.blob_size
         blobs = [data[i : i + blob_size] for i in range(0, len(data), blob_size)]
+
+        # ---- async encode admission, then allocation ----
+        # Admit the parity encode FIRST: the batched device step (which
+        # also coalesces with concurrent PUTs/repairs of the same
+        # geometry, codec/batcher.py) runs while this request does its
+        # allocation round-trips, instead of starting after them.
+        shard_size = enc.shard_size(len(blobs[0]))
+        stripes = np.zeros((len(blobs), t.total, shard_size), dtype=np.uint8)
+        for i, blob in enumerate(blobs):
+            buf = np.frombuffer(blob, dtype=np.uint8)
+            stripes[i].reshape(-1)[: buf.size] = buf
+        timeline = {"encode_admitted": time.monotonic()}
+        pending = enc.encode_async(stripes)
+
         if self.proxy is not None:  # allocation cache: no per-put cm trip
             meta, _ = self.proxy.call("alloc", {"codemode": mode,
                                                 "count": len(blobs)})
@@ -113,18 +132,10 @@ class AccessHandler:
             meta, _ = self.cm.call("alloc_bids", {"count": len(blobs),
                                                   "op_id": uuid.uuid4().hex})
             min_bid = meta["start"]
-
-        # ---- batched device encode: group equal shard sizes ----
-        shard_size = enc.shard_size(len(blobs[0]))
-        stripes = np.zeros((len(blobs), t.total, shard_size), dtype=np.uint8)
-        for i, blob in enumerate(blobs):
-            buf = np.frombuffer(blob, dtype=np.uint8)
-            stripes[i].reshape(-1)[: buf.size] = buf
-        # ONE batched submission for all this PUT's blobs; the encoder's
-        # admission surface (codec/batcher.py) additionally coalesces it
-        # with CONCURRENT PUTs and repair legs of the same geometry, so
-        # the device sees device-sized steps even at request granularity
-        enc.encode(stripes)
+        timeline["alloc_done"] = time.monotonic()
+        timeline["encode_resolved_before_wait"] = pending.resolved
+        pending.wait()
+        timeline["encode_done"] = time.monotonic()
 
         # ---- quorum writes ----
         quorum = self.cfg.put_quorum_override or t.put_quorum
@@ -143,6 +154,8 @@ class AccessHandler:
                 ok_per_bid[bid] += 1
             else:
                 fails.append((bid, idx))
+        timeline["quorum_done"] = time.monotonic()
+        self.last_put_timeline = timeline
         for bid, n_ok in ok_per_bid.items():
             if n_ok < quorum:
                 if self.proxy is not None:

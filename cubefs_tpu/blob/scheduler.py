@@ -62,6 +62,7 @@ class Scheduler:
         self._lock = threading.RLock()
         self.tasks: dict[str, dict] = {}  # task_id -> record
         self._done_units: dict[int, set[int]] = {}  # disk -> unit indexes done
+        self.last_drain_plan: dict = {}  # most recent plan_disk_drain result
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # task-state checkpoint + transition record log (reference:
@@ -214,7 +215,63 @@ class Scheduler:
                 n += 1
             if n == 0:
                 self.cm.set_disk_status(disk_id, DiskStatus.REPAIRED)
+            else:
+                self.plan_disk_drain(disk_id)
             return n
+
+    def _unit_bytes(self, vid: int, unit_index: int) -> int:
+        """Drain size of one failed slot, measured from any surviving
+        unit's chunk listing (shards of a stripe are equal-width, so a
+        survivor's chunk bytes == the dead slot's chunk bytes)."""
+        if self.nodes is None:
+            return 0
+        vol = self.cm.get_volume(vid)
+        for u in vol.units:
+            if u.index == unit_index:
+                continue
+            try:
+                meta, _ = self.nodes.get(u.node_addr).call(
+                    "list_chunk",
+                    {"disk_id": u.disk_id, "chunk_id": u.chunk_id})
+                return sum(s for _, s, _ in meta["shards"])
+            except Exception:
+                continue
+        return 0
+
+    def plan_disk_drain(self, disk_id: int) -> dict:
+        """Group one failed disk's open unit-repair tasks into drain
+        steps sized against CUBEFS_CODEC_STEP_BYTES: workers that lease
+        a step's tasks together submit reconstructs that coalesce into
+        full device-width codec steps instead of one skinny stripe per
+        drain. Re-runnable (re-plans the still-open tasks)."""
+        try:
+            step_bytes = int(os.environ.get(
+                "CUBEFS_CODEC_STEP_BYTES", str(64 << 20)) or str(64 << 20))
+        except ValueError:
+            step_bytes = 64 << 20
+        step_bytes = max(1, step_bytes)
+        with self._lock:
+            open_tasks = [t for t in self.tasks.values()
+                          if t.get("src_disk") == disk_id
+                          and t["state"] in ("pending", "leased")]
+            step, acc, total = 0, 0, 0
+            for t in open_tasks:
+                b = t.get("drain_bytes")
+                if b is None:
+                    b = t["drain_bytes"] = self._unit_bytes(
+                        t["vid"], t["unit_index"])
+                total += b
+                if acc and acc + b > step_bytes:
+                    step, acc = step + 1, 0
+                t["drain_step"] = step
+                acc += b
+            plan = {"disk_id": disk_id, "tasks": len(open_tasks),
+                    "total_bytes": total, "step_bytes": step_bytes,
+                    "steps": (step + 1) if open_tasks else 0}
+            self.last_drain_plan = plan
+            if open_tasks:
+                self._checkpoint()
+            return plan
 
     def _queue_unit_repair(self, vid: int, unit_index: int, reason: str,
                            src_disk: int | None = None,

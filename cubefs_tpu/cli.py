@@ -104,6 +104,35 @@ def _write_path_view(text: str) -> dict:
                 if coalesced_entries else None,
         },
     }
+    # pipelined replication (CUBEFS_RAFT_PIPELINE) + shared mux planes
+    pipelined = total("cubefs_raft_pipelined_appends_total")
+    win_sum = total("cubefs_raft_inflight_window_sum")
+    win_cnt = total("cubefs_raft_inflight_window_count")
+    mux_jobs = [(lb.get("kind"), v) for n, lb, v in series
+                if n == "cubefs_raft_mux_jobs_total"]
+    senders = total("cubefs_raft_mux_senders")
+    if pipelined or mux_jobs:
+        view["pipeline"] = {
+            "pipelined_appends": pipelined,
+            "inflight_window_avg":
+                round(win_sum / win_cnt, 2) if win_cnt else None,
+            "mux_jobs": {k: v for k, v in mux_jobs},
+            "mux_sender_threads": senders,
+        }
+    # client-side cross-partition fan-out (CUBEFS_META_FANOUT)
+    fan_batches = total("cubefs_meta_fanout_batches_total")
+    fan_ops = total("cubefs_meta_fanout_ops_total")
+    fan_sum = total("cubefs_meta_fanout_partitions_inflight_sum")
+    fan_cnt = total("cubefs_meta_fanout_partitions_inflight_count")
+    if fan_batches or fan_cnt:
+        view["client_fanout"] = {
+            "fanout_batches": fan_batches,
+            "fanout_ops": fan_ops,
+            "ops_per_batch_avg":
+                round(fan_ops / fan_batches, 2) if fan_batches else None,
+            "partitions_inflight_avg":
+                round(fan_sum / fan_cnt, 2) if fan_cnt else None,
+        }
     return view
 
 

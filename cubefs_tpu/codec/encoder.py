@@ -21,6 +21,33 @@ from .batcher import admit
 from .engine import Engine
 
 
+class PendingEncode:
+    """An encode admitted to the codec batcher while its caller still
+    has other work in hand (bid allocation, header parsing, streaming
+    the rest of the body). wait() lands the parity rows into the
+    original stripe array — same array encode() would return — raising
+    any per-submission error at the collect point. `resolved` says
+    whether the device step already completed without blocking."""
+
+    __slots__ = ("shards", "_fill", "_fut")
+
+    def __init__(self, shards: np.ndarray, fill=None, fut=None):
+        self.shards = shards
+        self._fill = fill  # runs at most once; None = already complete
+        self._fut = fut
+
+    @property
+    def resolved(self) -> bool:
+        return self._fill is None or (self._fut is not None
+                                      and self._fut.done)
+
+    def wait(self, timeout: float = 120.0) -> np.ndarray:
+        if self._fill is not None:
+            fill, self._fill = self._fill, None
+            fill(timeout)
+        return self.shards
+
+
 class ECError(Exception):
     pass
 
@@ -100,6 +127,30 @@ class Encoder:
         if self.cfg.enable_verify and not self.verify(shards):
             raise VerifyError("parity verify failed after encode")
         return shards
+
+    def encode_async(self, shards: np.ndarray) -> PendingEncode:
+        """Admit the parity encode and return immediately; wait() fills
+        the parity rows in place. With a batcher-admitted engine the
+        device step runs (coalesced with concurrent submissions) while
+        the caller overlaps allocation or IO; engines without an
+        admission surface degrade to an inline encode."""
+        shards = self._check(shards)
+        n, m = self.t.n, self.t.m
+        if not m:
+            return PendingEncode(shards)
+        batcher = getattr(self.engine, "batcher", None)
+        if batcher is None or not batcher.enabled:
+            return PendingEncode(self.encode(shards))
+        flat = shards.reshape(-1, self.t.total, shards.shape[-1])
+        fut = batcher.submit_encode_async(
+            self.engine.label, np.ascontiguousarray(flat[:, :n, :]), m)
+
+        def fill(timeout: float) -> None:
+            flat[:, n:n + m, :] = fut.result(timeout)
+            if self.cfg.enable_verify and not self.verify(shards):
+                raise VerifyError("parity verify failed after encode")
+
+        return PendingEncode(shards, fill, fut)
 
     def verify(self, shards: np.ndarray) -> bool:
         shards = self._check(shards)
@@ -195,6 +246,32 @@ class LrcEncoder(Encoder):
         if self.cfg.enable_verify and not self.verify(shards):
             raise VerifyError("parity verify failed after encode")
         return shards
+
+    def encode_async(self, shards: np.ndarray) -> PendingEncode:
+        """Admit the global parity step; the per-AZ local parity (cheap,
+        depends on the global rows) is computed at wait() time, after
+        the batched device step lands."""
+        shards = self._check(shards)
+        t = self.t
+        batcher = getattr(self.engine, "batcher", None)
+        if batcher is None or not batcher.enabled or not t.m:
+            return PendingEncode(self.encode(shards))
+        flat = shards.reshape(-1, t.total, shards.shape[-1])
+        fut = batcher.submit_encode_async(
+            self.engine.label, np.ascontiguousarray(flat[:, : t.n, :]), t.m)
+
+        def fill(timeout: float) -> None:
+            flat[:, t.n : t.n + t.m, :] = fut.result(timeout)
+            ln, lm = self._local_nm
+            for az in range(t.az_count):
+                stripe_idx, _, _ = t.local_stripe_in_az(az)
+                local_data = shards[..., stripe_idx[:ln], :]
+                shards[..., stripe_idx[ln:], :] = self.engine.encode_parity(
+                    local_data, lm)
+            if self.cfg.enable_verify and not self.verify(shards):
+                raise VerifyError("parity verify failed after encode")
+
+        return PendingEncode(shards, fill, fut)
 
     def verify(self, shards: np.ndarray) -> bool:
         shards = np.asarray(shards, dtype=np.uint8)

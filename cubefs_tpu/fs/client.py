@@ -9,11 +9,13 @@ the gateway layers (FUSE wire protocol, S3) sit on top of this facade.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
 import uuid
 
+from ..utils import metrics as _metrics
 from ..utils import packet as pkt
 from ..utils import rpc
 from . import metanode as mn
@@ -42,6 +44,154 @@ _META_READ_OPS = {"lookup", "inode_get", "readdir", "dentry_count", "walk"}
 
 
 
+class _FanoutWaiter:
+    """One submit parked in the client's cross-partition coalescer.
+    Doubles as the async handle submit_async returns."""
+
+    __slots__ = ("record", "result", "exc", "done", "event")
+
+    def __init__(self, record: dict):
+        self.record = record
+        self.result = None
+        self.exc: BaseException | None = None
+        self.done = False
+        self.event = threading.Event()
+
+    def finish(self, result, exc: BaseException | None) -> None:
+        self.result = result
+        self.exc = exc
+        self.done = True
+        self.event.set()
+
+    def wait(self, timeout: float = 30.0):
+        if not self.event.wait(timeout) and not self.done:
+            raise TimeoutError("fan-out submit not resolved in time")
+        if self.exc is not None:
+            raise self.exc
+        return self.result
+
+
+class SubmitFanout:
+    """Client-side cross-partition submit coalescer (CUBEFS_META_FANOUT
+    = K, 0 disables): mutations queue per metapartition, the first
+    caller to find a partition idle drains its whole queue as ONE
+    submit_batch RPC, and a K-wide gate keeps up to K partitions'
+    batches in flight concurrently — the same first-caller-drains shape
+    as codec/batcher.py, lifted to the wire. Under a multi-threaded
+    workload the per-partition RPC tax amortizes across every queued
+    record AND the partitions progress in parallel instead of one
+    submit round-trip at a time. submit_async() + the lazy drain pool
+    give a single-threaded caller the same K-partition concurrency."""
+
+    def __init__(self, wrapper: "MetaWrapper", k: int):
+        self.wrapper = wrapper
+        self.k = k
+        self._mu = threading.Lock()
+        self._queues: dict[int, list[_FanoutWaiter]] = {}
+        self._busy: set[int] = set()
+        self._scheduled: set[int] = set()  # pids with a drain task queued
+        self._gate = threading.Semaphore(k)
+        self._pool = None  # lazy; only submit_async needs threads
+
+    def submit(self, mp: dict, record: dict, timeout: float = 30.0):
+        w = self._enqueue(mp, record)
+        self._drain_if_idle(mp)
+        return w.wait(timeout)
+
+    def submit_async(self, mp: dict, record: dict) -> _FanoutWaiter:
+        """Queue a mutation and return its handle; a drain-pool worker
+        ships the partition's batch so ONE caller thread can keep K
+        partitions in flight (call .wait() to collect). One drain task
+        per partition burst: the drain re-spins while records keep
+        arriving, so scheduling a task per record would only tax the
+        pool."""
+        pid = mp["pid"]
+        with self._mu:
+            self._queues.setdefault(pid, []).append(w := _FanoutWaiter(record))
+            schedule = pid not in self._scheduled
+            if schedule:
+                self._scheduled.add(pid)
+        if schedule:
+            self._ensure_pool().submit(self._drain_scheduled, mp)
+        return w
+
+    def _drain_scheduled(self, mp: dict) -> None:
+        with self._mu:
+            self._scheduled.discard(mp["pid"])
+        self._drain_if_idle(mp)
+
+    def close(self) -> None:
+        """Stop the async drain pool (sync submits keep working)."""
+        with self._mu:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
+
+    def _enqueue(self, mp: dict, record: dict) -> _FanoutWaiter:
+        w = _FanoutWaiter(record)
+        with self._mu:
+            self._queues.setdefault(mp["pid"], []).append(w)
+        return w
+
+    def _ensure_pool(self):
+        with self._mu:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self.k,
+                    thread_name_prefix="meta-fanout")
+            return self._pool
+
+    def _drain_if_idle(self, mp: dict) -> None:
+        pid = mp["pid"]
+        while True:
+            with self._mu:
+                batch = self._queues.get(pid)
+                if not batch or pid in self._busy:
+                    return
+                self._busy.add(pid)
+                self._queues[pid] = []
+                inflight = len(self._busy)
+            try:
+                _metrics.meta_fanout_inflight.observe(inflight)
+                self._land(mp, batch)
+            finally:
+                with self._mu:
+                    self._busy.discard(pid)
+            # records queued while we were on the wire ride the next
+            # spin (unless another caller already claimed the drain)
+
+    def _land(self, mp: dict, batch: list[_FanoutWaiter]) -> None:
+        pid = mp["pid"]
+        self._gate.acquire()  # at most K partitions' batches in flight
+        try:
+            if len(batch) == 1:
+                # uncontended fast path: plain submit, no batch envelope
+                meta, _ = self.wrapper._call_wire(
+                    mp, "submit", {"record": batch[0].record})
+                batch[0].finish(meta["result"], None)
+                return
+            meta, _ = self.wrapper._call_wire(
+                mp, "submit_batch",
+                {"records": [w.record for w in batch]})
+            _metrics.meta_fanout_batches.inc(pid=pid)
+            _metrics.meta_fanout_ops.inc(len(batch), pid=pid)
+            for w, (result, err) in zip(batch, meta["results"]):
+                if err is not None:
+                    w.finish(None, FsError(err[0], err[1]))
+                else:
+                    w.finish(result, None)
+        except BaseException as e:
+            # batch-level failure (redirect exhausted, transport): every
+            # still-unresolved waiter observes the same outcome
+            for w in batch:
+                if not w.done:
+                    w.finish(None, e)
+        finally:
+            self._gate.release()
+
+
 class MetaWrapper:
     """Routes inode/dentry ops to the owning meta partition by range."""
 
@@ -62,6 +212,15 @@ class MetaWrapper:
             vol_view.get("meta_read_addrs") or {})
         self._packet_clients: dict[str, object] = {}
         self._packet_down: dict[str, float] = {}  # plane addr -> retry ts
+        # cross-partition fan-out coalescer: submits queue per partition
+        # and ship as submit_batch RPCs, up to K partitions' batches in
+        # flight (CUBEFS_META_FANOUT=0 restores per-op submits — A/B)
+        try:
+            k = int(os.environ.get("CUBEFS_META_FANOUT", "8") or "0")
+        except ValueError:
+            k = 8
+        self.fanout: SubmitFanout | None = (
+            SubmitFanout(self, k) if k > 0 else None)
 
     def _mp_for(self, ino: int) -> dict:
         for mp in self.mps:
@@ -72,15 +231,31 @@ class MetaWrapper:
     REDIRECT = 421  # metanode "not leader" status
 
     def _call(self, mp: dict, method: str, args: dict):
+        """Partition call router: submits detour through the cross-
+        partition fan-out coalescer when it's enabled (CUBEFS_META_FANOUT
+        > 0) so concurrent mutations against one partition share a
+        submit_batch RPC; everything else goes straight to the wire."""
+        if method == "submit" and self.fanout is not None:
+            return {"result": self.fanout.submit(mp, args["record"])}, b""
+        return self._call_wire(mp, method, args)
+
+    def _call_wire(self, mp: dict, method: str, args: dict):
         """Call the partition via the shared replica/redirect loop.
-        Mutations ("submit") carry a unique op_id so a retry after a
-        lost response is exactly-once; metanode 4xx codes map back to
-        errnos. Hot ops ride the binary packet plane when advertised."""
+        Mutations ("submit"/"submit_batch") carry unique op_ids so a
+        retry after a lost response is exactly-once; metanode 4xx codes
+        map back to errnos. Hot ops ride the binary packet plane when
+        advertised."""
         addrs = list(mp.get("addrs") or [mp["addr"]])
         payload = {"pid": mp["pid"], **args}
         if method == "submit":
             payload["record"] = dict(payload["record"])
             payload["record"].setdefault("op_id", uuid.uuid4().hex)
+        elif method == "submit_batch":
+            # stamp ids BEFORE the replica loop: a transport retry must
+            # re-present the same ids for the dedup window to catch
+            payload["records"] = [dict(r) for r in payload["records"]]
+            for r in payload["records"]:
+                r.setdefault("op_id", uuid.uuid4().hex)
         try:
             if ((self.packet_addrs or self.read_addrs)
                     and method in _META_PACKET_OPS):

@@ -98,7 +98,11 @@ class MetaPartition:
             self._load()
             self._oplog = open(os.path.join(data_dir, "oplog.jsonl"), "a")
         if self.start <= ROOT_INO < self.end and ROOT_INO not in self.inodes:
-            self.apply({"op": "mk_inode", "ino": ROOT_INO, "type": DIR, "mode": 0o755})
+            # fixed ts: the bootstrap root is applied LOCALLY on every
+            # replica (it precedes the raft log), so a wall-clock stamp
+            # would make freshly-born replicas bitwise-divergent
+            self.apply({"op": "mk_inode", "ino": ROOT_INO, "type": DIR,
+                        "mode": 0o755, "ts": 0.0})
 
     # ---------------- apply door (replication interface) ----------------
     def submit(self, record: dict) -> dict:
@@ -1450,6 +1454,63 @@ class MetaNode:
         except MetaError as e:
             raise _rpc_err(e) from None
         return {"result": res}
+
+    def rpc_submit_batch(self, args, body):
+        """Server half of the client-side cross-partition fan-out: one
+        RPC lands a whole batch of mutations for ONE partition as a
+        single __batch__ raft entry — the batch was already coalesced
+        client-side, so re-splitting it through per-record batcher
+        waiters would only add N events per call; one propose carries
+        the lot (and the raft proposal batcher still merges it with any
+        concurrent rpc_submit traffic into one WAL write/replication
+        round). Per-record outcomes fan back as [result, null] | [null,
+        [code, msg]] — a per-record MetaError fails exactly that
+        record, while batch-level outcomes (leader redirect, partition
+        gone) fail the call so client-side retry/redirect covers every
+        record at once. Records carry their own op_ids: a retried batch
+        replays cached results instead of re-applying."""
+        pid = args["pid"]
+        records = list(args["records"])
+        raft_node = self.rafts.get(pid)
+        mp = self._mp(pid)
+        outs: list = [None] * len(records)
+        todo: list[tuple[int, dict]] = []
+        for i, rec in enumerate(records):
+            try:
+                mp.check_limits(rec)
+            except MetaError as e:
+                outs[i] = [None, [e.code, str(e)]]
+                continue
+            todo.append((i, rec))
+        if todo:
+            from ..parallel.raft import NotLeaderError
+            from ..utils import metrics
+
+            try:
+                if raft_node is None:
+                    for i, rec in todo:
+                        try:
+                            outs[i] = [mp.submit(rec), None]
+                        except MetaError as e:
+                            outs[i] = [None, [e.code, str(e)]]
+                elif len(todo) == 1:
+                    i, rec = todo[0]
+                    try:
+                        outs[i] = [raft_node.propose(rec), None]
+                    except MetaError as e:
+                        outs[i] = [None, [e.code, str(e)]]
+                else:
+                    landed = raft_node.propose(
+                        {"op": "__batch__",
+                         "records": [rec for _, rec in todo]})
+                    metrics.meta_batch_entries.inc(pid=pid)
+                    metrics.meta_batched_ops.inc(len(todo), pid=pid)
+                    for (i, _), out in zip(todo, landed):
+                        outs[i] = out
+            except NotLeaderError as e:
+                raise rpc.RpcError(self.REDIRECT,
+                                   f"leader={e.leader or ''}") from None
+        return {"results": outs}
 
     def rpc_alloc_ino(self, args, body):
         try:

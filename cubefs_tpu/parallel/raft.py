@@ -32,6 +32,21 @@ Design notes:
     records at or below the restored log_base. Appends are fsync'd
     before an entry is acknowledged; rewrites go through tmp +
     os.replace + directory fsync.
+  * Pipelined replication (CUBEFS_RAFT_PIPELINE, default 4): instead of
+    one synchronous ship-then-await loop per follower, the leader keeps
+    up to W AppendEntries in flight per follower — batch N+1 is
+    dispatched (and its WAL fsync runs) while followers are still
+    acking batch N, and concurrent appends queued at a follower share
+    its group fsync. next_index is advanced OPTIMISTICALLY at dispatch
+    time (tracked as `_shipped`); acknowledged progress still only
+    moves through the max()-guarded match_index/next_index updates, so
+    commit-index advancement stays quorum-ordered. Sends are carried by
+    the ReplMux: per-NodePool, per-address sender lanes shared by every
+    group targeting that address (proposals for hundreds of partitions
+    share sockets/threads, not one loop each). `=0` restores the
+    per-peer synchronous repl threads. CUBEFS_RAFT_MUX (default on)
+    likewise collapses the per-node election/compaction tickers into
+    ONE TickMux thread per pool.
 """
 
 from __future__ import annotations
@@ -39,6 +54,7 @@ from __future__ import annotations
 import base64
 import json
 import os
+import queue as _queue
 import random
 import threading
 import time
@@ -155,15 +171,38 @@ class RaftNode:
                 # entries would land after the garbage and be dropped by
                 # the next load
                 self._persist_entries([], rewrote=True)
-        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
-        # one long-lived replication thread per peer (the tiglabs-raft
-        # dedicated-transport analog): signaled on propose/leadership,
-        # self-firing every HEARTBEAT while leader — no per-heartbeat
-        # thread churn even with hundreds of groups in one process
-        self._repl_events = {p: threading.Event() for p in self.peers}
+        # pipelined replication door: W in-flight AppendEntries per
+        # follower, dispatched through the shared ReplMux lanes. "0"
+        # restores the per-peer synchronous repl threads below exactly.
+        try:
+            self._pipeline = max(
+                0, int(os.environ.get("CUBEFS_RAFT_PIPELINE", "4") or "0"))
+        except ValueError:
+            self._pipeline = 4
+        # timer mux door: enroll in the per-pool TickMux instead of
+        # running a private 10ms election/compaction ticker thread
+        self._use_mux = os.environ.get("CUBEFS_RAFT_MUX", "1") != "0"
+        self._tick_busy = False  # TickMux: an election/compaction runs
+        self._ticker: threading.Thread | None = None
+        # pipelined-mode send progress, all guarded by _lock:
+        #   _shipped[peer]  highest abs index handed to the mux
+        #                   (optimistic next_index; 0 = resend from the
+        #                   acknowledged next_index)
+        #   _inflight[peer] append/snapshot RPCs currently in flight
+        #   _repl_retry[peer] transport-error backoff deadline
+        self._shipped: dict[str, int] = {}
+        self._inflight: dict[str, int] = {}
+        self._repl_retry: dict[str, float] = {}
+        self._replmux: "ReplMux | None" = None
+        # legacy plane (pipeline=0): one long-lived replication thread
+        # per peer (the tiglabs-raft dedicated-transport analog):
+        # signaled on propose/leadership, self-firing every HEARTBEAT
+        # while leader
+        legacy_peers = [] if self._pipeline else self.peers
+        self._repl_events = {p: threading.Event() for p in legacy_peers}
         self._repl_threads = [
             threading.Thread(target=self._repl_loop, args=(p,), daemon=True)
-            for p in self.peers
+            for p in legacy_peers
         ]
 
     # ---------------- index helpers (absolute <-> list) ----------------
@@ -322,7 +361,15 @@ class RaftNode:
 
     # ---------------- lifecycle ----------------
     def start(self) -> "RaftNode":
-        self._ticker.start()
+        if self._use_mux:
+            TickMux.get(self.pool).enroll(self)
+        else:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, daemon=True)
+            self._ticker.start()
+        if self._pipeline and self.peers:
+            self._replmux = ReplMux.get(self.pool)
+            self._replmux.enroll(self)
         for t in self._repl_threads:
             t.start()
         if self.peers:
@@ -331,6 +378,11 @@ class RaftNode:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._use_mux:
+            TickMux.get(self.pool).drop(self)
+        if self._replmux is not None:
+            self._replmux.drop(self)
+            self._replmux = None
         if self.peers:
             HeartbeatMux.get(self.pool).drop(self)
         for ev in self._repl_events.values():
@@ -398,6 +450,125 @@ class RaftNode:
             ev.wait(self.HEARTBEAT)
             ev.clear()
 
+    def _kick_repl(self, peer: str | None = None) -> None:
+        """Wake the replication plane: the ReplMux dispatcher in
+        pipelined mode, the per-peer thread(s) in legacy mode."""
+        if self._pipeline:
+            mux = self._replmux
+            if mux is not None:
+                mux.kick(self)
+        elif peer is None:
+            for ev in self._repl_events.values():
+                ev.set()
+        else:
+            ev = self._repl_events.get(peer)
+            if ev is not None:
+                ev.set()
+
+    def _dispatch_appends(self, mux: "ReplMux") -> bool:
+        """Pipelined-mode send pass (called by the ReplMux dispatcher):
+        for every follower with unshipped entries and a free window
+        slot, build AppendEntries from the OPTIMISTIC send cursor
+        (`_shipped`) and hand it to the peer's sender lane — without
+        waiting for outstanding acks. Returns True when pending work
+        was left undispatched (window full, snapshot in flight, or
+        error backoff) so the mux re-ticks this node at heartbeat pace
+        instead of waiting for an ack that may never come."""
+        jobs: list[tuple[str, str, dict]] = []
+        blocked = False
+        now = time.monotonic()
+        with self._lock:
+            if self._stop.is_set() or self.role != "leader":
+                return False
+            last = self._last_index()
+            for peer in self.peers:
+                ni = self.next_index.get(peer, last + 1)
+                start = max(ni, self._shipped.get(peer, 0) + 1)
+                if ni > self.log_base and start > last:
+                    continue  # fully shipped (acks may still be pending)
+                if now < self._repl_retry.get(peer, 0.0):
+                    blocked = True
+                    continue
+                inflight = self._inflight.get(peer, 0)
+                if inflight >= self._pipeline:
+                    blocked = True
+                    continue
+                if ni <= self.log_base:
+                    # peer needs compacted entries: stream the snapshot,
+                    # never pipelining around it (its reply resets the
+                    # peer's whole cursor). The snapshot is stamped at
+                    # last_applied — snapshot_fn() reflects exactly that
+                    # index under the lock, and pairing it with the
+                    # (older) log_base would make the follower re-apply
+                    # the gap on top of state that already contains it
+                    if self.snapshot_fn is None:
+                        continue
+                    if inflight:
+                        blocked = True
+                        continue
+                    upto = self.last_applied
+                    args = {
+                        "term": self.term, "leader": self.me,
+                        "index": upto,
+                        "snap_term": self._term_at(upto),
+                        "data": base64.b64encode(self.snapshot_fn()).decode(),
+                    }
+                    self._shipped[peer] = upto
+                    jobs.append((peer, "snap", args))
+                else:
+                    prev_index = start - 1
+                    prev_term = (
+                        self._term_at(prev_index) if prev_index else 0)
+                    args = {
+                        "term": self.term, "leader": self.me,
+                        "prev_index": prev_index, "prev_term": prev_term,
+                        "entries": self.log[start - 1 - self.log_base:],
+                        "commit": self.commit_index,
+                    }
+                    self._shipped[peer] = last
+                    jobs.append((peer, "append", args))
+                self._inflight[peer] = inflight + 1
+                _metrics.raft_inflight_window.observe(
+                    inflight + 1, group=self.group_id)
+        appended = sum(1 for j in jobs if j[1] == "append")
+        if appended:
+            _metrics.raft_pipelined_appends.inc(
+                appended, group=self.group_id)
+        for peer, kind, args in jobs:
+            mux.submit(self, peer, kind, args)
+        return blocked
+
+    def _on_repl_error(self, peer: str) -> None:
+        """A pipelined send to `peer` failed in transport: resend from
+        the acknowledged next_index after a heartbeat's backoff (the
+        legacy loop's retry pacing)."""
+        with self._lock:
+            self._shipped[peer] = 0
+            self._repl_retry[peer] = time.monotonic() + self.HEARTBEAT
+
+    def _on_snapshot_reply(self, peer: str, args: dict, meta: dict) -> None:
+        with self._lock:
+            if self._stop.is_set():
+                return
+            if meta.get("term", 0) > self.term:
+                self._step_down(meta["term"])
+            elif meta.get("ok") and self.role == "leader" \
+                    and args.get("term") == self.term:
+                self.match_index[peer] = max(
+                    self.match_index.get(peer, 0), args["index"])
+                self.next_index[peer] = max(
+                    self.next_index.get(peer, 1), args["index"] + 1)
+                self.applied_index[peer] = max(
+                    self.applied_index.get(peer, 0), args["index"])
+                self._apply_cv.notify_all()
+
+    def _repl_job_done(self, peer: str) -> None:
+        """A mux sender finished one RPC for `peer`: free its window
+        slot and re-kick the dispatcher so the slot refills."""
+        with self._lock:
+            self._inflight[peer] = max(0, self._inflight.get(peer, 0) - 1)
+        self._kick_repl()
+
     def heartbeat_args(self) -> list[tuple[str, dict]]:
         """(peer, empty-AppendEntries args) for every peer this LEADER
         has no pending entries for — consumed by the HeartbeatMux."""
@@ -408,7 +579,8 @@ class RaftNode:
             last = self._last_index()
             for peer in self.peers:
                 ni = self.next_index.get(peer, last + 1)
-                if ni <= self.log_base or ni <= last:
+                if ni <= self.log_base or ni <= last \
+                        or self._inflight.get(peer, 0):
                     continue  # snapshot/bulk replication owns this peer
                 prev_index = ni - 1
                 prev_term = self._term_at(prev_index) if prev_index else 0
@@ -529,6 +701,11 @@ class RaftNode:
             n = self._last_index() + 1
             self.next_index = {p: n for p in self.peers}
             self.match_index = {p: 0 for p in self.peers}
+            # fresh leadership: forget optimistic send cursors from any
+            # earlier term of ours (in-flight decrements are max(0,·)-
+            # guarded, so stale completions can't corrupt the window)
+            self._shipped = {p: 0 for p in self.peers}
+            self._repl_retry.clear()
             # commit a current-term no-op immediately: prior-term entries
             # can only commit transitively through it (Raft §5.4.2)
             rec = {"term": self.term, "entry": dict(self.NOOP)}
@@ -536,8 +713,7 @@ class RaftNode:
             self._persist_entries([rec], rewrote=False)
             noop_idx = self._last_index()
         self._wal_sync(noop_idx)
-        for ev in self._repl_events.values():
-            ev.set()  # wake blocked follower-mode repl threads
+        self._kick_repl()  # wake the replication plane for the new term
         self._broadcast_append()
 
     def _notify_role(self) -> None:
@@ -702,8 +878,7 @@ class RaftNode:
             with self._lock:
                 self._advance_commit()
             return
-        for ev in self._repl_events.values():
-            ev.set()
+        self._kick_repl()
 
     def _append_to(self, peer: str) -> None:
         snapshot_args = None
@@ -712,12 +887,18 @@ class RaftNode:
                 return
             ni = self.next_index.get(peer, self._last_index() + 1)
             if ni <= self.log_base:
-                # peer needs entries we compacted: stream the snapshot
+                # peer needs entries we compacted: stream the snapshot.
+                # Stamp it at last_applied — snapshot_fn() reflects that
+                # index exactly (read under this lock); stamping the
+                # older log_base would make the follower re-apply the
+                # log_base..last_applied gap over state that already
+                # contains it (double-apply)
+                upto = self.last_applied
                 if self.snapshot_fn is None:
                     return
                 snapshot_args = {
                     "term": self.term, "leader": self.me,
-                    "index": self.log_base, "snap_term": self.log_base_term,
+                    "index": upto, "snap_term": self._term_at(upto),
                     "data": base64.b64encode(self.snapshot_fn()).decode(),
                 }
             else:
@@ -766,6 +947,13 @@ class RaftNode:
                 return
             if self.role != "leader":
                 return
+            if args.get("term") != self.term:
+                # reply to a send from an OLDER leadership of ours: the
+                # acked indices may hold different entries now — with a
+                # pipeline's worth of sends in flight across an
+                # election, counting them toward match_index could
+                # commit an uncommitted slot
+                return
             if meta.get("ok"):
                 # max() guards: a STALE reply (e.g. an in-flight heartbeat
                 # overtaken by an entry append) must never regress the
@@ -783,20 +971,28 @@ class RaftNode:
                 if self.commit_index > before:
                     # push the new commit index out NOW so followers
                     # apply within one round-trip, not one heartbeat
-                    for ev in self._repl_events.values():
-                        ev.set()
+                    self._kick_repl()
                 self._apply_cv.notify_all()  # wait_all proposers watch applied
             else:
+                # conflict hints are bounded BOTH ways: never below the
+                # acknowledged match (a pipelined resend racing a slow
+                # reply must not re-ship the whole log), never above
+                # this send's own prev (an overtaken out-of-order
+                # append reports conflict at follower-last+1, which can
+                # exceed what we've actually shipped in order)
                 hint = meta.get("conflict_index")
+                if not hint:
+                    hint = self.next_index.get(peer, 2) - 1
                 self.next_index[peer] = max(
-                    1, hint if hint else self.next_index.get(peer, 2) - 1
+                    self.match_index.get(peer, 0) + 1,
+                    min(hint, max(1, args["prev_index"])),
                 )
-                # the peer needs entries again: wake its bulk thread (a
-                # parked thread would otherwise never resume and the
+                # the peer needs entries again: rewind the optimistic
+                # send cursor and wake the replication plane (a parked
+                # legacy thread would otherwise never resume and the
                 # heartbeat plane skips pending peers)
-                ev = self._repl_events.get(peer)
-                if ev is not None:
-                    ev.set()
+                self._shipped[peer] = 0
+                self._kick_repl(peer)
 
     def _advance_commit(self) -> None:
         # caller holds lock; commit = highest index replicated on majority
@@ -1048,6 +1244,248 @@ class HeartbeatMux:
             reply = replies.get(gid)
             if reply is not None:
                 node._process_append_reply(addr, args, reply)
+
+
+class ReplMux:
+    """The shared bulk-replication plane for pipelined mode: ONE
+    dispatcher thread per NodePool walks every dirty leader's
+    `_dispatch_appends`, and per-ADDRESS sender lanes (bounded worker
+    threads over a FIFO job queue) carry the actual AppendEntries /
+    InstallSnapshot RPCs. All raft groups targeting the same address
+    share its lane — hundreds of partitions cost O(addresses x window)
+    sender threads instead of O(groups x peers) blocking loops, and the
+    lane's worker pool IS the per-follower in-flight window's
+    concurrency. Lane width caps at CUBEFS_RAFT_MUX_SENDERS (default
+    8); a dead address blocks only its own lane."""
+
+    _BY_POOL: dict[int, "ReplMux"] = {}
+    _BY_POOL_LOCK = threading.Lock()
+
+    @classmethod
+    def get(cls, pool) -> "ReplMux":
+        with cls._BY_POOL_LOCK:
+            mux = cls._BY_POOL.get(id(pool))
+            if mux is None:
+                mux = cls._BY_POOL[id(pool)] = ReplMux(pool)
+            return mux
+
+    def __init__(self, pool):
+        self.pool = pool
+        try:
+            self.senders_per_addr = max(1, int(
+                os.environ.get("CUBEFS_RAFT_MUX_SENDERS", "8") or "8"))
+        except ValueError:
+            self.senders_per_addr = 8
+        self._lock = threading.Lock()
+        self.nodes: dict[tuple[str, str], RaftNode] = {}  # (gid, me) ->
+        self._dirty: set[RaftNode] = set()
+        self._ev = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # addr -> {"q": SimpleQueue, "workers": int, "busy": int}
+        self._lanes: dict[str, dict] = {}
+
+    def enroll(self, node: RaftNode) -> None:
+        with self._lock:
+            if self._stop.is_set():
+                # raced a final drop(): re-resolve through the registry
+                ReplMux.get(node.pool).enroll(node)
+                return
+            self.nodes[(node.group_id, node.me)] = node
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+    def drop(self, node: RaftNode) -> None:
+        with self._lock:
+            cur = self.nodes.get((node.group_id, node.me))
+            if cur is node:
+                del self.nodes[(node.group_id, node.me)]
+            self._dirty.discard(node)
+            if not self.nodes:
+                self._stop.set()
+                self._ev.set()
+                with ReplMux._BY_POOL_LOCK:
+                    if ReplMux._BY_POOL.get(id(self.pool)) is self:
+                        del ReplMux._BY_POOL[id(self.pool)]
+
+    def kick(self, node: RaftNode) -> None:
+        """Mark a node as having replication work; the dispatcher picks
+        it up on its next pass (propose, freed window slot, conflict,
+        commit advance all land here)."""
+        with self._lock:
+            if self._stop.is_set():
+                return
+            self._dirty.add(node)
+        self._ev.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                batch = list(self._dirty)
+                self._dirty.clear()
+            again: list[RaftNode] = []
+            for node in batch:
+                try:
+                    if node._dispatch_appends(self):
+                        again.append(node)
+                except Exception:
+                    pass  # a stopping node mid-teardown; drop it
+            with self._lock:
+                self._dirty.update(again)
+                pending = bool(self._dirty)
+            # blocked nodes (window full / error backoff) re-tick at
+            # heartbeat pace; otherwise sleep until the next kick
+            if pending:
+                self._ev.wait(RaftNode.HEARTBEAT)
+            else:
+                self._ev.wait()
+            self._ev.clear()
+
+    def submit(self, node: RaftNode, peer: str, kind: str,
+               args: dict) -> None:
+        with self._lock:
+            lane = self._lanes.get(peer)
+            if lane is None:
+                lane = self._lanes[peer] = {
+                    "q": _queue.SimpleQueue(), "workers": 0, "busy": 0}
+            lane["q"].put((node, peer, kind, args))
+            # grow the lane while queued jobs outnumber free workers
+            while (lane["workers"] < self.senders_per_addr
+                   and lane["workers"] - lane["busy"] < lane["q"].qsize()):
+                lane["workers"] += 1
+                threading.Thread(target=self._worker, args=(peer, lane),
+                                 daemon=True).start()
+            _metrics.raft_mux_senders.set(lane["workers"], addr=peer)
+        _metrics.raft_mux_jobs.inc(kind=kind)
+
+    def _worker(self, addr: str, lane: dict) -> None:
+        q = lane["q"]
+        while not self._stop.is_set():
+            try:
+                job = q.get(timeout=5.0)
+            except _queue.Empty:
+                with self._lock:
+                    if q.empty():  # shrink: verified idle under the lock
+                        lane["workers"] -= 1
+                        _metrics.raft_mux_senders.set(
+                            lane["workers"], addr=addr)
+                        return
+                continue
+            with self._lock:
+                lane["busy"] += 1
+            try:
+                self._run_job(*job)
+            finally:
+                with self._lock:
+                    lane["busy"] -= 1
+        with self._lock:
+            lane["workers"] -= 1
+
+    def _run_job(self, node: RaftNode, peer: str, kind: str,
+                 args: dict) -> None:
+        try:
+            try:
+                with _fi.sender(node.me):
+                    if kind == "snap":
+                        meta, _ = self.pool.get_direct(peer).call(
+                            f"raft_{node.group_id}_snapshot", args,
+                            timeout=5.0)
+                    else:
+                        meta, _ = self.pool.get_direct(peer).call(
+                            f"raft_{node.group_id}_append", args,
+                            timeout=1.0)
+            except Exception:
+                node._on_repl_error(peer)
+                return
+            if kind == "snap":
+                node._on_snapshot_reply(peer, args, meta)
+            else:
+                node._process_append_reply(peer, args, meta)
+        finally:
+            node._repl_job_done(peer)
+
+
+class TickMux:
+    """Shared election-timer/compaction plane (CUBEFS_RAFT_MUX door):
+    ONE 10ms ticker per NodePool checks every enrolled node's election
+    deadline and compaction threshold, so hundreds of raft groups cost
+    one timer thread instead of one ticker each. Elections and
+    snapshots run on short-lived worker threads (rare events), guarded
+    by a per-node busy flag so a slow election can't be double-fired."""
+
+    _BY_POOL: dict[int, "TickMux"] = {}
+    _BY_POOL_LOCK = threading.Lock()
+
+    @classmethod
+    def get(cls, pool) -> "TickMux":
+        with cls._BY_POOL_LOCK:
+            mux = cls._BY_POOL.get(id(pool))
+            if mux is None:
+                mux = cls._BY_POOL[id(pool)] = TickMux(pool)
+            return mux
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._lock = threading.Lock()
+        self.nodes: dict[tuple[str, str], RaftNode] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def enroll(self, node: RaftNode) -> None:
+        with self._lock:
+            if self._stop.is_set():
+                TickMux.get(node.pool).enroll(node)
+                return
+            self.nodes[(node.group_id, node.me)] = node
+            if self._thread is None:
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+
+    def drop(self, node: RaftNode) -> None:
+        with self._lock:
+            cur = self.nodes.get((node.group_id, node.me))
+            if cur is node:
+                del self.nodes[(node.group_id, node.me)]
+            if not self.nodes:
+                self._stop.set()
+                with TickMux._BY_POOL_LOCK:
+                    if TickMux._BY_POOL.get(id(self.pool)) is self:
+                        del TickMux._BY_POOL[id(self.pool)]
+
+    def _loop(self) -> None:
+        while not self._stop.wait(0.01):
+            with self._lock:
+                nodes = list(self.nodes.values())
+            now = time.monotonic()
+            for node in nodes:
+                if node._stop.is_set() or node._tick_busy:
+                    continue
+                act = None
+                with node._lock:
+                    if (node.snapshot_fn is not None
+                            and len(node.log) > node.COMPACT_THRESHOLD
+                            and node.last_applied > node.log_base):
+                        act = "compact"
+                    elif (node.role != "leader"
+                          and now - node._last_heard > node._election_due):
+                        act = "election"
+                    if act:
+                        node._tick_busy = True
+                if act:
+                    threading.Thread(target=self._run, args=(node, act),
+                                     daemon=True).start()
+
+    def _run(self, node: RaftNode, act: str) -> None:
+        try:
+            if act == "compact":
+                node.take_snapshot()
+            else:
+                node._run_election()
+        finally:
+            node._tick_busy = False
 
 
 def register_routes(routes: dict, node: RaftNode) -> None:

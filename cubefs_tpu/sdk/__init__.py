@@ -4,8 +4,9 @@ wire, instead of hand-rolled method-name strings at call sites."""
 
 from .clients import (AccessClient, AuthClient, ClusterMgrClient,
                       ConsoleClient, FlashClient, FlashGroupClient,
-                      MasterClient, SchedulerClient)
+                      MasterClient, MetaNodeClient, SchedulerClient)
 
 __all__ = ["MasterClient", "SchedulerClient", "ClusterMgrClient",
+           "MetaNodeClient",
            "AccessClient", "AuthClient", "FlashClient", "FlashGroupClient",
            "ConsoleClient"]

@@ -205,6 +205,37 @@ class ClusterMgrClient(_Base):
                            "op_id": uuid.uuid4().hex})[0]["start"]
 
 
+class MetaNodeClient(_Base):
+    """Metanode mutation surface (sdk/meta analog, single node): typed
+    submit / submit_batch against one metanode. op_ids are stamped
+    client-side so retries after a lost response stay exactly-once —
+    the same discipline as MetaWrapper, without the partition-routing
+    layer (tools and tests that target ONE known partition use this)."""
+
+    def submit(self, pid: int, record: dict) -> dict:
+        rec = dict(record)
+        rec.setdefault("op_id", uuid.uuid4().hex)
+        return self._call("submit", {"pid": pid, "record": rec})[0]["result"]
+
+    def submit_batch(self, pid: int, records: list[dict]) -> list:
+        """Ship many mutations as ONE RPC (the wire shape the client
+        fan-out coalescer emits). Returns per-record [result, None] |
+        [None, [errno, msg]] pairs in submission order."""
+        recs = []
+        for r in records:
+            r = dict(r)
+            r.setdefault("op_id", uuid.uuid4().hex)
+            recs.append(r)
+        return self._call("submit_batch",
+                          {"pid": pid, "records": recs})[0]["results"]
+
+    def inode_get(self, pid: int, ino: int) -> dict:
+        return self._call("inode_get", {"pid": pid, "ino": ino})[0]["inode"]
+
+    def stat(self) -> dict:
+        return self._call("stat")[0]
+
+
 class AuthClient(_Base):
     """Ticket service surface (sdk/auth/api.go analog): key
     registration and ticket issue against a running authnode role. The

@@ -390,6 +390,277 @@ def write_ab(workdir: str, procs: int = 8, threads: int = 8,
     return out
 
 
+def _metric_sum(metric) -> float:
+    return sum(v for _, v in metric.samples())
+
+
+def _hist_totals(metric) -> tuple[float, float]:
+    tot = cnt = 0.0
+    for _, s in metric.samples():
+        tot += s["sum"]
+        cnt += s["count"]
+    return tot, cnt
+
+
+def _mk_meta_cluster(workdir: str, n_parts: int, base_id: int = 500):
+    """Two replicated metanodes carrying `n_parts` raft groups each —
+    the multi-partition sibling of server_create_capacity's cluster.
+    Returns (pool, nodes, mps-view) once every group has a leader."""
+    from ..fs.metanode import MetaNode
+    from ..utils.rpc import NodePool
+
+    pool = NodePool()
+    addrs = ["scale0", "scale1"]
+    nodes = []
+    for i, a in enumerate(addrs):
+        node = MetaNode(base_id + i, data_dir=os.path.join(workdir, a),
+                        addr=a, node_pool=pool)
+        pool.bind(a, node)
+        nodes.append(node)
+    for node in nodes:
+        for pid in range(1, n_parts + 1):
+            node.create_partition(pid, 1, 1 << 20, peers=addrs)
+    deadline = time.monotonic() + max(20.0, 0.25 * n_parts)
+    pending = set(range(1, n_parts + 1))
+    while pending and time.monotonic() < deadline:
+        for pid in list(pending):
+            for node in nodes:
+                if node.rafts[pid].status()["role"] == "leader":
+                    pending.discard(pid)
+                    break
+        if pending:
+            time.sleep(0.02)
+    if pending:
+        for node in nodes:
+            node.stop()
+        raise TimeoutError(
+            f"{len(pending)} of {n_parts} groups never elected a leader")
+    mps = [{"pid": pid, "start": 1, "end": 1 << 20, "addrs": list(addrs)}
+           for pid in range(1, n_parts + 1)]
+    return pool, nodes, mps
+
+
+def _scale_leg(workdir: str, n_parts: int, threads: int,
+               secs: float) -> dict:
+    """One measured round: saturated mixed create/mkdir spread across
+    `n_parts` partitions through the real client layer (MetaWrapper →
+    fan-out coalescer when enabled → submit/submit_batch wire), so the
+    number reflects the whole write path, not just the raft core."""
+    import threading as _th
+
+    from ..fs.client import MetaWrapper
+    from ..utils import metrics
+
+    pool, nodes, mps = _mk_meta_cluster(workdir, n_parts)
+    wrapper = MetaWrapper({"mps": mps}, pool)
+    base = {
+        "pipelined": _metric_sum(metrics.raft_pipelined_appends),
+        "mux_jobs": _metric_sum(metrics.raft_mux_jobs),
+        "fan_batches": _metric_sum(metrics.meta_fanout_batches),
+        "fan_ops": _metric_sum(metrics.meta_fanout_ops),
+        "win": _hist_totals(metrics.raft_inflight_window),
+        "fsyncs": _metric_sum(metrics.raft_wal_fsyncs),
+    }
+    stop = time.perf_counter() + secs
+    counts = [0] * threads
+
+    def _rec(t, i):
+        return {"op": "mknod", "parent": 1, "name": f"s{t}_{i}",
+                "type": "file" if i % 2 else "dir", "mode": 0o644,
+                "ts": time.time(), "op_id": f"sc{t}-{i}"}
+
+    def worker(t):
+        i = 0
+        if wrapper.fanout is not None:
+            # the async fan-out shape: keep a window of submits in
+            # flight across partitions sized so every partition sees a
+            # fat batch (~32 records) even when load is spread over
+            # hundreds of groups
+            window = max(32, (32 * n_parts) // threads)
+            while time.perf_counter() < stop:
+                ws = []
+                for _ in range(window):
+                    mp = mps[(t + i) % n_parts]
+                    ws.append(wrapper.fanout.submit_async(mp, _rec(t, i)))
+                    i += 1
+                for w in ws:
+                    w.wait()
+                counts[t] += window
+            return
+        # control: the PR 3 client — one blocking submit per op
+        while time.perf_counter() < stop:
+            mp = mps[(t + i) % n_parts]
+            wrapper._call(mp, "submit", {"record": _rec(t, i)})
+            i += 1
+            counts[t] += 1
+
+    t0 = time.perf_counter()
+    ths = [_th.Thread(target=worker, args=(t,)) for t in range(threads)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    dt = time.perf_counter() - t0
+    win = _hist_totals(metrics.raft_inflight_window)
+    out = {
+        "create_ops": round(sum(counts) / dt, 1),
+        "creates": sum(counts),
+        "pipelined_appends": int(
+            _metric_sum(metrics.raft_pipelined_appends) - base["pipelined"]),
+        "mux_jobs": int(_metric_sum(metrics.raft_mux_jobs)
+                        - base["mux_jobs"]),
+        "fanout_batches": int(_metric_sum(metrics.meta_fanout_batches)
+                              - base["fan_batches"]),
+        "fanout_ops": int(_metric_sum(metrics.meta_fanout_ops)
+                          - base["fan_ops"]),
+        "wal_fsyncs": int(_metric_sum(metrics.raft_wal_fsyncs)
+                          - base["fsyncs"]),
+        "inflight_window_avg": round(
+            (win[0] - base["win"][0]) / (win[1] - base["win"][1]), 2)
+        if win[1] > base["win"][1] else None,
+    }
+    if wrapper.fanout is not None:
+        wrapper.fanout.close()
+    for node in nodes:
+        node.stop()
+    return out
+
+
+_SCALE_KNOBS = {
+    # control = the PR 3 write path: group commit on, but per-follower
+    # lockstep replication, per-partition timers, per-op client submits
+    "control": {"CUBEFS_RAFT_PIPELINE": "0", "CUBEFS_RAFT_MUX": "0",
+                "CUBEFS_META_FANOUT": "0"},
+    # K=16 measured best on the bench box: enough partition-level
+    # concurrency to hide commit latency, few enough drain workers that
+    # scheduler churn doesn't eat the batching win
+    "pipelined": {"CUBEFS_RAFT_PIPELINE": "4", "CUBEFS_RAFT_MUX": "1",
+                  "CUBEFS_META_FANOUT": "16"},
+}
+
+
+def fsm_identity_check(workdir: str, n_parts: int = 4,
+                       records_per_part: int = 200) -> dict:
+    """Drive an IDENTICAL deterministic mutation sequence (fixed op_ids,
+    fixed timestamps, serial order) through the pipelined and the
+    unpipelined write path, wait for every follower to catch up, and
+    compare sha256 digests of each partition's serialized FSM state
+    across replicas AND across the two configurations. Equal digests on
+    the follower prove replication delivered exactly-once (no double-
+    apply, no gap); equal digests across configs prove the pipeline door
+    changes scheduling only, never state."""
+    import hashlib
+
+    digests: dict[str, dict] = {}
+    saved = {k: os.environ.get(k)
+             for leg in _SCALE_KNOBS.values() for k in leg}
+    try:
+        for leg, knobs in _SCALE_KNOBS.items():
+            os.environ.update(knobs)
+            pool, nodes, mps = _mk_meta_cluster(
+                os.path.join(workdir, f"ident_{leg}"), n_parts,
+                base_id=700)
+            from ..fs.client import MetaWrapper
+
+            wrapper = MetaWrapper({"mps": mps}, pool)
+            for mp in mps:
+                for i in range(records_per_part):
+                    wrapper._call(mp, "submit", {"record": {
+                        "op": "mknod", "parent": 1, "name": f"id_{i}",
+                        "type": "file" if i % 2 else "dir",
+                        "mode": 0o644, "ts": 1000.0 + i,
+                        "op_id": f"ident-{mp['pid']}-{i}"}})
+            # followers apply behind the commit index: wait for every
+            # replica of every group to reach the leader's apply_id
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                ids = {pid: {n.addr: n.partitions[pid].apply_id
+                             for n in nodes}
+                       for pid in range(1, n_parts + 1)}
+                if all(len(set(v.values())) == 1 for v in ids.values()):
+                    break
+                time.sleep(0.05)
+            digests[leg] = {
+                str(pid): {n.addr: hashlib.sha256(
+                    n.partitions[pid].state_bytes()).hexdigest()
+                    for n in nodes}
+                for pid in range(1, n_parts + 1)}
+            if wrapper.fanout is not None:
+                wrapper.fanout.close()
+            for node in nodes:
+                node.stop()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    replicas_agree = all(
+        len(set(per_node.values())) == 1
+        for leg in digests.values() for per_node in leg.values())
+    configs_agree = all(
+        set(digests["control"][pid].values())
+        == set(digests["pipelined"][pid].values())
+        for pid in digests["control"])
+    return {"replicas_agree": replicas_agree,
+            "configs_agree": configs_agree,
+            "bit_identical": replicas_agree and configs_agree,
+            "partitions": n_parts,
+            "records_per_partition": records_per_part,
+            "digests": digests}
+
+
+def scale_partitions(workdir: str, parts=(1, 16, 64, 256),
+                     threads: int = 128, secs: float = 1.5,
+                     rounds: int = 3, fan_threads: int = 4) -> dict:
+    """The hundreds-of-partitions write bench: aggregate creates/s at
+    1→256 metapartitions with the pipelined+fanned-out write path,
+    against the unpipelined single-partition control (the PR 3 shape).
+    Each leg is driven at its saturating client shape: the control
+    needs one blocking thread per in-flight op (`threads`), the fan-out
+    path keeps thousands of ops in flight from a few submit_async
+    windows (`fan_threads` — more would only burn scheduler time).
+    Rounds alternate control / pipelined legs so drift lands on both
+    sides evenly; medians are reported. The FSM identity check runs
+    once at the end on a small cluster."""
+    import statistics
+
+    out: dict = {"threads": threads, "fan_threads": fan_threads,
+                 "secs_per_round": secs, "rounds": rounds,
+                 "knobs": _SCALE_KNOBS}
+    runs: dict[str, list[dict]] = {"control": []}
+    for p in parts:
+        runs[f"pipelined_{p}"] = []
+    saved = {k: os.environ.get(k)
+             for leg in _SCALE_KNOBS.values() for k in leg}
+    try:
+        for r in range(rounds):
+            os.environ.update(_SCALE_KNOBS["control"])
+            runs["control"].append(_scale_leg(
+                os.path.join(workdir, f"ctl_r{r}"), 1, threads, secs))
+            os.environ.update(_SCALE_KNOBS["pipelined"])
+            for p in parts:
+                runs[f"pipelined_{p}"].append(_scale_leg(
+                    os.path.join(workdir, f"p{p}_r{r}"), p, fan_threads,
+                    secs))
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    for leg, rs in runs.items():
+        med = statistics.median(x["create_ops"] for x in rs)
+        out[leg] = {"rounds": rs, "median_create_ops": round(med, 1)}
+    ctl = out["control"]["median_create_ops"]
+    out["speedup_vs_control"] = {
+        str(p): round(out[f"pipelined_{p}"]["median_create_ops"] / ctl, 2)
+        for p in parts} if ctl else None
+    out["fsm_identity"] = fsm_identity_check(
+        os.path.join(workdir, "identity"))
+    return out
+
+
 def native_loadgen(view, iters: int = 30_000, conns: int = 4) -> dict:
     """Server-capacity measurement with the C++ load generator
     (metaserve.cc ms_bench): serial round-trips over `conns`
@@ -502,8 +773,29 @@ def main(argv=None):
     ap.add_argument("--cap-threads", type=int, default=384,
                     help="concurrent creates for the in-process "
                          "server-capacity leg")
+    ap.add_argument("--scale-partitions", action="store_true",
+                    help="aggregate creates/s at 1..256 metapartitions: "
+                         "pipelined replication + client fan-out vs the "
+                         "unpipelined single-partition control")
+    ap.add_argument("--parts", type=int, nargs="+",
+                    default=[1, 16, 64, 256],
+                    help="partition counts for the scale sweep")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="alternating rounds per leg (median reported)")
+    ap.add_argument("--out", help="also write the result JSON here")
     args = ap.parse_args(argv)
     metas = []
+    if args.scale_partitions:
+        workdir = tempfile.mkdtemp(prefix="cubefs-bench-scale-")
+        res = scale_partitions(workdir, parts=tuple(args.parts),
+                               threads=args.cap_threads, secs=args.secs,
+                               rounds=args.rounds)
+        text = json.dumps(res, indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        print(text)
+        return
     if args.write_ab:
         workdir = tempfile.mkdtemp(prefix="cubefs-bench-writeab-")
         print(json.dumps(write_ab(workdir, procs=args.procs,

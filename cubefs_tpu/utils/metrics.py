@@ -223,6 +223,38 @@ meta_ops_per_batch = DEFAULT.histogram(
     "mutations carried per coalesced submit (1 = uncontended fast path)",
     ("pid",), buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256))
 
+# pipelined replication (CUBEFS_RAFT_PIPELINE) + the shared ReplMux
+# sender plane + the fs client's cross-partition fan-out coalescer
+raft_pipelined_appends = DEFAULT.counter(
+    "cubefs_raft_pipelined_appends_total",
+    "AppendEntries dispatched through the pipelined per-follower "
+    "window (sent without waiting for the previous batch's ack)",
+    ("group",))
+raft_inflight_window = DEFAULT.histogram(
+    "cubefs_raft_inflight_window",
+    "in-flight appends per follower observed at dispatch — the "
+    "replication pipeline depth actually used", ("group",),
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16))
+raft_mux_jobs = DEFAULT.counter(
+    "cubefs_raft_mux_jobs_total",
+    "replication jobs shipped through the shared per-address ReplMux "
+    "sender lanes (the multi-raft proposal mux)", ("kind",))
+raft_mux_senders = DEFAULT.gauge(
+    "cubefs_raft_mux_senders",
+    "live sender worker threads in a ReplMux address lane", ("addr",))
+meta_fanout_batches = DEFAULT.counter(
+    "cubefs_meta_fanout_batches_total",
+    "client-side cross-partition fan-out drains (one submit_batch RPC "
+    "per drain)", ("pid",))
+meta_fanout_ops = DEFAULT.counter(
+    "cubefs_meta_fanout_ops_total",
+    "mutations carried by client fan-out drains", ("pid",))
+meta_fanout_inflight = DEFAULT.histogram(
+    "cubefs_meta_fanout_partitions_inflight",
+    "partitions with a batch in flight when a fan-out drain launches — "
+    "the client-side K window actually used",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32))
+
 # failure-domain topology (blob/topology.py): placement + rebalance
 placement_az_skew = DEFAULT.gauge(
     "cubefs_placement_az_skew",

@@ -1,0 +1,27 @@
+"""Known-bad fixture for the fanout-discipline checker.
+
+Direct proposes and wire dials outside the sanctums: each bypasses the
+client-side submit coalescer and its A/B doors."""
+
+
+class MetaNode:
+    def rpc_rename(self, args, body):
+        # CFW001: proposing straight from an RPC handler skips the
+        # batcher sanctums entirely
+        raft_node = self.rafts[args["pid"]]
+        return {"result": raft_node.propose(args["record"])}
+
+    def _gc_sweep(self, pid):
+        # CFW001: background work must land through _submit_local
+        self.rafts[pid].propose({"op": "gc"})
+
+
+class Tool:
+    def backfill(self, wrapper, mp, records):
+        # CFW002: dialing the wire under the router loses coalescing
+        for rec in records:
+            wrapper._call_wire(mp, "submit", {"record": rec})
+
+    def probe(self, wrapper, mp):
+        # CFW002: even reads of the submit surface ride the router
+        return wrapper._call_wire(mp, "submit", {"record": {"op": "noop"}})

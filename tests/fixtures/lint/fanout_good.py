@@ -1,0 +1,33 @@
+"""Known-good fixture for the fanout-discipline checker.
+
+Proposals land through the sanctioned sites; client code dials the
+wire only from the fan-out router and lander."""
+
+
+class MetaNode:
+    def rpc_submit(self, args, body):
+        raft_node = self.rafts[args["pid"]]
+        return {"result": raft_node.propose(args["record"])}
+
+    def rpc_submit_batch(self, args, body):
+        raft_node = self.rafts[args["pid"]]
+        outs = raft_node.propose(
+            {"op": "__batch__", "records": args["records"]})
+        return {"results": outs}
+
+    def _submit_local(self, pid, record):
+        return self.rafts[pid].propose(record)
+
+
+class Wrapper:
+    def _call(self, mp, method, args):
+        if method == "submit" and self.fanout is not None:
+            return {"result": self.fanout.submit(mp, args["record"])}, b""
+        return self._call_wire(mp, method, args)
+
+
+class Fanout:
+    def _land(self, mp, batch):
+        meta, _ = self.wrapper._call_wire(
+            mp, "submit_batch", {"records": [w.record for w in batch]})
+        return meta["results"]

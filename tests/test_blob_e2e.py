@@ -531,3 +531,46 @@ def test_scheduler_checkpoints_into_cm_kv(tmp_path):
                      allow_colocated_units=True)
     s3 = Scheduler(cm2)
     assert set(s3.tasks) == {"t1", "t2", "t3"}
+
+
+def test_put_admits_encode_before_alloc(cluster, rng):
+    """The PUT path admits the parity encode to the codec batcher
+    BEFORE its allocation round-trips and the encode future resolves
+    before quorum commit — observable through last_put_timeline."""
+    data = payload(rng, 200_000)
+    loc = cluster.access.put(data, codemode=cmode.CodeMode.EC6P3)
+    tl = cluster.access.last_put_timeline
+    assert (tl["encode_admitted"] <= tl["alloc_done"]
+            <= tl["encode_done"] <= tl["quorum_done"])
+    assert "encode_resolved_before_wait" in tl
+    assert cluster.access.get(loc) == data
+
+
+def test_disk_drain_planned_in_codec_steps(cluster, rng, monkeypatch):
+    """Repair planner sizes a failed disk's drain against
+    CUBEFS_CODEC_STEP_BYTES: tasks are grouped into full-width steps,
+    steps ~= ceil(total_bytes / step_bytes)."""
+    import math
+    for _ in range(6):
+        cluster.access.put(payload(rng, 60_000), codemode=cmode.CodeMode.EC6P3)
+    # break the disk carrying the most volume-units
+    disk_id = max(cluster.cm.disks,
+                  key=lambda d: len(cluster.cm.volumes_on_disk(d)))
+    n = cluster.sched.mark_disk_broken(disk_id)
+    tasks = [t for t in cluster.sched.tasks.values()
+             if t.get("src_disk") == disk_id]
+    assert n == len(tasks) >= 2
+    per = [t["drain_bytes"] for t in tasks]
+    assert all(b > 0 for b in per)
+    total = sum(per)
+    # default 64MiB step swallows the whole disk in one step
+    assert cluster.sched.last_drain_plan["steps"] == 1
+
+    step_bytes = 2 * max(per)
+    monkeypatch.setenv("CUBEFS_CODEC_STEP_BYTES", str(step_bytes))
+    plan = cluster.sched.plan_disk_drain(disk_id)
+    steps = len({t["drain_step"] for t in tasks})
+    want = math.ceil(total / step_bytes)
+    assert plan["steps"] == steps
+    assert want <= steps <= want + 1  # first-fit over unequal chunks
+    assert plan["total_bytes"] == total

@@ -19,6 +19,7 @@ import pytest
 
 from tool.lint import cli, core
 from tool.lint.checkers.batch_discipline import BatchDisciplineChecker
+from tool.lint.checkers.fanout_discipline import FanoutDisciplineChecker
 from tool.lint.checkers.lock_discipline import LockDisciplineChecker
 from tool.lint.checkers.placement_discipline import PlacementDisciplineChecker
 from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
@@ -247,3 +248,25 @@ def test_cli_entrypoint_exits_clean():
         [sys.executable, "-m", "tool.lint", "-q"],
         cwd=core.REPO_ROOT, capture_output=True, text=True, timeout=120)
     assert rc.returncode == 0, rc.stdout + rc.stderr
+
+
+# ---------------- fanout-discipline ----------------
+
+def test_fanout_discipline_true_positives():
+    mod = _module("fanout_bad.py", "cubefs_tpu/fs/fx.py")
+    found = FanoutDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFW001", "CFW001", "CFW002", "CFW002"]
+
+
+def test_fanout_discipline_true_negative():
+    mod = _module("fanout_good.py", "cubefs_tpu/fs/fx.py")
+    assert FanoutDisciplineChecker().check(mod) == []
+
+
+def test_fanout_discipline_scope():
+    c = FanoutDisciplineChecker()
+    assert c.applies("cubefs_tpu/fs/metanode.py")
+    assert c.applies("cubefs_tpu/fs/client.py")
+    # data plane replication has its own door, not the meta coalescer
+    assert not c.applies("cubefs_tpu/fs/datanode.py")
+    assert not c.applies("cubefs_tpu/blob/worker.py")

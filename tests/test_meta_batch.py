@@ -265,3 +265,87 @@ def test_group_commit_disabled_still_correct(tmp_path, monkeypatch):
         assert len(leader.partitions[7].dentries[mn.ROOT_INO]) == 8
     finally:
         pair.stop()
+
+
+# ---------------- client fan-out coalescer (PR 7) ----------------
+
+def _wrapper_for(pair, monkeypatch, k="8"):
+    from cubefs_tpu.fs.client import MetaWrapper
+
+    monkeypatch.setenv("CUBEFS_META_FANOUT", k)
+    mps = [{"pid": 7, "start": 1, "end": 1 << 20,
+            "addrs": ["bm0", "bm1"]}]
+    return MetaWrapper({"mps": mps}, pair.pool)
+
+
+def test_fanout_coalesces_submits_into_batches(meta_pair, monkeypatch):
+    """Concurrent submits through MetaWrapper share submit_batch RPCs:
+    every op lands exactly once and the fan-out metrics show multi-op
+    batches (ops ≫ batches)."""
+    wrapper = _wrapper_for(meta_pair, monkeypatch)
+    assert wrapper.fanout is not None
+    b0 = metrics.meta_fanout_batches.value(pid="7")
+    o0 = metrics.meta_fanout_ops.value(pid="7")
+    mp = wrapper.mps[0]
+    try:
+        waiters = [wrapper.fanout.submit_async(
+            mp, _mknod(f"fan{i}", op_id=f"fan-{i}")) for i in range(64)]
+        inos = [w.wait()["ino"] for w in waiters]
+        assert len(set(inos)) == 64
+        leader = meta_pair.leader()
+        names = {f"fan{i}" for i in range(64)}
+        assert names <= set(leader.partitions[7].dentries[mn.ROOT_INO])
+        ops = metrics.meta_fanout_ops.value(pid="7") - o0
+        batches = metrics.meta_fanout_batches.value(pid="7") - b0
+        assert ops >= 32 and batches >= 1 and ops > batches
+    finally:
+        wrapper.fanout.close()
+
+
+def test_fanout_errors_fan_back_per_record(meta_pair, monkeypatch):
+    """A losing duplicate inside a fan-out batch surfaces as ITS
+    waiter's FsError; the rest of the batch lands."""
+    from cubefs_tpu.fs.client import FsError
+
+    wrapper = _wrapper_for(meta_pair, monkeypatch)
+    mp = wrapper.mps[0]
+    try:
+        ws = [wrapper.fanout.submit_async(
+            mp, _mknod("fclash", op_id=f"fc-{i}")) for i in range(6)]
+        ws += [wrapper.fanout.submit_async(
+            mp, _mknod(f"fok{i}", op_id=f"fo-{i}")) for i in range(6)]
+        wins, losses, oks = 0, 0, 0
+        for i, w in enumerate(ws):
+            try:
+                w.wait()
+                if i < 6:
+                    wins += 1
+                else:
+                    oks += 1
+            except FsError as e:
+                assert e.errno == mn.EEXIST
+                losses += 1
+        assert (wins, losses, oks) == (1, 5, 6)
+    finally:
+        wrapper.fanout.close()
+
+
+def test_submit_batch_rpc_is_exactly_once_on_retry(meta_pair):
+    """A transport-level replay of a whole submit_batch (same op_ids)
+    returns the cached per-record outcomes instead of re-applying."""
+    leader = meta_pair.leader()
+    client = meta_pair.pool.get(leader.addr)
+    records = [_mknod(f"sb{i}", op_id=f"sb-{i}") for i in range(5)]
+    records.append(_mknod("sb0", op_id="sb-dup"))  # EEXIST loser
+    meta, _ = client.call("submit_batch", {"pid": 7, "records": records})
+    outs = meta["results"]
+    assert [o[1] for o in outs[:5]] == [None] * 5
+    assert outs[5][0] is None and outs[5][1][0] == mn.EEXIST
+    inos = [o[0]["ino"] for o in outs[:5]]
+    n_inodes = len(leader.partitions[7].inodes)
+
+    meta2, _ = client.call("submit_batch", {"pid": 7, "records": records})
+    outs2 = meta2["results"]
+    assert [o[0]["ino"] for o in outs2[:5]] == inos
+    assert outs2[5][1][0] == mn.EEXIST
+    assert len(leader.partitions[7].inodes) == n_inodes  # no double apply

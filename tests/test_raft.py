@@ -523,3 +523,58 @@ def test_propose_timeout_cleans_up_waiter():
         leader2.node.propose({"seq": 2}, timeout=5.0)
     finally:
         stop_all(members)
+
+
+# ---------------- pipelined replication (CUBEFS_RAFT_PIPELINE) ----------------
+
+def test_pipelined_appends_overlap_and_commit_in_order(monkeypatch):
+    """With a window > 1 the leader ships optimistic appends (the
+    pipelined counter moves, the in-flight histogram records widths)
+    while commit/apply order stays exactly the propose order."""
+    from cubefs_tpu.utils import metrics
+
+    monkeypatch.setenv("CUBEFS_RAFT_PIPELINE", "4")
+    monkeypatch.setenv("CUBEFS_RAFT_MUX", "1")
+    members, _ = make_cluster(3)
+    try:
+        leader = wait_leader(members)
+        gid = leader.node.group_id
+        a0 = metrics.raft_pipelined_appends.value(group=gid)
+        ths = []
+        for i in range(30):
+            t = threading.Thread(
+                target=leader.node.propose, args=({"n": i},),
+                kwargs={"timeout": 5.0})
+            t.start()
+            ths.append(t)
+        for t in ths:
+            t.join(timeout=10.0)
+        wait_applied(members, 30)
+        seen = [e["n"] for e in leader.applied]
+        for m in members.values():
+            assert [e["n"] for e in m.applied] == seen  # one total order
+        assert sorted(seen) == list(range(30))
+        assert metrics.raft_pipelined_appends.value(group=gid) > a0
+        assert not leader.node._waiters
+    finally:
+        stop_all(members)
+
+
+def test_pipeline_door_off_restores_legacy_path(monkeypatch):
+    """CUBEFS_RAFT_PIPELINE=0: per-peer replication threads, no
+    pipelined dispatches — and the cluster still replicates."""
+    from cubefs_tpu.utils import metrics
+
+    monkeypatch.setenv("CUBEFS_RAFT_PIPELINE", "0")
+    members, _ = make_cluster(3)
+    try:
+        leader = wait_leader(members)
+        gid = leader.node.group_id
+        a0 = metrics.raft_pipelined_appends.value(group=gid)
+        assert leader.node._pipeline == 0
+        for i in range(5):
+            leader.node.propose({"n": i}, timeout=5.0)
+        wait_applied(members, 5)
+        assert metrics.raft_pipelined_appends.value(group=gid) == a0
+    finally:
+        stop_all(members)

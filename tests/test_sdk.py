@@ -270,3 +270,28 @@ def test_console_client_typed_surface(tmp_path):
             m.stop()
         for d in datas:
             d.stop()
+
+
+def test_metanode_client_typed_surface(tmp_path):
+    from cubefs_tpu.sdk import MetaNodeClient
+
+    pool = NodePool()
+    node = MetaNode(0, addr="m0", node_pool=pool,
+                    data_dir=str(tmp_path / "m0"))
+    pool.bind("m0", node)
+    node.create_partition(3, 1, 1 << 20, peers=["m0"])
+    mnc = MetaNodeClient(node)
+    try:
+        def rec(name):
+            return {"op": "mknod", "parent": 1, "name": name,
+                    "type": "file", "mode": 0o644, "ts": 1.0}
+
+        one = mnc.submit(3, rec("solo"))
+        assert one["ino"] > 1
+        outs = mnc.submit_batch(3, [rec("a"), rec("b"), rec("solo")])
+        assert [o[1] for o in outs[:2]] == [None, None]
+        assert outs[2][0] is None  # EEXIST fans back per record
+        assert mnc.inode_get(3, one["ino"])["ino"] == one["ino"]
+        assert "partitions" in mnc.stat() or "node_id" in mnc.stat()
+    finally:
+        node.stop()

@@ -1,6 +1,7 @@
 """Checker registry: every family the suite ships, in report order."""
 
 from .batch_discipline import BatchDisciplineChecker
+from .fanout_discipline import FanoutDisciplineChecker
 from .lock_discipline import LockDisciplineChecker
 from .placement_discipline import PlacementDisciplineChecker
 from .retry_discipline import RetryDisciplineChecker
@@ -16,4 +17,5 @@ ALL_CHECKERS = (
     Tier1PurityChecker,
     PlacementDisciplineChecker,
     BatchDisciplineChecker,
+    FanoutDisciplineChecker,
 )

@@ -1,0 +1,76 @@
+"""Fan-out-discipline checker (rule: fanout-discipline, codes CFW0xx).
+
+The metadata write path has exactly one client-side door: MetaWrapper
+routes submits through the cross-partition fan-out coalescer
+(SubmitFanout, CUBEFS_META_FANOUT), which batches per partition and
+ships submit_batch RPCs; on the server, batches land through the raft
+proposal sanctums. A call site that proposes straight into a partition's
+raft node — or dials the wire layer itself — silently opts out of
+coalescing, the A/B doors, and the fan-out metrics. The regression
+shape:
+
+  CFW001  .propose() on a raft node outside the sanctioned proposal
+          sites (`_land`, `_submit_local`, `rpc_submit`,
+          `rpc_submit_batch`) — server code must land records through
+          the batcher/raft sanctums, client code must submit through
+          MetaWrapper
+  CFW002  ._call_wire() outside MetaWrapper's router (`_call`) or the
+          fan-out's lander (`_land`) — dialing the wire directly
+          bypasses the submit coalescer the router exists to apply
+
+The analysis is syntactic: violations key off the ENCLOSING function
+name, so new proposal sites must either route through the existing
+sanctums or be added here deliberately. fs/datanode.py is exempt — its
+proposes drive extent replication on the DATA plane, which has its own
+chain/raft door and never rides the metadata coalescer.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+# enclosing functions allowed to propose into a raft node in fs/
+_PROPOSE_SANCTUMS = {"_land", "_submit_local", "rpc_submit",
+                     "rpc_submit_batch"}
+# enclosing functions allowed to dial the wire layer directly
+_WIRE_SANCTUMS = {"_call", "_call_wire", "_land"}
+
+
+class FanoutDisciplineChecker(Checker):
+    rule = "fanout-discipline"
+    dirs = ("cubefs_tpu/fs/",)
+
+    def applies(self, relpath: str) -> bool:
+        if relpath.endswith("fs/datanode.py"):
+            return False  # data plane: extent replication, not submits
+        return super().applies(relpath)
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+
+        def visit(node: ast.AST, fn: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = node.name
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr == "propose" and fn not in _PROPOSE_SANCTUMS:
+                    out.append(self.violation(
+                        mod, "CFW001", node,
+                        f".propose() in `{fn or '<module>'}` bypasses the "
+                        f"submit coalescer — land records through the "
+                        f"proposal sanctums ({', '.join(sorted(_PROPOSE_SANCTUMS))}) "
+                        f"or submit via MetaWrapper"))
+                elif attr == "_call_wire" and fn not in _WIRE_SANCTUMS:
+                    out.append(self.violation(
+                        mod, "CFW002", node,
+                        f"._call_wire() in `{fn or '<module>'}` dials the "
+                        f"wire under the fan-out router — submits must go "
+                        f"through MetaWrapper._call so they coalesce"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, fn)
+
+        visit(mod.tree, "")
+        return out

@@ -113,9 +113,22 @@ ALLOWLIST: dict[tuple[str, str], str] = {
 
     # ---- per-caller entries ----
     ("cubefs_tpu/fs/client.py", "submit"):
-        "MetaWrapper._call setdefaults a uuid op_id into every submit "
-        "record before it leaves the client (fs/client.py _call); the "
-        "call sites just don't spell the token",
+        "MetaWrapper._call_wire setdefaults a uuid op_id into every "
+        "submit record before the replica loop (fs/client.py "
+        "_call_wire); the call sites just don't spell the token",
+    ("cubefs_tpu/fs/client.py", "submit_batch"):
+        "MetaWrapper._call_wire stamps a uuid op_id into every batch "
+        "record before the replica loop, so a transport retry "
+        "re-presents the same ids to the FSM dedup window",
+    ("cubefs_tpu/sdk/clients.py", "submit"):
+        "MetaNodeClient.submit setdefaults a uuid op_id into the "
+        "record in its own body before dialing; retries re-present it",
+    ("cubefs_tpu/sdk/clients.py", "submit_batch"):
+        "MetaNodeClient.submit_batch setdefaults a uuid op_id into "
+        "every record in its own body before dialing",
+    ("cubefs_tpu/tool/bench_fs.py", "submit"):
+        "scale-bench control-leg records carry deterministic op_ids "
+        "stamped by _rec ('sc<thread>-<i>'); a retry dedups in the FSM",
     ("cubefs_tpu/blob/access.py", "alloc"):
         "the proxy serves alloc from locally leased volume/bid ranges "
         "(blob/proxy.py); a duplicate burns leased ids only — the "
