@@ -16,7 +16,8 @@ import uuid
 
 import numpy as np
 
-from ..utils import rpc
+from ..codec.batcher import admit
+from ..utils import metrics, rpc
 from .chunkstore import ChunkStore, ChunkStoreError, CrcMismatchError, ShardNotFoundError
 
 
@@ -28,6 +29,10 @@ class BlobNode:
         self.az = az  # failure-domain labels; carried on register + heartbeat
         self.rack = rack
         self.cm = cm_client
+        # helper-side MSR combinations go through the codec admission
+        # surface: concurrent repairs' sub-shard reads coalesce into
+        # shared device steps like any other stripe math
+        self.codec = admit("auto")
         self.stores: dict[int, ChunkStore] = {}  # disk_id -> store
         self._disk_paths = list(disk_paths)
         self.disk_ids: list[int] = []
@@ -115,6 +120,43 @@ class BlobNode:
     def list_chunk(self, disk_id: int, chunk_id: int) -> list[tuple[int, int, int]]:
         return self._store(disk_id).list_shards(chunk_id)
 
+    def read_subshard(self, disk_id: int, chunk_id: int, bids: list[int],
+                      coeff: list[int]) -> tuple[list[int], bytes]:
+        """MSR helper read: for each bid, return the GF combination
+        `coeff` (length alpha) of the shard's alpha sub-shards — one
+        beta = S/alpha payload per bid instead of the full shard. This
+        single RPC is where the (k*alpha/d)x repair-traffic saving
+        happens: the combination runs HERE, helper-side, so only beta
+        bytes cross the wire. Batched over all of a repair task's bids
+        so the device step sees one (B, alpha, beta) stack per size."""
+        store = self._store(disk_id)
+        alpha = len(coeff)
+        if alpha < 1:
+            raise rpc.RpcError(400, "empty helper coefficient row")
+        row = np.asarray([coeff], dtype=np.uint8)
+        shards: list[bytes] = []
+        for bid in bids:
+            data, _ = store.get_shard(chunk_id, bid)  # CRC-checked read
+            if len(data) % alpha:
+                raise rpc.RpcError(
+                    409, f"bid {bid}: shard size {len(data)} not "
+                         f"divisible by alpha={alpha} — not MSR-encoded")
+            shards.append(data)
+        sizes = [len(s) // alpha for s in shards]
+        out: list[bytes | None] = [None] * len(bids)
+        by_size: dict[int, list[int]] = {}
+        for i, beta in enumerate(sizes):
+            by_size.setdefault(beta, []).append(i)
+        for beta, idxs in by_size.items():
+            stack = np.stack([
+                np.frombuffer(shards[i], dtype=np.uint8).reshape(alpha, beta)
+                for i in idxs])  # (B, alpha, beta)
+            combined = self.codec.matrix_apply(row, stack)  # (B, 1, beta)
+            for pos, i in enumerate(idxs):
+                out[i] = combined[pos, 0].tobytes()
+        metrics.repair_subshard_reads.inc(len(bids))
+        return sizes, b"".join(out)  # type: ignore[arg-type]
+
     # ---------------- RPC surface ----------------
     def rpc_put_shard(self, args, body):
         crc = self.put_shard(args["disk_id"], args["chunk_id"], args["bid"], body)
@@ -139,6 +181,17 @@ class BlobNode:
     def rpc_list_chunk(self, args, body):
         shards = self.list_chunk(args["disk_id"], args["chunk_id"])
         return {"shards": [[b, s, c] for b, s, c in shards]}
+
+    def rpc_read_subshard(self, args, body):
+        try:
+            sizes, payload = self.read_subshard(
+                args["disk_id"], args["chunk_id"], args["bids"],
+                args["coeff"])
+        except ShardNotFoundError as e:
+            raise rpc.RpcError(404, str(e)) from None
+        except CrcMismatchError as e:
+            raise rpc.RpcError(409, str(e)) from None
+        return {"sizes": sizes}, payload
 
     def rpc_compact_chunk(self, args, body):
         reclaimed = self._store(args["disk_id"]).compact(args["chunk_id"])

@@ -16,6 +16,7 @@ import threading
 import time
 import uuid
 
+from ..codec import codemode as cmode
 from ..utils import metrics, rpc
 from ..utils.retry import RetryPolicy
 
@@ -238,6 +239,23 @@ class Scheduler:
                 continue
         return 0
 
+    def _drain_bytes(self, vid: int, unit_index: int) -> int:
+        """Drain weight of one repair task for step packing. The unit of
+        account is the conventional path's pull: one chunk-width per
+        survivor read is normalized to ONE chunk (the historical
+        convention). An MSR sub-shard repair pulls d beta-symbols where
+        the conventional decode pulls k full shards — d/(alpha*k) of the
+        traffic — so more MSR tasks pack into one admission step and the
+        coalesced device batches stay full-width."""
+        base = self._unit_bytes(vid, unit_index)
+        try:
+            t = cmode.tactic(self.cm.get_volume(vid).codemode)
+        except (KeyError, ValueError, rpc.RpcError):
+            return base
+        if not t.is_msr():
+            return base
+        return max(1, -(-base * t.d // (t.alpha * t.n))) if base else 0
+
     def plan_disk_drain(self, disk_id: int) -> dict:
         """Group one failed disk's open unit-repair tasks into drain
         steps sized against CUBEFS_CODEC_STEP_BYTES: workers that lease
@@ -258,7 +276,7 @@ class Scheduler:
             for t in open_tasks:
                 b = t.get("drain_bytes")
                 if b is None:
-                    b = t["drain_bytes"] = self._unit_bytes(
+                    b = t["drain_bytes"] = self._drain_bytes(
                         t["vid"], t["unit_index"])
                 total += b
                 if acc and acc + b > step_bytes:

@@ -210,6 +210,40 @@ def pick_destination(disks, exclude_disks: set[int],
         "no destination disk outside the volume's failure domains")
 
 
+def pick_repair_helpers(units, failed_index: int, d: int) -> list[int]:
+    """Elect the d helper units for an MSR sub-shard repair, plus
+    standby extras for pre-writeback verification.
+
+    Preference order: every survivor in the failed unit's AZ first
+    (beta-sized reads that never cross the DCN), then the remote
+    survivors round-robin across the other AZs so cross-AZ egress
+    spreads evenly instead of draining one AZ. Pure function of the
+    volume's unit labels; returns the FULL preference-ordered survivor
+    list (>= d entries, first d are the helper set) so the caller can
+    use position d as the verification extra."""
+    failed_az = units[failed_index].az
+    local: list[int] = []
+    remote: dict[str, list[int]] = {}
+    for u in units:
+        if u.index == failed_index:
+            continue
+        if u.az == failed_az:
+            local.append(u.index)
+        else:
+            remote.setdefault(u.az, []).append(u.index)
+    order = sorted(local)
+    queues = [sorted(remote[a]) for a in sorted(remote)]
+    while any(queues):
+        for q in queues:
+            if q:
+                order.append(q.pop(0))
+    if len(order) < d:
+        raise NoAvailableDisks(
+            f"MSR repair needs d={d} helpers, volume has only "
+            f"{len(order)} survivors")
+    return order
+
+
 # ---------------- misplacement scoring ----------------
 
 def unit_az(unit, disk_map: dict[int, DiskInfo]) -> str:

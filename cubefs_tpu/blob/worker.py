@@ -12,6 +12,7 @@ batch dimension.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -22,8 +23,27 @@ import numpy as np
 from ..ops import rs_kernel
 from ..codec import codemode as cm
 from ..codec.batcher import admit
-from ..utils import rpc
+from ..utils import metrics, rpc
+from . import topology
 from .types import VolumeInfo
+
+
+def _msr_repair_enabled() -> bool:
+    """CUBEFS_CODEC_MSR=0 pins MSR-coded volumes to the conventional
+    k-full-shard repair path (the A/B door; reconstruction stays
+    byte-identical either way, only the traffic shape changes)."""
+    return os.environ.get("CUBEFS_CODEC_MSR", "1").lower() not in (
+        "0", "false", "")
+
+
+class MsrFallback(Exception):
+    """Raised inside the MSR sub-shard path to hand the repair to the
+    conventional decode — always BEFORE any writeback, so the fallback
+    re-runs from scratch with no partial writes to undo."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(detail or reason)
 
 
 class RepairWorker:
@@ -67,6 +87,7 @@ class RepairWorker:
             self.sched.call("complete_task",
                             {"task_id": task["task_id"], "worker_id": self.worker_id})
             self.completed += 1
+            metrics.repair_tasks.inc(state="completed")
         except Exception as e:
             self.sched.call(
                 "fail_task",
@@ -74,6 +95,7 @@ class RepairWorker:
                  "error": f"{type(e).__name__}: {e}"},
             )
             self.failed += 1
+            metrics.repair_tasks.inc(state="failed")
         return True
 
     # ---------------- execution ----------------
@@ -114,6 +136,20 @@ class RepairWorker:
         if not bids:
             return  # empty chunk: nothing to rebuild
 
+        if t.is_msr() and _msr_repair_enabled():
+            try:
+                return self._execute_msr(task, vol, t, bad, bids, dest)
+            except MsrFallback as e:
+                # exactly-once degradation: the sub-shard path never
+                # wrote anything (reads and verification both precede
+                # writeback), so the conventional decode below rebuilds
+                # from scratch
+                metrics.repair_msr_fallbacks.inc(reason=e.reason)
+        self._execute_conventional(task, vol, t, bad, bids, dest)
+
+    def _execute_conventional(self, task: dict, vol: VolumeInfo,
+                              t: cm.Tactic, bad: int, bids: list[int],
+                              dest) -> None:
         # choose the read set: prefer the bad unit's local stripe peers
         # when an LRC local repair is possible (intra-AZ bandwidth). A
         # dark AZ (blackout) starves the local read set entirely — fall
@@ -147,7 +183,8 @@ class RepairWorker:
             try:
                 for bid in bids:
                     subs, shards = self._read_survivors(
-                        vol, read_set, code_pos, bid, need=n_solve, want=want)
+                        vol, read_set, code_pos, bid, need=n_solve,
+                        want=want, failed_az=vol.units[bad].az)
                     by_key[(len(shards[0]), tuple(subs))].append((bid, shards))
             except RuntimeError:
                 if source != sources[-1]:
@@ -169,6 +206,14 @@ class RepairWorker:
                     n_solve, total_code, t.ec_layout_by_az(),
                     (t.n + t.m) // t.az_count, solve_subs, wanted_out
                 )
+            elif t.is_msr():
+                # conventional decode of an MSR-coded stripe: k full
+                # shards solved with the product-matrix generator over
+                # the sub-shard space (this IS the CUBEFS_CODEC_MSR=0
+                # control path and the helper-failure fallback)
+                rows = rs_kernel.msr_reconstruct_rows(
+                    n_solve, total_code, t.d,
+                    tuple(solve_subs), tuple(wanted_out))
             else:
                 rows = rs_kernel.reconstruct_rows(
                     n_solve, total_code, solve_subs, wanted_out
@@ -181,7 +226,17 @@ class RepairWorker:
                               for s in shards[:n_solve]])
                     for _, shards in chunk
                 ])  # (B, n_solve, size)
-                recovered = self.codec.matrix_apply(rows, batch)
+                if t.is_msr() and bad_sub < total_code:
+                    if size % t.alpha:
+                        raise RuntimeError(
+                            f"shard size {size} not divisible by "
+                            f"alpha={t.alpha}: not MSR-encoded")
+                    sub = batch.reshape(
+                        len(chunk), n_solve * t.alpha, size // t.alpha)
+                    recovered = self.codec.matrix_apply(rows, sub).reshape(
+                        len(chunk), len(wanted_out), size)
+                else:
+                    recovered = self.codec.matrix_apply(rows, batch)
                 for (bid, shards), rec in zip(chunk, recovered):
                     if len(subs) > n_solve:
                         expect = np.frombuffer(shards[n_solve], dtype=np.uint8)
@@ -197,6 +252,99 @@ class RepairWorker:
                          "chunk_id": task["dest_chunk"], "bid": bid},
                         rec[out_pos].tobytes(),
                     )
+
+    def _execute_msr(self, task: dict, vol: VolumeInfo, t: cm.Tactic,
+                     bad: int, bids: list[int], dest) -> None:
+        """Sub-shard repair of one failed MSR unit: pull a single
+        beta-sized helper symbol per bid from each of d helpers
+        (d*S/alpha bytes total vs the conventional k*S), solve the
+        cached product-matrix repair rows, verify against an extra
+        helper's symbol, THEN write back. Any miss before writeback
+        raises MsrFallback — the conventional path owns the retry."""
+        k, total, d, alpha = t.n, t.total, t.d, t.alpha
+        try:
+            order = topology.pick_repair_helpers(vol.units, bad, d)
+        except topology.NoAvailableDisks as e:
+            raise MsrFallback("helpers_unavailable", str(e)) from None
+        helpers = tuple(order[:d])
+        extra = order[d] if len(order) > d else None
+        coeff = rs_kernel.msr_helper_rows(k, total, d, bad)[0].tolist()
+        failed_az = vol.units[bad].az
+
+        # ONE read_subshard RPC per helper, batched over every bid; all
+        # network reads land before any math or writeback, so a helper
+        # dying mid-repair costs nothing but the fallback
+        per_bid: dict[int, dict[int, bytes]] = {b: {} for b in bids}
+        for h in helpers + ((extra,) if extra is not None else ()):
+            u = vol.units[h]
+            try:
+                meta, raw = self.nodes.get(u.node_addr).call(
+                    "read_subshard",
+                    {"disk_id": u.disk_id, "chunk_id": u.chunk_id,
+                     "bids": bids, "coeff": coeff})
+                sizes = meta["sizes"]
+                if len(sizes) != len(bids):
+                    raise rpc.RpcError(409, f"{len(sizes)} sizes for "
+                                            f"{len(bids)} bids")
+            except rpc.RpcError as e:
+                if h == extra:
+                    extra = None  # verification extra is best-effort
+                    continue
+                raise MsrFallback(
+                    "helper_read", f"helper unit {h}: {e}") from None
+            scope = ("az_local" if u.az == failed_az else "cross_az")
+            metrics.repair_bytes_pulled.inc(len(raw), scope=scope)
+            off = 0
+            for bid, beta in zip(bids, sizes):
+                per_bid[bid][h] = raw[off:off + beta]
+                off += beta
+
+        rows = rs_kernel.msr_repair_rows(k, total, d, bad, helpers)
+        if extra is not None:
+            # verification rides the SAME device step: one stacked
+            # (alpha+1, d) matrix predicts the extra helper's symbol
+            # alongside the repair — a corrupt download breaks the
+            # prediction before it can become the new truth
+            rows = np.concatenate(
+                [rows, rs_kernel.msr_verify_rows(
+                    k, total, d, bad, helpers, extra)])
+        groups: dict[int, list[int]] = defaultdict(list)
+        for bid in bids:
+            sym = per_bid[bid]
+            beta = len(sym[helpers[0]])
+            if any(len(sym[h]) != beta for h in helpers):
+                raise MsrFallback("helper_read",
+                                  f"bid {bid}: helper symbol widths differ")
+            groups[beta].append(bid)
+
+        writes: list[tuple[int, bytes]] = []
+        for beta, group in groups.items():
+            for start in range(0, len(group), self.batch_stripes):
+                chunk = group[start:start + self.batch_stripes]
+                batch = np.stack([
+                    np.stack([np.frombuffer(per_bid[b][h], dtype=np.uint8)
+                              for h in helpers])
+                    for b in chunk
+                ])  # (B, d, beta)
+                out = self.codec.matrix_apply(rows, batch)
+                for i, b in enumerate(chunk):
+                    if extra is not None:
+                        expect = np.frombuffer(per_bid[b].get(extra, b""),
+                                               dtype=np.uint8)
+                        if (expect.size != beta
+                                or not np.array_equal(out[i, alpha], expect)):
+                            raise MsrFallback(
+                                "verify",
+                                f"bid {b}: repair disagrees with extra "
+                                f"helper {extra}'s symbol")
+                    writes.append((b, out[i, :alpha].reshape(-1).tobytes()))
+        for bid, shard in writes:
+            dest.call(
+                "put_shard",
+                {"disk_id": task["dest_disk"],
+                 "chunk_id": task["dest_chunk"], "bid": bid},
+                shard,
+            )
 
     def _execute_shard_swap(self, task: dict) -> None:
         """shard_repair / shard_migrate execution (shard_disk_repairer
@@ -245,7 +393,7 @@ class RepairWorker:
 
     def _read_survivors(
         self, vol: VolumeInfo, read_set: list[int], code_pos: dict[int, int],
-        bid: int, need: int, want: int | None = None,
+        bid: int, need: int, want: int | None = None, failed_az: str = "",
     ) -> tuple[list[int], list[bytes]]:
         """Read up to `want` survivors for bid (at least `need`, which is
         fatal to miss; the extras enable pre-writeback verification).
@@ -264,6 +412,9 @@ class RepairWorker:
                 )
             except rpc.RpcError:
                 continue
+            metrics.repair_bytes_pulled.inc(
+                len(payload),
+                scope="az_local" if u.az == failed_az else "cross_az")
             subs.append(code_pos[idx])
             shards.append(payload)
         if len(shards) < need:

@@ -182,6 +182,38 @@ def _codec_view(text: str) -> dict:
     return view
 
 
+def _repair_view(text: str) -> dict:
+    """The repair-traffic digest: how many bytes did repairs pull from
+    survivors (and from which failure domains), how much of it rode the
+    beta-sized MSR sub-shard path, and did any MSR repair degrade to the
+    conventional k-shard decode?"""
+    series = _parse_metrics(text)
+
+    def total(name, **match):
+        return sum(v for n, lb, v in series if n == name
+                   and all(lb.get(k) == str(w) for k, w in match.items()))
+
+    az_local = total("cubefs_repair_bytes_pulled_total", scope="az_local")
+    cross_az = total("cubefs_repair_bytes_pulled_total", scope="cross_az")
+    pulled = az_local + cross_az
+    fallbacks = {lb.get("reason", ""): v for n, lb, v in series
+                 if n == "cubefs_repair_msr_fallback_total"}
+    return {
+        "bytes_pulled": {
+            "total": pulled,
+            "az_local": az_local,
+            "cross_az": cross_az,
+            "cross_az_fraction":
+                round(cross_az / pulled, 4) if pulled else None,
+        },
+        "subshard_reads": total("cubefs_repair_subshard_reads_total"),
+        "msr_fallbacks": fallbacks,
+        "repair_tasks": {
+            lb.get("state", ""): v for n, lb, v in series
+            if n == "cubefs_repair_tasks_total"},
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="cubefs-tpu-cli")
     sub = ap.add_subparsers(dest="group", required=True)
@@ -302,7 +334,8 @@ def main(argv=None):
                         help="cap unit migrations queued this sweep")
 
     p_metrics = sub.add_parser("metrics")  # node observability views
-    p_metrics.add_argument("action", choices=["write-path", "codec", "raw"])
+    p_metrics.add_argument("action",
+                           choices=["write-path", "codec", "repair", "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
 
@@ -570,6 +603,8 @@ def main(argv=None):
             print(text, end="")
         elif args.action == "codec":
             print(json.dumps(_codec_view(text), indent=2))
+        elif args.action == "repair":
+            print(json.dumps(_repair_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
 

@@ -10,6 +10,7 @@ The values are the protocol constants of the system, not code.
 from __future__ import annotations
 
 import enum
+import math
 from dataclasses import dataclass
 
 ALIGN_0B = 0
@@ -33,19 +34,28 @@ class CodeMode(enum.IntEnum):
     EC6P3 = 13
     EC12P9 = 14
     EC24P8 = 15
+    EC6P6MSR = 16
+    EC6P6MSROneAZ = 17
     Replica3 = 100
     Replica3OneAZ = 101
     # test-only modes
     EC6P6L9 = 200
     EC6P8L10 = 201
     Replica4TwoAZ = 202
+    EC4P4MSR = 203
 
 
 @dataclass(frozen=True)
 class Tactic:
     """Constant strategy of one CodeMode: N data / M global parity /
     L local parity shards over az_count AZs; put_quorum must keep data
-    recoverable with one AZ down (ignoring local shards)."""
+    recoverable with one AZ down (ignoring local shards).
+
+    scheme selects the code family: "rs" (Reed-Solomon / LRC) or "msr"
+    (product-matrix MSR regenerating code, ops/msr.py). MSR tactics
+    carry d — the helper count of a single-shard repair: each helper
+    ships one beta = S/alpha sub-shard (alpha = d-n+1) instead of its
+    full shard, cutting repair traffic n*alpha/d-fold."""
 
     n: int
     m: int
@@ -54,6 +64,8 @@ class Tactic:
     put_quorum: int = 0
     get_quorum: int = 0
     min_shard_size: int = 0
+    scheme: str = "rs"
+    d: int = 0
 
     def __post_init__(self):
         # ec_layout_by_az slices with integer division: a shard count
@@ -67,6 +79,66 @@ class Tactic:
                     f"Tactic {name}={v} is not divisible by "
                     f"az_count={self.az_count}: ec_layout_by_az would "
                     f"silently truncate shards")
+        if self.scheme not in ("rs", "msr"):
+            raise ValueError(f"unknown code scheme {self.scheme!r}")
+        if self.scheme == "rs":
+            if self.d:
+                raise ValueError("d (helper count) is only meaningful "
+                                 "for scheme='msr'")
+            return
+        self._validate_msr()
+
+    def _validate_msr(self) -> None:
+        """Reject MSR geometries the product-matrix construction cannot
+        build or the blob plane cannot repair (pure arithmetic — the
+        heavyweight matrix build in ops/msr.py re-validates)."""
+        if self.l:
+            raise ValueError(
+                "MSR tactics do not compose with LRC local parity: the "
+                "sub-shard repair protocol replaces the local stripe")
+        k, total, d = self.n, self.n + self.m, self.d
+        if k < 2:
+            raise ValueError(f"MSR needs k >= 2 data shards, got k={k}")
+        if d < k:
+            raise ValueError(
+                f"MSR d={d} < k={k}: a regenerating repair needs at "
+                f"least as many helpers as a conventional decode")
+        if d >= total:
+            raise ValueError(
+                f"MSR d={d} >= total={total}: helpers must be "
+                f"surviving shards, so d can be at most total-1")
+        if d < 2 * k - 2:
+            raise ValueError(
+                f"product-matrix MSR exists only for d >= 2k-2 = "
+                f"{2 * k - 2}, got d={d}")
+        alpha = d - k + 1
+        nbar = total + (d - (2 * k - 2))
+        if nbar > 255 // math.gcd(alpha, 255):
+            raise ValueError(
+                f"GF(256) admits only {255 // math.gcd(alpha, 255)} "
+                f"nodes with distinct lambda^{alpha} values; this "
+                f"geometry needs {nbar}")
+        if self.az_count > 1:
+            # helpers are elected AZ-local-first: the failed slot's
+            # per_az-1 AZ peers, then the rest spread over the remote
+            # AZs. An uneven remainder would hot-spot one remote AZ's
+            # egress on every repair, so reject the geometry.
+            local = total // self.az_count - 1
+            cross = d - local
+            if cross < 0 or cross % (self.az_count - 1):
+                raise ValueError(
+                    f"MSR d={d} is AZ-indivisible: after the {local} "
+                    f"AZ-local survivors, {cross} cross-AZ helpers "
+                    f"cannot spread evenly over {self.az_count - 1} "
+                    f"remote AZs")
+
+    @property
+    def alpha(self) -> int:
+        """Sub-shards per shard (MSR); 1 for RS/LRC tactics."""
+        return self.d - self.n + 1 if self.scheme == "msr" else 1
+
+    def is_msr(self) -> bool:
+        return self.scheme == "msr"
 
     @property
     def total(self) -> int:
@@ -135,6 +207,13 @@ TACTICS: dict[CodeMode, Tactic] = {
     CodeMode.EC10P4: Tactic(10, 4, 0, 1, 13, 0, ALIGN_2KB),
     CodeMode.EC6P3: Tactic(6, 3, 0, 1, 8, 0, ALIGN_2KB),
     CodeMode.EC24P8: Tactic(24, 8, 0, 1, 30, 0, ALIGN_2KB),
+    # product-matrix MSR regenerating codes (sub-shard repair): same
+    # footprint as EC6P6 but a single-shard repair pulls d beta-sized
+    # helper reads (d*S/alpha bytes) instead of 6 full shards
+    CodeMode.EC6P6MSR: Tactic(6, 6, 0, 3, 11, 0, ALIGN_2KB,
+                              scheme="msr", d=11),
+    CodeMode.EC6P6MSROneAZ: Tactic(6, 6, 0, 1, 11, 0, ALIGN_2KB,
+                                   scheme="msr", d=10),
     # env-test modes
     CodeMode.EC6P3L3: Tactic(6, 3, 3, 3, 9, 0, ALIGN_2KB),
     CodeMode.EC6P6Align0: Tactic(6, 6, 0, 3, 11, 0, ALIGN_0B),
@@ -143,6 +222,8 @@ TACTICS: dict[CodeMode, Tactic] = {
     CodeMode.EC6P6L9: Tactic(6, 6, 9, 3, 11, 0, ALIGN_2KB),
     CodeMode.EC6P8L10: Tactic(6, 8, 10, 2, 13, 0, ALIGN_0B),
     CodeMode.Replica4TwoAZ: Tactic(4, 0, 0, 2, 3),
+    CodeMode.EC4P4MSR: Tactic(4, 4, 0, 1, 6, 0, ALIGN_0B,
+                              scheme="msr", d=6),
     # replicate
     CodeMode.Replica3: Tactic(3, 0, 0, 3, 3),
     CodeMode.Replica3OneAZ: Tactic(3, 0, 0, 1, 3),

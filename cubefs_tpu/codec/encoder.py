@@ -75,6 +75,8 @@ def new_encoder(cfg: CodecConfig) -> "Encoder":
     # surface (codec/batcher.py): concurrent PUT/repair/verify callers
     # sharing a geometry coalesce into one device step, bit-identically
     eng = admit(cfg.engine)
+    if t.is_msr():
+        return MsrEncoder(cfg, t, eng)
     if t.l != 0:
         return LrcEncoder(cfg, t, eng)
     return Encoder(cfg, t, eng)
@@ -220,6 +222,84 @@ class Encoder:
             range(n + lm * az, n + lm * (az + 1))
         )
         return shards[..., idx, :]
+
+
+class MsrEncoder(Encoder):
+    """Product-matrix MSR codec: same Encoder interface, but parity and
+    reconstruction run over the sub-shard space (each shard is alpha
+    rows of beta bytes) so a single-shard repair can pull beta-sized
+    helper symbols instead of full shards (ops/msr.py). Shard sizes are
+    alpha-aligned at split/encode time so every stored shard divides
+    cleanly into sub-shards."""
+
+    @property
+    def alpha(self) -> int:
+        return self.t.alpha
+
+    def shard_size(self, data_len: int) -> int:
+        per = super().shard_size(data_len)
+        return -(-per // self.alpha) * self.alpha  # round up to alpha
+
+    def _parity_rows(self):
+        t = self.t
+        return rs_kernel.msr_encode_rows(t.n, t.n + t.m, t.d)
+
+    def encode(self, shards: np.ndarray) -> np.ndarray:
+        shards = self._check(shards)
+        t, alpha = self.t, self.alpha
+        sub = rs_kernel.msr_subshards(shards[..., : t.n, :], alpha)
+        parity = self.engine.matrix_apply(self._parity_rows(), sub)
+        shards[..., t.n:, :] = rs_kernel.msr_join_subshards(parity, alpha)
+        if self.cfg.enable_verify and not self.verify(shards):
+            raise VerifyError("parity verify failed after encode")
+        return shards
+
+    def encode_async(self, shards: np.ndarray) -> PendingEncode:
+        shards = self._check(shards)
+        t, alpha = self.t, self.alpha
+        batcher = getattr(self.engine, "batcher", None)
+        if batcher is None or not batcher.enabled:
+            return PendingEncode(self.encode(shards))
+        flat = shards.reshape(-1, t.total, shards.shape[-1])
+        sub = np.ascontiguousarray(
+            rs_kernel.msr_subshards(flat[:, : t.n, :], alpha))
+        fut = batcher.submit_apply_async(
+            self.engine.label, self._parity_rows(), sub)
+
+        def fill(timeout: float) -> None:
+            flat[:, t.n:, :] = rs_kernel.msr_join_subshards(
+                fut.result(timeout), alpha)
+            if self.cfg.enable_verify and not self.verify(shards):
+                raise VerifyError("parity verify failed after encode")
+
+        return PendingEncode(shards, fill, fut)
+
+    def verify(self, shards: np.ndarray) -> bool:
+        shards = self._check(shards)
+        t, alpha = self.t, self.alpha
+        sub = rs_kernel.msr_subshards(shards[..., : t.n, :], alpha)
+        parity = rs_kernel.msr_join_subshards(
+            self.engine.matrix_apply(self._parity_rows(), sub), alpha)
+        return bool(np.array_equal(parity, shards[..., t.n:, :]))
+
+    def _reconstruct(
+        self, shards: np.ndarray, bad_idx: list[int], wanted: list[int]
+    ) -> np.ndarray:
+        shards = self._check(shards, total=self.t.total)
+        if not wanted:
+            return shards
+        t, alpha = self.t, self.alpha
+        n, total = t.n, t.total
+        bad = set(bad_idx)
+        present = [i for i in range(total) if i not in bad]
+        if len(present) < n:
+            raise ECError(f"unrecoverable: only {len(present)} of {n} shards")
+        rows = rs_kernel.msr_reconstruct_rows(
+            n, total, t.d, tuple(present[:n]), tuple(wanted))
+        sub = rs_kernel.msr_subshards(shards[..., present[:n], :], alpha)
+        rec = self.engine.matrix_apply(rows, sub)
+        shards[..., wanted, :] = rs_kernel.msr_join_subshards(rec, alpha)
+        return shards
 
 
 class LrcEncoder(Encoder):

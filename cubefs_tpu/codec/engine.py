@@ -45,9 +45,16 @@ class NumpyEngine:
         shards = np.asarray(shards, dtype=np.uint8)
         if shards.ndim == 2:
             return gf256.gf_matmul(coeff, shards)
-        flat = shards.reshape(-1, *shards.shape[-2:])
-        out = np.stack([gf256.gf_matmul(coeff, s) for s in flat])
-        return out.reshape(*shards.shape[:-2], coeff.shape[0], shards.shape[-1])
+        # one table-gather pass for the whole batch: fold the batch axis
+        # into the byte axis ((.., C, S) -> (C, B*S)) so gf_matmul's
+        # per-column gather runs once per coefficient column instead of
+        # once per stripe — the dominant cost of the table path
+        lead, (c, s) = shards.shape[:-2], shards.shape[-2:]
+        flat = np.ascontiguousarray(
+            np.moveaxis(shards.reshape(-1, c, s), 1, 0)).reshape(c, -1)
+        out = np.moveaxis(
+            gf256.gf_matmul(coeff, flat).reshape(coeff.shape[0], -1, s), 0, 1)
+        return np.ascontiguousarray(out).reshape(*lead, coeff.shape[0], s)
 
     def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
         return self.matrix_apply(gf256.parity_matrix(data.shape[-2], n_parity), data)

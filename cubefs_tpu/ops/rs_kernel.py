@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import bitlin, gf256
+from . import bitlin, gf256, msr
 
 _BITS = (1 << np.arange(8)).astype(np.int32)
 
@@ -234,3 +234,55 @@ def reconstruct_stripes(
     stacked in ascending shard-index order; returns (..., len(wanted), S)."""
     rows = reconstruct_rows(n_data, n_total, present, wanted)
     return gf_matrix_apply(rows, surviving)
+
+
+# ---------------- product-matrix MSR (regenerating-code) kernels --------
+# Row construction lives in ops/msr.py (tiny exact host math, lru-cached
+# per geometry/failed-slot/helper-set); these wrappers are the kernel
+# surface the codec engines and the blob plane consume. Like RS, the
+# byte work is ONE gf_matrix_apply — the same bit-matmul (jax/pallas)
+# or table (numpy/cpp) engines serve both families, and admitted
+# callers coalesce MSR sub-shard steps with RS stripes for free.
+
+msr_encode_rows = msr.encode_rows
+msr_helper_rows = msr.helper_rows
+msr_repair_rows = msr.repair_rows
+msr_verify_rows = msr.verify_rows
+msr_reconstruct_rows = msr.reconstruct_rows
+
+
+def msr_subshards(shards: jax.Array, alpha: int) -> jax.Array:
+    """(..., B, S) -> (..., B*alpha, S/alpha): expose each shard's alpha
+    sub-shards as rows so MSR coefficient matrices can apply. S must be
+    alpha-divisible (MsrEncoder.shard_size guarantees it on write)."""
+    *lead, b, s = shards.shape
+    if s % alpha:
+        raise ValueError(f"shard size {s} not divisible by alpha={alpha}")
+    return shards.reshape(*lead, b * alpha, s // alpha)
+
+
+def msr_join_subshards(sub: jax.Array, alpha: int) -> jax.Array:
+    """Inverse of msr_subshards: (..., B*alpha, beta) -> (..., B, S)."""
+    *lead, rows, beta = sub.shape
+    return sub.reshape(*lead, rows // alpha, alpha * beta)
+
+
+def msr_encode_parity(data: jax.Array, k: int, total: int, d: int) -> jax.Array:
+    """data: (..., k, S) uint8 -> parity (..., total-k, S) uint8 via the
+    product-matrix generator (jax path; engines route the same rows
+    through their own matrix_apply)."""
+    alpha = d - k + 1
+    rows = msr.encode_rows(k, total, d)
+    sub = msr_subshards(np.asarray(data), alpha)
+    return msr_join_subshards(gf_matrix_apply(rows, sub), alpha)
+
+
+def msr_repair_shard(payloads: jax.Array, k: int, total: int, d: int,
+                     failed: int, helpers: tuple[int, ...]) -> jax.Array:
+    """payloads: (..., d, beta) helper symbols (in `helpers` order) ->
+    the failed shard (..., S=alpha*beta) — repair traffic d*beta bytes
+    instead of the conventional k*alpha*beta."""
+    rows = msr.repair_rows(k, total, d, failed, helpers)
+    out = gf_matrix_apply(rows, payloads)  # (..., alpha, beta)
+    *lead, alpha, beta = out.shape
+    return out.reshape(*lead, alpha * beta)

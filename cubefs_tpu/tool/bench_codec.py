@@ -179,8 +179,163 @@ def run_ab(submitters: int = 32, iters: int = 200, n: int = 6, m: int = 3,
     return out
 
 
+def _az_layout(k: int, m: int, az_count: int) -> list[int]:
+    """Unit index -> AZ id under the contiguous data/parity split the
+    placement layer uses (ec_layout_by_az): each AZ hosts an equal
+    contiguous slice of the data shards and of the parity shards."""
+    az_of = [0] * (k + m)
+    per_d, per_p = k // az_count, m // az_count
+    for i in range(k):
+        az_of[i] = min(i // per_d, az_count - 1)
+    for i in range(m):
+        az_of[k + i] = min(i // per_p, az_count - 1)
+    return az_of
+
+
+def _helper_order(az_of: list[int], failed: int) -> list[int]:
+    """AZ-local-first survivor preference (topology.pick_repair_helpers
+    shape): the failed unit's AZ peers first, then remote AZs round-robin."""
+    local = [i for i in range(len(az_of))
+             if i != failed and az_of[i] == az_of[failed]]
+    remote: dict[int, list[int]] = {}
+    for i in range(len(az_of)):
+        if i != failed and az_of[i] != az_of[failed]:
+            remote.setdefault(az_of[i], []).append(i)
+    order = list(local)
+    queues = [remote[a] for a in sorted(remote)]
+    while any(queues):
+        for q in queues:
+            if q:
+                order.append(q.pop(0))
+    return order
+
+
+def run_repair_ab(stripes: int = 96, k: int = 6, m: int = 6, d: int = 11,
+                  az_count: int = 3, shard_size: int = 12288,
+                  engine: str = "auto", seed: int = 0x4353, failed: int = 0,
+                  wait_ms: float = 0.25, rounds: int = 3) -> dict:
+    """Single-shard repair A/B: the MSR sub-shard path (leg A) pulls one
+    beta = S/alpha helper symbol from each of d survivors; the
+    conventional control (leg B) pulls k full shards. Both rebuild the
+    same lost shard from the same encoded stripes; the artifact reports
+    bytes-pulled (split az_local / cross_az by the placement layout),
+    the reduction factor, repair throughput, bit-identity of the two
+    reconstructions against the original, and the admission-layer
+    stripes-per-step occupancy that proves MSR repair math rides the
+    batched codec like any other stripe work."""
+    total = k + m
+    alpha = d - k + 1
+    if shard_size % alpha:
+        raise SystemExit(f"--shard-size {shard_size} must be divisible by "
+                         f"alpha={alpha}")
+    beta = shard_size // alpha
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, (stripes, k, shard_size), dtype=np.uint8)
+    parity = rs_kernel.msr_encode_parity(data, k, total, d)
+    shards = np.concatenate([data, np.asarray(parity)], axis=1)
+    subs = shards.reshape(stripes, total, alpha, beta)
+
+    az_of = _az_layout(k, m, az_count)
+    order = _helper_order(az_of, failed)
+    helpers = tuple(order[:d])
+    conv_set = tuple(sorted(order[:k]))
+    helper_row = rs_kernel.msr_helper_rows(k, total, d, failed)
+    repair_rows = rs_kernel.msr_repair_rows(k, total, d, failed, helpers)
+    recon_rows = rs_kernel.msr_reconstruct_rows(
+        k, total, d, conv_set, (failed,))
+
+    codec = BatchCodec(enabled=True, max_wait_ms=wait_ms)
+    codec.submit_apply(engine, helper_row, subs[0, 1][None])  # warm-up
+
+    def msr_leg() -> tuple[np.ndarray, float]:
+        t0 = time.perf_counter()
+        # helper-side combination: ONE beta-symbol per (stripe, helper),
+        # every submission shares the same phi_f row -> they coalesce
+        futs = [[codec.submit_apply_async(engine, helper_row,
+                                          subs[s, h][None])
+                 for h in helpers] for s in range(stripes)]
+        syms = np.stack([
+            np.concatenate([f.result()[0] for f in row]) for row in futs])
+        # replacement-side solve: shared repair matrix across stripes
+        futs2 = [codec.submit_apply_async(engine, repair_rows, syms[s][None])
+                 for s in range(stripes)]
+        out = np.stack([f.result().reshape(shard_size) for f in futs2])
+        return out, time.perf_counter() - t0
+
+    def conv_leg() -> tuple[np.ndarray, float]:
+        t0 = time.perf_counter()
+        futs = [codec.submit_apply_async(
+                    engine, recon_rows,
+                    subs[s, list(conv_set)].reshape(1, k * alpha, beta))
+                for s in range(stripes)]
+        out = np.stack([f.result().reshape(shard_size) for f in futs])
+        return out, time.perf_counter() - t0
+
+    m_walls, c_walls = [], []
+    m_occ = None
+    for _ in range(rounds):
+        s0, c0 = _occupancy_totals()
+        m_out, mw = msr_leg()
+        s1, c1 = _occupancy_totals()
+        c_out, cw = conv_leg()
+        m_walls.append(mw)
+        c_walls.append(cw)
+        m_occ = (s1 - s0, c1 - c0)
+    bit_identical = (np.array_equal(m_out, shards[:, failed])
+                     and np.array_equal(c_out, shards[:, failed]))
+
+    # traffic accounting is arithmetic over the placement layout: the
+    # MSR leg moves one beta per helper, the control k full shards
+    msr_local = sum(beta for h in helpers if az_of[h] == az_of[failed])
+    msr_cross = sum(beta for h in helpers if az_of[h] != az_of[failed])
+    conv_local = sum(shard_size for i in conv_set
+                     if az_of[i] == az_of[failed])
+    conv_cross = sum(shard_size for i in conv_set
+                     if az_of[i] != az_of[failed])
+    repaired = stripes * shard_size
+    med_m, med_c = _median(m_walls), _median(c_walls)
+    return {
+        "mode": "repair-ab",
+        "geometry": {"k": k, "m": m, "d": d, "alpha": alpha,
+                     "az_count": az_count, "shard_size": shard_size,
+                     "beta": beta, "failed_unit": failed,
+                     "helpers": list(helpers),
+                     "conventional_read_set": list(conv_set)},
+        "stripes": stripes,
+        "rounds": rounds,
+        "engine": engine,
+        "bytes_pulled_per_stripe": {
+            "msr": {"az_local": msr_local, "cross_az": msr_cross,
+                    "total": msr_local + msr_cross},
+            "conventional": {"az_local": conv_local, "cross_az": conv_cross,
+                             "total": conv_local + conv_cross},
+        },
+        "reduction_x": round((conv_local + conv_cross)
+                             / (msr_local + msr_cross), 2),
+        "cross_az_reduction_x":
+            round(conv_cross / msr_cross, 2) if msr_cross else None,
+        "msr": {"median_wall_s": round(med_m, 3),
+                "repair_gibs": round(repaired / med_m / 2**30, 4)},
+        "conventional": {"median_wall_s": round(med_c, 3),
+                         "repair_gibs": round(repaired / med_c / 2**30, 4)},
+        "msr_mean_stripes_per_device_step":
+            round(m_occ[0] / m_occ[1], 2) if m_occ and m_occ[1] else None,
+        "bit_identical": bool(bit_identical),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="cubefs-tpu-bench-codec")
+    ap.add_argument("--repair-ab", action="store_true",
+                    help="run the MSR sub-shard vs conventional k-shard "
+                         "repair-traffic A/B instead of the encode bench")
+    ap.add_argument("--stripes", type=int, default=96,
+                    help="repair-ab: stripes repaired per leg")
+    ap.add_argument("--d", type=int, default=11,
+                    help="repair-ab: MSR helper count")
+    ap.add_argument("--az-count", type=int, default=3)
+    ap.add_argument("--failed", type=int, default=0,
+                    help="repair-ab: unit index to lose")
     ap.add_argument("--submitters", type=int, default=32)
     ap.add_argument("--iters", type=int, default=200)
     ap.add_argument("--n", type=int, default=6)
@@ -196,9 +351,19 @@ def main(argv=None):
     ap.add_argument("--out", default=None,
                     help="write the artifact JSON here")
     args = ap.parse_args(argv)
-    result = run_ab(args.submitters, args.iters, args.n, args.m,
-                    args.shard_size, args.engine, wait_ms=args.wait_ms,
-                    depth=args.depth, rounds=args.rounds)
+    if args.repair_ab:
+        # repair-ab defaults to the EC6P6MSR production geometry; the
+        # encode bench's 6+3/2048 defaults don't carry over
+        shard = args.shard_size if args.shard_size != 2048 else 12288
+        m_ = args.m if args.m != 3 else 6
+        result = run_repair_ab(
+            stripes=args.stripes, k=args.n, m=m_, d=args.d,
+            az_count=args.az_count, shard_size=shard, engine=args.engine,
+            failed=args.failed, wait_ms=args.wait_ms, rounds=args.rounds)
+    else:
+        result = run_ab(args.submitters, args.iters, args.n, args.m,
+                        args.shard_size, args.engine, wait_ms=args.wait_ms,
+                        depth=args.depth, rounds=args.rounds)
     text = json.dumps(result, indent=2)
     print(text)
     if args.out:

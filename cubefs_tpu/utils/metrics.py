@@ -300,3 +300,21 @@ codec_batch_errors = DEFAULT.counter(
 codec_batch_dp_steps = DEFAULT.counter(
     "cubefs_codec_batch_dp_steps_total",
     "device steps sharded dp-wise across the mesh", ("dp",))
+
+# repair-bandwidth observability (blob/worker.py): what a single-shard
+# repair actually pulls over the network, split by failure-domain scope
+# — the numbers the MSR sub-shard protocol (CUBEFS_CODEC_MSR) exists to
+# shrink. `cubefs-cli metrics repair` renders these.
+repair_bytes_pulled = DEFAULT.counter(
+    "cubefs_repair_bytes_pulled_total",
+    "bytes downloaded from survivors by repair (full shards on the "
+    "conventional path, beta-sized helper symbols on the MSR path)",
+    ("scope",))  # az_local | cross_az
+repair_subshard_reads = DEFAULT.counter(
+    "cubefs_repair_subshard_reads_total",
+    "beta-sized helper symbols served through read_subshard (one per "
+    "bid per helper)")
+repair_msr_fallbacks = DEFAULT.counter(
+    "cubefs_repair_msr_fallback_total",
+    "MSR repairs that fell back to the conventional k-shard decode",
+    ("reason",))

@@ -1,0 +1,31 @@
+"""Known-bad fixture for CFC003: sub-shard reconstruction outside the
+repair worker.
+
+This module pretends to be a blob-plane file that is NOT
+cubefs_tpu/blob/worker.py, yet builds MSR repair matrices itself —
+forking the repair protocol (helper election, pre-writeback verify,
+conventional fallback, traffic metrics) the worker owns."""
+
+from ..codec.batcher import admit
+from ..ops import rs_kernel
+
+
+class SideDoorRepair:
+    def __init__(self):
+        self.codec = admit("auto")
+
+    def rebuild(self, syms, k, total, d, failed, helpers):
+        # CFC003: repair-row construction outside blob/worker.py
+        rows = rs_kernel.msr_repair_rows(k, total, d, failed, helpers)
+        return self.codec.matrix_apply(rows, syms)
+
+    def decode(self, stack, k, total, d, present, wanted):
+        # CFC003: bare-name call via from-import is also fenced
+        from ..ops.rs_kernel import msr_reconstruct_rows
+        rows = msr_reconstruct_rows(k, total, d, present, wanted)
+        return self.codec.matrix_apply(rows, stack)
+
+    def one_shot(self, payloads, k, total, d, failed, helpers):
+        # CFC003: the convenience wrapper is the same side door
+        return rs_kernel.msr_repair_shard(payloads, k, total, d,
+                                          failed, helpers)

@@ -1,0 +1,24 @@
+"""Known-good fixture for CFC003: a blob-plane helper that serves
+sub-shard reads WITHOUT building repair matrices.
+
+The helper side of MSR repair only applies the opaque coefficient row
+the worker ships in the read_subshard RPC — through the admitted codec
+facade. Geometry-free: no msr_*_rows construction here."""
+
+import numpy as np
+
+from ..codec.batcher import admit
+
+
+class HelperNode:
+    def __init__(self):
+        self.codec = admit("auto")
+
+    def read_subshard(self, shards, coeff):
+        # the worker's coefficient row is opaque bytes to the helper
+        row = np.asarray([coeff], dtype=np.uint8)
+        alpha = len(coeff)
+        stack = np.stack([
+            np.frombuffer(s, dtype=np.uint8).reshape(alpha, -1)
+            for s in shards])
+        return self.codec.matrix_apply(row, stack)

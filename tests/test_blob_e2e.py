@@ -18,7 +18,7 @@ from cubefs_tpu.blob.scheduler import Scheduler
 from cubefs_tpu.blob.types import DiskStatus
 from cubefs_tpu.blob.worker import RepairWorker
 from cubefs_tpu.codec import codemode as cmode
-from cubefs_tpu.utils import rpc
+from cubefs_tpu.utils import metrics, rpc
 
 
 class Cluster:
@@ -117,6 +117,48 @@ def test_disk_repair_end_to_end(cluster, rng):
         rebuilt, _ = new_node.get_shard(new_unit.disk_id, new_unit.chunk_id, bid)
         assert rebuilt == blob
     # source disk fully repaired; GET healthy again
+    assert cluster.cm.disks[victim.disk_id].status == DiskStatus.REPAIRED
+    assert cluster.access.get(loc) == data
+
+
+def test_msr_disk_repair_pulls_subshards(cluster, rng):
+    """EC4P4MSR repair goes down the sub-shard path: helper blobnodes
+    serve beta-sized read_subshard combinations instead of full shards,
+    and the rebuilt unit is still bit-identical."""
+    cluster.cm.allow_colocated_units = True  # 8 units on a 4-node cluster
+    data = payload(rng, 60_000)
+    loc = cluster.access.put(data, codemode=cmode.CodeMode.EC4P4MSR)
+    vid = loc.slices[0].vid
+    vol_before = cluster.cm.get_volume(vid)
+    victim = vol_before.units[2]
+    victim_node = cluster.node_of(victim.node_addr)
+    original = {
+        bid: victim_node.get_shard(victim.disk_id, victim.chunk_id, bid)[0]
+        for bid, _, _ in victim_node.list_chunk(victim.disk_id, victim.chunk_id)
+    }
+    victim_node.break_disk(victim.disk_id)
+
+    sub0 = metrics.repair_subshard_reads.value()
+    pulled0 = sum(v for _, v in metrics.repair_bytes_pulled.samples())
+    fb0 = sum(v for _, v in metrics.repair_msr_fallbacks.samples())
+    assert cluster.sched.mark_disk_broken(victim.disk_id) >= 1
+    cluster.drain_worker()
+
+    # the sub-shard protocol carried the repair, without falling back
+    n_subshard = metrics.repair_subshard_reads.value() - sub0
+    assert n_subshard >= vol_before.tactic.d * len(original)
+    assert sum(v for _, v in metrics.repair_msr_fallbacks.samples()) == fb0
+    # traffic: d beta-symbols per bid, strictly under one full shard * d
+    shard_bytes = max(len(b) for b in original.values())
+    pulled = sum(v for _, v in metrics.repair_bytes_pulled.samples()) - pulled0
+    assert pulled < vol_before.tactic.d * shard_bytes * len(original)
+
+    vol_after = cluster.cm.get_volume(vid)
+    new_unit = vol_after.units[2]
+    new_node = cluster.node_of(new_unit.node_addr)
+    for bid, blob in original.items():
+        rebuilt, _ = new_node.get_shard(new_unit.disk_id, new_unit.chunk_id, bid)
+        assert rebuilt == blob
     assert cluster.cm.disks[victim.disk_id].status == DiskStatus.REPAIRED
     assert cluster.access.get(loc) == data
 

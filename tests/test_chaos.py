@@ -509,6 +509,160 @@ def test_plan_disk_fault_composes_with_transport_fault(tmp_path, rng):
         c.nodes[1].get_shard(disk, 1, 1)
 
 
+# ---------------- MSR repair: helper dies mid-repair ----------------
+
+def _msr_helper_death(tmp_path, seed):
+    """One seeded pass: EC4P4MSR volume, one unit lost, and the FIRST
+    helper's blobnode dies exactly when the repair worker asks it for
+    sub-shard symbols. The worker must degrade to the conventional
+    k-shard decode exactly once, with NO partial writes from the
+    aborted MSR attempt (reads and verification precede writeback), and
+    the rebuilt unit must be bit-identical. No wall clocks: the only
+    injected fault is an error, and the drain is run_once-driven."""
+    from test_blob_e2e import Cluster
+
+    from cubefs_tpu.blob.blobnode import BlobNode
+    from cubefs_tpu.blob.types import DiskStatus
+    from cubefs_tpu.codec import codemode as cmode
+
+    tmp_path.mkdir(exist_ok=True)
+    c = Cluster(tmp_path)
+    c.cm.allow_colocated_units = True
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, 60_000, dtype=np.uint8).tobytes()
+    loc = c.access.put(data, codemode=cmode.CodeMode.EC4P4MSR)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    bad = 2
+    victim = vol.units[bad]
+    vnode = c.node_of(victim.node_addr)
+    original = {
+        bid: vnode.get_shard(victim.disk_id, victim.chunk_id, bid)[0]
+        for bid, _, _ in vnode.list_chunk(victim.disk_id, victim.chunk_id)
+    }
+    vnode.break_disk(victim.disk_id)
+
+    # all units share az="" here, so helper preference is the sorted
+    # survivor order. Kill the first helper hosted on a DIFFERENT node
+    # than the lead helper: earlier helpers' beta-reads have already
+    # been served when the death lands — a genuinely mid-repair abort.
+    from cubefs_tpu.blob import topology
+    order = topology.pick_repair_helpers(vol.units, bad, vol.tactic.d)
+    lead_addr = vol.units[order[0]].node_addr
+    dead_helper = next(h for h in order[1:vol.tactic.d]
+                       if vol.units[h].node_addr != lead_addr)
+    first_helper = vol.units[dead_helper]
+    fb0 = metrics.repair_msr_fallbacks.value(reason="helper_read")
+    sub0 = metrics.repair_subshard_reads.value()
+
+    # count every shard writeback during the drain: the aborted MSR
+    # attempt must contribute zero, the conventional pass one per bid
+    writes = []
+    orig_put = BlobNode.put_shard
+
+    def counting_put(self, disk_id, chunk_id, bid, payload):
+        writes.append((self.addr, disk_id, chunk_id, bid))
+        return orig_put(self, disk_id, chunk_id, bid, payload)
+
+    plan = FaultPlan(seed=seed)
+    plan.on(first_helper.node_addr, "read_subshard", kind="error",
+            code=503, message="helper died mid-repair", times=1)
+    BlobNode.put_shard = counting_put
+    try:
+        with fi.installed(plan):
+            assert c.sched.mark_disk_broken(victim.disk_id) >= 1
+            c.drain_worker()
+    finally:
+        BlobNode.put_shard = orig_put
+
+    # exactly one fallback, for the helper-read reason, and the MSR
+    # attempt really was underway (sub-shard reads were served before
+    # the injected death aborted the pass)
+    assert metrics.repair_msr_fallbacks.value(
+        reason="helper_read") == fb0 + 1
+    assert metrics.repair_subshard_reads.value() > sub0
+    assert any(e[1] == "error" and e[2] == first_helper.node_addr
+               for e in plan.schedule())
+
+    # no partial writes: exactly one writeback per bid, all from the
+    # conventional pass, all landing on the repair destination
+    vol_after = c.cm.get_volume(vol.vid)
+    new_unit = vol_after.units[bad]
+    assert len(writes) == len(original)
+    assert {w[3] for w in writes} == set(original)
+    assert all(w[1:3] == (new_unit.disk_id, new_unit.chunk_id)
+               for w in writes)
+
+    # and the fallback rebuilt the exact bytes
+    nn = c.node_of(new_unit.node_addr)
+    for bid, blob in original.items():
+        rebuilt, _ = nn.get_shard(new_unit.disk_id, new_unit.chunk_id, bid)
+        assert rebuilt == blob
+    assert c.cm.disks[victim.disk_id].status == DiskStatus.REPAIRED
+    assert c.access.get(loc) == data
+    assert c.worker.failed == 0  # degraded, never failed the task
+    return plan.schedule_digest(), sorted(writes)
+
+
+def test_msr_repair_helper_death_falls_back_exactly_once(tmp_path):
+    d1, w1 = _msr_helper_death(tmp_path / "r1", seed=83)
+    d2, w2 = _msr_helper_death(tmp_path / "r2", seed=83)
+    assert d1 == d2  # byte-for-byte reproducible fault schedule
+    assert [w[3] for w in w1] == [w[3] for w in w2]  # same bid writes
+
+
+def test_msr_repair_verify_mismatch_falls_back(tmp_path, rng):
+    """A corrupt helper symbol must break the extra-helper prediction
+    BEFORE writeback: the MSR pass aborts (reason=verify) and the
+    conventional decode — which reads full shards, not the corrupt
+    combination — rebuilds the true bytes."""
+    from test_blob_e2e import Cluster
+
+    from cubefs_tpu.blob.blobnode import BlobNode
+    from cubefs_tpu.codec import codemode as cmode
+
+    c = Cluster(tmp_path)
+    c.cm.allow_colocated_units = True
+    data = rng.integers(0, 256, 60_000, dtype=np.uint8).tobytes()
+    loc = c.access.put(data, codemode=cmode.CodeMode.EC4P4MSR)
+    vol = c.cm.get_volume(loc.slices[0].vid)
+    bad = 1
+    victim = vol.units[bad]
+    vnode = c.node_of(victim.node_addr)
+    original = {
+        bid: vnode.get_shard(victim.disk_id, victim.chunk_id, bid)[0]
+        for bid, _, _ in vnode.list_chunk(victim.disk_id, victim.chunk_id)
+    }
+    vnode.break_disk(victim.disk_id)
+
+    # corrupt ONE helper's sub-shard reply (not its stored shard): the
+    # repair solve then disagrees with the extra helper's symbol
+    target = vol.units[0].node_addr
+    orig_read = BlobNode.read_subshard
+
+    def corrupting_read(self, disk_id, chunk_id, bids, coeff):
+        sizes, payload = orig_read(self, disk_id, chunk_id, bids, coeff)
+        if self.addr == target:
+            payload = bytes([payload[0] ^ 0x5A]) + payload[1:]
+        return sizes, payload
+
+    fb0 = metrics.repair_msr_fallbacks.value(reason="verify")
+    BlobNode.read_subshard = corrupting_read
+    try:
+        assert c.sched.mark_disk_broken(victim.disk_id) >= 1
+        c.drain_worker()
+    finally:
+        BlobNode.read_subshard = orig_read
+
+    assert metrics.repair_msr_fallbacks.value(reason="verify") == fb0 + 1
+    vol_after = c.cm.get_volume(vol.vid)
+    new_unit = vol_after.units[bad]
+    nn = c.node_of(new_unit.node_addr)
+    for bid, blob in original.items():
+        rebuilt, _ = nn.get_shard(new_unit.disk_id, new_unit.chunk_id, bid)
+        assert rebuilt == blob  # corruption never reached the writeback
+    assert c.access.get(loc) == data
+
+
 # ---------------- single-AZ blackout (failure-domain topology) ----------------
 
 def _blackout_scenario(base, seed):

@@ -24,8 +24,10 @@ def make_encoder(mode, engine="tpu", verify=False):
 def test_encode_verify_roundtrip(mode, engine, rng):
     enc = make_encoder(mode, engine)
     t = enc.t
-    stripe = np.zeros((t.total, 64), dtype=np.uint8)
-    stripe[: t.n] = rng.integers(0, 256, (t.n, 64))
+    # 60 is divisible by every production alpha (1, 3, 5, 6): MSR modes
+    # need alpha-divisible shard widths (beta = S / alpha sub-shards)
+    stripe = np.zeros((t.total, 60), dtype=np.uint8)
+    stripe[: t.n] = rng.integers(0, 256, (t.n, 60))
     enc.encode(stripe)
     assert enc.verify(stripe)
     stripe[0, 0] ^= 0xFF
@@ -35,7 +37,7 @@ def test_encode_verify_roundtrip(mode, engine, rng):
 @pytest.mark.parametrize("mode", EC_MODES)
 def test_engines_bit_identical(mode, rng):
     t = cm.tactic(mode)
-    data = rng.integers(0, 256, (t.total, 32)).astype(np.uint8)
+    data = rng.integers(0, 256, (t.total, 60)).astype(np.uint8)
     data[t.n :] = 0
     a = make_encoder(mode, "numpy").encode(data.copy())
     b = make_encoder(mode, "tpu").encode(data.copy())

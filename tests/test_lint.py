@@ -183,6 +183,23 @@ def test_batch_discipline_true_negative():
     assert BatchDisciplineChecker().check(mod) == []
 
 
+def test_batch_discipline_cfc003_true_positives():
+    mod = _module("subshard_bad.py", "cubefs_tpu/blob/fx.py")
+    found = BatchDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFC003", "CFC003", "CFC003"]
+
+
+def test_batch_discipline_cfc003_true_negative():
+    mod = _module("subshard_good.py", "cubefs_tpu/blob/fx.py")
+    assert BatchDisciplineChecker().check(mod) == []
+
+
+def test_batch_discipline_cfc003_worker_is_sanctioned():
+    # the SAME bad source is clean when it IS the repair worker
+    mod = _module("subshard_bad.py", "cubefs_tpu/blob/worker.py")
+    assert BatchDisciplineChecker().check(mod) == []
+
+
 def test_batch_discipline_scoped_to_blob_plane():
     c = BatchDisciplineChecker()
     assert c.applies("cubefs_tpu/blob/worker.py")

@@ -1,0 +1,255 @@
+"""Product-matrix MSR regenerating codes: geometry validation, repair /
+reconstruct bit-identity across the production MSR geometries, the
+cached per-repair inverse, and the numpy engine's batched matrix_apply.
+
+The math under test is ops/msr.py (Rashmi-Shah-Kumar product-matrix
+construction, PAPERS.md arXiv:1412.3022); the integration surface is
+rs_kernel.msr_* + codec/codemode.py's Tactic validation.
+"""
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.codec import codemode as cm
+from cubefs_tpu.codec.encoder import CodecConfig, new_encoder
+from cubefs_tpu.ops import msr, rs_kernel
+
+# (k, total, d): every shipped MSR tactic + the exact-MSR-point corner
+GEOMETRIES = [
+    (6, 12, 11),  # EC6P6MSR      (3 AZ, shortened j=1, alpha=6)
+    (6, 12, 10),  # EC6P6MSROneAZ (exact point d=2k-2, alpha=5)
+    (4, 8, 6),    # EC4P4MSR      (test-tier, exact point, alpha=3)
+    (4, 8, 7),    # shortened j=1 variant of the test-tier geometry
+]
+
+
+# ---------------- geometry validation ----------------
+
+def test_rejects_d_below_k():
+    with pytest.raises(ValueError, match="d=3 < k=4"):
+        msr.validate_geometry(4, 8, 3)
+
+
+def test_rejects_d_at_or_above_total():
+    with pytest.raises(ValueError, match="helpers must be surviving"):
+        msr.validate_geometry(4, 8, 8)
+    with pytest.raises(ValueError, match="helpers must be surviving"):
+        msr.validate_geometry(4, 8, 9)
+
+
+def test_rejects_interior_points_below_msr():
+    # d in [k, 2k-2) is a valid regenerating regime but NOT product-matrix
+    with pytest.raises(ValueError, match="d >= 2k-2"):
+        msr.validate_geometry(6, 14, 8)
+
+
+def test_rejects_gf256_infeasible_lambda_count():
+    # alpha = d-k+1 = 15 -> gcd(15, 255) = 15 -> only 17 distinct
+    # lambda^alpha values, but the shortened parent needs total+j nodes
+    with pytest.raises(ValueError, match="GF\\(256\\) admits only 17"):
+        msr.validate_geometry(16, 40, 30)
+
+
+def test_feasible_nodes_bound():
+    assert msr.feasible_nodes(1) == 255
+    assert msr.feasible_nodes(3) == 85
+    assert msr.feasible_nodes(5) == 51
+    assert msr.feasible_nodes(6) == 85
+    assert msr.feasible_nodes(15) == 17
+
+
+def test_tactic_rejects_az_indivisible_helper_count():
+    # 3 AZs, 12 units -> 3 AZ-local survivors; the d-3 remote helpers
+    # must split evenly over the 2 remote AZs, so even d is rejected
+    with pytest.raises(ValueError, match="AZ"):
+        cm.Tactic(6, 6, 0, 3, 11, 0, cm.ALIGN_2KB, scheme="msr", d=10)
+
+
+def test_tactic_rejects_msr_with_local_stripes():
+    with pytest.raises(ValueError, match="local parity"):
+        cm.Tactic(6, 6, 3, 3, 11, 0, cm.ALIGN_2KB, scheme="msr", d=11)
+
+
+def test_tactic_rejects_d_on_rs_scheme():
+    with pytest.raises(ValueError, match="scheme"):
+        cm.Tactic(6, 3, 0, 1, 9, 0, cm.ALIGN_2KB, d=8)
+
+
+def test_shipped_msr_tactics_validate():
+    for mode in (cm.CodeMode.EC6P6MSR, cm.CodeMode.EC6P6MSROneAZ,
+                 cm.CodeMode.EC4P4MSR):
+        t = cm.tactic(mode)
+        assert t.is_msr()
+        assert t.alpha == t.d - t.n + 1
+        msr.validate_geometry(t.n, t.total, t.d)
+
+
+# ---------------- encode -> lose one -> repair bit-identity ----------------
+
+def _stripe(rng, k, total, d, beta=64):
+    alpha = d - k + 1
+    size = alpha * beta
+    data = rng.integers(0, 256, (k, size), dtype=np.uint8)
+    parity = np.asarray(rs_kernel.msr_encode_parity(
+        data[None], k, total, d))[0]
+    return np.concatenate([data, parity]), size
+
+
+@pytest.mark.parametrize("k,total,d", GEOMETRIES)
+def test_msr_repair_every_slot_bit_identical(k, total, d, rng):
+    """Lose each slot in turn; rebuild it from d beta-sized helper
+    symbols and compare to the original bytes."""
+    shards, size = _stripe(rng, k, total, d)
+    alpha = d - k + 1
+    for failed in range(total):
+        helpers = tuple(i for i in range(total) if i != failed)[:d]
+        row = rs_kernel.msr_helper_rows(k, total, d, failed)
+        syms = np.stack([
+            np.asarray(rs_kernel.gf_matrix_apply(
+                row, shards[h].reshape(1, alpha, size // alpha)))[0, 0]
+            for h in helpers])
+        rebuilt = np.asarray(rs_kernel.gf_matrix_apply(
+            rs_kernel.msr_repair_rows(k, total, d, failed, helpers),
+            syms[None]))[0].reshape(size)
+        assert np.array_equal(rebuilt, shards[failed]), failed
+
+
+@pytest.mark.parametrize("k,total,d", GEOMETRIES)
+def test_msr_repair_from_random_helper_subsets(k, total, d, rng):
+    shards, size = _stripe(rng, k, total, d, beta=16)
+    alpha = d - k + 1
+    for failed in (0, k - 1, total - 1):
+        survivors = [i for i in range(total) if i != failed]
+        helpers = tuple(rng.permutation(survivors)[:d].tolist())
+        row = rs_kernel.msr_helper_rows(k, total, d, failed)
+        syms = np.stack([
+            np.asarray(rs_kernel.gf_matrix_apply(
+                row, shards[h].reshape(1, alpha, size // alpha)))[0, 0]
+            for h in helpers])
+        rebuilt = np.asarray(rs_kernel.gf_matrix_apply(
+            rs_kernel.msr_repair_rows(k, total, d, failed, helpers),
+            syms[None]))[0].reshape(size)
+        assert np.array_equal(rebuilt, shards[failed]), failed
+
+
+@pytest.mark.parametrize("k,total,d", [g for g in GEOMETRIES
+                                       if g[2] < g[1] - 1])
+def test_msr_verify_row_predicts_extra_helper(k, total, d, rng):
+    # needs a survivor OUTSIDE the d-helper set (d < total-1 geometries)
+    shards, size = _stripe(rng, k, total, d, beta=16)
+    alpha = d - k + 1
+    failed = 1
+    order = [i for i in range(total) if i != failed]
+    helpers, extra = tuple(order[:d]), order[d]
+    row = rs_kernel.msr_helper_rows(k, total, d, failed)
+
+    def sym(h):
+        return np.asarray(rs_kernel.gf_matrix_apply(
+            row, shards[h].reshape(1, alpha, size // alpha)))[0, 0]
+
+    syms = np.stack([sym(h) for h in helpers])
+    pred = np.asarray(rs_kernel.gf_matrix_apply(
+        rs_kernel.msr_verify_rows(k, total, d, failed, helpers, extra),
+        syms[None]))[0, 0]
+    assert np.array_equal(pred, sym(extra))
+    # and a corrupted helper symbol breaks the prediction
+    syms[0, 0] ^= 0x5A
+    pred_bad = np.asarray(rs_kernel.gf_matrix_apply(
+        rs_kernel.msr_verify_rows(k, total, d, failed, helpers, extra),
+        syms[None]))[0, 0]
+    assert not np.array_equal(pred_bad, sym(extra))
+
+
+@pytest.mark.parametrize("k,total,d", GEOMETRIES)
+def test_msr_conventional_reconstruct_any_k(k, total, d, rng):
+    """The k-full-shard fallback: any k survivors rebuild any shard."""
+    shards, size = _stripe(rng, k, total, d, beta=8)
+    alpha = d - k + 1
+    for failed in (0, total - 1):
+        survivors = [i for i in range(total) if i != failed]
+        present = tuple(sorted(rng.permutation(survivors)[:k].tolist()))
+        stack = shards[list(present)].reshape(1, k * alpha, size // alpha)
+        rebuilt = np.asarray(rs_kernel.gf_matrix_apply(
+            rs_kernel.msr_reconstruct_rows(k, total, d, present, (failed,)),
+            stack))[0].reshape(size)
+        assert np.array_equal(rebuilt, shards[failed]), failed
+
+
+def test_msr_traffic_reduction_factor():
+    """The whole point: helper symbols total d*beta bytes vs k*alpha*beta
+    for the conventional decode -- k*alpha/d is the advertised factor."""
+    for k, total, d in GEOMETRIES:
+        alpha = d - k + 1
+        assert k * alpha / d >= 2.0, (k, total, d)
+    t = cm.tactic(cm.CodeMode.EC6P6MSR)
+    assert round(t.n * t.alpha / t.d, 2) == 3.27
+
+
+# ---------------- encoder integration ----------------
+
+@pytest.mark.parametrize("mode", [cm.CodeMode.EC6P6MSR,
+                                  cm.CodeMode.EC6P6MSROneAZ,
+                                  cm.CodeMode.EC4P4MSR])
+def test_msr_encoder_shard_size_alpha_divisible(mode):
+    enc = new_encoder(CodecConfig(mode=mode, engine="numpy"))
+    for blob in (1, 100, 64 << 10, (64 << 10) + 1):
+        s = enc.shard_size(blob)
+        assert s % enc.t.alpha == 0
+        assert s * enc.t.n >= blob
+
+
+@pytest.mark.parametrize("mode", [cm.CodeMode.EC6P6MSR,
+                                  cm.CodeMode.EC4P4MSR])
+def test_msr_encoder_split_encode_reconstruct_join(mode, rng):
+    enc = new_encoder(CodecConfig(mode=mode, engine="numpy"))
+    t = enc.t
+    blob = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
+    stripe = enc.split(blob)
+    enc.encode(stripe)
+    assert enc.verify(stripe)
+    golden = stripe.copy()
+    stripe[[0, t.n]] = 0
+    enc.reconstruct(stripe, [0, t.n])
+    assert np.array_equal(stripe, golden)
+    assert enc.join(stripe, len(blob)) == blob
+
+
+# ---------------- the cached per-repair inverse ----------------
+
+def test_repair_rows_cache_hit_identity():
+    k, total, d = 4, 8, 6
+    helpers = tuple(range(1, 7))
+    a = msr.repair_rows(k, total, d, 0, helpers)
+    b = msr.repair_rows(k, total, d, 0, helpers)
+    assert a is b  # same object: the inverse was solved once
+    assert not a.flags.writeable  # cached matrices are frozen
+    # a different failed slot or helper-set is a different cache key
+    c = msr.repair_rows(k, total, d, 0, tuple(range(2, 8)))
+    assert c is not a
+    before = msr.repair_rows.cache_info().hits
+    msr.repair_rows(k, total, d, 0, helpers)
+    assert msr.repair_rows.cache_info().hits == before + 1
+
+
+def test_helper_and_encode_rows_cached():
+    assert (msr.helper_rows(4, 8, 6, 2) is msr.helper_rows(4, 8, 6, 2))
+    assert (msr.encode_rows(4, 8, 6) is msr.encode_rows(4, 8, 6))
+
+
+# ---------------- numpy engine batch vectorization ----------------
+
+def test_numpy_engine_batched_apply_identity(rng):
+    """The vectorized (B, C, S) matrix_apply must equal the per-stripe
+    loop it replaced, including over multi-dim leading batches."""
+    from cubefs_tpu.codec.engine import NumpyEngine
+    from cubefs_tpu.ops import gf256
+
+    eng = NumpyEngine()
+    coeff = rs_kernel.msr_repair_rows(4, 8, 6, 0, tuple(range(1, 7)))
+    shards = rng.integers(0, 256, (3, 5, 6, 32), dtype=np.uint8)
+    out = eng.matrix_apply(np.asarray(coeff), shards)
+    assert out.shape == (3, 5, coeff.shape[0], 32)
+    for i in range(3):
+        for j in range(5):
+            ref = gf256.gf_matmul(np.asarray(coeff), shards[i, j])
+            assert np.array_equal(out[i, j], ref)

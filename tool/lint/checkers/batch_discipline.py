@@ -15,6 +15,14 @@ metrics and to backpressure. The regression shape:
           that is not the admitted facade — blob code must call these
           on an ``admit()``-returned handle (held as ``.codec`` by
           convention) or through BatchCodec.submit_*
+  CFC003  raw sub-shard reconstruction (msr_repair_rows /
+          msr_reconstruct_rows / msr_helper_rows / msr_verify_rows /
+          msr_repair_shard) outside blob/worker.py — the worker is the
+          single orchestrator of MSR repair: it owns helper election,
+          the pre-writeback verify, the conventional fallback, and the
+          repair-traffic metrics; a second call-site forks that
+          protocol (helpers serve opaque coefficient rows over
+          read_subshard, they never build repair matrices themselves)
 
 The analysis is syntactic. The admitted receiver convention is a final
 attribute/name of ``codec`` (``self.codec``, ``enc.codec``) or an
@@ -35,6 +43,11 @@ _ENGINE_NAMES = {"get_engine", "engine_for", "Engine", "NumpyEngine",
 # receiver final names allowed to dispatch device math in the blob plane
 _ADMITTED_RECV = {"codec", "batcher", "admitted"}
 _DEVICE_CALLS = {"encode_parity", "matrix_apply"}
+# MSR repair-protocol primitives: row construction + one-shot repair.
+# Only blob/worker.py may call these (CFC003).
+_MSR_CALLS = {"msr_repair_rows", "msr_reconstruct_rows", "msr_helper_rows",
+              "msr_verify_rows", "msr_repair_shard"}
+_MSR_SANCTIONED = "cubefs_tpu/blob/worker.py"
 
 
 def _final_name(node: ast.AST) -> str:
@@ -80,6 +93,18 @@ class BatchDisciplineChecker(Checker):
                                 f"access bypasses the admission surface"))
             elif isinstance(node, ast.Call):
                 func = node.func
+                called = (func.attr if isinstance(func, ast.Attribute)
+                          else func.id if isinstance(func, ast.Name) else "")
+                if (called in _MSR_CALLS
+                        and mod.relpath != _MSR_SANCTIONED):
+                    out.append(self.violation(
+                        mod, "CFC003", node,
+                        f"`{called}()` outside {_MSR_SANCTIONED} — "
+                        f"sub-shard reconstruction is the repair worker's "
+                        f"protocol (helper election, pre-writeback verify, "
+                        f"conventional fallback, traffic metrics); helpers "
+                        f"only apply opaque coefficient rows via "
+                        f"read_subshard"))
                 if (isinstance(func, ast.Attribute)
                         and func.attr in _DEVICE_CALLS
                         and _final_name(func.value) not in _ADMITTED_RECV):
