@@ -26,6 +26,7 @@ import numpy as np
 from ..codec import codemode as cm
 from ..codec.encoder import CodecConfig, new_encoder
 from ..utils import metrics, rpc
+from ..utils import trace as tracelib
 from .types import Location, Slice, VolumeInfo
 
 
@@ -97,6 +98,11 @@ class AccessHandler:
 
     # ------------------------------ PUT ------------------------------
     def put(self, data: bytes, codemode: int | None = None) -> Location:
+        with tracelib.path_span("blob.put", "access.put") as sp:
+            sp.set_tag("svc", "access").set_tag("bytes", len(data))
+            return self._put(data, codemode)
+
+    def _put(self, data: bytes, codemode: int | None = None) -> Location:
         if not data:
             raise ValueError("empty payload")
         mode = int(codemode if codemode is not None
@@ -120,40 +126,54 @@ class AccessHandler:
         timeline = {"encode_admitted": time.monotonic()}
         pending = enc.encode_async(stripes)
 
-        if self.proxy is not None:  # allocation cache: no per-put cm trip
-            meta, _ = self.proxy.call("alloc", {"codemode": mode,
-                                                "count": len(blobs)})
-            vol = VolumeInfo.from_dict(meta["volume"])
-            min_bid = meta["min_bid"]
-        else:
-            meta, _ = self.cm.call("alloc_volume", {"codemode": mode,
-                                                    "op_id": uuid.uuid4().hex})
-            vol = VolumeInfo.from_dict(meta["volume"])
-            meta, _ = self.cm.call("alloc_bids", {"count": len(blobs),
-                                                  "op_id": uuid.uuid4().hex})
-            min_bid = meta["start"]
+        with tracelib.stage("bid_alloc"):
+            if self.proxy is not None:  # alloc cache: no per-put cm trip
+                meta, _ = self.proxy.call("alloc", {"codemode": mode,
+                                                    "count": len(blobs)})
+                vol = VolumeInfo.from_dict(meta["volume"])
+                min_bid = meta["min_bid"]
+            else:
+                meta, _ = self.cm.call(
+                    "alloc_volume", {"codemode": mode,
+                                     "op_id": uuid.uuid4().hex})
+                vol = VolumeInfo.from_dict(meta["volume"])
+                meta, _ = self.cm.call(
+                    "alloc_bids", {"count": len(blobs),
+                                   "op_id": uuid.uuid4().hex})
+                min_bid = meta["start"]
         timeline["alloc_done"] = time.monotonic()
         timeline["encode_resolved_before_wait"] = pending.resolved
-        pending.wait()
-        timeline["encode_done"] = time.monotonic()
+        # the stage is the RESIDUAL admission wait left on the critical
+        # path after overlapping allocation; admitted->done wall time
+        # rides as a tag on the stage span
+        with tracelib.stage("encode_admission") as st:
+            pending.wait()
+            timeline["encode_done"] = time.monotonic()
+            if getattr(st, "span", None) is not None:
+                st.span.set_tag(
+                    "encode_total_ms",
+                    round((timeline["encode_done"]
+                           - timeline["encode_admitted"]) * 1000, 3))
 
         # ---- quorum writes ----
         quorum = self.cfg.put_quorum_override or t.put_quorum
-        futures = []
-        for i in range(len(blobs)):
-            bid = min_bid + i
-            for u in vol.units:
-                futures.append(
-                    self._submit(self._write_shard, vol, u, bid, stripes[i, u.index])
-                )
-        fails: list[tuple[int, int]] = []  # (bid, unit index)
-        ok_per_bid = {min_bid + i: 0 for i in range(len(blobs))}
-        for f in futures:
-            bid, idx, err = f.result()
-            if err is None:
-                ok_per_bid[bid] += 1
-            else:
-                fails.append((bid, idx))
+        with tracelib.stage("quorum_write"):
+            futures = []
+            for i in range(len(blobs)):
+                bid = min_bid + i
+                for u in vol.units:
+                    futures.append(
+                        self._submit(self._write_shard, vol, u, bid,
+                                     stripes[i, u.index])
+                    )
+            fails: list[tuple[int, int]] = []  # (bid, unit index)
+            ok_per_bid = {min_bid + i: 0 for i in range(len(blobs))}
+            for f in futures:
+                bid, idx, err = f.result()
+                if err is None:
+                    ok_per_bid[bid] += 1
+                else:
+                    fails.append((bid, idx))
         timeline["quorum_done"] = time.monotonic()
         self.last_put_timeline = timeline
         for bid, n_ok in ok_per_bid.items():
@@ -205,6 +225,11 @@ class AccessHandler:
 
     # ------------------------------ GET ------------------------------
     def get(self, loc: Location) -> bytes:
+        with tracelib.path_span("blob.get", "access.get") as sp:
+            sp.set_tag("svc", "access").set_tag("bytes", loc.size)
+            return self._get(loc)
+
+    def _get(self, loc: Location) -> bytes:
         enc = self._encoder(loc.codemode)
         t = enc.t
         out = bytearray()
@@ -250,28 +275,30 @@ class AccessHandler:
         # fast path: read the N data shards; if any straggle past the
         # hedge delay, fire backup requests at parity shards and take the
         # first n results (the reference's n-of-N+x hedged GET)
-        pending_map = {self._submit(self._read_shard, vol, i, bid): i
-                       for i in range(t.n)}
-        _, pending = wait(pending_map, timeout=self.HEDGE_DELAY)
-        # hedge only for reads that STARTED and stalled; queued-not-started
-        # futures mean the pool is saturated — extra reads would amplify
-        # load exactly when overloaded
-        stalled = sum(1 for f in pending if f.running())
-        for i in range(t.n, t.n + min(t.m, stalled)):
-            pending_map[self._submit(self._read_shard, vol, i, bid)] = i
-        # first n distinct shards win (any mix of data/parity decodes);
-        # on the happy path the straggler is abandoned in-flight
-        got: dict[int, bytes] = {}
-        errs: dict[int, object] = {}
-        remaining = set(pending_map)
-        while remaining and len(got) < t.n:
-            done, remaining = wait(remaining, return_when=FIRST_COMPLETED)
-            for f in done:
-                i, p, err = f.result()
-                if err is None:
-                    got[i] = p
-                else:
-                    errs[i] = err
+        with tracelib.stage("read"):
+            pending_map = {self._submit(self._read_shard, vol, i, bid): i
+                           for i in range(t.n)}
+            _, pending = wait(pending_map, timeout=self.HEDGE_DELAY)
+            # hedge only for reads that STARTED and stalled; queued-not-
+            # started futures mean the pool is saturated — extra reads
+            # would amplify load exactly when overloaded
+            stalled = sum(1 for f in pending if f.running())
+            for i in range(t.n, t.n + min(t.m, stalled)):
+                pending_map[self._submit(self._read_shard, vol, i, bid)] = i
+            # first n distinct shards win (any mix of data/parity
+            # decodes); on the happy path the straggler is abandoned
+            # in-flight
+            got: dict[int, bytes] = {}
+            errs: dict[int, object] = {}
+            remaining = set(pending_map)
+            while remaining and len(got) < t.n:
+                done, remaining = wait(remaining, return_when=FIRST_COMPLETED)
+                for f in done:
+                    i, p, err = f.result()
+                    if err is None:
+                        got[i] = p
+                    else:
+                        errs[i] = err
         if all(i in got for i in range(t.n)):  # got may also hold hedged parity
             data = b"".join(got[i] for i in range(t.n))
             return data[:payload_len]
@@ -291,7 +318,8 @@ class AccessHandler:
             # each missing data shard inside its local stripe — reads
             # stay within one AZ (the client's first, when labeled)
             if t.l and any(i not in got for i in range(t.n)):
-                self._local_reconstruct(enc, vol, bid, got, errs)
+                with tracelib.stage("local_reconstruct"):
+                    self._local_reconstruct(enc, vol, bid, got, errs)
                 if all(i in got for i in range(t.n)):
                     self._file_repairs(vol, bid, got, errs, t.n)
                     metrics.reconstruct_reads.inc(path="local")
@@ -304,24 +332,26 @@ class AccessHandler:
             ):
                 if err is None:
                     got[i] = p
-        missing = [i for i in range(t.n) if i not in got]
-        present = sorted(i for i in got if i < t.n + t.m)
-        if len(present) < t.n:
-            raise GetError(
-                f"bid {bid}: only {len(present)} of {t.n} shards readable"
-            )
-        self._file_repairs(vol, bid, got, errs, t.n)
-        metrics.reconstruct_reads.inc(path="global")
-        shard_size = len(next(iter(got.values())))
-        stripe = np.zeros((t.n + t.m, shard_size), dtype=np.uint8)
-        for i in present:
-            if i < t.n + t.m:
-                stripe[i] = np.frombuffer(got[i], dtype=np.uint8)
-        # EVERY unread row is bad — including parity we never fetched;
-        # marking only the missing data rows would let zero-filled parity
-        # rows join the solving set and silently corrupt the decode
-        all_bad = [i for i in range(t.n + t.m) if i not in got]
-        enc.reconstruct_data(stripe, all_bad)
+        with tracelib.stage("global_reconstruct"):
+            missing = [i for i in range(t.n) if i not in got]
+            present = sorted(i for i in got if i < t.n + t.m)
+            if len(present) < t.n:
+                raise GetError(
+                    f"bid {bid}: only {len(present)} of {t.n} shards readable"
+                )
+            self._file_repairs(vol, bid, got, errs, t.n)
+            metrics.reconstruct_reads.inc(path="global")
+            shard_size = len(next(iter(got.values())))
+            stripe = np.zeros((t.n + t.m, shard_size), dtype=np.uint8)
+            for i in present:
+                if i < t.n + t.m:
+                    stripe[i] = np.frombuffer(got[i], dtype=np.uint8)
+            # EVERY unread row is bad — including parity we never
+            # fetched; marking only the missing data rows would let
+            # zero-filled parity rows join the solving set and silently
+            # corrupt the decode
+            all_bad = [i for i in range(t.n + t.m) if i not in got]
+            enc.reconstruct_data(stripe, all_bad)
         data = np.ascontiguousarray(stripe[: t.n]).reshape(-1)[:payload_len]
         return data.tobytes()
 

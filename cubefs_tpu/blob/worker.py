@@ -24,6 +24,7 @@ from ..ops import rs_kernel
 from ..codec import codemode as cm
 from ..codec.batcher import admit
 from ..utils import metrics, rpc
+from ..utils import trace as tracelib
 from . import topology
 from .types import VolumeInfo
 
@@ -122,6 +123,11 @@ class RepairWorker:
             renew_stop.set()
 
     def _execute(self, task: dict) -> None:
+        with tracelib.path_span("blob.repair", "worker.repair") as sp:
+            sp.set_tag("svc", "worker").set_tag("task", task["type"])
+            self._execute_traced(task, sp)
+
+    def _execute_traced(self, task: dict, sp) -> None:
         if task["type"] in ("shard_repair", "shard_migrate"):
             return self._execute_shard_swap(task)
         vol = VolumeInfo.from_dict(
@@ -145,6 +151,7 @@ class RepairWorker:
                 # writeback), so the conventional decode below rebuilds
                 # from scratch
                 metrics.repair_msr_fallbacks.inc(reason=e.reason)
+                sp.set_tag("msr_fallback", e.reason)
         self._execute_conventional(task, vol, t, bad, bids, dest)
 
     def _execute_conventional(self, task: dict, vol: VolumeInfo,
@@ -160,38 +167,60 @@ class RepairWorker:
         local_idx, ln, lm = t.local_stripe(bad) if t.l else ([], 0, 0)
         sources = (["local", "global"] if local_idx and bad in local_idx
                    else ["global"])
-        for source in sources:
-            if source == "local":
-                read_set = [i for i in local_idx if i != bad]
-                n_solve, total_code = ln, ln + lm
-                code_pos = {u: s for s, u in enumerate(local_idx)}
-                bad_sub = code_pos[bad]
-            else:
-                read_set = [i for i in range(t.n + t.m) if i != bad]
-                n_solve, total_code = t.n, t.n + t.m
-                code_pos = {u: u for u in read_set}
-                bad_sub = bad
+        with tracelib.stage("survivor_reads"):
+            for source in sources:
+                if source == "local":
+                    read_set = [i for i in local_idx if i != bad]
+                    n_solve, total_code = ln, ln + lm
+                    code_pos = {u: s for s, u in enumerate(local_idx)}
+                    bad_sub = code_pos[bad]
+                else:
+                    read_set = [i for i in range(t.n + t.m) if i != bad]
+                    n_solve, total_code = t.n, t.n + t.m
+                    code_pos = {u: u for u in read_set}
+                    bad_sub = bad
 
-            # per-bid survivor reads (one EXTRA when available: the
-            # extra is reconstructed from the first n and compared, the
-            # pre-writeback consistency check — a corrupted download
-            # must not become the new truth). The ACTUALLY-read survivor
-            # set selects the decode matrix, so per-shard read failures
-            # mid-task are fine.
-            want = min(n_solve + 1, len(read_set))
-            by_key: dict[tuple, list] = defaultdict(list)
-            try:
-                for bid in bids:
-                    subs, shards = self._read_survivors(
-                        vol, read_set, code_pos, bid, need=n_solve,
-                        want=want, failed_az=vol.units[bad].az)
-                    by_key[(len(shards[0]), tuple(subs))].append((bid, shards))
-            except RuntimeError:
-                if source != sources[-1]:
-                    continue  # local stripe unreadable: widen to global
-                raise
-            break
+                # per-bid survivor reads (one EXTRA when available: the
+                # extra is reconstructed from the first n and compared,
+                # the pre-writeback consistency check — a corrupted
+                # download must not become the new truth). The ACTUALLY-
+                # read survivor set selects the decode matrix, so per-
+                # shard read failures mid-task are fine.
+                want = min(n_solve + 1, len(read_set))
+                by_key: dict[tuple, list] = defaultdict(list)
+                try:
+                    for bid in bids:
+                        subs, shards = self._read_survivors(
+                            vol, read_set, code_pos, bid, need=n_solve,
+                            want=want, failed_az=vol.units[bad].az)
+                        by_key[(len(shards[0]), tuple(subs))].append(
+                            (bid, shards))
+                except RuntimeError:
+                    if source != sources[-1]:
+                        continue  # local stripe unreadable: widen global
+                    raise
+                break
 
+        self._decode_writeback(task, t, by_key, n_solve, total_code,
+                               bad_sub, dest)
+
+    def _decode_writeback(self, task, t, by_key, n_solve, total_code,
+                          bad_sub, dest) -> None:
+        writes: list[tuple[int, bytes]] = []
+        with tracelib.stage("decode"):
+            self._decode_groups(t, by_key, n_solve, total_code, bad_sub,
+                                writes)
+        with tracelib.stage("writeback"):
+            for bid, shard in writes:
+                dest.call(
+                    "put_shard",
+                    {"disk_id": task["dest_disk"],
+                     "chunk_id": task["dest_chunk"], "bid": bid},
+                    shard,
+                )
+
+    def _decode_groups(self, t, by_key, n_solve, total_code, bad_sub,
+                       writes) -> None:
         for (size, subs), group in by_key.items():
             solve_subs = list(subs[:n_solve])
             wanted_out = [bad_sub]
@@ -246,12 +275,7 @@ class RepairWorker:
                                 f"extra survivor {subs[n_solve]} — refusing "
                                 f"writeback (crc-conflict role)"
                             )
-                    dest.call(
-                        "put_shard",
-                        {"disk_id": task["dest_disk"],
-                         "chunk_id": task["dest_chunk"], "bid": bid},
-                        rec[out_pos].tobytes(),
-                    )
+                    writes.append((bid, rec[out_pos].tobytes()))
 
     def _execute_msr(self, task: dict, vol: VolumeInfo, t: cm.Tactic,
                      bad: int, bids: list[int], dest) -> None:
@@ -262,89 +286,99 @@ class RepairWorker:
         helper's symbol, THEN write back. Any miss before writeback
         raises MsrFallback — the conventional path owns the retry."""
         k, total, d, alpha = t.n, t.total, t.d, t.alpha
-        try:
-            order = topology.pick_repair_helpers(vol.units, bad, d)
-        except topology.NoAvailableDisks as e:
-            raise MsrFallback("helpers_unavailable", str(e)) from None
-        helpers = tuple(order[:d])
-        extra = order[d] if len(order) > d else None
-        coeff = rs_kernel.msr_helper_rows(k, total, d, bad)[0].tolist()
+        with tracelib.stage("helper_election"):
+            try:
+                order = topology.pick_repair_helpers(vol.units, bad, d)
+            except topology.NoAvailableDisks as e:
+                raise MsrFallback("helpers_unavailable", str(e)) from None
+            helpers = tuple(order[:d])
+            extra = order[d] if len(order) > d else None
+            coeff = rs_kernel.msr_helper_rows(k, total, d, bad)[0].tolist()
         failed_az = vol.units[bad].az
 
         # ONE read_subshard RPC per helper, batched over every bid; all
         # network reads land before any math or writeback, so a helper
         # dying mid-repair costs nothing but the fallback
         per_bid: dict[int, dict[int, bytes]] = {b: {} for b in bids}
-        for h in helpers + ((extra,) if extra is not None else ()):
-            u = vol.units[h]
-            try:
-                meta, raw = self.nodes.get(u.node_addr).call(
-                    "read_subshard",
-                    {"disk_id": u.disk_id, "chunk_id": u.chunk_id,
-                     "bids": bids, "coeff": coeff})
-                sizes = meta["sizes"]
-                if len(sizes) != len(bids):
-                    raise rpc.RpcError(409, f"{len(sizes)} sizes for "
-                                            f"{len(bids)} bids")
-            except rpc.RpcError as e:
-                if h == extra:
-                    extra = None  # verification extra is best-effort
-                    continue
-                raise MsrFallback(
-                    "helper_read", f"helper unit {h}: {e}") from None
-            scope = ("az_local" if u.az == failed_az else "cross_az")
-            metrics.repair_bytes_pulled.inc(len(raw), scope=scope)
-            off = 0
-            for bid, beta in zip(bids, sizes):
-                per_bid[bid][h] = raw[off:off + beta]
-                off += beta
+        with tracelib.stage("beta_pulls"):
+            for h in helpers + ((extra,) if extra is not None else ()):
+                u = vol.units[h]
+                try:
+                    meta, raw = self.nodes.get(u.node_addr).call(
+                        "read_subshard",
+                        {"disk_id": u.disk_id, "chunk_id": u.chunk_id,
+                         "bids": bids, "coeff": coeff})
+                    sizes = meta["sizes"]
+                    if len(sizes) != len(bids):
+                        raise rpc.RpcError(409, f"{len(sizes)} sizes for "
+                                                f"{len(bids)} bids")
+                except rpc.RpcError as e:
+                    if h == extra:
+                        extra = None  # verification extra is best-effort
+                        continue
+                    raise MsrFallback(
+                        "helper_read", f"helper unit {h}: {e}") from None
+                scope = ("az_local" if u.az == failed_az else "cross_az")
+                metrics.repair_bytes_pulled.inc(len(raw), scope=scope)
+                off = 0
+                for bid, beta in zip(bids, sizes):
+                    per_bid[bid][h] = raw[off:off + beta]
+                    off += beta
 
-        rows = rs_kernel.msr_repair_rows(k, total, d, bad, helpers)
-        if extra is not None:
-            # verification rides the SAME device step: one stacked
-            # (alpha+1, d) matrix predicts the extra helper's symbol
-            # alongside the repair — a corrupt download breaks the
-            # prediction before it can become the new truth
-            rows = np.concatenate(
-                [rows, rs_kernel.msr_verify_rows(
-                    k, total, d, bad, helpers, extra)])
-        groups: dict[int, list[int]] = defaultdict(list)
-        for bid in bids:
-            sym = per_bid[bid]
-            beta = len(sym[helpers[0]])
-            if any(len(sym[h]) != beta for h in helpers):
-                raise MsrFallback("helper_read",
-                                  f"bid {bid}: helper symbol widths differ")
-            groups[beta].append(bid)
-
+        # repair math + the extra-helper prediction are ONE fused device
+        # step, so the "verify" stage covers both
         writes: list[tuple[int, bytes]] = []
-        for beta, group in groups.items():
-            for start in range(0, len(group), self.batch_stripes):
-                chunk = group[start:start + self.batch_stripes]
-                batch = np.stack([
-                    np.stack([np.frombuffer(per_bid[b][h], dtype=np.uint8)
-                              for h in helpers])
-                    for b in chunk
-                ])  # (B, d, beta)
-                out = self.codec.matrix_apply(rows, batch)
-                for i, b in enumerate(chunk):
-                    if extra is not None:
-                        expect = np.frombuffer(per_bid[b].get(extra, b""),
-                                               dtype=np.uint8)
-                        if (expect.size != beta
-                                or not np.array_equal(out[i, alpha], expect)):
-                            raise MsrFallback(
-                                "verify",
-                                f"bid {b}: repair disagrees with extra "
-                                f"helper {extra}'s symbol")
-                    writes.append((b, out[i, :alpha].reshape(-1).tobytes()))
-        for bid, shard in writes:
-            dest.call(
-                "put_shard",
-                {"disk_id": task["dest_disk"],
-                 "chunk_id": task["dest_chunk"], "bid": bid},
-                shard,
-            )
+        with tracelib.stage("verify"):
+            rows = rs_kernel.msr_repair_rows(k, total, d, bad, helpers)
+            if extra is not None:
+                # verification rides the SAME device step: one stacked
+                # (alpha+1, d) matrix predicts the extra helper's symbol
+                # alongside the repair — a corrupt download breaks the
+                # prediction before it can become the new truth
+                rows = np.concatenate(
+                    [rows, rs_kernel.msr_verify_rows(
+                        k, total, d, bad, helpers, extra)])
+            groups: dict[int, list[int]] = defaultdict(list)
+            for bid in bids:
+                sym = per_bid[bid]
+                beta = len(sym[helpers[0]])
+                if any(len(sym[h]) != beta for h in helpers):
+                    raise MsrFallback(
+                        "helper_read",
+                        f"bid {bid}: helper symbol widths differ")
+                groups[beta].append(bid)
+
+            for beta, group in groups.items():
+                for start in range(0, len(group), self.batch_stripes):
+                    chunk = group[start:start + self.batch_stripes]
+                    batch = np.stack([
+                        np.stack([np.frombuffer(per_bid[b][h],
+                                                dtype=np.uint8)
+                                  for h in helpers])
+                        for b in chunk
+                    ])  # (B, d, beta)
+                    out = self.codec.matrix_apply(rows, batch)
+                    for i, b in enumerate(chunk):
+                        if extra is not None:
+                            expect = np.frombuffer(
+                                per_bid[b].get(extra, b""), dtype=np.uint8)
+                            if (expect.size != beta
+                                    or not np.array_equal(out[i, alpha],
+                                                          expect)):
+                                raise MsrFallback(
+                                    "verify",
+                                    f"bid {b}: repair disagrees with extra "
+                                    f"helper {extra}'s symbol")
+                        writes.append(
+                            (b, out[i, :alpha].reshape(-1).tobytes()))
+        with tracelib.stage("writeback"):
+            for bid, shard in writes:
+                dest.call(
+                    "put_shard",
+                    {"disk_id": task["dest_disk"],
+                     "chunk_id": task["dest_chunk"], "bid": bid},
+                    shard,
+                )
 
     def _execute_shard_swap(self, task: dict) -> None:
         """shard_repair / shard_migrate execution (shard_disk_repairer

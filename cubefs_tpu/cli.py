@@ -43,6 +43,18 @@ def _fetch_metrics(addr: str) -> str:
         conn.close()
 
 
+def _fetch_json(addr: str, path: str) -> dict:
+    import http.client
+
+    host, port = addr.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=5.0)
+    try:
+        conn.request("GET", path)
+        return json.loads(conn.getresponse().read().decode())
+    finally:
+        conn.close()
+
+
 def _parse_metrics(text: str) -> list[tuple[str, dict, float]]:
     """Prometheus exposition text -> [(name, labels, value)]."""
     out = []
@@ -214,6 +226,40 @@ def _repair_view(text: str) -> dict:
     }
 
 
+def _slo_view(text: str) -> dict:
+    """The tail-latency digest: per-path quantiles from the sliding
+    window, SLO burn rate, and remaining error budget (scraping
+    /metrics triggers the node's tracker refresh)."""
+    series = _parse_metrics(text)
+    paths = sorted({lb["path"] for n, lb, _ in series
+                    if n.startswith("cubefs_slo_") and "path" in lb})
+    view = {}
+    for path in paths:
+        quantiles = {lb["quantile"]: v for n, lb, v in series
+                     if n == "cubefs_slo_latency_quantile_seconds"
+                     and lb.get("path") == path}
+        burn = [v for n, lb, v in series
+                if n == "cubefs_slo_burn_rate" and lb.get("path") == path]
+        budget = [v for n, lb, v in series
+                  if n == "cubefs_slo_error_budget_remaining"
+                  and lb.get("path") == path]
+        total = sum(v for n, lb, v in series
+                    if n == "cubefs_request_stage_seconds_count"
+                    and lb.get("path") == path and lb.get("stage") == "total")
+        view[path] = {
+            "latency_ms": {q: round(v * 1000, 3)
+                           for q, v in sorted(quantiles.items())},
+            "burn_rate": burn[0] if burn else None,
+            "budget_remaining": budget[0] if budget else None,
+            "requests": total,
+        }
+    slow = {lb.get("path", ""): v for n, lb, v in series
+            if n == "cubefs_slow_traces_total"}
+    if slow:
+        view["slow_traces"] = slow
+    return view
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="cubefs-tpu-cli")
     sub = ap.add_subparsers(dest="group", required=True)
@@ -335,9 +381,21 @@ def main(argv=None):
 
     p_metrics = sub.add_parser("metrics")  # node observability views
     p_metrics.add_argument("action",
-                           choices=["write-path", "codec", "repair", "raw"])
+                           choices=["write-path", "codec", "repair", "slo",
+                                    "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
+
+    p_trace = sub.add_parser("trace")  # distributed-trace forensics
+    p_trace.add_argument("action", choices=["show", "slow", "list"])
+    p_trace.add_argument("trace_id", nargs="?",
+                         help="trace id (for show)")
+    p_trace.add_argument("--addr", required=True,
+                         help="any node's RPC addr (serves /traces)")
+    p_trace.add_argument("--top", type=int, default=10,
+                         help="worst-N slow roots (for slow)")
+    p_trace.add_argument("--json", action="store_true",
+                         help="raw JSON instead of the rendered tree")
 
     p_auth = sub.add_parser("auth")
     p_auth.add_argument("action", choices=["register", "ticket"])
@@ -605,8 +663,36 @@ def main(argv=None):
             print(json.dumps(_codec_view(text), indent=2))
         elif args.action == "repair":
             print(json.dumps(_repair_view(text), indent=2))
+        elif args.action == "slo":
+            print(json.dumps(_slo_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
+
+    elif args.group == "trace":
+        if args.action == "show":
+            if not args.trace_id:
+                sys.exit("trace show needs a trace_id")
+            out = _fetch_json(args.addr, f"/traces?trace_id={args.trace_id}")
+            if args.json:
+                print(json.dumps(out, indent=2))
+            else:
+                print(f"trace {out['trace_id']}")
+                print(out.get("render") or "(no spans collected)")
+        elif args.action == "slow":
+            out = _fetch_json(args.addr, f"/traces?top={args.top}")
+            slow = out.get("slow", [])
+            if args.json:
+                print(json.dumps(slow, indent=2))
+            else:
+                for rec in slow:
+                    print(f"{rec['duration_ms']:>10.2f}ms  "
+                          f"{rec['path']:<14} {rec['trace_id']}  "
+                          f"{rec.get('stages', '')}")
+                if not slow:
+                    print("(no slow traces captured; set CUBEFS_SLOW_MS)")
+        else:  # list
+            out = _fetch_json(args.addr, "/traces")
+            print(json.dumps(out.get("trace_ids", []), indent=2))
 
     elif args.group == "auth":
         import base64

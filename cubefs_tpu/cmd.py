@@ -25,13 +25,19 @@ import threading
 import time
 
 
-def _serve(routes, cfg):
-    from .utils import rpc
+def _audit_for(cfg):
     from .utils.auditlog import AuditLogger
 
-    audit = None
     if cfg.get("audit_dir"):
-        audit = AuditLogger(f"{cfg['audit_dir']}/{cfg['role']}.audit.log")
+        return AuditLogger(f"{cfg['audit_dir']}/{cfg['role']}.audit.log")
+    return None
+
+
+def _serve(routes, cfg, audit=None):
+    from .utils import rpc
+
+    if audit is None:
+        audit = _audit_for(cfg)
     srv = rpc.RpcServer(
         routes, host=cfg.get("listen_host", "127.0.0.1"),
         port=int(cfg.get("listen_port", 0)),
@@ -77,11 +83,14 @@ def run_role(cfg: dict):
 
         svc = MetaNode(int(cfg.get("node_id", 0)), data_dir=cfg.get("data_dir"),
                        node_pool=pool)
-        srv = _serve(svc, cfg)  # live routing: per-partition raft handlers
+        audit = _audit_for(cfg)
+        srv = _serve(svc, cfg, audit=audit)  # live routing: per-partition raft handlers
         svc.addr = srv.addr
         # the binary meta plane (manager_op.go analog) listens beside HTTP
+        # and shares the HTTP plane's audit log
         psrv = svc.serve_packets(host=cfg.get("listen_host", "127.0.0.1"),
-                                 port=int(cfg.get("packet_port", 0)))
+                                 port=int(cfg.get("packet_port", 0)),
+                                 audit=audit)
         print(f"[metanode] packet plane on {psrv.addr}", flush=True)
         # native C++ read plane (metaserve.cc) beside the Python planes
         raddr = svc.serve_native(host=cfg.get("listen_host", "127.0.0.1"),
@@ -111,11 +120,14 @@ def run_role(cfg: dict):
         svc = DataNode(int(cfg.get("node_id", 0)), cfg["data_dir"], "pending", pool,
                        qos=cfg.get("qos"),  # {"read_bps":..., "write_bps":...}
                        disks=cfg.get("disks"))  # multi-disk: list of dirs
-        srv = _serve(svc, cfg)  # live routing: per-dp raft handlers
+        audit = _audit_for(cfg)
+        srv = _serve(svc, cfg, audit=audit)  # live routing: per-dp raft handlers
         svc.addr = srv.addr
         # the binary packet plane (hot data path) listens beside HTTP
+        # and shares the HTTP plane's audit log
         psrv = svc.serve_packets(host=cfg.get("listen_host", "127.0.0.1"),
-                                 port=int(cfg.get("packet_port", 0)))
+                                 port=int(cfg.get("packet_port", 0)),
+                                 audit=audit)
         print(f"[datanode] packet plane on {psrv.addr}", flush=True)
         # native C++ read plane (dataserve.cc) beside the Python planes
         raddr = svc.serve_native(host=cfg.get("listen_host", "127.0.0.1"),

@@ -50,6 +50,7 @@ import time
 import numpy as np
 
 from ..utils import metrics
+from ..utils import trace as tracelib
 from .engine import Engine, _call_with_fallback, engine_for, get_engine
 
 
@@ -77,7 +78,7 @@ class CodecFuture:
     layer's hottest per-submission costs)."""
 
     __slots__ = ("arr", "stripes", "value", "exc", "done", "event",
-                 "enq_t", "_batcher", "_key")
+                 "enq_t", "ref", "_batcher", "_key")
 
     def __init__(self, batcher: "BatchCodec", key: tuple, arr: np.ndarray):
         self.arr = arr
@@ -87,6 +88,10 @@ class CodecFuture:
         self.done = False
         self.event: threading.Event | None = None
         self.enq_t = time.perf_counter()
+        # span handoff: the drainer runs in ONE submitter's context;
+        # every other submitter's span survives only through this ref,
+        # which the drain span records as a follows-from link
+        self.ref = tracelib.capture()
         self._batcher = batcher
         self._key = key
 
@@ -389,12 +394,22 @@ class BatchCodec:
         wait_now = time.perf_counter()
         metrics.codec_batch_wait.observe_many(
             [wait_now - sub.enq_t for sub in step], op=op)
-        try:
-            out = self._engine_call(key, coeff, arr)
-        except BaseException as e:  # fan the step's failure back
-            for sub in step:
-                sub.resolve(None, e)
-            return
+        # one drain-step span, follows-from every OTHER submitter's
+        # captured context (the drainer's own span is the parent)
+        span = tracelib.start_span(
+            "stage:codec_step",
+            links=[s.ref for s in step if s.ref is not None])
+        span.set_tag("stage", "codec_step").set_tag("op", op)
+        span.set_tag("stripes", n_stripes)
+        with span:
+            try:
+                out = self._engine_call(key, coeff, arr)
+            except BaseException as e:  # fan the step's failure back
+                for sub in step:
+                    sub.resolve(None, e)
+                return
+        tracelib.observe_stage("codec_step", span.path,
+                               time.perf_counter() - wait_now)
         metrics.codec_batch_stripes.observe(n_stripes, op=op)
         off = 0
         for sub in step:  # resolve inlined: this is the hottest loop

@@ -18,6 +18,7 @@ import uuid
 from ..utils import metrics as _metrics
 from ..utils import packet as pkt
 from ..utils import rpc
+from ..utils import trace as tracelib
 from . import metanode as mn
 
 
@@ -48,7 +49,8 @@ class _FanoutWaiter:
     """One submit parked in the client's cross-partition coalescer.
     Doubles as the async handle submit_async returns."""
 
-    __slots__ = ("record", "result", "exc", "done", "event")
+    __slots__ = ("record", "result", "exc", "done", "event", "ref",
+                 "enq_t")
 
     def __init__(self, record: dict):
         self.record = record
@@ -56,6 +58,10 @@ class _FanoutWaiter:
         self.exc: BaseException | None = None
         self.done = False
         self.event = threading.Event()
+        # span handoff across the first-caller-drains boundary: the
+        # drain span links back to every submitter through this ref
+        self.ref = tracelib.capture()
+        self.enq_t = time.perf_counter()
 
     def finish(self, result, exc: BaseException | None) -> None:
         self.result = result
@@ -165,6 +171,31 @@ class SubmitFanout:
     def _land(self, mp: dict, batch: list[_FanoutWaiter]) -> None:
         pid = mp["pid"]
         self._gate.acquire()  # at most K partitions' batches in flight
+        t0 = time.perf_counter()
+        tracelib.observe_stage("fanout_queue_wait", "meta.write",
+                               [t0 - w.enq_t for w in batch])
+        links = [w.ref for w in batch if w.ref is not None]
+        cur = tracelib.current()
+        if cur is None and links:
+            # async drains run on pool threads with no context: adopt
+            # the first submitter as parent so the drain still stitches
+            first = links[0]
+            span = tracelib.Span(
+                "stage:fanout_drain", first.trace_id, first.span_id,
+                sampled=first.sampled, path=first.path)
+            for ref in links[1:]:
+                span.link(ref)
+        else:
+            span = tracelib.start_span("stage:fanout_drain", links=links)
+        span.set_tag("stage", "fanout_drain").set_tag("pid", pid)
+        span.set_tag("ops", len(batch))
+        with span:
+            self._land_wire(mp, batch)
+        tracelib.observe_stage("fanout_drain", span.path or "meta.write",
+                               time.perf_counter() - t0)
+
+    def _land_wire(self, mp: dict, batch: list[_FanoutWaiter]) -> None:
+        pid = mp["pid"]
         try:
             if len(batch) == 1:
                 # uncontended fast path: plain submit, no batch envelope
@@ -235,8 +266,15 @@ class MetaWrapper:
         partition fan-out coalescer when it's enabled (CUBEFS_META_FANOUT
         > 0) so concurrent mutations against one partition share a
         submit_batch RPC; everything else goes straight to the wire."""
-        if method == "submit" and self.fanout is not None:
-            return {"result": self.fanout.submit(mp, args["record"])}, b""
+        if method in ("submit", "submit_batch"):
+            # client hop of the meta write path: the root span a
+            # stitched client -> metanode -> raft trace hangs from
+            with tracelib.path_span("meta.write", f"client.{method}") as sp:
+                sp.set_tag("svc", "client").set_tag("pid", mp["pid"])
+                if method == "submit" and self.fanout is not None:
+                    return ({"result": self.fanout.submit(
+                        mp, args["record"])}, b"")
+                return self._call_wire(mp, method, args)
         return self._call_wire(mp, method, args)
 
     def _call_wire(self, mp: dict, method: str, args: dict):

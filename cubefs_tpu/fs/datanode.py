@@ -727,7 +727,7 @@ class DataNode:
     # onto the same write/read/repair logic, so both transports share
     # one consistency story (leader routing, raft overwrites, chain).
     def serve_packets(self, host: str = "127.0.0.1",
-                      port: int = 0) -> "packet.PacketServer":
+                      port: int = 0, audit=None) -> "packet.PacketServer":
         from ..utils import packet
 
         def op_write(hdr, args, payload):
@@ -768,7 +768,7 @@ class DataNode:
             packet.OP_FINGERPRINT: op_fingerprint,
             packet.OP_ALLOC_EXTENT: op_alloc,
             packet.OP_PING: op_ping,
-        }, host=host, port=port).start()
+        }, host=host, port=port, service="datanode", audit=audit).start()
         self.packet_addr = srv.addr
         self._packet_srv = srv
         return srv

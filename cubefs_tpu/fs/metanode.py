@@ -19,6 +19,7 @@ import uuid
 import zlib
 
 from ..utils import rpc
+from ..utils import trace as tracelib
 
 ROOT_INO = 1
 
@@ -985,7 +986,7 @@ class MetaPartition:
 class _SubmitWaiter:
     """One rpc_submit call parked in a partition's submit coalescer."""
 
-    __slots__ = ("record", "result", "exc", "done", "event")
+    __slots__ = ("record", "result", "exc", "done", "event", "ref")
 
     def __init__(self, record: dict):
         self.record = record
@@ -993,6 +994,9 @@ class _SubmitWaiter:
         self.exc: BaseException | None = None
         self.done = False
         self.event = threading.Event()
+        # span handoff across the coalescer's first-caller-drains
+        # boundary (same contract as the raft _ProposeWaiter)
+        self.ref = tracelib.capture()
 
     def finish(self, result, exc: BaseException | None) -> None:
         self.result = result
@@ -1050,31 +1054,48 @@ class _SubmitBatcher:
         from ..utils import metrics
 
         raft_node = self.node.rafts.get(self.pid)
+        span = tracelib.start_span(
+            "stage:submit_coalesce",
+            links=[w.ref for w in batch if w.ref is not None])
+        span.set_tag("stage", "submit_coalesce").set_tag("pid", self.pid)
+        span.set_tag("ops", len(batch))
+        t0 = time.perf_counter()
         try:
-            if raft_node is None:
-                raise rpc.RpcError(
-                    404, f"meta partition {self.pid} no longer replicated "
-                         f"on node {self.node.node_id}")
-            metrics.meta_ops_per_batch.observe(len(batch), pid=self.pid)
-            if len(batch) == 1:
-                batch[0].finish(raft_node.propose(batch[0].record), None)
-                return
-            outs = raft_node.propose(
-                {"op": "__batch__",
-                 "records": [w.record for w in batch]})
-            metrics.meta_batch_entries.inc(pid=self.pid)
-            metrics.meta_batched_ops.inc(len(batch), pid=self.pid)
-            for w, (result, err) in zip(batch, outs):
-                if err is not None:
-                    w.finish(None, MetaError(err[0], err[1]))
-                else:
-                    w.finish(result, None)
-        except BaseException as e:
-            # batch-level failure (NotLeaderError, timeout, apply bug):
-            # every still-unresolved waiter observes the same outcome
-            for w in batch:
-                if not w.done:
-                    w.finish(None, e)
+            with span:
+                try:
+                    if raft_node is None:
+                        raise rpc.RpcError(
+                            404, f"meta partition {self.pid} no longer "
+                                 f"replicated on node {self.node.node_id}")
+                    metrics.meta_ops_per_batch.observe(len(batch),
+                                                       pid=self.pid)
+                    if len(batch) == 1:
+                        batch[0].finish(
+                            raft_node.propose(batch[0].record), None)
+                        return
+                    outs = raft_node.propose(
+                        {"op": "__batch__",
+                         "records": [w.record for w in batch]})
+                    metrics.meta_batch_entries.inc(pid=self.pid)
+                    metrics.meta_batched_ops.inc(len(batch), pid=self.pid)
+                    for w, (result, err) in zip(batch, outs):
+                        if err is not None:
+                            w.finish(None, MetaError(err[0], err[1]))
+                        else:
+                            w.finish(result, None)
+                except BaseException as e:
+                    # batch-level failure (NotLeaderError, timeout,
+                    # apply bug): every still-unresolved waiter
+                    # observes the same outcome
+                    for w in batch:
+                        if not w.done:
+                            w.finish(None, e)
+        finally:
+            # the early return above still lands here: the coalesce
+            # stage is observed for every drained batch
+            tracelib.observe_stage("submit_coalesce",
+                                   span.path or "meta.write",
+                                   time.perf_counter() - t0)
 
 
 class MetaNode:
@@ -1664,7 +1685,7 @@ class MetaNode:
     # methods, so both transports share one semantics (leader redirect,
     # errno encoding, idempotent submits).
     def serve_packets(self, host: str = "127.0.0.1",
-                      port: int = 0) -> "packet.PacketServer":
+                      port: int = 0, audit=None) -> "packet.PacketServer":
         from ..utils import packet
 
         def wrap(rpc_method):
@@ -1692,5 +1713,5 @@ class MetaNode:
             packet.OP_META_ALLOC_INO: wrap(self.rpc_alloc_ino),
             packet.OP_META_WALK: wrap(self.rpc_walk),
             packet.OP_PING: lambda hdr, a, p: ({}, b""),
-        }, host, port)
+        }, host, port, service="metanode", audit=audit)
         return srv.start()

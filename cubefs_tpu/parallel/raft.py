@@ -61,6 +61,7 @@ import time
 
 from ..utils import faultinject as _fi
 from ..utils import metrics as _metrics
+from ..utils import trace as _trace
 
 
 class NotLeaderError(Exception):
@@ -75,7 +76,8 @@ class _ProposeWaiter:
     drain (NotLeaderError), or stop() — then its private event fires:
     waiters never contend on a shared condition variable."""
 
-    __slots__ = ("entry", "index", "term", "result", "exc", "done", "event")
+    __slots__ = ("entry", "index", "term", "result", "exc", "done",
+                 "event", "ref", "enq_t")
 
     def __init__(self, entry: dict):
         self.entry = entry
@@ -85,6 +87,11 @@ class _ProposeWaiter:
         self.exc: BaseException | None = None
         self.done = False
         self.event = threading.Event()
+        # span handoff: the draining caller's context is the only one
+        # that survives into the batch — every other submitter's span
+        # reaches the drain span through this captured ref
+        self.ref = _trace.capture()
+        self.enq_t = time.perf_counter()
 
     def resolve(self, result, exc: BaseException | None) -> None:
         self.result = result
@@ -783,6 +790,11 @@ class RaftNode:
             if self.role != "leader":
                 raise NotLeaderError(self.leader)
         w = _ProposeWaiter(entry)
+        with _trace.stage("raft_propose"):
+            return self._propose_wait(w, timeout, wait_all)
+
+    def _propose_wait(self, w: _ProposeWaiter, timeout: float,
+                      wait_all: bool):
         if self._group_commit:
             with self._prop_mu:
                 self._prop_queue.append(w)
@@ -835,10 +847,23 @@ class RaftNode:
                     self._prop_busy = False
                     return
                 self._prop_queue = []
-            last = self._append_batch(batch)
-            if last:
-                self._wal_sync(last)
-                self._broadcast_append()
+            t0 = time.perf_counter()
+            _trace.observe_stage("propose_queue_wait", "meta.write",
+                                 [t0 - w.enq_t for w in batch])
+            span = _trace.start_span(
+                "stage:propose_drain",
+                links=[w.ref for w in batch if w.ref is not None])
+            span.set_tag("stage", "propose_drain")
+            span.set_tag("entries", len(batch))
+            with span:
+                last = self._append_batch(batch)
+                if last:
+                    with _trace.stage("group_fsync", path="meta.write"):
+                        self._wal_sync(last)
+                    self._broadcast_append()
+            _trace.observe_stage("propose_drain",
+                                 span.path or "meta.write",
+                                 time.perf_counter() - t0)
 
     def _append_batch(self, batch: list[_ProposeWaiter]) -> int:
         """Append every waiter's entry under ONE node-lock acquisition
@@ -1039,8 +1064,12 @@ class RaftNode:
         # the whole drained range is applied before ANY waiter wakes:
         # one event per waiter, no shared-cv thundering herd
         if resolved:
+            dt = time.perf_counter() - t0
             _metrics.raft_batch_apply_latency.observe(
-                time.perf_counter() - t0, group=self.group_id)
+                dt, group=self.group_id)
+            # apply runs with no request context (it serves submitters
+            # it cannot see), so the stage path is explicit
+            _trace.observe_stage("raft_apply", "meta.write", dt)
             for w in resolved:
                 w.event.set()
         self._apply_cv.notify_all()  # wait_all watchers track applied_index

@@ -324,11 +324,161 @@ def run_repair_ab(stripes: int = 96, k: int = 6, m: int = 6, d: int = 11,
     }
 
 
+def _blob_cluster(tmpdir: str, n_nodes: int = 4, disks_per_node: int = 3):
+    """Fresh in-process blob cluster (the test_blob_e2e shape) — one per
+    obs-tail leg, since the repair phase breaks a disk."""
+    from ..blob.access import AccessConfig, AccessHandler, NodePool
+    from ..blob.blobnode import BlobNode
+    from ..blob.clustermgr import ClusterMgr
+    from ..blob.mq import MessageQueue
+    from ..blob.scheduler import Scheduler
+    from ..blob.worker import RepairWorker
+    from ..utils import rpc
+
+    os.makedirs(tmpdir, exist_ok=True)
+    cm = ClusterMgr()
+    cm_client = rpc.Client(cm)
+    pool = NodePool()
+    nodes = []
+    for nn in range(n_nodes):
+        node = BlobNode(
+            node_id=nn,
+            disk_paths=[os.path.join(tmpdir, f"n{nn}d{d}")
+                        for d in range(disks_per_node)],
+            cm_client=cm_client, addr=f"node{nn}")
+        node.register()
+        node.send_heartbeat()
+        pool.bind(f"node{nn}", node)
+        nodes.append(node)
+    rq, dq = MessageQueue(), MessageQueue()
+    access = AccessHandler(cm_client, pool, AccessConfig(blob_size=64 << 10),
+                           repair_queue=rq, delete_queue=dq)
+    sched = Scheduler(cm, repair_queue=rq, delete_queue=dq, node_pool=pool)
+    worker = RepairWorker(rpc.Client(sched), cm_client, pool)
+    return cm, nodes, access, sched, worker
+
+
+def run_obs_tail(workdir: str, puts: int = 48, payload_kb: int = 256,
+                 rounds: int = 5) -> dict:
+    """Blob-plane observability A/B (the OBS_TAIL artifact's blob
+    section). The trace door is read per request, so the A/B
+    interleaves CUBEFS_TRACE=1 / =0 PUT+GET batches against ONE
+    cluster — per-cluster construction variance and host drift cancel
+    instead of landing on one leg. Reports per-batch medians, the
+    per-stage tails for blob.put / blob.get / blob.repair (repair runs
+    once, instrumented, at the end: it breaks a disk), and one
+    rendered example PUT trace."""
+    from ..codec import codemode as cmode
+    from ..utils import slo as slolib
+    from ..utils import trace as tracelib
+
+    saved = os.environ.get("CUBEFS_TRACE")
+    put_on: list[float] = []
+    put_off: list[float] = []
+    example = ""
+    try:
+        os.environ["CUBEFS_TRACE"] = "1"
+        cm, nodes, access, sched, worker = _blob_cluster(
+            os.path.join(workdir, "ab"))
+        rng = np.random.default_rng(0x0B5)
+        data = [rng.integers(0, 256, payload_kb << 10,
+                             dtype=np.uint8).tobytes()
+                for _ in range(puts)]
+        # warm up outside the timed batches: engine load, crossover
+        # table, volume allocation
+        warm = access.put(data[0], codemode=cmode.CodeMode.EC6P3)
+        assert access.get(warm) == data[0]
+        tracelib.reset_collector()
+        mib = puts * payload_kb / 1024.0
+        # ABBA pair ordering: a monotone drift (cache warming, log
+        # growth) would otherwise always tax the same leg
+        order: list[bool] = []
+        for i in range(rounds):
+            order += [True, False] if i % 2 == 0 else [False, True]
+        first_locs = None
+        for on in order:
+            os.environ["CUBEFS_TRACE"] = "1" if on else "0"
+            t0 = time.perf_counter()
+            locs = [access.put(d, codemode=cmode.CodeMode.EC6P3)
+                    for d in data]
+            pw = time.perf_counter() - t0
+            (put_on if on else put_off).append(round(mib / pw, 2))
+            if on and first_locs is None:
+                first_locs = locs
+        # correctness + blob.get stage tails, instrumented, outside
+        # the timed A/B (gets are read-path bound and would separate
+        # the paired batches)
+        os.environ["CUBEFS_TRACE"] = "1"
+        t0 = time.perf_counter()
+        ok = all(access.get(loc) == d
+                 for loc, d in zip(first_locs, data))
+        get_wall = time.perf_counter() - t0
+        roots = [s for s in tracelib.finished_spans()
+                 if s["op"] == "access.put" and s["parent_id"] is None]
+        if roots:
+            example = tracelib.render_tree(
+                tracelib.trace_tree(roots[0]["trace_id"]))
+        # one full disk repair, instrumented, so blob.repair stages
+        # land in the histogram (destructive: runs after the A/B)
+        vol = cm.get_volume(first_locs[0].slices[0].vid)
+        victim = vol.units[1]
+        next(n for n in nodes
+             if n.addr == victim.node_addr).break_disk(victim.disk_id)
+        sched.mark_disk_broken(victim.disk_id)
+        t0 = time.perf_counter()
+        # enough drains to fill the blob.repair stage histogram — a
+        # full-disk drain would dwarf the A/B (reads stay correct
+        # either way: one lost unit degrades, it doesn't fail)
+        for _ in range(64):
+            if not worker.run_once():
+                break
+        repair_wall = time.perf_counter() - t0
+        ok = ok and access.get(first_locs[0]) == data[0]
+        tails = slolib.quantiles_from_histogram()
+    finally:
+        if saved is None:
+            os.environ.pop("CUBEFS_TRACE", None)
+        else:
+            os.environ["CUBEFS_TRACE"] = saved
+    med_on, med_off = _median(put_on), _median(put_off)
+    # per-pair ratios: pair i contributed put_on[i] and put_off[i]
+    # back-to-back, so the store-growth drift that dominates absolute
+    # throughput cancels inside each pair
+    pair_overheads = [round((off_v / on_v - 1.0) * 100, 2)
+                      for on_v, off_v in zip(put_on, put_off)]
+    return {
+        "paths": ["blob.put", "blob.get", "blob.repair"],
+        "puts_per_batch": puts,
+        "payload_kb": payload_kb,
+        "batches_per_leg": rounds,
+        "interleaved": True,
+        "trace_on": {"median_put_mibs": med_on, "put_mibs": put_on},
+        "trace_off": {"median_put_mibs": med_off,
+                      "put_mibs": put_off},
+        "get_mibs": round(mib / get_wall, 2),
+        "overhead_pct": _median(pair_overheads),
+        "pair_overheads_pct": pair_overheads,
+        "repair_wall_s": round(repair_wall, 3),
+        "roundtrip_identical": bool(ok),
+        "stage_tails": {p: t for p, t in tails.items()
+                        if p.startswith("blob.")},
+        "example_trace": example,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="cubefs-tpu-bench-codec")
     ap.add_argument("--repair-ab", action="store_true",
                     help="run the MSR sub-shard vs conventional k-shard "
                          "repair-traffic A/B instead of the encode bench")
+    ap.add_argument("--obs-tail", action="store_true",
+                    help="blob-plane instrumentation overhead A/B "
+                         "(CUBEFS_TRACE=1 vs 0) + per-stage tails; "
+                         "merges into --out")
+    ap.add_argument("--puts", type=int, default=48,
+                    help="obs-tail: PUTs per round")
+    ap.add_argument("--payload-kb", type=int, default=256,
+                    help="obs-tail: payload size per PUT")
     ap.add_argument("--stripes", type=int, default=96,
                     help="repair-ab: stripes repaired per leg")
     ap.add_argument("--d", type=int, default=11,
@@ -351,6 +501,19 @@ def main(argv=None):
     ap.add_argument("--out", default=None,
                     help="write the artifact JSON here")
     args = ap.parse_args(argv)
+    if args.obs_tail:
+        import tempfile
+
+        from .bench_fs import merge_artifact
+
+        workdir = tempfile.mkdtemp(prefix="cubefs-bench-obscodec-")
+        result = run_obs_tail(workdir, puts=args.puts,
+                              payload_kb=args.payload_kb,
+                              rounds=args.rounds)
+        print(json.dumps(result, indent=1))
+        if args.out:
+            merge_artifact(args.out, "blob", result)
+        return
     if args.repair_ab:
         # repair-ab defaults to the EC6P6MSR production geometry; the
         # encode bench's 6+3/2048 defaults don't carry over

@@ -374,6 +374,12 @@ def write_ab(workdir: str, procs: int = 8, threads: int = 8,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+    from ..utils import slo as slolib
+
+    # per-stage write-path tails observed across both legs (the stage
+    # histogram is process-wide; the trace door defaults to open here)
+    out["stage_tails"] = slolib.quantiles_from_histogram().get(
+        "meta.write", {})
     cap_base = out["baseline_per_op"]["server_capacity"]["create_ops"]
     cap_gc = out["group_commit"]["server_capacity"]["create_ops"]
     dep_base = out["baseline_per_op"]["deployed"]["create_ops"]
@@ -610,6 +616,206 @@ def fsm_identity_check(workdir: str, n_parts: int = 4,
             "digests": digests}
 
 
+def _obs_digest_leg(workdir: str, n_parts: int = 2,
+                    records_per_part: int = 150) -> dict:
+    """Fixed mutation sequence (fixed op_ids/timestamps, serial order)
+    -> per-partition/replica sha256 of the FSM state, under whatever
+    CUBEFS_TRACE setting is active. Run once per door position: equal
+    digests prove spans never perturb the state machine."""
+    import hashlib
+
+    from ..fs.client import MetaWrapper
+
+    pool, nodes, mps = _mk_meta_cluster(workdir, n_parts, base_id=900)
+    wrapper = MetaWrapper({"mps": mps}, pool)
+    for mp in mps:
+        for i in range(records_per_part):
+            wrapper._call(mp, "submit", {"record": {
+                "op": "mknod", "parent": 1, "name": f"ob_{i}",
+                "type": "file" if i % 2 else "dir", "mode": 0o644,
+                "ts": 1000.0 + i, "op_id": f"obs-{mp['pid']}-{i}"}})
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        ids = {pid: {n.addr: n.partitions[pid].apply_id for n in nodes}
+               for pid in range(1, n_parts + 1)}
+        if all(len(set(v.values())) == 1 for v in ids.values()):
+            break
+        time.sleep(0.05)
+    digests = {str(pid): {n.addr: hashlib.sha256(
+        n.partitions[pid].state_bytes()).hexdigest() for n in nodes}
+        for pid in range(1, n_parts + 1)}
+    if wrapper.fanout is not None:
+        wrapper.fanout.close()
+    for node in nodes:
+        node.stop()
+    return digests
+
+
+def _obs_window(wrapper, mps, threads: int, secs: float,
+                tag: str) -> float:
+    """One timed create window against an already-running cluster
+    (names/op_ids namespaced by `tag` so windows never collide).
+    Returns creates/s."""
+    import threading as _th
+
+    n_parts = len(mps)
+    stop = time.perf_counter() + secs
+    counts = [0] * threads
+
+    def _rec(t, i):
+        return {"op": "mknod", "parent": 1, "name": f"{tag}_{t}_{i}",
+                "type": "file" if i % 2 else "dir", "mode": 0o644,
+                "ts": time.time(), "op_id": f"{tag}-{t}-{i}"}
+
+    def worker(t):
+        i = 0
+        if wrapper.fanout is not None:
+            window = max(32, (32 * n_parts) // threads)
+            while time.perf_counter() < stop:
+                ws = []
+                for _ in range(window):
+                    mp = mps[(t + i) % n_parts]
+                    ws.append(wrapper.fanout.submit_async(mp, _rec(t, i)))
+                    i += 1
+                for w in ws:
+                    w.wait()
+                counts[t] += window
+            return
+        while time.perf_counter() < stop:
+            mp = mps[(t + i) % n_parts]
+            wrapper._call(mp, "submit", {"record": _rec(t, i)})
+            i += 1
+            counts[t] += 1
+
+    t0 = time.perf_counter()
+    ths = [_th.Thread(target=worker, args=(t,)) for t in range(threads)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    return round(sum(counts) / (time.perf_counter() - t0), 1)
+
+
+def obs_tail(workdir: str, threads: int = 16, secs: float = 1.5,
+             rounds: int = 3, n_parts: int = 4) -> dict:
+    """Meta-write observability A/B (the OBS_TAIL artifact's meta
+    section). The trace door is read per request, so the A/B
+    interleaves CUBEFS_TRACE=1 / =0 create windows against ONE
+    cluster — construction variance and host drift cancel instead of
+    landing on one leg. Reports per-window medians, per-stage
+    p50/p95/p99/p999 from the shared stage histogram, one rendered
+    example trace tree, and the FSM-digest proof that the door changes
+    observability only, never state."""
+    import statistics
+
+    from ..fs.client import MetaWrapper
+    from ..utils import slo as slolib
+    from ..utils import trace as tracelib
+
+    on: list[float] = []
+    off: list[float] = []
+    example = ""
+    saved = os.environ.get("CUBEFS_TRACE")
+    try:
+        os.environ["CUBEFS_TRACE"] = "1"
+        pool, nodes, mps = _mk_meta_cluster(
+            os.path.join(workdir, "ab"), n_parts, base_id=940)
+        wrapper = MetaWrapper({"mps": mps}, pool)
+        try:
+            _obs_window(wrapper, mps, threads, 0.4, "warm")
+            tracelib.reset_collector()
+            # ABBA pair ordering so monotone drift cancels across legs
+            order: list[bool] = []
+            for i in range(rounds):
+                order += [True, False] if i % 2 == 0 else [False, True]
+            for b, is_on in enumerate(order):
+                os.environ["CUBEFS_TRACE"] = "1" if is_on else "0"
+                ops = _obs_window(wrapper, mps, threads, secs, f"b{b}")
+                (on if is_on else off).append(ops)
+            # example tree + submit_coalesce/raft_propose tails ride
+            # the per-op client path (the saturated windows drive the
+            # fan-out coalescer, whose drains root at the batcher)
+            os.environ["CUBEFS_TRACE"] = "1"
+            for i in range(8):
+                wrapper._call(mps[0], "submit", {"record": {
+                    "op": "mknod", "parent": 1, "name": f"ex_{i}",
+                    "type": "file", "mode": 0o644, "ts": 2000.0 + i,
+                    "op_id": f"obs-ex-{i}"}})
+            roots = [s for s in tracelib.finished_spans()
+                     if s["op"].startswith("client.submit")
+                     and s["parent_id"] is None]
+            if roots:
+                example = tracelib.render_tree(
+                    tracelib.trace_tree(roots[-1]["trace_id"]))
+        finally:
+            if wrapper.fanout is not None:
+                wrapper.fanout.close()
+            for node in nodes:
+                node.stop()
+        stage_tails = slolib.quantiles_from_histogram().get(
+            "meta.write", {})
+        os.environ["CUBEFS_TRACE"] = "1"
+        dig_on = _obs_digest_leg(os.path.join(workdir, "dig_on"))
+        os.environ["CUBEFS_TRACE"] = "0"
+        dig_off = _obs_digest_leg(os.path.join(workdir, "dig_off"))
+    finally:
+        if saved is None:
+            os.environ.pop("CUBEFS_TRACE", None)
+        else:
+            os.environ["CUBEFS_TRACE"] = saved
+    med_on = statistics.median(on)
+    med_off = statistics.median(off)
+    # per-pair ratios: window i of each leg ran back-to-back, so host
+    # drift cancels inside the pair instead of biasing one leg
+    pair_overheads = [round((off_v / on_v - 1.0) * 100, 2)
+                      for on_v, off_v in zip(on, off)]
+    replicas_agree = all(
+        len(set(d.values())) == 1
+        for leg in (dig_on, dig_off) for d in leg.values())
+    doors_agree = all(set(dig_on[pid].values())
+                      == set(dig_off[pid].values()) for pid in dig_on)
+    return {
+        "path": "meta.write",
+        "threads": threads,
+        "secs_per_window": secs,
+        "window_pairs": rounds,
+        "partitions": n_parts,
+        "interleaved": True,
+        "trace_on": {"median_create_ops": round(med_on, 1),
+                     "create_ops": on},
+        "trace_off": {"median_create_ops": round(med_off, 1),
+                      "create_ops": off},
+        "overhead_pct": statistics.median(pair_overheads)
+        if pair_overheads else None,
+        "pair_overheads_pct": pair_overheads,
+        "stage_tails": stage_tails,
+        "fsm_digests": {
+            "replicas_agree": replicas_agree,
+            "trace_door_agrees": doors_agree,
+            "bit_identical": replicas_agree and doors_agree,
+            "trace_on": dig_on,
+            "trace_off": dig_off,
+        },
+        "example_trace": example,
+    }
+
+
+def merge_artifact(path: str, section: str, data: dict) -> None:
+    """Read-merge-write one section of a shared artifact JSON, so
+    bench_fs and bench_codec can fill their halves independently."""
+    existing: dict = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            existing = {}
+    existing[section] = data
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        f.write(json.dumps(existing, indent=1) + "\n")
+
+
 def scale_partitions(workdir: str, parts=(1, 16, 64, 256),
                      threads: int = 128, secs: float = 1.5,
                      rounds: int = 3, fan_threads: int = 4) -> dict:
@@ -773,6 +979,10 @@ def main(argv=None):
     ap.add_argument("--cap-threads", type=int, default=384,
                     help="concurrent creates for the in-process "
                          "server-capacity leg")
+    ap.add_argument("--obs-tail", action="store_true",
+                    help="instrumentation overhead A/B (CUBEFS_TRACE=1 "
+                         "vs 0) + per-stage meta.write tails + FSM "
+                         "digest proof; merges into --out")
     ap.add_argument("--scale-partitions", action="store_true",
                     help="aggregate creates/s at 1..256 metapartitions: "
                          "pipelined replication + client fan-out vs the "
@@ -785,6 +995,14 @@ def main(argv=None):
     ap.add_argument("--out", help="also write the result JSON here")
     args = ap.parse_args(argv)
     metas = []
+    if args.obs_tail:
+        workdir = tempfile.mkdtemp(prefix="cubefs-bench-obs-")
+        res = obs_tail(workdir, threads=args.threads, secs=args.secs,
+                       rounds=args.rounds)
+        print(json.dumps(res, indent=1))
+        if args.out:
+            merge_artifact(args.out, "meta_write", res)
+        return
     if args.scale_partitions:
         workdir = tempfile.mkdtemp(prefix="cubefs-bench-scale-")
         res = scale_partitions(workdir, parts=tuple(args.parts),

@@ -36,6 +36,7 @@ import threading
 
 from . import metrics
 from . import rpc
+from . import trace as tracelib
 from .retry import Clock, MONOTONIC
 
 _NULL_CTX = contextlib.nullcontext()
@@ -148,16 +149,21 @@ class FaultPlan:
 
     def schedule_digest(self) -> str:
         """sha256 over the injected-fault log; equal across runs with
-        the same seed and call sequence (acceptance criterion)."""
+        the same seed and call sequence (acceptance criterion).  Only
+        the first five fields are hashed: field 5 is the active trace
+        id (forensics — which request ate this fault), and trace ids
+        are random per run, so they must never perturb the digest."""
         h = hashlib.sha256()
         for entry in self.schedule():
-            h.update(repr(entry).encode())
+            h.update(repr(entry[:5]).encode())
         return h.hexdigest()
 
     # ---- decision engine ----
     def _log(self, kind: str, addr: str, method: str, index: int) -> None:
         # caller holds self._lock
-        self.log.append((len(self.log), kind, addr, method, index))
+        span = tracelib.current()
+        tid = span.trace_id if span is not None else ""
+        self.log.append((len(self.log), kind, addr, method, index, tid))
         metrics.faults_injected.inc(kind=kind)
 
     def _check_partition(self, addr: str, method: str) -> None:

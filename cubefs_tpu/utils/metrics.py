@@ -318,3 +318,40 @@ repair_msr_fallbacks = DEFAULT.counter(
     "cubefs_repair_msr_fallback_total",
     "MSR repairs that fell back to the conventional k-shard decode",
     ("reason",))
+
+# end-to-end request observability (utils/trace.py + utils/slo.py):
+# one shared per-stage histogram across every instrumented hot path,
+# plus the SLO tail estimator's exported gauges. `path` is the request
+# family (blob.put, blob.get, blob.repair, meta.write); `stage` is the
+# hop inside it (encode_admission, quorum_write, group_fsync, ...).
+request_stage_seconds = DEFAULT.histogram(
+    "cubefs_request_stage_seconds",
+    "per-stage latency of instrumented hot-path requests",
+    ("path", "stage"),
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60))
+slo_latency_quantile = DEFAULT.gauge(
+    "cubefs_slo_latency_quantile_seconds",
+    "sliding-window latency quantile estimate per instrumented path",
+    ("path", "quantile"))
+slo_burn_rate = DEFAULT.gauge(
+    "cubefs_slo_burn_rate",
+    "error-budget burn rate per path: fraction of windowed requests "
+    "over the SLO target divided by the budget (1-objective); 1.0 "
+    "burns the budget exactly at the objective rate",
+    ("path",))
+slo_budget_remaining = DEFAULT.gauge(
+    "cubefs_slo_error_budget_remaining",
+    "fraction of the window's error budget still unspent (1 = no "
+    "violations, 0 = budget exhausted)",
+    ("path",))
+trace_spans_total = DEFAULT.counter(
+    "cubefs_trace_spans_total",
+    "spans finished into the in-memory collector")
+trace_evictions = DEFAULT.counter(
+    "cubefs_trace_evictions_total",
+    "whole traces evicted from the collector (oldest-root-first)")
+slow_traces = DEFAULT.counter(
+    "cubefs_slow_traces_total",
+    "root spans that exceeded CUBEFS_SLOW_MS and were captured to the "
+    "slow-trace forensics log", ("path",))

@@ -61,6 +61,26 @@ OP_META_WALK = 0x26
 RESULT_OK = 0
 RESULT_RPC = 0xE1  # structured rpc error: code+message ride the args
 
+# span/audit naming for the binary plane (the header has no method
+# string, only an opcode)
+OP_NAMES = {
+    OP_WRITE: "write", OP_READ: "read",
+    OP_WRITE_REPLICA: "write_replica", OP_FINGERPRINT: "fingerprint",
+    OP_ALLOC_EXTENT: "alloc_extent", OP_PING: "ping",
+    OP_META_LOOKUP: "meta_lookup", OP_META_INODE_GET: "meta_inode_get",
+    OP_META_READDIR: "meta_readdir", OP_META_SUBMIT: "meta_submit",
+    OP_META_DENTRY_COUNT: "meta_dentry_count",
+    OP_META_ALLOC_INO: "meta_alloc_ino", OP_META_WALK: "meta_walk",
+}
+
+
+def op_name(opcode: int) -> str:
+    return OP_NAMES.get(opcode, f"op{opcode:#x}")
+
+# reserved args key carrying the trace header across the binary wire
+# (the 64-byte header has no spare string field; args is the envelope)
+TRACE_ARG = "_trace"
+
 
 class PacketError(Exception):
     """`code` carries a full rpc status (421 redirect, 499 errno=...)
@@ -119,8 +139,10 @@ class PacketServer:
     exception returns 0xEF."""
 
     def __init__(self, handlers: dict, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, service: str = "packet", audit=None):
         self.handlers = handlers
+        self.service = service
+        self.audit = audit  # AuditLogger or None
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -151,6 +173,49 @@ class PacketServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
+    def _dispatch(self, fn, hdr: dict, args: dict, payload: bytes) -> bytes:
+        """One handler call: joins the caller's trace (the header rides a
+        reserved args key), times it, and audits it — the binary plane
+        gets the same observability discipline as the HTTP plane."""
+        import time as _time
+
+        from . import metrics, trace as tracelib
+
+        name = op_name(hdr["opcode"])
+        span = tracelib.from_header(f"{self.service}.{name}",
+                                    args.pop(TRACE_ARG, None))
+        t0 = _time.perf_counter()
+        code = 200
+        try:
+            with span:
+                args_out, payload_out = fn(hdr, args, payload)
+            reply = pack(hdr["opcode"], req_id=hdr["req_id"],
+                         args=args_out, payload=payload_out)
+        except PacketError as e:
+            code = e.code if e.code is not None else e.result
+            err_args = {"error": e.message or str(e)}
+            if e.code is not None:
+                err_args["code"] = e.code
+            reply = pack(hdr["opcode"], req_id=hdr["req_id"],
+                         result=e.result, args=err_args)
+        except Exception as e:  # handler bug: surface, don't die
+            code = 500
+            reply = pack(hdr["opcode"], req_id=hdr["req_id"],
+                         result=0xEF,
+                         args={"error": f"{type(e).__name__}: {e}"})
+        finally:
+            dt = _time.perf_counter() - t0
+            metrics.rpc_requests.inc(method=f"pkt_{name}", code=code)
+            metrics.rpc_latency.observe(dt, method=f"pkt_{name}")
+            if self.audit is not None:
+                detail = ""
+                slow_ms = tracelib.slow_threshold_ms()
+                if slow_ms > 0 and dt * 1000.0 >= slow_ms:
+                    detail = tracelib.stage_summary(span.trace_id)
+                self.audit.record(self.service, f"pkt_{name}", code, dt,
+                                  trace_id=span.trace_id, detail=detail)
+        return reply
+
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while not self._stop.is_set():
@@ -169,20 +234,7 @@ class PacketServer:
                                  result=0xFD,
                                  args={"error": f"no opcode {hdr['opcode']:#x}"})
                 else:
-                    try:
-                        args_out, payload_out = fn(hdr, args, payload)
-                        reply = pack(hdr["opcode"], req_id=hdr["req_id"],
-                                     args=args_out, payload=payload_out)
-                    except PacketError as e:
-                        err_args = {"error": e.message or str(e)}
-                        if e.code is not None:
-                            err_args["code"] = e.code
-                        reply = pack(hdr["opcode"], req_id=hdr["req_id"],
-                                     result=e.result, args=err_args)
-                    except Exception as e:  # handler bug: surface, don't die
-                        reply = pack(hdr["opcode"], req_id=hdr["req_id"],
-                                     result=0xEF,
-                                     args={"error": f"{type(e).__name__}: {e}"})
+                    reply = self._dispatch(fn, hdr, args, payload)
                 try:
                     conn.sendall(reply)
                 except OSError:
@@ -291,6 +343,14 @@ class PacketClient:
         with self._req_lock:
             self._req_id += 1
             req_id = self._req_id
+        from . import trace as tracelib
+
+        cur = tracelib.current()
+        if cur is not None:
+            # propagate the active span across the binary wire so the
+            # server-side handler joins this trace (X-Trace analog)
+            args = dict(args or {})
+            args[TRACE_ARG] = cur.header()
         frame = pack(opcode, partition=partition, extent=extent,
                      offset=offset, req_id=req_id, args=args,
                      payload=payload)

@@ -95,6 +95,11 @@ class RpcServer:
             self.routes = {}
         self.service = service
         self.audit = audit  # AuditLogger or None
+        if audit is not None and getattr(audit, "path", None):
+            from . import trace as _tracelib
+
+            _tracelib.configure_slow_log(os.path.join(
+                os.path.dirname(audit.path) or ".", "slowtrace.jsonl"))
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -111,6 +116,9 @@ class RpcServer:
 
                 parts = urlsplit(self.path)
                 if parts.path == "/metrics":
+                    from . import slo
+
+                    slo.refresh()
                     body = metrics.DEFAULT.render_text().encode()
                     self._reply_raw(200, body, "text/plain; version=0.0.4")
                 elif parts.path == "/spans":
@@ -118,6 +126,24 @@ class RpcServer:
                     tid = (q.get("trace_id") or [None])[0]
                     body = json.dumps(tracelib.finished_spans(tid)).encode()
                     self._reply_raw(200, body, "application/json")
+                elif parts.path == "/traces":
+                    q = parse_qs(parts.query)
+                    tid = (q.get("trace_id") or [None])[0]
+                    if tid:
+                        tree = tracelib.trace_tree(tid)
+                        out = {
+                            "trace_id": tid,
+                            "tree": tree,
+                            "render": tracelib.render_tree(tree),
+                        }
+                    else:
+                        top = int((q.get("top") or ["10"])[0])
+                        out = {
+                            "trace_ids": tracelib.known_trace_ids(),
+                            "slow": tracelib.slow_traces(top=top),
+                        }
+                    self._reply_raw(200, json.dumps(out).encode(),
+                                    "application/json")
                 else:
                     self._reply_raw(404, b"not found", "text/plain")
 
@@ -174,8 +200,13 @@ class RpcServer:
                     metrics.rpc_requests.inc(method=name, code=code)
                     metrics.rpc_latency.observe(dt, method=name)
                     if outer.audit is not None:
+                        detail = ""
+                        slow_ms = tracelib.slow_threshold_ms()
+                        if slow_ms > 0 and dt * 1000.0 >= slow_ms:
+                            detail = tracelib.stage_summary(span.trace_id)
                         outer.audit.record(outer.service, name, code, dt,
-                                           trace_id=span.trace_id)
+                                           trace_id=span.trace_id,
+                                           detail=detail)
 
             def _reply(self, code: int, meta: dict, payload: bytes):
                 self.send_response(code)

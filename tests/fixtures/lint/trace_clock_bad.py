@@ -1,0 +1,17 @@
+"""Fixture: CFT006 true positives (naked wall-clock in span timing)."""
+
+import time
+import time as _t
+from time import time as now
+
+
+def span_start():
+    return time.time()  # CFT006: aliasless module call
+
+
+def span_end():
+    return _t.time()  # CFT006: aliased module call
+
+
+def stage_mark():
+    return now()  # CFT006: from-import alias

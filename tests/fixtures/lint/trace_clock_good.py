@@ -1,0 +1,17 @@
+"""Fixture: CFT006 true negatives (sanctioned clocks only)."""
+
+import time
+
+from cubefs_tpu.utils.retry import MONOTONIC
+
+
+def span_start(clock=MONOTONIC):
+    return clock.now()
+
+
+def stage_duration(t0):
+    return time.perf_counter() - t0
+
+
+def ring_roll():
+    return time.monotonic()

@@ -26,7 +26,8 @@ from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
 from tool.lint.checkers.rpc_idempotency import (RpcIdempotencyChecker,
                                                 is_mutating)
 from tool.lint.checkers.tier1_purity import Tier1PurityChecker
-from tool.lint.checkers.tracer_safety import TracerSafetyChecker
+from tool.lint.checkers.tracer_safety import (TraceClockChecker,
+                                              TracerSafetyChecker)
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
 
@@ -59,6 +60,28 @@ def test_tracer_safety_scoped_to_accel_dirs():
     c = TracerSafetyChecker()
     assert c.applies("cubefs_tpu/ops/pallas_gf.py")
     assert not c.applies("cubefs_tpu/fs/master.py")
+
+
+# ---------------- trace-clock (CFT006) ----------------
+
+def test_trace_clock_true_positives():
+    mod = _module("trace_clock_bad.py", "cubefs_tpu/utils/trace.py")
+    found = TraceClockChecker().check(mod)
+    assert _codes(found) == ["CFT006", "CFT006", "CFT006"]
+
+
+def test_trace_clock_true_negative():
+    mod = _module("trace_clock_good.py", "cubefs_tpu/utils/trace.py")
+    assert TraceClockChecker().check(mod) == []
+
+
+def test_trace_clock_scoped_to_instrumented_modules():
+    c = TraceClockChecker()
+    assert c.applies("cubefs_tpu/utils/trace.py")
+    assert c.applies("cubefs_tpu/blob/access.py")
+    # wall-clock ts fields (mtime/ctime) are legitimate in the meta layer
+    assert not c.applies("cubefs_tpu/fs/metanode.py")
+    assert not c.applies("cubefs_tpu/fs/client.py")
 
 
 # ---------------- lock-discipline ----------------

@@ -7,10 +7,11 @@ from .placement_discipline import PlacementDisciplineChecker
 from .retry_discipline import RetryDisciplineChecker
 from .rpc_idempotency import RpcIdempotencyChecker
 from .tier1_purity import Tier1PurityChecker
-from .tracer_safety import TracerSafetyChecker
+from .tracer_safety import TraceClockChecker, TracerSafetyChecker
 
 ALL_CHECKERS = (
     TracerSafetyChecker,
+    TraceClockChecker,
     LockDisciplineChecker,
     RpcIdempotencyChecker,
     RetryDisciplineChecker,

@@ -35,7 +35,9 @@ from ..core import Checker, Module, Violation
 _PROPOSE_SANCTUMS = {"_land", "_submit_local", "rpc_submit",
                      "rpc_submit_batch"}
 # enclosing functions allowed to dial the wire layer directly
-_WIRE_SANCTUMS = {"_call", "_call_wire", "_land"}
+# (_land_wire is the fan-out lander's wire half, split from _land so the
+# drain span can wrap exactly the wire leg)
+_WIRE_SANCTUMS = {"_call", "_call_wire", "_land", "_land_wire"}
 
 
 class FanoutDisciplineChecker(Checker):

@@ -18,6 +18,14 @@ A coercion is only flagged when its argument expression mentions a
 non-static parameter of the traced function (values derived from
 closure constants or static args are concrete and fine — see
 ops/pallas_gf.py's `w_np` closure idiom).
+
+The family also covers the *distributed* tracer (`TraceClockChecker`):
+
+  CFT006  naked time.time() in an instrumented hot-path module — span
+          timing and the SLO sliding window ride the injectable clock
+          (trace.set_clock / utils.retry.Clock) or time.perf_counter();
+          wall-clock reads there make FakeClock-driven timing tests
+          nondeterministic
 """
 
 from __future__ import annotations
@@ -186,4 +194,54 @@ class TracerSafetyChecker(Checker):
                     f"unhashable default ({type(d).__name__.lower()}); "
                     f"jit's static-argument hashing will raise on every "
                     f"call that uses the default"))
+        return out
+
+
+class TraceClockChecker(Checker):
+    """CFT006: no naked wall-clock reads in span-instrumented modules.
+
+    These modules time spans, stages, and SLO windows; tests drive them
+    with FakeClock (utils/retry.py) and seeded ids for byte-identical
+    traces. A time.time() slipping in reintroduces wall-clock jitter —
+    durations must come from the injected clock or time.perf_counter(),
+    and wall timestamps (audit `ts` fields etc.) belong to the
+    un-instrumented layers."""
+
+    rule = "trace-clock"
+    # exact instrumented hot-path modules, not whole dirs: fs/client.py
+    # and fs/metanode.py legitimately stamp wall-clock mtime/ctime `ts`
+    # fields, so the fence covers only the span/timing substrate and
+    # the four hot paths' span-heavy modules
+    dirs = (
+        "cubefs_tpu/utils/trace.py",
+        "cubefs_tpu/utils/slo.py",
+        "cubefs_tpu/utils/metrics.py",
+        "cubefs_tpu/codec/batcher.py",
+        "cubefs_tpu/parallel/raft.py",
+        "cubefs_tpu/blob/access.py",
+        "cubefs_tpu/blob/worker.py",
+    )
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+        # names resolving to the time module ("import time [as t]")
+        time_mods = {alias for alias, full in mod.import_aliases.items()
+                     if full == "time"}
+        # names resolving to the function ("from time import time [as t]")
+        bare = {name for name, full in mod.from_imports.items()
+                if full == "time.time"}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if ((isinstance(f, ast.Attribute) and f.attr == "time"
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id in time_mods)
+                    or (isinstance(f, ast.Name) and f.id in bare)):
+                out.append(self.violation(
+                    mod, "CFT006", node,
+                    "naked time.time() in an instrumented hot path; use "
+                    "the injectable clock (trace.set_clock / "
+                    "utils.retry.Clock) or time.perf_counter() so "
+                    "FakeClock timing tests stay deterministic"))
         return out
