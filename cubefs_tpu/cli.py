@@ -226,6 +226,43 @@ def _repair_view(text: str) -> dict:
     }
 
 
+def _read_path_view(text: str) -> dict:
+    """The hot-read-tier digest: is the flash cache actually absorbing
+    reads, are serves staying AZ-local, and is admission / singleflight
+    / invalidation behaving on this node?"""
+    series = _parse_metrics(text)
+
+    def total(name, **match):
+        return sum(v for n, lb, v in series if n == name
+                   and all(lb.get(k) == str(w) for k, w in match.items()))
+
+    hits = total("cubefs_flashcache_ops_total", result="hit")
+    misses = total("cubefs_flashcache_ops_total", result="miss")
+    az_local = total("cubefs_readcache_serves_total", scope="az_local")
+    cross_az = total("cubefs_readcache_serves_total", scope="cross_az")
+    serves = az_local + cross_az
+    return {
+        "lookups": {
+            "hits": hits,
+            "misses": misses,
+            "hit_ratio": round(hits / (hits + misses), 4)
+            if hits + misses else None,
+        },
+        "serves": {
+            "az_local": az_local,
+            "cross_az": cross_az,
+            "az_local_fraction":
+                round(az_local / serves, 4) if serves else None,
+        },
+        "fills": {lb.get("outcome", ""): v for n, lb, v in series
+                  if n == "cubefs_readcache_fills_total"},
+        "singleflight_collapses":
+            total("cubefs_readcache_singleflight_total"),
+        "invalidated_blocks":
+            total("cubefs_readcache_invalidations_total"),
+    }
+
+
 def _slo_view(text: str) -> dict:
     """The tail-latency digest: per-path quantiles from the sliding
     window, SLO burn rate, and remaining error budget (scraping
@@ -372,8 +409,9 @@ def main(argv=None):
     p_flash.add_argument("--status", help="group status (set-status)")
 
     p_topo = sub.add_parser("topology")  # failure-domain views
-    p_topo.add_argument("action", choices=["fs", "blob", "rebalance"])
-    p_topo.add_argument("--master", help="fs master addr (fs)")
+    p_topo.add_argument("action", choices=["fs", "blob", "rebalance",
+                                           "tree"])
+    p_topo.add_argument("--master", help="fs master addr (fs/tree)")
     p_topo.add_argument("--clustermgr", help="clustermgr addr (blob)")
     p_topo.add_argument("--scheduler", help="scheduler addr (rebalance)")
     p_topo.add_argument("--max-moves", type=int,
@@ -382,7 +420,7 @@ def main(argv=None):
     p_metrics = sub.add_parser("metrics")  # node observability views
     p_metrics.add_argument("action",
                            choices=["write-path", "codec", "repair", "slo",
-                                    "raw"])
+                                    "read-path", "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
 
@@ -644,6 +682,12 @@ def main(argv=None):
             if not args.master:
                 sys.exit("topology fs needs --master")
             out = rpc.call(args.master, "topology_view")[0]
+        elif args.action == "tree":
+            # az -> rack -> node map of the fs plane, with the
+            # misplaced-replica gauge the sweep drives to zero
+            if not args.master:
+                sys.exit("topology tree needs --master")
+            out = rpc.call(args.master, "topology_tree")[0]
         elif args.action == "blob":
             if not args.clustermgr:
                 sys.exit("topology blob needs --clustermgr")
@@ -665,6 +709,8 @@ def main(argv=None):
             print(json.dumps(_repair_view(text), indent=2))
         elif args.action == "slo":
             print(json.dumps(_slo_view(text), indent=2))
+        elif args.action == "read-path":
+            print(json.dumps(_read_path_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
 

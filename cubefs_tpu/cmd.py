@@ -9,7 +9,7 @@ plane, and blocks. Heartbeat loops run in daemon threads.
 Config keys (JSON):
   role:        master | metanode | datanode | objectnode | fuseclient |
                clustermgr | blobnode | access | proxy | scheduler | codec |
-               fsgateway | console
+               fsgateway | console | flashnode | flashgroupmanager
   listen_host / listen_port: bind address (port 0 = ephemeral)
   master_addr / clustermgr_addr / scheduler_addr: upstreams
   data_dirs / data_dir: storage paths
@@ -99,11 +99,14 @@ def run_role(cfg: dict):
             print(f"[metanode] native read plane on {raddr}", flush=True)
         master = rpc.Client(cfg["master_addr"])
         zone = cfg.get("zone", "default")
+        rack = cfg.get("rack")
         master.call("register", {"kind": "meta", "addr": srv.addr,
-                                 "zone": zone, "packet_addr": psrv.addr,
+                                 "zone": zone, "rack": rack,
+                                 "packet_addr": psrv.addr,
                                  "read_addr": raddr})
         _heartbeat_loop(lambda: master.call(
             "heartbeat", {"kind": "meta", "addr": srv.addr, "zone": zone,
+                          "rack": rack,
                           "packet_addr": psrv.addr, "read_addr": raddr}))
 
         def _dp_view():
@@ -136,17 +139,40 @@ def run_role(cfg: dict):
             print(f"[datanode] native read plane on {raddr}", flush=True)
         master = rpc.Client(cfg["master_addr"])
         zone = cfg.get("zone", "default")
+        rack = cfg.get("rack")
         master.call("register", {"kind": "data", "addr": srv.addr,
-                                 "zone": zone, "packet_addr": psrv.addr,
+                                 "zone": zone, "rack": rack,
+                                 "packet_addr": psrv.addr,
                                  "read_addr": raddr,
                                  "disks": svc.disk_report()})
         # heartbeats carry the disk report: the master's disk manager
         # migrates partitions off any disk reported broken
         _heartbeat_loop(lambda: master.call(
             "heartbeat", {"kind": "data", "addr": srv.addr, "zone": zone,
+                          "rack": rack,
                           "packet_addr": psrv.addr, "read_addr": raddr,
                           "disks": svc.disk_report()}))
         return srv, svc
+
+    if role == "flashnode":
+        from .fs.remotecache import FlashNode
+
+        svc = FlashNode(capacity_bytes=int(cfg.get("capacity_bytes",
+                                                   256 << 20)))
+        srv = _serve(svc, cfg)
+        if cfg.get("fgm_addr"):
+            fgm = rpc.Client(cfg["fgm_addr"])
+            _heartbeat_loop(lambda: fgm.call("flashnode_heartbeat",
+                                             {"addr": srv.addr}))
+        return srv, svc
+
+    if role == "flashgroupmanager":
+        from .fs.remotecache import FlashGroupManager
+
+        svc = FlashGroupManager(data_dir=cfg.get("data_dir"),
+                                me=cfg.get("me"), peers=cfg.get("peers"),
+                                node_pool=pool)
+        return _serve(svc, cfg), svc
 
     if role == "objectnode":
         from .fs.client import FileSystem

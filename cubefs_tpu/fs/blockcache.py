@@ -22,6 +22,10 @@ class BlockCache:
         self.spill_dir = spill_dir
         self._lock = threading.Lock()
         self._lru: OrderedDict[str, bytes | None] = OrderedDict()
+        # spilled entries hold None in the LRU; their payload size is
+        # tracked here so the capacity budget covers the spill dir too
+        # (a laptop-local cache dir must not grow without bound)
+        self._sizes: dict[str, int] = {}
         self._used = 0
         self.hits = 0
         self.misses = 0
@@ -38,10 +42,8 @@ class BlockCache:
                 data = self._lru[key]
                 self._lru.move_to_end(key)
                 if data is None and self.spill_dir:  # spilled entry
-                    try:
-                        data = open(self._path(key), "rb").read()
-                    except OSError:
-                        del self._lru[key]
+                    data = self._load_spilled(key)
+                    if data is None:
                         self.misses += 1
                         return None
                 self.hits += 1
@@ -49,15 +51,39 @@ class BlockCache:
             self.misses += 1
             return None
 
+    def _load_spilled(self, key: str) -> bytes | None:
+        """Read a spill file back, verifying the stored digest — a
+        truncated or bit-flipped file is dropped and reads as a miss,
+        never served as data. Caller holds the lock."""
+        try:
+            raw = open(self._path(key), "rb").read()
+            digest, data = raw[:20], raw[20:]
+            if hashlib.sha1(data).digest() != digest:
+                raise OSError("spill checksum mismatch")
+            return data
+        except OSError:
+            del self._lru[key]
+            self._used -= self._sizes.pop(key, 0)
+            try:
+                os.unlink(self._path(key))
+            except OSError:
+                pass
+            return None
+
     def put(self, key: str, data: bytes) -> None:
         with self._lock:
             old = self._lru.pop(key, None)
             if old:
                 self._used -= len(old)
+            elif key in self._sizes:
+                self._used -= self._sizes.pop(key)
             if self.spill_dir:
                 with open(self._path(key), "wb") as f:
+                    f.write(hashlib.sha1(data).digest())
                     f.write(data)
                 self._lru[key] = None  # present on disk
+                self._sizes[key] = len(data)
+                self._used += len(data)
             else:
                 self._lru[key] = data
                 self._used += len(data)
@@ -66,6 +92,7 @@ class BlockCache:
                 if evicted:
                     self._used -= len(evicted)
                 elif self.spill_dir:
+                    self._used -= self._sizes.pop(k, 0)
                     try:
                         os.unlink(self._path(k))
                     except OSError:
@@ -113,6 +140,13 @@ class CachingExtentClient:
                 v = self.cache._lru.pop(k)
                 if v:
                     self.cache._used -= len(v)
+                else:
+                    self.cache._used -= self.cache._sizes.pop(k, 0)
+                    if self.cache.spill_dir:
+                        try:
+                            os.unlink(self.cache._path(k))
+                        except OSError:
+                            pass
 
     def close_stream(self, ino: int) -> None:
         self.inner.close_stream(ino)

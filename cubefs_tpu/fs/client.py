@@ -960,12 +960,30 @@ class FileSystem:
 
     QUOTA_TTL = 30.0  # seconds between quota-table refreshes
 
-    def __init__(self, vol_view: dict, node_pool, master_addr: str | None = None):
+    def __init__(self, vol_view: dict, node_pool, master_addr: str | None = None,
+                 *, flash_fgm=None, client_az: str | None = None):
         self.meta = MetaWrapper(vol_view, node_pool)
         self.data = ExtentClient(vol_view, node_pool)
         self.vol_name = vol_view.get("name")
         self.nodes = node_pool
         self.master_addr = master_addr
+        # A/B door for the AZ-local hot-read tier: CUBEFS_READ_CACHE=1
+        # (plus a flash ring handle) routes reads through CachedReader;
+        # off (default) is byte-for-byte the plain ExtentClient path.
+        try:
+            rc = int(os.environ.get("CUBEFS_READ_CACHE", "0") or "0")
+        except ValueError:
+            rc = 0
+        self.read_cache = None
+        if rc > 0 and flash_fgm is not None:
+            try:
+                hot = int(os.environ.get("CUBEFS_READ_HOT", "2") or "2")
+            except ValueError:
+                hot = 2
+            from .remotecache import CachedReader
+            self.read_cache = CachedReader(
+                self.data, flash_fgm, node_pool, client_az=client_az,
+                hotness_threshold=hot)
         # dir_ino -> [qid]: files created under a quota dir inherit its
         # ids (master_quota_manager.go analog); long-lived clients with a
         # master configured re-pull the table every QUOTA_TTL, so quotas
@@ -1052,6 +1070,10 @@ class FileSystem:
         inode = self.meta.inode_get(ino)
         off = inode["size"] if append else 0
         if not append and inode["size"]:
+            if self.read_cache is not None:
+                # overwrite: evict every flash copy of the old extents
+                # BEFORE they leave the inode (write-path invalidation)
+                self.read_cache.invalidate(inode.get("extents") or [])
             self.meta.truncate(ino, 0)
             self.data.close_stream(ino)
             # freed extents ride the metanode freelist: the server's
@@ -1066,11 +1088,23 @@ class FileSystem:
             ino = self.resolve(path)
         except FsError:
             ino = self.create(path)
+        if self.read_cache is not None:
+            inode = self.meta.inode_get(ino)
+            lo, hi = offset, offset + len(data)
+            self.read_cache.invalidate(
+                [ek for ek in inode.get("extents") or []
+                 if ek["file_offset"] < hi
+                 and ek["file_offset"] + ek["size"] > lo])
         self.data.write(self.meta, ino, offset, data)
         return ino
 
     def truncate_file(self, path: str, size: int) -> None:
         ino = self.resolve(path)
+        if self.read_cache is not None:
+            inode = self.meta.inode_get(ino)
+            self.read_cache.invalidate(
+                [ek for ek in inode.get("extents") or []
+                 if ek["file_offset"] + ek["size"] > size])
         self.meta.truncate(ino, size)
         self.data.close_stream(ino)
         # freed extents are reclaimed server-side via the freelist
@@ -1086,6 +1120,8 @@ class FileSystem:
         else:
             # pread(2) semantics: reads at/past EOF return short/empty
             length = max(0, min(length, inode["size"] - offset))
+        if self.read_cache is not None:
+            return self.read_cache.read(inode, offset, length)
         return self.data.read(inode, offset, length)
 
     def readdir(self, path: str) -> dict[str, int]:
@@ -1100,6 +1136,12 @@ class FileSystem:
 
     def unlink(self, path: str) -> None:
         parent, name = self._parent_of(path)
+        if self.read_cache is not None:
+            try:
+                inode = self.meta.inode_get(self.meta.lookup(parent, name))
+                self.read_cache.invalidate(inode.get("extents") or [])
+            except FsError:
+                pass  # racing unlink: nothing left to invalidate
         try:
             # compound: dentry + inode in one commit (mknod placement
             # puts them in the same partition); errno 18 = foreign inode

@@ -23,48 +23,16 @@ import os
 import threading
 import time
 
-from ..utils import rpc
+from . import topology
+from ..utils import metrics, rpc
 from ..utils.fsm import ReplicatedFsm
+from .topology import SELECTORS  # noqa: F401  (public selector registry)
 
 INO_RANGE = 1 << 24  # inodes per meta partition
 
 
 class MasterError(Exception):
     pass
-
-
-# ---------------- pluggable node selectors (node_selector.go) ----------
-def _select_least_load(cands: list[str], k: int, load: dict,
-                       state: dict) -> list[str]:
-    return sorted(cands, key=lambda a: (load.get(a, 0), a))[:k]
-
-
-def _select_round_robin(cands: list[str], k: int, load: dict,
-                        state: dict) -> list[str]:
-    cands = sorted(cands)
-    start = state.get("rr", 0) % len(cands)
-    state["rr"] = start + k
-    return [cands[(start + i) % len(cands)] for i in range(k)]
-
-
-def _select_carry_weight(cands: list[str], k: int, load: dict,
-                         state: dict) -> list[str]:
-    """CarryWeightNodeSelector analog: each node accumulates carry
-    proportional to its headroom; the k highest carries win and pay 1."""
-    carry = state.setdefault("carry", {})
-    for a in cands:
-        carry[a] = carry.get(a, 0.0) + 1.0 / (1.0 + load.get(a, 0))
-    picks = sorted(cands, key=lambda a: (-carry.get(a, 0.0), a))[:k]
-    for a in picks:
-        carry[a] -= 1.0
-    return picks
-
-
-SELECTORS = {
-    "least_load": _select_least_load,
-    "round_robin": _select_round_robin,
-    "carry_weight": _select_carry_weight,
-}
 
 
 class Master(ReplicatedFsm):
@@ -439,11 +407,14 @@ class Master(ReplicatedFsm):
     def register_datanode(self, addr: str, zone: str = "default",
                           packet_addr: str | None = None,
                           disks: dict | None = None,
-                          read_addr: str | None = None) -> None:
+                          read_addr: str | None = None,
+                          rack: str | None = None) -> None:
         with self._lock:
             info = self.datanodes.setdefault(addr, {"addr": addr})
             info["hb"] = time.time()
             info["zone"] = zone
+            if rack:
+                info["rack"] = rack
             if packet_addr:
                 info["packet_addr"] = packet_addr
             if read_addr:
@@ -453,11 +424,14 @@ class Master(ReplicatedFsm):
 
     def register_metanode(self, addr: str, zone: str = "default",
                           packet_addr: str | None = None,
-                          read_addr: str | None = None) -> None:
+                          read_addr: str | None = None,
+                          rack: str | None = None) -> None:
         with self._lock:
             info = self.metanodes.setdefault(addr, {"addr": addr})
             info["hb"] = time.time()
             info["zone"] = zone
+            if rack:
+                info["rack"] = rack
             if packet_addr:
                 info["packet_addr"] = packet_addr
             if read_addr:
@@ -466,7 +440,8 @@ class Master(ReplicatedFsm):
     def heartbeat(self, addr: str, kind: str, zone: str | None = None,
                   packet_addr: str | None = None,
                   read_addr: str | None = None,
-                  disks: dict | None = None) -> None:
+                  disks: dict | None = None,
+                  rack: str | None = None) -> None:
         with self._lock:
             reg = self.datanodes if kind == "data" else self.metanodes
             # unknown addr re-registers: a restarted master recovers its
@@ -477,6 +452,8 @@ class Master(ReplicatedFsm):
             info["hb"] = time.time()
             if zone or "zone" not in info:
                 info["zone"] = zone or "default"
+            if rack:
+                info["rack"] = rack
             if packet_addr:
                 info["packet_addr"] = packet_addr
             if read_addr:
@@ -527,17 +504,12 @@ class Master(ReplicatedFsm):
 
     # ---------------- topology (zones / nodesets) ----------------
     def _zones_of(self, reg: dict, live: list[str]) -> dict[str, list[str]]:
-        zones: dict[str, list[str]] = {}
-        for a in live:
-            zones.setdefault(reg[a].get("zone", "default"), []).append(a)
-        return zones
+        return topology.zones_of(reg, live)
 
     def _nodesets(self, members: list[str]) -> list[list[str]]:
         """Chunk a zone's nodes into nodesets (failure domains) of
         NODESET_SIZE, deterministically by address order."""
-        members = sorted(members)
-        return [members[i:i + self.NODESET_SIZE]
-                for i in range(0, len(members), self.NODESET_SIZE)]
+        return topology.nodesets(members, self.NODESET_SIZE)
 
     def topology_view(self) -> dict:
         """Zone -> nodeset -> node tree for both node kinds, including
@@ -565,42 +537,31 @@ class Master(ReplicatedFsm):
     def rpc_topology_view(self, args, body):
         return self.topology_view()
 
+    def topology_tree(self) -> dict:
+        """az -> rack -> node map for both node kinds (`cubefs-cli
+        topology tree` renders this beside the blob-plane zone map)."""
+        with self._lock:
+            out = {}
+            for kind, reg in (("datanodes", self.datanodes),
+                              ("metanodes", self.metanodes)):
+                out[kind] = topology.topology_tree(
+                    reg, set(self._live(reg)), self.decommissioned)
+            return out
+
+    def rpc_topology_tree(self, args, body):
+        return self.topology_tree()
+
     def _pick(self, cands: list[str], k: int, load: dict) -> list[str]:
         fn = SELECTORS[self.selector]
         return fn(cands, k, load, self._selector_state)
 
     def _select_hosts(self, reg: dict, live: list[str], k: int,
                       load: dict) -> list[str]:
-        """Topology-aware placement: one replica per zone when k zones
-        exist (cross-AZ volumes); otherwise all replicas from one
-        nodeset of the least-loaded zone (the reference keeps a
-        partition's replicas inside one failure domain)."""
-        zones = self._zones_of(reg, live)
-        if len(zones) >= k > 1:
-            zone_load = {z: sum(load.get(a, 0) for a in m)
-                         for z, m in zones.items()}
-            picked_zones = sorted(zones, key=lambda z: (zone_load[z], z))[:k]
-            return [self._pick(zones[z], 1, load)[0] for z in picked_zones]
-        if len(zones) > 1:
-            # fewer zones than replicas: spread as evenly as possible
-            out: list[str] = []
-            ordered = sorted(zones, key=lambda z: (-len(zones[z]), z))
-            zi = 0
-            while len(out) < k:
-                z = ordered[zi % len(ordered)]
-                remaining = [a for a in zones[z] if a not in out]
-                if remaining:
-                    out.append(self._pick(remaining, 1, load)[0])
-                zi += 1
-                if zi > 4 * k:
-                    break
-            return out
-        members = next(iter(zones.values()))
-        full = [ns for ns in self._nodesets(members) if len(ns) >= k]
-        if full:
-            ns = min(full, key=lambda s: (sum(load.get(a, 0) for a in s), s[0]))
-            return self._pick(ns, k, load)
-        return self._pick(members, k, load)  # no full nodeset: whole zone
+        """Replica spread lives in the fs topology scorer (one-per-AZ
+        when enough AZs, even spread, one nodeset otherwise); the
+        master only supplies its pluggable selector."""
+        return topology.select_hosts(reg, live, k, load, self._pick,
+                                     self.NODESET_SIZE)
 
     # ---------------- volume lifecycle ----------------
     def create_volume(self, name: str, mp_count: int = 3, dp_count: int = 4) -> dict:
@@ -692,7 +653,7 @@ class Master(ReplicatedFsm):
             if a in load:
                 load[a] += n
         picks = self._select_hosts(self.datanodes, live_data, k, load)
-        leader = min(picks, key=lambda a: (intra_load or {}).get(a, 0))
+        leader = topology.pick_leader(picks, intra_load)
         if intra_load is not None:
             for a in picks:
                 intra_load[a] = intra_load.get(a, 0) + 1
@@ -733,6 +694,16 @@ class Master(ReplicatedFsm):
             for mp in v["mps"]:
                 for a in mp.get("addrs") or [mp["addr"]]:
                     load[a] = load.get(a, 0) + 1
+        return load
+
+    def _dp_load(self) -> dict[str, int]:
+        """dp replica count per datanode (placement load; caller holds
+        _lock)."""
+        load: dict[str, int] = {}
+        for v in self.volumes.values():
+            for dp in v["dps"]:
+                for r in dp["replicas"]:
+                    load[r] = load.get(r, 0) + 1
         return load
 
     # ---------------- meta-partition split ----------------
@@ -834,6 +805,7 @@ class Master(ReplicatedFsm):
         would go stale and cascade."""
         with self._lock:
             live = set(self._live(self.datanodes))
+            load = self._dp_load()
             plans = []
             for vname, vol in self.volumes.items():
                 for dp in vol["dps"]:
@@ -846,7 +818,16 @@ class Master(ReplicatedFsm):
                                  )
                         if not healthy or not cands:
                             continue
-                        plans.append((vname, dict(dp), dead_addr, cands[0],
+                        # rebuild into the dead replica's AZ when it has
+                        # capacity, so a node loss doesn't erode the
+                        # dp's one-per-AZ footprint
+                        new = topology.pick_destination(
+                            self.datanodes, cands, healthy,
+                            prefer_az=topology.az_of(
+                                self.datanodes.get(dead_addr) or {}),
+                            load=load)
+                        load[new] = load.get(new, 0) + 1
+                        plans.append((vname, dict(dp), dead_addr, new,
                                       healthy[0]))
         # one sweep covers BOTH failure domains: dead nodes above,
         # broken disks below — existing periodic check_replicas callers
@@ -892,6 +873,7 @@ class Master(ReplicatedFsm):
         longer receive writes."""
         with self._lock:
             live = set(self._live(self.datanodes))
+            load = self._dp_load()
             plans = []
             for vname, vol in self.volumes.items():
                 for dp in vol["dps"]:
@@ -905,7 +887,15 @@ class Master(ReplicatedFsm):
                                  if self.allow_single_node else [])
                     if not healthy or not cands:
                         continue
-                    plans.append((vname, dict(dp), addr, cands[0],
+                    # the drained node stays in its AZ: prefer keeping
+                    # the migrated replica in that same AZ
+                    new = topology.pick_destination(
+                        self.datanodes, cands, healthy,
+                        prefer_az=topology.az_of(
+                            self.datanodes.get(addr) or {}),
+                        load=load)
+                    load[new] = load.get(new, 0) + 1
+                    plans.append((vname, dict(dp), addr, new,
                                   healthy[0]))
         actions = self._execute_rebuilds(plans)
         for dp_id, dead, _new in actions:
@@ -948,6 +938,66 @@ class Master(ReplicatedFsm):
             actions += self._migrate_dps_off(addr, dp_ids)
         return actions
 
+    # ---------------- misplaced-replica sweep ----------------
+    def misplacement_view(self) -> dict:
+        """Score every dp against the one-per-AZ contract and publish
+        the `cubefs_fs_placement_misplaced` gauge (0 == clean)."""
+        with self._lock:
+            view = topology.cluster_misplacement(self.datanodes,
+                                                 self.volumes)
+        metrics.fs_placement_misplaced.set(view["misplaced"])
+        return view
+
+    def sweep_misplaced(self, max_moves: int = 1) -> list:
+        """Rate-limited sweep: migrate at most `max_moves` colocated dp
+        replicas per call toward one-per-AZ. Rebuilds ride the standard
+        resync path; the superseded replica (its node is ALIVE — this
+        is a placement fix, not a failure) is dropped afterwards.
+        Returns (dp_id, old, new) actions."""
+        with self._lock:
+            live = set(self._live(self.datanodes))
+            load = self._dp_load()
+            work = topology.cluster_misplacement(self.datanodes,
+                                                 self.volumes)["dps"]
+            plans = []
+            moved = 0
+            for vname, dp_id, excess in work:
+                if moved >= max_moves:
+                    break
+                dp = next((d for d in self.volumes[vname]["dps"]
+                           if d["dp_id"] == dp_id), None)
+                if dp is None:
+                    continue
+                for old in excess:
+                    if moved >= max_moves or old not in dp["replicas"]:
+                        break
+                    survivors = [a for a in dp["replicas"] if a != old]
+                    healthy = [a for a in survivors if a in live]
+                    cands = [a for a in live if a not in dp["replicas"]]
+                    if not healthy or not cands:
+                        continue
+                    new = topology.pick_destination(
+                        self.datanodes, cands, survivors, load=load)
+                    moved_to = [new if a == old else a
+                                for a in dp["replicas"]]
+                    # only move when the destination actually improves
+                    # the AZ spread — a full cluster can't, so the sweep
+                    # must not churn replicas for nothing
+                    if len(topology.replica_misplacement(
+                            self.datanodes, moved_to)) >= len(excess):
+                        continue
+                    load[new] = load.get(new, 0) + 1
+                    plans.append((vname, dict(dp), old, new, healthy[0]))
+                    moved += 1
+        actions = self._execute_rebuilds(plans)
+        for dp_id, old, _new in actions:
+            try:
+                self.nodes.get(old).call("drop_partition", {"dp_id": dp_id})
+            except rpc.RpcError:
+                pass  # stale replica cleaned up on a later sweep
+        self.misplacement_view()  # refresh the gauge post-move
+        return actions
+
     def _rebuild_replica(self, vname: str, dp: dict, dead: str, new: str,
                          src: str) -> None:
         peers = [new if a == dead else a for a in dp["replicas"]]
@@ -983,18 +1033,21 @@ class Master(ReplicatedFsm):
             self.register_datanode(args["addr"], zone,
                                    packet_addr=args.get("packet_addr"),
                                    disks=args.get("disks"),
-                                   read_addr=args.get("read_addr"))
+                                   read_addr=args.get("read_addr"),
+                                   rack=args.get("rack"))
         else:
             self.register_metanode(args["addr"], zone,
                                    packet_addr=args.get("packet_addr"),
-                                   read_addr=args.get("read_addr"))
+                                   read_addr=args.get("read_addr"),
+                                   rack=args.get("rack"))
         return {}
 
     def rpc_heartbeat(self, args, body):
         self.heartbeat(args["addr"], args["kind"], args.get("zone"),
                        packet_addr=args.get("packet_addr"),
                        read_addr=args.get("read_addr"),
-                       disks=args.get("disks"))
+                       disks=args.get("disks"),
+                       rack=args.get("rack"))
         return {}
 
     def rpc_offline_disk(self, args, body):
@@ -1008,6 +1061,17 @@ class Master(ReplicatedFsm):
     def rpc_check_broken_disks(self, args, body):
         self._leader_gate()
         return {"actions": self.check_broken_disks()}
+
+    def rpc_misplacement(self, args, body):
+        view = self.misplacement_view()
+        return {"misplaced": view["misplaced"],
+                "dps": [list(t) for t in view["dps"]]}
+
+    def rpc_sweep_misplaced(self, args, body):
+        self._leader_gate()
+        actions = self.sweep_misplaced(int(args.get("max_moves", 1)))
+        return {"actions": actions,
+                "misplaced": self.misplacement_view()["misplaced"]}
 
     def rpc_node_list(self, args, body):
         return self.node_list()

@@ -93,20 +93,35 @@ class MasterClient(_Base):
         return self._call("list_users")[0]["users"]
 
     def register(self, kind: str, addr: str, zone: str = "default",
-                 packet_addr: str | None = None) -> None:
+                 packet_addr: str | None = None,
+                 rack: str | None = None) -> None:
         args = {"kind": kind, "addr": addr, "zone": zone}
+        if rack:
+            args["rack"] = rack
         if packet_addr:
             args["packet_addr"] = packet_addr
         self._call("register", args)
 
     def heartbeat(self, kind: str, addr: str, zone: str | None = None,
-                  packet_addr: str | None = None) -> None:
+                  packet_addr: str | None = None,
+                  rack: str | None = None) -> None:
         args = {"kind": kind, "addr": addr}
         if zone:
             args["zone"] = zone
+        if rack:
+            args["rack"] = rack
         if packet_addr:
             args["packet_addr"] = packet_addr
         self._call("heartbeat", args)
+
+    def topology_tree(self) -> dict:
+        return self._call("topology_tree")[0]
+
+    def misplacement(self) -> dict:
+        return self._call("misplacement")[0]
+
+    def sweep_misplaced(self, max_moves: int = 1) -> dict:
+        return self._call("sweep_misplaced", {"max_moves": max_moves})[0]
 
 
 class SchedulerClient(_Base):
@@ -277,6 +292,9 @@ class FlashClient(_Base):
     def cache_put(self, key: str, data: bytes) -> None:
         self._call("cache_put", {"key": key}, data)
 
+    def cache_delete(self, key: str) -> bool:
+        return self._call("cache_delete", {"key": key})[0]["deleted"]
+
     def stats(self) -> dict:
         return self._call("stats")[0]
 
@@ -284,8 +302,12 @@ class FlashClient(_Base):
 class FlashGroupClient(_Base):
     """FlashGroupManager admin surface (flashgroupmanager role)."""
 
-    def register_group(self, group_id: int, addrs: list[str]) -> None:
-        self._call("register_group", {"group_id": group_id, "addrs": addrs})
+    def register_group(self, group_id: int, addrs: list[str],
+                       az: str | None = None) -> None:
+        args = {"group_id": group_id, "addrs": addrs}
+        if az:
+            args["az"] = az
+        self._call("register_group", args)
 
     def remove_group(self, group_id: int) -> None:
         self._call("remove_group", {"group_id": group_id})

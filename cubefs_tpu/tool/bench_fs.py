@@ -800,6 +800,270 @@ def obs_tail(workdir: str, threads: int = 16, secs: float = 1.5,
     }
 
 
+def _mk_read_cluster(workdir: str):
+    """In-process fs cluster for the read A/B, shaped like the
+    deployment the hot-read tier exists for: the client lives in a
+    compute-only AZ (az1) with NO datanode replica, storage datanodes
+    sit one-per-AZ in az2/az3/az4, and the flash ring has an az1-local
+    group (plus a cross-AZ group so slot fallback is exercised). Every
+    cold read is a cross-AZ hop; every hot read can stay in az1."""
+    from ..fs.datanode import DataNode
+    from ..fs.master import Master
+    from ..fs.metanode import MetaNode
+    from ..fs.remotecache import FlashGroupManager, FlashNode
+    from ..utils.rpc import NodePool
+
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas = []
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    azs = ("az2", "az3", "az4")
+    for i in range(3):
+        node = DataNode(i, os.path.join(workdir, f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", node)
+        master.register_datanode(f"data{i}", zone=azs[i])
+    view = master.create_volume("bench", mp_count=2, dp_count=3)
+    fgm = FlashGroupManager()
+    for gid, az in ((1, "az1"), (2, "az2")):
+        pool.bind(f"flash-{az}", FlashNode())
+        fgm.register_group(gid, [f"flash-{az}"], az=az)
+    return pool, view, fgm, metas
+
+
+# Cross-AZ round-trip cost injected on the wire during timed windows
+# (both doors pay it identically). 1ms + seeded jitter is the usual
+# intra-region inter-AZ figure; in-process calls are otherwise free,
+# which would erase the topology the tier is built around.
+CROSS_AZ_RTT_S = 0.001
+CROSS_AZ_JITTER_S = 0.0002
+
+
+def _rtt_plan(seed: int):
+    """Seeded delay-only fault plan: every cross-AZ data/flash read
+    pays CROSS_AZ_RTT_S. az1-local flash and (az1-resident) meta RPCs
+    are left at in-process speed."""
+    from ..utils import faultinject as fi
+
+    plan = fi.FaultPlan(seed=seed)
+    for i in range(3):
+        plan.on(f"data{i}", "read", kind="delay",
+                delay=CROSS_AZ_RTT_S, jitter=CROSS_AZ_JITTER_S)
+    plan.on("flash-az2", "cache_get", kind="delay",
+            delay=CROSS_AZ_RTT_S, jitter=CROSS_AZ_JITTER_S)
+    return plan
+
+
+def _metric_total(name: str, **match) -> float:
+    """Sum a DEFAULT-registry series over label matches (bench-side
+    twin of the CLI's /metrics parser)."""
+    from ..utils import metrics as mlib
+
+    total = 0.0
+    for line in mlib.DEFAULT.render_text().splitlines():
+        if not line.startswith(name):
+            continue
+        head, _, val = line.rpartition(" ")
+        if all(f'{k}="{v}"' in head for k, v in match.items()):
+            try:
+                total += float(val)
+            except ValueError:
+                continue
+    return total
+
+
+def read_ab(workdir: str, files: int = 48, file_kb: int = 768,
+            secs: float = 1.0, rounds: int = 3, zipf_s: float = 1.2,
+            seed: int = 11) -> dict:
+    """Hot-read tier A/B (the READ_AB artifact): a zipf-skewed read mix
+    over ONE cluster, interleaving CUBEFS_READ_CACHE=1 / =0 windows
+    (ABBA pairs so host drift cancels). Every read is byte-checked
+    against the written payload in BOTH door positions, and the off
+    leg is asserted to be the plain (pre-door) ExtentClient path.
+    Reports per-window read/s + p99 medians, flash hit ratio, AZ-local
+    vs cross-AZ serve counts, singleflight collapses (from a dedicated
+    cold-key thundering-herd phase), and the fs.read per-stage tails
+    from a trace-on sampling pass.
+
+    Topology model: the client sits in compute-only az1 (see
+    _mk_read_cluster); a seeded delay plan charges CROSS_AZ_RTT_S per
+    cross-AZ data/flash read RPC in BOTH door positions, so the A/B
+    measures exactly what the tier buys — hot reads that stay in az1
+    instead of hopping AZs."""
+    import random
+    import statistics
+    import threading
+
+    from ..fs.client import FileSystem
+    from ..utils import faultinject as fi
+    from ..utils import slo as slolib
+
+    pool, view, fgm, metas = _mk_read_cluster(workdir)
+    saved = {k: os.environ.get(k) for k in
+             ("CUBEFS_READ_CACHE", "CUBEFS_READ_HOT", "CUBEFS_TRACE")}
+    on: list[float] = []
+    off: list[float] = []
+    on_p99: list[float] = []
+    off_p99: list[float] = []
+    serves0 = {s: _metric_total("cubefs_readcache_serves_total", scope=s)
+               for s in ("az_local", "cross_az")}
+    sf0 = _metric_total("cubefs_readcache_singleflight_total")
+    try:
+        os.environ["CUBEFS_READ_CACHE"] = "0"
+        os.environ["CUBEFS_READ_HOT"] = "2"
+        os.environ.pop("CUBEFS_TRACE", None)
+        fs0 = FileSystem(view, pool)
+        rng = random.Random(seed)
+        fs0.mkdir("/hot")
+        payloads = {}
+        for i in range(files):
+            payloads[i] = rng.randbytes(file_kb << 10)
+            fs0.write_file(f"/hot/f{i}", payloads[i])
+        # zipf-skewed access sequence, SHARED by every window: both
+        # legs replay the identical byte stream
+        weights = [1.0 / (r + 1) ** zipf_s for r in range(files)]
+        seq = rng.choices(range(files), weights=weights, k=4096)
+
+        # ONE long-lived client per door position, reused across every
+        # window — a real mount's heat tracker doesn't reset each
+        # second, and admission must be allowed to reach steady state
+        os.environ["CUBEFS_READ_CACHE"] = "1"
+        fs_on = FileSystem(view, pool, flash_fgm=fgm, client_az="az1")
+        os.environ["CUBEFS_READ_CACHE"] = "0"
+        fs_off = FileSystem(view, pool, flash_fgm=fgm, client_az="az1")
+        assert fs_off.read_cache is None  # door off == pre-PR path
+
+        def window(with_cache: bool) -> tuple[float, float]:
+            fs = fs_on if with_cache else fs_off
+            lat: list[float] = []
+            t_start = time.perf_counter()
+            t_end = t_start + secs
+            i = 0
+            while time.perf_counter() < t_end:
+                fi = seq[i % len(seq)]
+                t0 = time.perf_counter()
+                got = fs.read_file(f"/hot/f{fi}")
+                lat.append(time.perf_counter() - t0)
+                if got != payloads[fi]:
+                    raise AssertionError(
+                        f"byte mismatch on f{fi} (cache={with_cache})")
+                i += 1
+            rate = i / (time.perf_counter() - t_start)
+            p99 = sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
+            return rate, p99 * 1000.0
+
+        with fi.installed(_rtt_plan(seed)):
+            window(True)  # warm: fill the flash tier outside the timing
+            window(True)  # second pass clears the 2-touch admission gate
+            h0, m0 = fs_on.read_cache.hits, fs_on.read_cache.misses
+            order: list[bool] = []
+            for r in range(rounds):
+                order += [True, False] if r % 2 == 0 else [False, True]
+            for is_on in order:
+                rate, p99 = window(is_on)
+                (on if is_on else off).append(rate)
+                (on_p99 if is_on else off_p99).append(p99)
+            # hit ratio of the TIMED windows only (warm-up misses are
+            # admission cost, not steady-state behaviour)
+            hits = fs_on.read_cache.hits - h0
+            misses = fs_on.read_cache.misses - m0
+
+            # stage-tail sampling pass: trace door on, cache door on —
+            # the cache_lookup / cache_fill / datanode_read stages feed
+            # the shared request_stage_seconds histogram (PR 9 SLO
+            # tracker)
+            os.environ["CUBEFS_TRACE"] = "1"
+            os.environ["CUBEFS_READ_CACHE"] = "1"
+            fs_t = FileSystem(view, pool, flash_fgm=fgm, client_az="az1")
+            for i in range(512):
+                fs_t.read_file(f"/hot/f{seq[i % len(seq)]}")
+            stage_tails = slolib.quantiles_from_histogram().get(
+                "fs.read", {})
+
+            # thundering-herd phase: N threads race one COLD key; the
+            # singleflight door must collapse them onto one cross-AZ
+            # fill (followers reuse the leader's bytes)
+            os.environ.pop("CUBEFS_TRACE", None)
+            os.environ["CUBEFS_READ_HOT"] = "1"
+            from ..fs.remotecache import CACHE_BLOCK
+            herd_payload = rng.randbytes(CACHE_BLOCK)
+            fs0.write_file("/hot/herd", herd_payload)
+            fs_h = FileSystem(view, pool, flash_fgm=fgm, client_az="az1")
+            herd_errs: list[Exception] = []
+
+            def _herd_read():
+                try:
+                    if fs_h.read_file("/hot/herd") != herd_payload:
+                        raise AssertionError("herd byte mismatch")
+                except Exception as e:  # pragma: no cover - surfaced below
+                    herd_errs.append(e)
+
+            threads = [threading.Thread(target=_herd_read)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if herd_errs:
+                raise herd_errs[0]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for m in metas:
+            m.stop()
+    med_on = statistics.median(on)
+    med_off = statistics.median(off)
+    med_on_p99 = statistics.median(on_p99)
+    med_off_p99 = statistics.median(off_p99)
+    serves = {s: _metric_total("cubefs_readcache_serves_total", scope=s)
+              - serves0[s] for s in ("az_local", "cross_az")}
+    return {
+        "path": "fs.read",
+        "files": files,
+        "file_kb": file_kb,
+        "zipf_s": zipf_s,
+        "window_secs": secs,
+        "window_pairs": rounds,
+        "interleaved": True,
+        "topology_model": {
+            "client_az": "az1",
+            "datanode_azs": ["az2", "az3", "az4"],
+            "flash_azs": ["az1", "az2"],
+            "cross_az_rtt_ms": CROSS_AZ_RTT_S * 1000.0,
+            "cross_az_jitter_ms": CROSS_AZ_JITTER_S * 1000.0,
+            "note": "seeded delay plan charges the RTT on every "
+                    "cross-AZ data/flash read RPC in both door "
+                    "positions; az1 is a compute-only AZ",
+        },
+        "cache_on": {"median_reads_per_s": round(med_on, 1),
+                     "reads_per_s": [round(x, 1) for x in on],
+                     "median_p99_ms": round(med_on_p99, 3),
+                     "p99_ms": [round(x, 3) for x in on_p99]},
+        "cache_off": {"median_reads_per_s": round(med_off, 1),
+                      "reads_per_s": [round(x, 1) for x in off],
+                      "median_p99_ms": round(med_off_p99, 3),
+                      "p99_ms": [round(x, 3) for x in off_p99]},
+        "speedup": round(med_on / med_off, 2) if med_off else None,
+        "p99_reduction": round(med_off_p99 / med_on_p99, 2)
+        if med_on_p99 else None,
+        "byte_identical": True,  # asserted on every read, both doors
+        "door_off_is_plain_path": True,  # asserted per off window
+        "hit_ratio": round(hits / (hits + misses), 4)
+        if hits + misses else None,
+        "serves_by_scope": serves,
+        "singleflight_collapses":
+            _metric_total("cubefs_readcache_singleflight_total") - sf0,
+        "stage_tails": stage_tails,
+    }
+
+
 def merge_artifact(path: str, section: str, data: dict) -> None:
     """Read-merge-write one section of a shared artifact JSON, so
     bench_fs and bench_codec can fill their halves independently."""
@@ -983,6 +1247,10 @@ def main(argv=None):
                     help="instrumentation overhead A/B (CUBEFS_TRACE=1 "
                          "vs 0) + per-stage meta.write tails + FSM "
                          "digest proof; merges into --out")
+    ap.add_argument("--read-ab", action="store_true",
+                    help="hot-read tier A/B: zipf read mix with "
+                         "CUBEFS_READ_CACHE=1 vs 0, byte-identity "
+                         "checked; merges into --out")
     ap.add_argument("--scale-partitions", action="store_true",
                     help="aggregate creates/s at 1..256 metapartitions: "
                          "pipelined replication + client fan-out vs the "
@@ -1002,6 +1270,13 @@ def main(argv=None):
         print(json.dumps(res, indent=1))
         if args.out:
             merge_artifact(args.out, "meta_write", res)
+        return
+    if args.read_ab:
+        workdir = tempfile.mkdtemp(prefix="cubefs-bench-readab-")
+        res = read_ab(workdir, secs=args.secs, rounds=args.rounds)
+        print(json.dumps(res, indent=1))
+        if args.out:
+            merge_artifact(args.out, "fs_read", res)
         return
     if args.scale_partitions:
         workdir = tempfile.mkdtemp(prefix="cubefs-bench-scale-")

@@ -355,3 +355,29 @@ slow_traces = DEFAULT.counter(
     "cubefs_slow_traces_total",
     "root spans that exceeded CUBEFS_SLOW_MS and were captured to the "
     "slow-trace forensics log", ("path",))
+
+# AZ-local hot-read tier (fs/remotecache.py CachedReader) + fs-plane
+# topology (fs/topology.py). `cubefs-cli metrics read-path` renders the
+# readcache series; the misplaced gauge is the fs sweep's 0-contract.
+readcache_serves = DEFAULT.counter(
+    "cubefs_readcache_serves_total",
+    "reads answered by the flash tier, by the serving group's AZ "
+    "locality relative to the client", ("scope",))  # az_local | cross_az
+readcache_fills = DEFAULT.counter(
+    "cubefs_readcache_fills_total",
+    "miss-path outcomes: `populated` pushed the block to a flashnode, "
+    "`skipped_cold` failed the hotness admission bar (streaming scans "
+    "must not flush the hot set), `failed` found no writable flashnode",
+    ("outcome",))
+readcache_singleflight = DEFAULT.counter(
+    "cubefs_readcache_singleflight_total",
+    "concurrent misses of one block collapsed onto another caller's "
+    "in-flight datanode read (thundering-herd suppression)")
+readcache_invalidations = DEFAULT.counter(
+    "cubefs_readcache_invalidations_total",
+    "cached blocks evicted from the flash tier by write-path "
+    "invalidation (overwrite / truncate / unlink)")
+fs_placement_misplaced = DEFAULT.gauge(
+    "cubefs_fs_placement_misplaced_replicas",
+    "dp replicas colocated in an AZ beyond the one-per-AZ fair share; "
+    "the rate-limited misplaced-replica sweep drives this to zero")

@@ -1,0 +1,17 @@
+"""Known-bad fixture for the fs-placement checker (CFZ002/CFZ003)."""
+
+
+def pick_target(cands, load):
+    best = min(cands, key=lambda a: load.get(a, 0))          # CFZ002
+    ranked = sorted(cands, key=lambda a: load[a])            # CFZ002
+    cands.sort(key=lambda a: load.get(a, 0))                 # CFZ002
+    return best, ranked
+
+
+def plan_mp(reg, meta_load):
+    return max(reg, key=lambda a: -meta_load.get(a, 0))      # CFZ002
+
+
+def sneak_fill(cli, pool, key, data):
+    cli.cache_put(key, data)                                 # CFZ003
+    pool.get("flash1").call("cache_put", {"key": key}, data)  # CFZ003

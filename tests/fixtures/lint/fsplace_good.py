@@ -1,0 +1,25 @@
+"""Known-good fixture for the fs-placement checker: selections routed
+through fs/topology, population through CachedReader, and lookalike
+tokens (payload / json.loads / download) that must not match."""
+
+import json
+
+from cubefs_tpu.fs import topology
+
+
+def pick_target(reg, live, cands, load, pick):
+    order = topology.order_by_load(cands, load)
+    picks = topology.select_hosts(reg, live, 3, load, pick)
+    dest = topology.pick_destination(reg, cands, picks, load=load)
+    return order, picks, dest
+
+
+def not_load_sorts(items, text):
+    by_payload = sorted(items, key=lambda x: x.payload)
+    parsed = min(json.loads(text) or [0])
+    downloads_first = max(items, key=lambda x: x.download_count)
+    return by_payload, parsed, downloads_first
+
+
+def fill(reader, key, data):
+    reader._populate(key, data)  # the one sanctioned admission door

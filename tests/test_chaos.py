@@ -1010,3 +1010,143 @@ def test_pipelined_follower_partition_drains_inflight(monkeypatch):
     finally:
         for n in nodes.values():
             n.stop()
+
+
+# ---------------- flash tier: flashnode death + AZ blackout ----------------
+
+def _flash_tier_drill(base, seed):
+    """One seeded pass over the hot-read tier's failure ladder: the az1
+    flashnode dies mid-read (transport errors -> breaker opens inside a
+    single read), then the whole az1 flash tier blacks out (network
+    isolation + the control plane marks the group inactive -> election
+    serves cross-AZ from az2), then everything heals and az-local
+    serving resumes off the copies that survived the outage. Every read
+    along the way must return the exact written bytes. Returns
+    (digest, facts) for cross-run comparison."""
+    from cubefs_tpu.fs.client import FileSystem
+    from cubefs_tpu.fs.datanode import DataNode
+    from cubefs_tpu.fs.master import Master
+    from cubefs_tpu.fs.metanode import MetaNode
+    from cubefs_tpu.fs.remotecache import (CACHE_BLOCK, CachedReader,
+                                           FlashGroupManager, FlashNode)
+    from cubefs_tpu.utils.rpc import NodePool
+
+    base.mkdir()
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas, datas = [], []
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    for i in range(3):
+        node = DataNode(i, str(base / f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", node)
+        master.register_datanode(f"data{i}")
+        datas.append(node)
+    view = master.create_volume("chaosrc", mp_count=1, dp_count=2)
+    fgm = FlashGroupManager()
+    flashes = {}
+    for gid, az in ((1, "az1"), (2, "az2")):
+        fn = FlashNode()
+        pool.bind(f"flash-{az}", fn)
+        fgm.register_group(gid, [f"flash-{az}"], az=az)
+        flashes[az] = fn
+    facts = {}
+    try:
+        fs = FileSystem(view, pool)
+        rng = np.random.default_rng(0xF1A5)
+        data = rng.integers(0, 256, 3 * CACHE_BLOCK,
+                            dtype=np.uint8).tobytes()
+        fs.write_file("/hot", data)
+        # determinism: the breaker moves only with failure counts on a
+        # fake clock; the scenario itself is single-threaded
+        bclock = FakeClock()
+        reader = CachedReader(fs.data, fgm, pool, client_az="az1",
+                              breaker=CircuitBreaker(threshold=3,
+                                                     cooldown=60.0,
+                                                     clock=bclock))
+        inode = fs.meta.inode_get(fs.resolve("/hot"))
+        assert reader.read(inode, 0, len(data)) == data  # fill az1
+        h0 = reader.hits
+        assert reader.read(inode, 0, len(data)) == data  # warm serve
+        assert reader.hits - h0 == 3
+        facts["warm_items"] = flashes["az1"].stats()["items"]
+
+        plan = FaultPlan(seed=seed)
+        with fi.installed(plan):
+            # -- phase A: the flashnode dies mid-read. The first block
+            # lookup of the next read eats a transport error and the
+            # read must fall through to the datanode byte-for-byte;
+            # three failing block lookups inside that ONE read reach
+            # the breaker threshold, so it opens before the read ends
+            # times=3: the node convulses for one read's worth of dials
+            # and is healthy again by phase C (heal() clears partitions,
+            # not rules)
+            plan.on("flash-az1", "cache_get", kind="error", code=503,
+                    times=3)
+            m0 = reader.misses
+            assert reader.read(inode, 0, len(data)) == data
+            facts["breaker_open"] = not reader.breaker.allow("flash-az1")
+            assert facts["breaker_open"]
+            assert reader.misses - m0 == 3
+            for _ in range(2):  # open breaker: straight to datanode
+                assert reader.read(inode, 0, len(data)) == data
+            # the breaker capped the blast radius: exactly one read's
+            # worth of dials ever reached the dying node
+            facts["injected_errors"] = sum(
+                1 for e in plan.schedule() if e[1] == "error")
+            assert facts["injected_errors"] == 3
+
+            # -- phase B: the whole az1 flash tier blacks out. The
+            # post-cooldown half-open probe hits the partition and
+            # re-opens the breaker; once the control plane marks the
+            # group inactive, election falls back cross-AZ and az2
+            # serves the hot set
+            plan.isolate("flash-az1")
+            bclock.advance(61.0)  # cooldown over: grant the one probe
+            assert reader.read(inode, 0, len(data)) == data
+            assert any(e[1] == "partition" and e[2] == "flash-az1"
+                       for e in plan.schedule())
+            assert not reader.breaker.allow("flash-az1")  # re-opened
+            fgm.set_group_status(1, "inactive")
+            c0 = metrics.readcache_serves.value(scope="cross_az")
+            assert reader.read(inode, 0, len(data)) == data  # fills az2
+            assert flashes["az2"].stats()["items"] == 3
+            assert reader.read(inode, 0, len(data)) == data  # serves az2
+            facts["cross_az_serves"] = \
+                metrics.readcache_serves.value(scope="cross_az") - c0
+            assert facts["cross_az_serves"] == 3
+
+            # -- phase C: heal transport + control plane, let the
+            # breaker cool down. The az1 copies survived the outage in
+            # the flashnode's LRU, so local serving resumes on the
+            # very next read — no refill traffic
+            plan.heal()
+            fgm.set_group_status(1, "active")
+            bclock.advance(61.0)
+            a0 = metrics.readcache_serves.value(scope="az_local")
+            assert reader.read(inode, 0, len(data)) == data
+            facts["local_resumed_serves"] = \
+                metrics.readcache_serves.value(scope="az_local") - a0
+            assert facts["local_resumed_serves"] == 3
+        assert any(e[1] == "error" and e[2] == "flash-az1"
+                   for e in plan.schedule())
+        return plan.schedule_digest(), facts
+    finally:
+        for n in metas:
+            n.stop()
+        for d in datas:
+            d.stop()
+
+
+def test_flashnode_death_and_az_blackout_reads_stay_exact(tmp_path):
+    d1, f1 = _flash_tier_drill(tmp_path / "r1", seed=23)
+    d2, f2 = _flash_tier_drill(tmp_path / "r2", seed=23)
+    # byte-for-byte reproducible schedule, identical facts
+    assert d1 == d2 and f1 == f2
+    assert f1["breaker_open"] and f1["injected_errors"] == 3
+    assert f1["cross_az_serves"] == 3
+    assert f1["local_resumed_serves"] == 3

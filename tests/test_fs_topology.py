@@ -1,0 +1,226 @@
+"""fs-plane topology scorer (PR 11 tentpole part 2): the blob plane's
+failure-domain discipline ported to the fs master.
+
+Unit tests pin the pure scorer (fs/topology.py): one-per-AZ selection
+with colocation degrade, destination scoring (AZ preference > survivor
+AZ count > rack > load), and misplacement accounting. E2E tests drive
+the master: volume creation places one replica per AZ at >=3 AZs,
+rebuild after a node death prefers the failed replica's AZ, and the
+rate-limited sweep migrates colocated replicas until the
+`cubefs_fs_placement_misplaced` gauge reads zero.
+"""
+
+import time
+
+import pytest
+
+from cubefs_tpu.fs import topology
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+from cubefs_tpu.utils import metrics
+from cubefs_tpu.utils.rpc import NodePool
+
+
+def _reg(spec: dict[str, tuple[str, str | None]]) -> dict:
+    """{addr: (az, rack)} -> a master-shaped registry."""
+    reg = {}
+    for addr, (az, rack) in spec.items():
+        info = {"addr": addr, "zone": az, "hb": time.time()}
+        if rack:
+            info["rack"] = rack
+        reg[addr] = info
+    return reg
+
+
+# ---------------- scorer units ----------------
+
+def test_select_hosts_one_per_az():
+    reg = _reg({f"n{i}": (f"az{i % 3 + 1}", None) for i in range(6)})
+    live = sorted(reg)
+    picks = topology.select_hosts(
+        reg, live, 3, {a: 0 for a in live},
+        lambda cands, k, load: sorted(cands)[:k])
+    assert len(picks) == 3
+    assert len({topology.az_of(reg[a]) for a in picks}) == 3
+
+
+def test_select_hosts_degrades_to_colocation_when_azs_short():
+    reg = _reg({f"n{i}": ("az1", None) for i in range(4)})
+    live = sorted(reg)
+    picks = topology.select_hosts(
+        reg, live, 3, {a: 0 for a in live},
+        lambda cands, k, load: sorted(cands)[:k])
+    assert len(picks) == 3 and len(set(picks)) == 3
+
+
+def test_pick_destination_prefers_the_failed_az():
+    reg = _reg({"a1": ("az1", None), "a2": ("az1", None),
+                "b1": ("az2", None), "c1": ("az3", None),
+                "c2": ("az3", None)})
+    # dp had replicas in az1/az2/az3; the az3 replica died
+    dest = topology.pick_destination(
+        reg, cands=["a2", "c2"], survivors=["a1", "b1"],
+        prefer_az="az3", load={})
+    assert dest == "c2"
+
+
+def test_pick_destination_avoids_survivor_azs_and_racks():
+    reg = _reg({"a1": ("az1", "r1"), "a2": ("az1", "r2"),
+                "b1": ("az2", "r3"), "b2": ("az2", "r3")})
+    # no az preference: a2 wins because az1 holds fewer survivors than
+    # az2... both hold one; then rack: b2 shares r3 with survivor b1
+    dest = topology.pick_destination(
+        reg, cands=["a2", "b2"], survivors=["a1", "b1"], load={})
+    assert dest == "a2"
+
+
+def test_pick_destination_breaks_ties_on_load():
+    reg = _reg({"x": ("az9", None), "y": ("az9", None)})
+    dest = topology.pick_destination(
+        reg, cands=["x", "y"], survivors=[], load={"x": 5, "y": 1})
+    assert dest == "y"
+
+
+def test_replica_misplacement_counts_az_excess():
+    reg = _reg({"a1": ("az1", None), "a2": ("az1", None),
+                "a3": ("az1", None), "b1": ("az2", None)})
+    # three colocated replicas, cluster has 2 AZs -> fair share 2
+    excess = topology.replica_misplacement(reg, ["a1", "a2", "a3"])
+    assert len(excess) == 1
+    clean = topology.replica_misplacement(reg, ["a1", "a2", "b1"])
+    assert clean == []
+
+
+def test_topology_tree_shape():
+    reg = _reg({"a1": ("az1", "r1"), "a2": ("az1", "r2"),
+                "b1": ("az2", None)})
+    tree = topology.topology_tree(reg, live={"a1", "b1"},
+                                  decommissioned={"a2"})
+    assert set(tree) == {"az1", "az2"}
+    assert tree["az1"]["r1"]["a1"]["live"]
+    assert tree["az1"]["r2"]["a2"]["decommissioned"]
+    # unlabeled rack defaults to the node's own addr (rack-per-host)
+    assert tree["az2"]["b1"]["b1"]["live"]
+
+
+# ---------------- master e2e ----------------
+
+@pytest.fixture
+def az_cluster(tmp_path):
+    """Six datanodes across three AZs (two per AZ, rack-labeled)."""
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas = []
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    datas = {}
+    for i in range(6):
+        az = f"az{i % 3 + 1}"
+        addr = f"d{i}"
+        node = DataNode(i, str(tmp_path / addr), addr, pool)
+        pool.bind(addr, node)
+        master.register_datanode(addr, zone=az, rack=f"{az}-r{i // 3}")
+        datas[addr] = node
+    yield pool, master, datas
+    for n in metas:
+        n.stop()
+    for d in datas.values():
+        d.stop()
+
+
+def _azs_of(master, dp):
+    return [topology.az_of(master.datanodes[a]) for a in dp["replicas"]]
+
+
+def test_create_volume_places_one_replica_per_az(az_cluster):
+    _, master, _ = az_cluster
+    view = master.create_volume("spread", mp_count=1, dp_count=4)
+    for dp in view["dps"]:
+        assert len(dp["replicas"]) == 3
+        assert len(set(_azs_of(master, dp))) == 3
+
+
+def test_rebuild_prefers_the_failed_replicas_az(az_cluster):
+    _, master, _ = az_cluster
+    view = master.create_volume("heal", mp_count=1, dp_count=1)
+    dp = view["dps"][0]
+    dead = dp["replicas"][1]
+    dead_az = topology.az_of(master.datanodes[dead])
+    master.datanodes[dead]["hb"] = time.time() - 60  # flatline it
+    actions = master.check_replicas()
+    moves = [(d, n) for _dp_id, d, n in actions]
+    assert moves and moves[0][0] == dead
+    new = moves[0][1]
+    assert topology.az_of(master.datanodes[new]) == dead_az
+    dp_now = master.volumes["heal"]["dps"][0]
+    assert dead not in dp_now["replicas"]
+    assert len(set(_azs_of(master, dp_now))) == 3  # footprint preserved
+
+
+def test_sweep_migrates_colocated_replicas_to_zero(tmp_path):
+    """Volume born in a single-AZ cluster; two more AZs come online;
+    the rate-limited sweep walks the misplaced gauge to 0."""
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas = []
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    datas = []
+
+    def add_dn(i, az):
+        addr = f"d{i}"
+        node = DataNode(i, str(tmp_path / addr), addr, pool)
+        pool.bind(addr, node)
+        master.register_datanode(addr, zone=az)
+        datas.append(node)
+
+    for i in range(3):
+        add_dn(i, "az1")
+    try:
+        master.create_volume("legacy", mp_count=1, dp_count=2)
+        assert master.misplacement_view()["misplaced"] == 0  # 1 AZ: fair
+        for i, az in ((3, "az2"), (4, "az3")):
+            add_dn(i, az)
+        before = master.misplacement_view()["misplaced"]
+        assert before == 4  # 2 dps x 2 excess az1 replicas each
+        moves = 0
+        for _ in range(10):  # rate limit: at most one move per sweep
+            acts = master.sweep_misplaced(max_moves=1)
+            assert len(acts) <= 1
+            moves += len(acts)
+            if master.misplacement_view()["misplaced"] == 0:
+                break
+        assert master.misplacement_view()["misplaced"] == 0
+        assert moves == before
+        gauge_line = next(
+            ln for ln in metrics.DEFAULT.render_text().splitlines()
+            if ln.startswith("cubefs_fs_placement_misplaced_replicas"))
+        assert gauge_line.rstrip().endswith(" 0") or \
+            gauge_line.rstrip().endswith(" 0.0")
+        for dp in master.volumes["legacy"]["dps"]:
+            azs = {topology.az_of(master.datanodes[a])
+                   for a in dp["replicas"]}
+            assert azs == {"az1", "az2", "az3"}
+        # idempotent: a clean cluster sweeps to no-op, no churn
+        assert master.sweep_misplaced(max_moves=4) == []
+    finally:
+        for n in metas:
+            n.stop()
+        for d in datas:
+            d.stop()
+
+
+def test_rack_labels_flow_through_registration(az_cluster):
+    _, master, _ = az_cluster
+    tree = master.topology_tree()
+    assert set(tree["datanodes"]) == {"az1", "az2", "az3"}
+    assert set(tree["datanodes"]["az1"]) == {"az1-r0", "az1-r1"}

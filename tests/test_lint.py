@@ -20,6 +20,7 @@ import pytest
 from tool.lint import cli, core
 from tool.lint.checkers.batch_discipline import BatchDisciplineChecker
 from tool.lint.checkers.fanout_discipline import FanoutDisciplineChecker
+from tool.lint.checkers.fs_placement import FsPlacementChecker
 from tool.lint.checkers.lock_discipline import LockDisciplineChecker
 from tool.lint.checkers.placement_discipline import PlacementDisciplineChecker
 from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
@@ -191,6 +192,43 @@ def test_placement_discipline_exempts_topology_itself():
     assert c.applies("cubefs_tpu/blob/scheduler.py")
     assert not c.applies("cubefs_tpu/blob/topology.py")
     assert not c.applies("cubefs_tpu/fs/master.py")
+
+
+# ---------------- fs-placement ----------------
+
+def test_fs_placement_true_positives():
+    mod = _module("fsplace_bad.py", "cubefs_tpu/fs/fx.py")
+    found = FsPlacementChecker().check(mod)
+    assert _codes(found) == ["CFZ002", "CFZ002", "CFZ002", "CFZ002",
+                             "CFZ003", "CFZ003"]
+
+
+def test_fs_placement_true_negative():
+    mod = _module("fsplace_good.py", "cubefs_tpu/fs/fx.py")
+    assert FsPlacementChecker().check(mod) == []
+
+
+def test_fs_placement_load_sorts_scoped_to_fs_plane():
+    # the SAME bad source outside cubefs_tpu/fs/ keeps only the
+    # cache_put fence (blob load-sorts are CFZ001's job)
+    mod = _module("fsplace_bad.py", "cubefs_tpu/blob/fx.py")
+    assert _codes(FsPlacementChecker().check(mod)) == ["CFZ003", "CFZ003"]
+
+
+def test_fs_placement_remotecache_is_sanctioned():
+    # ...and inside remotecache.py the population fence is silent
+    # (load-sorts still fire: topology.py is the only sort exemption)
+    mod = _module("fsplace_bad.py", "cubefs_tpu/fs/remotecache.py")
+    assert _codes(FsPlacementChecker().check(mod)) == [
+        "CFZ002", "CFZ002", "CFZ002", "CFZ002"]
+
+
+def test_fs_placement_scope():
+    c = FsPlacementChecker()
+    assert c.applies("cubefs_tpu/fs/master.py")
+    assert c.applies("cubefs_tpu/fs/topology.py")  # CFZ003 still applies
+    assert not c.applies("tool/lint/cli.py")
+    assert not c.applies("tests/test_fs_e2e.py")
 
 
 # ---------------- batch-discipline ----------------

@@ -1,0 +1,292 @@
+"""AZ-local hot-read tier: CachedReader wiring (PR 11 tentpole) and the
+BlockCache spill-dir satellite.
+
+Covers the contracts the read door rides on: consistent-hash slot
+routing with AZ-local group election (cross-AZ only when the local
+group is dead), singleflight miss-fill, hotness admission, span
+coalescing (a cold multi-block read must not amplify into per-block
+datanode round trips), write-path invalidation across AZ copies, and
+breaker isolation of a failing flashnode. Spill-dir tests pin the
+round-trip, capacity-driven unlink, and corrupt-file-is-a-miss
+behaviours of the client-local tier.
+"""
+
+import os
+import random
+import threading
+
+import pytest
+
+from cubefs_tpu.fs.blockcache import BlockCache
+from cubefs_tpu.fs.client import FileSystem
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode
+from cubefs_tpu.fs.remotecache import (CACHE_BLOCK, CachedReader,
+                                       FlashGroupManager, FlashNode)
+from cubefs_tpu.utils import rpc
+from cubefs_tpu.utils.rpc import NodePool
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas = []
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    datas = []
+    for i in range(3):
+        node = DataNode(i, str(tmp_path / f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", node)
+        master.register_datanode(f"data{i}")
+        datas.append(node)
+    view = master.create_volume("rcvol", mp_count=1, dp_count=2)
+    fgm = FlashGroupManager()
+    flashes = {}
+    for gid, az in ((1, "az1"), (2, "az2")):
+        fn = FlashNode()
+        pool.bind(f"flash-{az}", fn)
+        fgm.register_group(gid, [f"flash-{az}"], az=az)
+        flashes[az] = fn
+    yield pool, view, fgm, flashes
+    for n in metas:
+        n.stop()
+    for d in datas:
+        d.stop()
+
+
+def _payload(n, seed=7):
+    return random.Random(seed).randbytes(n)
+
+
+# ---------------- election / scope ----------------
+
+def test_az_local_election_pins_fills_to_local_group(cluster):
+    pool, view, fgm, flashes = cluster
+    fs = FileSystem(view, pool)
+    data = _payload(3 * CACHE_BLOCK)
+    fs.write_file("/f", data)
+    reader = CachedReader(fs.data, fgm, pool, client_az="az1")
+    inode = fs.meta.inode_get(fs.resolve("/f"))
+    assert reader.read(inode, 0, len(data)) == data
+    assert flashes["az1"].stats()["items"] == 3
+    assert flashes["az2"].stats()["items"] == 0  # nothing leaked cross-AZ
+
+
+def test_local_group_death_falls_back_cross_az(cluster):
+    pool, view, fgm, flashes = cluster
+    fs = FileSystem(view, pool)
+    data = _payload(2 * CACHE_BLOCK)
+    fs.write_file("/f", data)
+    fgm.set_group_status(1, "inactive")  # az1's whole flash group dies
+    reader = CachedReader(fs.data, fgm, pool, client_az="az1")
+    inode = fs.meta.inode_get(fs.resolve("/f"))
+    assert reader.read(inode, 0, len(data)) == data   # fill, cross-AZ
+    assert reader.read(inode, 0, len(data)) == data   # serve, cross-AZ
+    assert flashes["az2"].stats()["items"] == 2
+    addrs, scope = fgm.elect_group("any-key", client_az="az1")
+    assert addrs == ["flash-az2"] and scope == "cross_az"
+
+
+# ---------------- span coalescing ----------------
+
+def test_cold_read_coalesces_missing_blocks_into_one_fetch(cluster):
+    pool, view, fgm, _ = cluster
+    fs = FileSystem(view, pool)
+    data = _payload(4 * CACHE_BLOCK)
+    fs.write_file("/f", data)
+    reader = CachedReader(fs.data, fgm, pool, client_az="az1")
+    fetches = []
+    inner_read = fs.data._read_replicated
+
+    def counting(dp, eid, off, ln):
+        fetches.append((off, ln))
+        return inner_read(dp, eid, off, ln)
+
+    fs.data._read_replicated = counting
+    inode = fs.meta.inode_get(fs.resolve("/f"))
+    assert reader.read(inode, 0, len(data)) == data
+    # one datanode round trip per extent-contiguous run of cold blocks
+    # — never one per block
+    extents = len(inode["extents"])
+    assert len(fetches) == extents
+    assert reader.misses == 4
+    # warm repeat: no datanode traffic at all
+    fetches.clear()
+    assert reader.read(inode, 0, len(data)) == data
+    assert fetches == []
+
+
+# ---------------- singleflight ----------------
+
+def test_singleflight_collapses_thundering_herd(cluster):
+    pool, view, fgm, _ = cluster
+    fs = FileSystem(view, pool)
+    data = _payload(CACHE_BLOCK)
+    fs.write_file("/cold", data)
+    reader = CachedReader(fs.data, fgm, pool, client_az="az1")
+    calls = []
+    inner_read = fs.data._read_replicated
+    gate = threading.Event()
+
+    def slow_read(dp, eid, off, ln):
+        calls.append(off)
+        gate.wait(2.0)  # hold the leader so followers pile up
+        return inner_read(dp, eid, off, ln)
+
+    fs.data._read_replicated = slow_read
+    inode = fs.meta.inode_get(fs.resolve("/cold"))
+    results = []
+
+    def hit_it():
+        results.append(reader.read(inode, 0, len(data)))
+
+    threads = [threading.Thread(target=hit_it) for _ in range(8)]
+    for t in threads:
+        t.start()
+    import time
+    time.sleep(0.2)  # let followers enqueue on the flight
+    gate.set()
+    for t in threads:
+        t.join()
+    assert all(r == data for r in results)
+    assert len(calls) == 1  # one leader fetch, seven followers reused it
+
+
+# ---------------- hotness admission ----------------
+
+def test_hotness_gate_admits_only_repeated_misses(cluster):
+    pool, view, fgm, flashes = cluster
+    fs = FileSystem(view, pool)
+    data = _payload(CACHE_BLOCK)
+    fs.write_file("/warmup", data)
+    reader = CachedReader(fs.data, fgm, pool, client_az="az1",
+                          hotness_threshold=2)
+    inode = fs.meta.inode_get(fs.resolve("/warmup"))
+    assert reader.read(inode, 0, len(data)) == data  # 1st miss: too cold
+    assert flashes["az1"].stats()["items"] == 0
+    assert reader.read(inode, 0, len(data)) == data  # 2nd miss: admitted
+    assert flashes["az1"].stats()["items"] == 1
+    hits0 = reader.hits
+    assert reader.read(inode, 0, len(data)) == data  # now a hit
+    assert reader.hits > hits0
+
+
+# ---------------- write-path invalidation ----------------
+
+def test_overwrite_invalidates_all_az_copies(cluster, monkeypatch):
+    pool, view, fgm, flashes = cluster
+    monkeypatch.setenv("CUBEFS_READ_CACHE", "1")
+    monkeypatch.setenv("CUBEFS_READ_HOT", "1")
+    fs = FileSystem(view, pool, flash_fgm=fgm, client_az="az1")
+    assert fs.read_cache is not None
+    old = _payload(2 * CACHE_BLOCK, seed=1)
+    fs.write_file("/doc", old)
+    assert fs.read_file("/doc") == old
+    assert flashes["az1"].stats()["items"] == 2
+    # simulate the same blocks also cached by az2's readers: the
+    # invalidation contract says EVERY AZ copy must die on write
+    inode = fs.meta.inode_get(fs.resolve("/doc"))
+    for key in fs.read_cache.keys_for_extents(inode["extents"]):
+        flashes["az2"].put(key, b"stale-az2-copy")
+    new = _payload(2 * CACHE_BLOCK, seed=2)
+    fs.write_file("/doc", new)
+    assert flashes["az1"].stats()["items"] == 0
+    assert flashes["az2"].stats()["items"] == 0
+    assert fs.read_file("/doc") == new
+
+
+def test_door_off_is_plain_path(cluster, monkeypatch):
+    pool, view, fgm, _ = cluster
+    monkeypatch.delenv("CUBEFS_READ_CACHE", raising=False)
+    fs = FileSystem(view, pool, flash_fgm=fgm, client_az="az1")
+    assert fs.read_cache is None
+    fs.write_file("/plain", b"plain bytes")
+    assert fs.read_file("/plain") == b"plain bytes"
+
+
+# ---------------- breaker ----------------
+
+class _BrokenFlash:
+    def rpc_cache_get(self, args, body):
+        raise rpc.RpcError(500, "flash transport down")
+
+    def rpc_cache_put(self, args, body):
+        raise rpc.RpcError(500, "flash transport down")
+
+    def rpc_cache_delete(self, args, body):
+        raise rpc.RpcError(500, "flash transport down")
+
+
+def test_breaker_opens_on_failing_flashnode(cluster):
+    pool, view, fgm, _ = cluster
+    pool.bind("flash-broken", _BrokenFlash())
+    fgm.set_group_status(2, "inactive")
+    fgm.register_group(3, ["flash-broken"], az="az1")
+    fgm.set_group_status(1, "inactive")  # the broken node IS the tier
+    fs = FileSystem(view, pool)
+    data = _payload(CACHE_BLOCK)
+    fs.write_file("/f", data)
+    reader = CachedReader(fs.data, fgm, pool, client_az="az1")
+    inode = fs.meta.inode_get(fs.resolve("/f"))
+    for _ in range(8):  # every read stays byte-correct while it fails
+        assert reader.read(inode, 0, len(data)) == data
+    assert not reader.breaker.allow("flash-broken")  # breaker opened
+
+
+# ---------------- BlockCache spill dir (satellite) ----------------
+
+def test_spill_round_trip(tmp_path):
+    bc = BlockCache(spill_dir=str(tmp_path / "spill"))
+    data = _payload(4096)
+    bc.put("ino1/0", data)
+    assert len(os.listdir(tmp_path / "spill")) == 1
+    assert bc.get("ino1/0") == data
+    st = bc.stats()
+    assert st["items"] == 1 and st["hits"] == 1
+
+
+def test_spill_eviction_unlinks_backing_file(tmp_path):
+    spill = tmp_path / "spill"
+    bc = BlockCache(capacity_bytes=1000, spill_dir=str(spill))
+    for i in range(5):
+        bc.put(f"k{i}", _payload(400, seed=i))
+    st = bc.stats()
+    assert st["bytes"] <= 1000 and st["items"] == 2
+    # exactly the surviving entries remain on disk — evicted spill
+    # files are unlinked, not leaked
+    assert len(os.listdir(spill)) == 2
+    assert bc.get("k0") is None
+    assert bc.get("k4") == _payload(400, seed=4)
+
+
+def test_corrupt_spill_file_reads_as_miss(tmp_path):
+    spill = tmp_path / "spill"
+    bc = BlockCache(spill_dir=str(spill))
+    data = _payload(2048)
+    bc.put("blk", data)
+    path = bc._path("blk")
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:  # flip bits, keep the length
+        f.write(raw[:20] + bytes(b ^ 0xFF for b in raw[20:]))
+    assert bc.get("blk") is None          # never served corrupt bytes
+    assert not os.path.exists(path)       # poisoned file dropped
+    bc.put("blk", data)                   # and the slot recovers
+    assert bc.get("blk") == data
+
+
+def test_truncated_spill_file_reads_as_miss(tmp_path):
+    spill = tmp_path / "spill"
+    bc = BlockCache(spill_dir=str(spill))
+    bc.put("blk", _payload(2048))
+    path = bc._path("blk")
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    assert bc.get("blk") is None
+    assert bc.stats()["items"] == 0

@@ -2,6 +2,7 @@
 lifecycle (expire + cold transition to the blob plane + read-through),
 client block cache."""
 
+import os
 import time
 
 import numpy as np
@@ -123,9 +124,12 @@ def test_lcnode_cold_transition_and_read_through(fscluster, tmp_path, rng):
 
 
 def test_block_cache_spill_and_stats(tmp_path, rng):
-    bc = BlockCache(capacity_bytes=1, spill_dir=str(tmp_path / "bc"))
+    # with a spill dir every put lands on disk; capacity bounds the
+    # spill dir too, so it must be large enough to keep the entry
+    bc = BlockCache(capacity_bytes=1 << 20, spill_dir=str(tmp_path / "bc"))
     data = rng.integers(0, 256, 5000, dtype=np.uint8).tobytes()
     bc.put("a/0", data)
+    assert len(os.listdir(tmp_path / "bc")) == 1
     assert bc.get("a/0") == data  # served from spill file
     assert bc.stats()["hits"] == 1
 

@@ -2,6 +2,7 @@
 
 from .batch_discipline import BatchDisciplineChecker
 from .fanout_discipline import FanoutDisciplineChecker
+from .fs_placement import FsPlacementChecker
 from .lock_discipline import LockDisciplineChecker
 from .placement_discipline import PlacementDisciplineChecker
 from .retry_discipline import RetryDisciplineChecker
@@ -17,6 +18,7 @@ ALL_CHECKERS = (
     RetryDisciplineChecker,
     Tier1PurityChecker,
     PlacementDisciplineChecker,
+    FsPlacementChecker,
     BatchDisciplineChecker,
     FanoutDisciplineChecker,
 )

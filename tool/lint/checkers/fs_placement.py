@@ -1,0 +1,91 @@
+"""Fs-plane placement + cache-population checker (rule: fs-placement).
+
+PR 11 ported failure-domain scoring to the fs master: fs/topology.py is
+now the single authority for "which datanode/metanode takes this
+replica" (``select_hosts`` / ``pick_destination`` / ``order_by_load``),
+exactly as blob/topology.py is for the blob plane (CFZ001). The same
+regression shape applies — an ad-hoc ``min(cands, key=lambda a:
+load.get(a, 0))`` dropped into the fs plane is load-balanced and
+AZ-blind:
+
+  CFZ002  sorted()/min()/max()/.sort() over a load map in
+          cubefs_tpu/fs/ outside fs/topology.py
+
+The hot-read tier has a companion fence: CachedReader._populate is the
+ONE place that admits bytes into the flash ring (it owns hotness
+admission, breaker accounting, and the fill-outcome counters). A stray
+``cache_put`` anywhere else bypasses admission and poisons the
+AZ-copy invalidation contract:
+
+  CFZ003  `.cache_put(...)` (or `.call("cache_put", ...)`) outside
+          fs/remotecache.py and sdk/clients.py (the thin rpc wrapper)
+
+Both analyses are syntactic. CFZ002 matches a load-map token
+(``load`` / ``dp_load`` / ``meta_load`` / ``intra_load``) inside the
+call's source segment — ``payload``, ``json.loads`` and friends do not
+match.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Checker, Module, Violation
+
+_LOAD_TOKEN = re.compile(
+    r"(?<![A-Za-z0-9_])(?:dp_|meta_|intra_)?load(?![A-Za-z0-9_])")
+_CFZ002_SCOPE = "cubefs_tpu/fs/"
+_CFZ002_EXEMPT = ("cubefs_tpu/fs/topology.py",)
+_CFZ003_SANCTIONED = ("cubefs_tpu/fs/remotecache.py",
+                      "cubefs_tpu/sdk/clients.py")
+
+
+class FsPlacementChecker(Checker):
+    rule = "fs-placement"
+    dirs = ("cubefs_tpu/",)
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+        sort_scoped = (mod.relpath.startswith(_CFZ002_SCOPE)
+                       and mod.relpath not in _CFZ002_EXEMPT)
+        put_scoped = mod.relpath not in _CFZ003_SANCTIONED
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if sort_scoped:
+                what = None
+                if isinstance(func, ast.Name) and func.id in (
+                        "sorted", "min", "max"):
+                    what = f"{func.id}()"
+                elif isinstance(func, ast.Attribute) and func.attr == "sort":
+                    what = ".sort()"
+                if what is not None and _LOAD_TOKEN.search(mod.segment(node)):
+                    out.append(self.violation(
+                        mod, "CFZ002", node,
+                        f"{what} over a load map outside fs/topology.py "
+                        f"— route the selection through "
+                        f"topology.select_hosts / pick_destination / "
+                        f"order_by_load so AZ and rack constraints "
+                        f"apply"))
+                    continue
+            if not put_scoped:
+                continue
+            if isinstance(func, ast.Attribute) and func.attr == "cache_put":
+                out.append(self.violation(
+                    mod, "CFZ003", node,
+                    "direct cache_put outside fs/remotecache.py — flash "
+                    "population must go through CachedReader._populate "
+                    "(hotness admission + fill accounting + the "
+                    "per-AZ invalidation contract)"))
+            elif (isinstance(func, ast.Attribute) and func.attr == "call"
+                  and node.args
+                  and isinstance(node.args[0], ast.Constant)
+                  and node.args[0].value == "cache_put"):
+                out.append(self.violation(
+                    mod, "CFZ003", node,
+                    'raw .call("cache_put", ...) outside '
+                    "fs/remotecache.py — flash population must go "
+                    "through CachedReader._populate"))
+        return out
