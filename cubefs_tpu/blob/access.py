@@ -25,7 +25,7 @@ import numpy as np
 
 from ..codec import codemode as cm
 from ..codec.encoder import CodecConfig, new_encoder
-from ..utils import metrics, rpc
+from ..utils import metrics, qos, rpc
 from ..utils import trace as tracelib
 from .types import Location, Slice, VolumeInfo
 
@@ -57,6 +57,9 @@ class AccessConfig:
     # failure-domain locality: with an AZ label, degraded LRC reads try
     # this AZ's local stripe first (blob/topology.py contract)
     client_az: str | None = None
+    # admission gate for the put/get/delete front doors; None = the
+    # process-wide qos.DEFAULT (drills inject a FakeClock gate)
+    qos_gate: object | None = None
 
 
 class AccessHandler:
@@ -68,6 +71,7 @@ class AccessHandler:
         self.cm = cm_client
         self.nodes = node_clients
         self.cfg = cfg or AccessConfig()
+        self.qos = self.cfg.qos_gate or qos.DEFAULT
         self.proxy = proxy_client  # allocation cache (blob/proxy.py)
         self.repair_queue = repair_queue
         self.delete_queue = delete_queue
@@ -97,10 +101,13 @@ class AccessHandler:
             return self._encoders[mode]
 
     # ------------------------------ PUT ------------------------------
-    def put(self, data: bytes, codemode: int | None = None) -> Location:
-        with tracelib.path_span("blob.put", "access.put") as sp:
-            sp.set_tag("svc", "access").set_tag("bytes", len(data))
-            return self._put(data, codemode)
+    def put(self, data: bytes, codemode: int | None = None, *,
+            tenant: str | None = None) -> Location:
+        with self.qos.admit("blob.put", tenant=tenant, cost=len(data),
+                            svc="access"):
+            with tracelib.path_span("blob.put", "access.put") as sp:
+                sp.set_tag("svc", "access").set_tag("bytes", len(data))
+                return self._put(data, codemode)
 
     def _put(self, data: bytes, codemode: int | None = None) -> Location:
         if not data:
@@ -224,10 +231,12 @@ class AccessHandler:
             return bid, unit.index, e
 
     # ------------------------------ GET ------------------------------
-    def get(self, loc: Location) -> bytes:
-        with tracelib.path_span("blob.get", "access.get") as sp:
-            sp.set_tag("svc", "access").set_tag("bytes", loc.size)
-            return self._get(loc)
+    def get(self, loc: Location, *, tenant: str | None = None) -> bytes:
+        with self.qos.admit("blob.get", tenant=tenant, cost=loc.size,
+                            svc="access"):
+            with tracelib.path_span("blob.get", "access.get") as sp:
+                sp.set_tag("svc", "access").set_tag("bytes", loc.size)
+                return self._get(loc)
 
     def _get(self, loc: Location) -> bytes:
         enc = self._encoder(loc.codemode)
@@ -420,17 +429,18 @@ class AccessHandler:
                     got[j] = local[pos].tobytes()
 
     # ----------------------------- DELETE -----------------------------
-    def delete(self, loc: Location) -> None:
+    def delete(self, loc: Location, *, tenant: str | None = None) -> None:
         """Mark-delete: enqueue async deletion (proxy/mq analog); the
         consumer (scheduler blob_deleter) performs the actual unlink."""
-        if self.delete_queue is None:
-            self._delete_now(loc)
-            return
-        for sl in loc.slices:
-            self.delete_queue.put(
-                {"type": "blob_delete", "vid": sl.vid,
-                 "min_bid": sl.min_bid, "count": sl.count}
-            )
+        with self.qos.admit("blob.delete", tenant=tenant, svc="access"):
+            if self.delete_queue is None:
+                self._delete_now(loc)
+                return
+            for sl in loc.slices:
+                self.delete_queue.put(
+                    {"type": "blob_delete", "vid": sl.vid,
+                     "min_bid": sl.min_bid, "count": sl.count}
+                )
 
     def _delete_now(self, loc: Location) -> None:
         for sl in loc.slices:
@@ -450,14 +460,17 @@ class AccessHandler:
 
     # ---------------- RPC surface ----------------
     def rpc_put(self, args, body):
-        loc = self.put(body, args.get("codemode"))
+        loc = self.put(body, args.get("codemode"),
+                       tenant=args.get("tenant"))
         return {"location": loc.to_dict()}
 
     def rpc_get(self, args, body):
-        return {}, self.get(Location.from_dict(args["location"]))
+        return {}, self.get(Location.from_dict(args["location"]),
+                            tenant=args.get("tenant"))
 
     def rpc_delete(self, args, body):
-        self.delete(Location.from_dict(args["location"]))
+        self.delete(Location.from_dict(args["location"]),
+                    tenant=args.get("tenant"))
         return {}
 
 

@@ -17,7 +17,7 @@ import time
 import uuid
 
 from ..codec import codemode as cmode
-from ..utils import metrics, rpc
+from ..utils import metrics, qos, rpc
 from ..utils.retry import RetryPolicy
 
 # shard deletes: 2 quick retries on node-level blips, tightly bounded —
@@ -267,7 +267,11 @@ class Scheduler:
                 "CUBEFS_CODEC_STEP_BYTES", str(64 << 20)) or str(64 << 20))
         except ValueError:
             step_bytes = 64 << 20
-        step_bytes = max(1, step_bytes)
+        # graceful brownout: while any path burns SLO budget, repair
+        # drains in smaller steps so reconstruct reads yield bandwidth
+        # to foreground IO (1.0 healthy / 0.5 warn / 0.25 critical)
+        qos_scale = qos.repair_step_scale()
+        step_bytes = max(1, int(step_bytes * qos_scale))
         with self._lock:
             open_tasks = [t for t in self.tasks.values()
                           if t.get("src_disk") == disk_id
@@ -285,6 +289,7 @@ class Scheduler:
                 acc += b
             plan = {"disk_id": disk_id, "tasks": len(open_tasks),
                     "total_bytes": total, "step_bytes": step_bytes,
+                    "qos_scale": qos_scale,
                     "steps": (step + 1) if open_tasks else 0}
             self.last_drain_plan = plan
             if open_tasks:

@@ -6,11 +6,16 @@ degraded reconstruct) directly in-process, talking straight to
 clustermgr and blobnodes. `BlobClient` wraps AccessHandler with
 location (de)serialization, so a consumer needs only the clustermgr
 address and a node pool.
+
+QoS shed (429) surfaces here as client backoff: the SDK retries
+through a `RetryPolicy`, honoring the gate's retry-after hint, so a
+throttled tenant degrades to slower progress instead of hard errors.
 """
 
 from __future__ import annotations
 
-from ..utils import rpc
+from ..utils import qos, rpc
+from ..utils.retry import RetryPolicy
 from .access import AccessConfig, AccessHandler
 from .types import Location
 
@@ -19,7 +24,9 @@ class BlobClient:
     """In-process blob put/get/delete (the embedded access client)."""
 
     def __init__(self, clustermgr, node_pool, cfg: AccessConfig | None = None,
-                 proxy=None, client_az: str | None = None):
+                 proxy=None, client_az: str | None = None,
+                 tenant: str | None = None,
+                 throttle_policy: RetryPolicy | None = None):
         cm_client = (clustermgr if isinstance(clustermgr, rpc.Client)
                      else rpc.Client(clustermgr))
         proxy_client = (None if proxy is None else
@@ -30,15 +37,34 @@ class BlobClient:
             # prefer the local stripe (blob/topology.py contract)
             cfg = cfg or AccessConfig()
             cfg.client_az = client_az
+        self.tenant = tenant
+        # 429 backoff: a few shaped retries, then the shed propagates
+        self._throttle_policy = throttle_policy or RetryPolicy(
+            base=0.1, cap=2.0, max_retries=4, deadline=10.0)
         self._h = AccessHandler(cm_client, node_pool, cfg,
                                 proxy_client=proxy_client)
 
+    def _shaped(self, op, *args, **kw):
+        r = self._throttle_policy.start(op.__name__)
+        while True:
+            try:
+                return op(*args, **kw)
+            except qos.QosRejected:
+                if not r.tick(reason="throttled"):
+                    raise
+            except rpc.RpcError as e:
+                if e.code != 429 or not r.tick(reason="throttled"):
+                    raise
+
     def put(self, data: bytes, codemode: int | None = None) -> dict:
         """Store bytes; returns a JSON-serializable location."""
-        return self._h.put(data, codemode).to_dict()
+        return self._shaped(self._h.put, data, codemode,
+                            tenant=self.tenant).to_dict()
 
     def get(self, location: dict) -> bytes:
-        return self._h.get(Location.from_dict(location))
+        return self._shaped(self._h.get, Location.from_dict(location),
+                            tenant=self.tenant)
 
     def delete(self, location: dict) -> None:
-        self._h.delete(Location.from_dict(location))
+        self._shaped(self._h.delete, Location.from_dict(location),
+                     tenant=self.tenant)

@@ -263,6 +263,46 @@ def _read_path_view(text: str) -> dict:
     }
 
 
+def _qos_view(text: str) -> dict:
+    """The overload-protection digest: per-tenant admit/shed/throttle
+    counters, shaping waits, and burn-rate brownout state per path —
+    whether the gate is shedding, who it is shedding, and why."""
+    series = _parse_metrics(text)
+
+    def total(name, **match):
+        return sum(v for n, lb, v in series if n == name
+                   and all(lb.get(k) == str(w) for k, w in match.items()))
+
+    tenants = sorted({lb["tenant"] for n, lb, _ in series
+                      if n in ("cubefs_qos_admitted_total",
+                               "cubefs_qos_shed_total",
+                               "cubefs_qos_throttled_total")
+                      and "tenant" in lb})
+    per_tenant = {}
+    for t in tenants:
+        shed_reasons = {lb.get("reason", ""): v for n, lb, v in series
+                        if n == "cubefs_qos_shed_total"
+                        and lb.get("tenant") == t}
+        per_tenant[t] = {
+            "admitted": total("cubefs_qos_admitted_total", tenant=t),
+            "shed": sum(shed_reasons.values()),
+            "shed_reasons": shed_reasons,
+            "throttled": total("cubefs_qos_throttled_total", tenant=t),
+        }
+    brownout = {lb.get("path", ""): int(v) for n, lb, v in series
+                if n == "cubefs_qos_brownout_level"}
+    burn = {lb.get("path", ""): v for n, lb, v in series
+            if n == "cubefs_slo_burn_rate"}
+    return {
+        "tenants": per_tenant,
+        "brownout_level": brownout,
+        "burn_rate": burn,
+        "inflight": {lb.get("path", ""): int(v) for n, lb, v in series
+                     if n == "cubefs_qos_inflight"},
+        "ratelimit_waits": total("cubefs_ratelimit_waits_total"),
+    }
+
+
 def _slo_view(text: str) -> dict:
     """The tail-latency digest: per-path quantiles from the sliding
     window, SLO burn rate, and remaining error budget (scraping
@@ -420,7 +460,7 @@ def main(argv=None):
     p_metrics = sub.add_parser("metrics")  # node observability views
     p_metrics.add_argument("action",
                            choices=["write-path", "codec", "repair", "slo",
-                                    "read-path", "raw"])
+                                    "read-path", "qos", "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
 
@@ -711,6 +751,8 @@ def main(argv=None):
             print(json.dumps(_slo_view(text), indent=2))
         elif args.action == "read-path":
             print(json.dumps(_read_path_view(text), indent=2))
+        elif args.action == "qos":
+            print(json.dumps(_qos_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
 

@@ -24,6 +24,7 @@ import json
 from . import metanode as mn
 from . import s3policy
 from . import s3version
+from ..utils import qos
 from .client import FileSystem, FsError
 
 
@@ -44,11 +45,14 @@ def _parse_http_date(s: str) -> float | None:
 
 class ObjectNode:
     def __init__(self, volumes: dict[str, FileSystem], host="127.0.0.1", port=0,
-                 authenticator=None, audit_sinks=None):
+                 authenticator=None, audit_sinks=None, qos_gate=None):
         from . import s3ext
 
         self.volumes = dict(volumes)
         self.auth = authenticator
+        # per-tenant admission (tenant = authenticated principal);
+        # None = the process-wide gate, CUBEFS_QOS=0 no-ops it
+        self.qos = qos_gate or qos.DEFAULT
         # access-audit fan-out (audit_webhook.go / audit_kafka.go role):
         # every reply emits one event to each sink, fire-and-forget
         self.audit_sinks = list(audit_sinks or [])
@@ -104,6 +108,7 @@ class ObjectNode:
                     "method": self.command, "bucket": bucket,
                     "key": key, "code": code,
                     "principal": getattr(self, "_principal", None),
+                    "tenant": getattr(self, "_tenant", None),
                     "bytes_out": bytes_out,
                     "bytes_in": len(getattr(self, "_stashed_body",
                                             b"") or b""),
@@ -136,10 +141,41 @@ class ObjectNode:
                 ).encode()
                 self._reply(code, body)
 
+            def _admit_qos(self) -> bool:
+                """QoS admission for the authenticated request (tenant =
+                principal). On shed, replies 429 SlowDown with a
+                Retry-After hint and returns False. The admission slot
+                is released in handle_one_request's finally."""
+                tenant = self._principal or "anonymous"
+                self._tenant = tenant
+                try:
+                    self._admission = outer.qos.admit(
+                        f"s3.{self.command.lower()}", tenant=tenant,
+                        cost=max(1, len(self._stashed_body)), svc="s3")
+                except qos.QosRejected as e:
+                    body = (
+                        f"<?xml version='1.0'?><Error><Code>SlowDown"
+                        f"</Code><Message>{xs.escape(e.message)}"
+                        f"</Message></Error>").encode()
+                    self._reply(429, body, headers={
+                        "Retry-After": f"{e.retry_after:.3f}"})
+                    return False
+                return True
+
+            def handle_one_request(self):
+                try:
+                    super().handle_one_request()
+                finally:
+                    adm = getattr(self, "_admission", None)
+                    if adm is not None:
+                        self._admission = None
+                        adm.release()
+
             def _begin(self):
-                """Drain+stash the body and authenticate. Returns the
-                (bucket, key, query) triple, or None if a 403 was
-                already sent. Sets self._principal (None = anonymous)."""
+                """Drain+stash the body, authenticate, and pass QoS
+                admission. Returns the (bucket, key, query) triple, or
+                None if a 403/429 was already sent. Sets
+                self._principal (None = anonymous) and self._tenant."""
                 # the handler object lives for a whole keep-alive
                 # connection: bucket config must be re-read per REQUEST
                 # or an ACL/policy revocation never reaches it — and the
@@ -148,6 +184,8 @@ class ObjectNode:
                 self._conf_cache = None
                 self._via_token = False
                 self._principal = None
+                self._tenant = None
+                self._admission = None
                 self._stashed_body = b""
                 self._route = self._split()[:2]
                 if outer.auth is None:
@@ -162,6 +200,8 @@ class ObjectNode:
                         self._stashed_body = s3ext.strip_aws_chunked(
                             self._stashed_body)
                     self._principal = None
+                    if not self._admit_qos():
+                        return None
                     return self._split()
                 ok, who, reason = outer.auth.authenticate(self)
                 if not ok:
@@ -180,6 +220,8 @@ class ObjectNode:
                     self._error(403, code, reason)
                     return None
                 self._principal = who
+                if not self._admit_qos():
+                    return None
                 return self._split()
 
             def _bucket_conf(self, bucket) -> dict:
@@ -247,9 +289,11 @@ class ObjectNode:
                 return s3policy.cors_headers(rule, origin) if rule else {}
 
             def do_OPTIONS(self):
-                # CORS preflight
+                # CORS preflight: allowlisted from QoS admission (no
+                # data path; shedding it would break browser clients)
                 self._conf_cache = None
                 self._principal = None
+                self._tenant = None
                 self._stashed_body = b""
                 bucket, key, _ = self._split()
                 self._route = (bucket, key)

@@ -20,7 +20,7 @@ import threading
 import time
 from collections import OrderedDict
 
-from ..utils import metrics, rpc, trace
+from ..utils import metrics, qos, rpc, trace
 from ..utils.fsm import ReplicatedFsm
 from ..utils.retry import CircuitBreaker
 
@@ -393,7 +393,12 @@ class CachedReader:
             piece = data[off:off + CACHE_BLOCK]
             k = key if b == block else self._key(
                 dp["dp_id"], extent_id, b)
-            if self._heat_up(k) >= self.hotness_threshold:
+            if qos.fill_suppressed():
+                # brownout: cache population is deferrable work — stop
+                # copying datanode blocks into the flash tier while any
+                # path burns SLO budget (reads still hit existing cache)
+                metrics.readcache_fills.inc(outcome="suppressed")
+            elif self._heat_up(k) >= self.hotness_threshold:
                 with trace.stage("cache_fill", path="fs.read"):
                     self._populate(k, piece)
             else:

@@ -1,0 +1,390 @@
+"""Closed-loop million-client traffic model on FakeClock.
+
+The ROADMAP's brownout-under-load gate: a deterministic event-driven
+simulation of 10^5-10^6 clients with tenant identity, zipf-skewed
+object popularity, and open/closed arrival mixing, driving the
+per-tenant QoS gate (utils/qos.py) against a shared-capacity queueing
+backend — all on virtual time, so the same seed produces the same
+schedule digest byte for byte.
+
+Model
+-----
+Each client is one entry in a single event heap `(t, seq, client_id)`;
+per-client state is derived from the id (tenant = id range), and all
+randomness comes from one seeded `random.Random`, drawn in heap-pop
+order — no wall clock, no threads, no per-client objects, which is
+what makes 10^6 clients tractable and bit-reproducible.
+
+- closed loop: a client's next request departs `latency + think` after
+  the previous one completes (think ~ Exp(mean think_s)), so a
+  saturated server self-limits its clients — the production behavior
+  token-bucket sizing must be judged against.
+- open mixing: with probability `open_fraction` the next arrival is
+  scheduled `Exp(think_s)` after the *previous arrival* instead,
+  modeling fire-and-forget producers that do not slow down under
+  brownout.
+- zipf popularity: object ranks weighted 1/rank^s over `n_objects`,
+  sampled by CDF bisect.
+
+`SimBackend` is a deterministic shared-FIFO queueing model: one
+server of `capacity` cost-units/s; latency = queue wait + service.
+One tenant saturating PUTs therefore inflates every tenant's tail —
+exactly the noisy-neighbor failure the QoS gate must contain.
+
+The `--qos-ab` driver runs the seeded noisy-neighbor drill ABBA
+(on, off, off, on) and writes `artifacts/QOS_AB_r12.json`: with QoS
+on, the victim's read p99 stays within its registered SLO budget
+while the bully is shed; door-off, the same seed demonstrably
+violates it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import bisect
+import hashlib
+import heapq
+import json
+import math
+import random
+from typing import NamedTuple
+
+from ..utils import metrics, qos, slo
+from ..utils.retry import FakeClock
+
+
+class TenantSpec(NamedTuple):
+    """One tenant population: `clients` identical closed-loop clients."""
+    name: str
+    clients: int
+    think_s: float = 1.0        # mean think time between requests
+    read_fraction: float = 0.5  # GET share; rest are PUTs
+    put_cost: float = 8.0       # cost units per PUT (relative bytes)
+    get_cost: float = 1.0       # cost units per GET
+    open_fraction: float = 0.0  # share of arrivals that are open-loop
+    priority: int = qos.FOREGROUND
+
+
+class SimBackend:
+    """Shared-capacity FIFO server: the cluster reduced to one queue.
+
+    Deterministic: `issue(t, cost)` returns queue-wait + service time
+    against a single `busy_until` horizon. A closed-loop client fleet
+    against this reproduces the classic saturation curve (latency ~
+    outstanding_work / capacity) without threads or wall time."""
+
+    def __init__(self, capacity: float = 2000.0, base_latency: float = 0.002):
+        self.capacity = float(capacity)
+        self.base_latency = float(base_latency)
+        self.busy_until = 0.0
+        self.served_cost = 0.0
+
+    def issue(self, t: float, cost: float) -> float:
+        start = max(t, self.busy_until)
+        service = cost / self.capacity
+        self.busy_until = start + service
+        self.served_cost += cost
+        return (self.busy_until - t) + self.base_latency
+
+
+class _Measure:
+    """Per-(tenant, path) latency windows kept OUTSIDE the gate, so
+    the off leg (gate no-op) measures with the identical instrument."""
+
+    def __init__(self, clock, horizon_s: float):
+        self._clock = clock
+        self._horizon = horizon_s
+        self._wh: dict[tuple[str, str], slo.WindowedHistogram] = {}
+
+    def observe(self, tenant: str, path: str, latency: float) -> None:
+        key = (tenant, path)
+        wh = self._wh.get(key)
+        if wh is None:
+            wh = slo.WindowedHistogram(
+                window_s=self._horizon, windows=1, clock=self._clock)
+            self._wh[key] = wh
+        wh.observe(latency)
+
+    def quantile(self, tenant: str, path: str, q: float) -> float:
+        wh = self._wh.get((tenant, path))
+        return wh.quantile(q) if wh is not None else 0.0
+
+    def count(self, tenant: str, path: str) -> int:
+        wh = self._wh.get((tenant, path))
+        return wh.count() if wh is not None else 0
+
+
+class LoadModel:
+    """The event loop: seeded, clocked, digested."""
+
+    def __init__(self, tenants: list[TenantSpec], *, seed: int = 0,
+                 n_objects: int = 4096, zipf_s: float = 1.1,
+                 backend: SimBackend | None = None,
+                 gate: "qos.QosGate | None" = None,
+                 clock: FakeClock | None = None,
+                 slo_hist: metrics.Histogram | None = None,
+                 warmup_s: float = 1.0,
+                 max_retries: int = 8):
+        self.tenants = list(tenants)
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.clock = clock or FakeClock()
+        self.backend = backend or SimBackend()
+        self.gate = gate
+        # the gate's SloTracker reads this histogram's {path,
+        # stage="total"} series — the simulation feeds it directly so
+        # burn rates close the loop on modeled latency
+        self.slo_hist = slo_hist
+        self.warmup_s = warmup_s
+        self.max_retries = max_retries
+        # zipf CDF over object ranks (sampled by bisect)
+        weights = [1.0 / (r ** zipf_s) for r in range(1, n_objects + 1)]
+        total = math.fsum(weights)
+        acc, cdf = 0.0, []
+        for w in weights:
+            acc += w
+            cdf.append(acc / total)
+        self._zipf_cdf = cdf
+        # client_id -> tenant via contiguous id ranges
+        self._bounds, self._specs = [], []
+        base = 0
+        for t in self.tenants:
+            base += t.clients
+            self._bounds.append(base)
+            self._specs.append(t)
+        self.n_clients = base
+        self._digest = hashlib.sha256()
+        self.stats = {
+            "events": 0, "issued": 0, "shed": 0, "retries_exhausted": 0,
+            "per_tenant": {t.name: {"issued": 0, "shed": 0, "cost": 0.0}
+                           for t in self.tenants},
+        }
+
+    def _tenant_of(self, cid: int) -> TenantSpec:
+        return self._specs[bisect.bisect_right(self._bounds, cid)]
+
+    def _sample_object(self) -> int:
+        return bisect.bisect_left(self._zipf_cdf, self.rng.random())
+
+    def _exp(self, mean: float) -> float:
+        # inverse-CDF draw from the shared rng (deterministic order)
+        u = self.rng.random()
+        return -mean * math.log(1.0 - u) if mean > 0 else 0.0
+
+    def schedule_digest(self) -> str:
+        return self._digest.hexdigest()
+
+    def run(self, duration_s: float = 30.0,
+            max_events: int = 1_000_000) -> dict:
+        """Drive the fleet for `duration_s` of virtual time (or until
+        `max_events`). Returns the stats dict (digest included)."""
+        heap: list[tuple[float, int, int, int]] = []
+        seq = 0
+        for cid in range(self.n_clients):
+            # staggered first arrivals across the warmup window; the
+            # 4th tuple slot is the retry count of a shed request
+            heap.append((self.rng.random() * self.warmup_s, seq, cid, 0))
+            seq += 1
+        heapq.heapify(heap)
+        measure = _Measure(self.clock, horizon_s=duration_s + self.warmup_s)
+        self.measure = measure
+        while heap and self.stats["events"] < max_events:
+            t, _, cid, retries = heapq.heappop(heap)
+            if t > duration_s:
+                break
+            now = self.clock.now()
+            if t > now:
+                self.clock.advance(t - now)
+            spec = self._tenant_of(cid)
+            is_read = self.rng.random() < spec.read_fraction
+            op = "get" if is_read else "put"
+            path = f"blob.{op}"
+            cost = spec.get_cost if is_read else spec.put_cost
+            obj = self._sample_object()
+            self.stats["events"] += 1
+            self._digest.update(
+                f"{t:.9f}|{cid}|{spec.name}|{op}|{obj}|{retries}\n"
+                .encode())
+            pt = self.stats["per_tenant"][spec.name]
+            try:
+                if self.gate is not None:
+                    adm = self.gate.admit(path, tenant=spec.name,
+                                          priority=spec.priority, cost=cost)
+                else:
+                    adm = qos.NOOP_ADMISSION
+                with adm:
+                    latency = (self.backend.issue(t, cost)
+                               + adm.throttle_s)
+            except qos.QosRejected as e:
+                self.stats["shed"] += 1
+                pt["shed"] += 1
+                if retries < self.max_retries:
+                    # capped exponential client backoff on 429, as the
+                    # SDK's RetryPolicy would apply over the hint
+                    backoff = min(5.0, e.retry_after * (2 ** retries))
+                    heapq.heappush(
+                        heap, (t + backoff + self._exp(backoff / 2),
+                               seq, cid, retries + 1))
+                    seq += 1
+                else:
+                    # give up this request; client thinks, then moves on
+                    self.stats["retries_exhausted"] += 1
+                    heapq.heappush(
+                        heap, (t + self._exp(spec.think_s), seq, cid, 0))
+                    seq += 1
+                continue
+            self.stats["issued"] += 1
+            pt["issued"] += 1
+            pt["cost"] += cost
+            measure.observe(spec.name, path, latency)
+            if self.slo_hist is not None:
+                self.slo_hist.observe(latency, path=path, stage="total")
+            if self.rng.random() < spec.open_fraction:
+                # open-loop: next arrival independent of completion
+                nxt = t + self._exp(spec.think_s)
+            else:
+                nxt = t + latency + self._exp(spec.think_s)
+            heapq.heappush(heap, (nxt, seq, cid, 0))
+            seq += 1
+        self.stats["digest"] = self.schedule_digest()
+        self.stats["clients"] = self.n_clients
+        self.stats["virtual_s"] = round(self.clock.now(), 6)
+        return self.stats
+
+
+# --------------------------------------------------- noisy-neighbor drill
+
+VICTIM_SLO = slo.SloTarget(0.25, 0.999)  # blob.get: 250ms @ 99.9%
+
+
+def noisy_neighbor_leg(seed: int, qos_on: bool, *,
+                       victim_clients: int = 400,
+                       bully_clients: int = 1600,
+                       capacity: float = 2000.0,
+                       bully_quota: float = 800.0,
+                       duration_s: float = 30.0) -> dict:
+    """One leg of the drill: a well-behaved read-mostly victim sharing
+    the cluster with a bully saturating PUTs. Returns the victim's
+    p99 vs its SLO budget, bully progress, shed counts, digest."""
+    clock = FakeClock()
+    hist = metrics.Histogram("loadgen_stage_seconds", "", ("path", "stage"))
+    tracker = slo.SloTracker(hist=hist, clock=clock, window_s=2.0, windows=5)
+    tracker.register("blob.get", VICTIM_SLO.target_s, VICTIM_SLO.objective)
+    tracker.register("blob.put", 0.5, 0.999)
+    gate = None
+    if qos_on:
+        gate = qos.QosGate(tracker=tracker, clock=clock, blocking=False,
+                           max_inflight=100_000, refresh_s=0.5,
+                           shaping_timeout=0.05)
+        # quota config: the bully's PUT budget is 40% of capacity with
+        # a quarter-second burst allowance (a full-second burst would
+        # itself flood the shared FIFO past the victim's 250ms budget);
+        # the victim is trusted (unconfigured => work-conserving)
+        gate.configure("bully", rate=bully_quota, burst=bully_quota / 4)
+    tenants = [
+        TenantSpec("victim", victim_clients, think_s=1.0,
+                   read_fraction=1.0, get_cost=1.0),
+        TenantSpec("bully", bully_clients, think_s=0.2,
+                   read_fraction=0.0, put_cost=8.0, open_fraction=0.25),
+    ]
+    model = LoadModel(tenants, seed=seed, clock=clock, gate=gate,
+                      backend=SimBackend(capacity=capacity),
+                      slo_hist=hist)
+    stats = model.run(duration_s=duration_s, max_events=400_000)
+    p99 = model.measure.quantile("victim", "blob.get", 0.99)
+    return {
+        "qos": "on" if qos_on else "off",
+        "seed": seed,
+        "digest": stats["digest"],
+        "events": stats["events"],
+        "victim": {
+            "reads": model.measure.count("victim", "blob.get"),
+            "p99_s": round(p99, 6),
+            "slo_target_s": VICTIM_SLO.target_s,
+            "within_budget": bool(p99 <= VICTIM_SLO.target_s),
+        },
+        "bully": {
+            "issued": stats["per_tenant"]["bully"]["issued"],
+            "shed": stats["per_tenant"]["bully"]["shed"],
+            "cost_admitted": round(
+                stats["per_tenant"]["bully"]["cost"], 1),
+        },
+        "shed_total": stats["shed"],
+    }
+
+
+def qos_ab(seed: int = 12, out: str | None = None) -> dict:
+    """ABBA noisy-neighbor A/B: legs (on, off, off, on), same seed.
+    QoS on must keep the victim within budget; off must violate it."""
+    legs = [noisy_neighbor_leg(seed, on) for on in (True, False,
+                                                    False, True)]
+    on_legs = [r for r in legs if r["qos"] == "on"]
+    off_legs = [r for r in legs if r["qos"] == "off"]
+    result = {
+        "bench": "QOS_AB",
+        "seed": seed,
+        "order": ["on", "off", "off", "on"],
+        "legs": legs,
+        "victim_slo": {"path": "blob.get",
+                       "target_s": VICTIM_SLO.target_s,
+                       "objective": VICTIM_SLO.objective},
+        "qos_on_within_budget": all(
+            r["victim"]["within_budget"] for r in on_legs),
+        "qos_off_violates": all(
+            not r["victim"]["within_budget"] for r in off_legs),
+        "reproducible": (
+            on_legs[0]["digest"] == on_legs[1]["digest"]
+            and off_legs[0]["digest"] == off_legs[1]["digest"]),
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+    return result
+
+
+def scale_run(clients: int = 100_000, seed: int = 7,
+              max_events: int = 150_000, duration_s: float = 5.0) -> dict:
+    """The >=10^5-client determinism check: a large mixed fleet against
+    an uncontended backend, digest-stable across runs of the same
+    seed. No gate — this measures the model, not the policy."""
+    tenants = [
+        TenantSpec("web", int(clients * 0.6), think_s=30.0,
+                   read_fraction=0.9, open_fraction=0.1),
+        TenantSpec("batch", int(clients * 0.3), think_s=60.0,
+                   read_fraction=0.2),
+        TenantSpec("scan", clients - int(clients * 0.6)
+                   - int(clients * 0.3), think_s=45.0, read_fraction=1.0),
+    ]
+    model = LoadModel(tenants, seed=seed,
+                      backend=SimBackend(capacity=1e9, base_latency=0.001),
+                      n_objects=65536)
+    return model.run(duration_s=duration_s, max_events=max_events)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic closed-loop traffic model / QoS drills")
+    ap.add_argument("--qos-ab", action="store_true",
+                    help="run the ABBA noisy-neighbor drill")
+    ap.add_argument("--scale", type=int, default=0, metavar="CLIENTS",
+                    help="run a CLIENTS-sized determinism check")
+    ap.add_argument("--seed", type=int, default=12)
+    ap.add_argument("--out", default=None, help="write JSON artifact here")
+    args = ap.parse_args(argv)
+    if args.qos_ab:
+        result = qos_ab(seed=args.seed, out=args.out)
+        print(json.dumps(result, indent=2))
+        return 0 if (result["qos_on_within_budget"]
+                     and result["qos_off_violates"]
+                     and result["reproducible"]) else 1
+    if args.scale:
+        stats = scale_run(clients=args.scale, seed=args.seed)
+        print(json.dumps({k: v for k, v in stats.items()
+                          if k != "per_tenant"}, indent=2))
+        return 0
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,11 +23,14 @@ class AuditLogger:
         self._f = open(path, "a")
 
     def record(self, service: str, method: str, code: int, latency_s: float,
-               trace_id: str = "", detail: str = "") -> None:
+               trace_id: str = "", detail: str = "",
+               tenant: str = "") -> None:
         rec = {
             "ts": round(time.time(), 3), "svc": service, "op": method,
             "code": code, "lat_ms": round(latency_s * 1000, 2),
         }
+        if tenant:
+            rec["tenant"] = tenant
         if trace_id:
             rec["trace"] = trace_id
         if detail:

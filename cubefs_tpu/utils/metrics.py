@@ -367,7 +367,8 @@ readcache_fills = DEFAULT.counter(
     "cubefs_readcache_fills_total",
     "miss-path outcomes: `populated` pushed the block to a flashnode, "
     "`skipped_cold` failed the hotness admission bar (streaming scans "
-    "must not flush the hot set), `failed` found no writable flashnode",
+    "must not flush the hot set), `failed` found no writable flashnode, "
+    "`suppressed` deferred the fill during a QoS brownout",
     ("outcome",))
 readcache_singleflight = DEFAULT.counter(
     "cubefs_readcache_singleflight_total",
@@ -381,3 +382,44 @@ fs_placement_misplaced = DEFAULT.gauge(
     "cubefs_fs_placement_misplaced_replicas",
     "dp replicas colocated in an AZ beyond the one-per-AZ fair share; "
     "the rate-limited misplaced-replica sweep drives this to zero")
+
+# token-bucket shaping (utils/ratelimit.py) — every shaped reservation
+# is observable, whether the bucket itself sleeps or the QoS gate
+# carries the wait as an admission delay.
+ratelimit_waits = DEFAULT.counter(
+    "cubefs_ratelimit_waits_total",
+    "token-bucket reservations that had to wait for refill", ("limiter",))
+ratelimit_wait_seconds = DEFAULT.histogram(
+    "cubefs_ratelimit_wait_seconds",
+    "per-reservation token-bucket wait (virtual-queue debt / rate)",
+    ("limiter",))
+
+# per-tenant QoS admission (utils/qos.py): the objectnode/S3 and blob
+# access front doors. `cubefs-cli metrics qos` renders these. Tenant
+# label cardinality is bounded by quota config (unconfigured tenants
+# appear only while active).
+qos_admitted = DEFAULT.counter(
+    "cubefs_qos_admitted_total",
+    "requests admitted through the QoS gate",
+    ("path", "tenant", "priority"))
+qos_shed = DEFAULT.counter(
+    "cubefs_qos_shed_total",
+    "requests shed (429) at admission: `over_quota` exhausted the "
+    "tenant bucket, `queue_depth` hit the per-priority inflight bound, "
+    "`brownout` was a low-priority class dropped while the path burns "
+    "SLO budget", ("path", "tenant", "reason"))
+qos_throttled = DEFAULT.counter(
+    "cubefs_qos_throttled_total",
+    "admissions shaped (delayed but not shed) by the tenant bucket",
+    ("path", "tenant"))
+qos_throttle_wait = DEFAULT.histogram(
+    "cubefs_qos_throttle_wait_seconds",
+    "admission shaping delay applied by the tenant bucket", ("path",))
+qos_inflight = DEFAULT.gauge(
+    "cubefs_qos_inflight",
+    "requests currently inside the QoS gate, per path", ("path",))
+qos_brownout = DEFAULT.gauge(
+    "cubefs_qos_brownout_level",
+    "burn-rate-driven degradation level per path: 0 healthy, 1 shed "
+    "scrub + suppress flash fills + halve repair steps, 2 shed repair "
+    "too and quarter repair steps", ("path",))

@@ -206,7 +206,8 @@ class RpcServer:
                             detail = tracelib.stage_summary(span.trace_id)
                         outer.audit.record(outer.service, name, code, dt,
                                            trace_id=span.trace_id,
-                                           detail=detail)
+                                           detail=detail,
+                                           tenant=getattr(span, "tenant", ""))
 
             def _reply(self, code: int, meta: dict, payload: bytes):
                 self.send_response(code)

@@ -46,6 +46,12 @@ from .retry import MONOTONIC
 _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "cubefs_span", default=None
 )
+# tenant identity of the request being served: stamped by the front
+# doors (objectnode auth, blob access admission), consumed by
+# path_span tags, audit records, and QoS admission defaults.
+_tenant: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "cubefs_tenant", default=""
+)
 
 _collector_lock = threading.Lock()
 # trace_id -> {"root_start": float, "seq": int, "spans": [dict]}; dict
@@ -110,6 +116,20 @@ def _rand_id() -> str:
         return f"{_ids.getrandbits(64):016x}"
 
 
+def set_tenant(tenant: str):
+    """Bind the serving tenant for the current context; returns a
+    token for reset_tenant(). Front doors call this per request."""
+    return _tenant.set(tenant or "")
+
+
+def reset_tenant(token) -> None:
+    _tenant.reset(token)
+
+
+def current_tenant() -> str:
+    return _tenant.get()
+
+
 def _sample_decision() -> bool:
     rate = _sample_rate()
     if rate >= 1.0:
@@ -135,7 +155,7 @@ class SpanRef(NamedTuple):
 class Span:
     def __init__(self, operation: str, trace_id: str | None = None,
                  parent_id: str | None = None, sampled: bool | None = None,
-                 path: str = ""):
+                 path: str = "", tenant: str = ""):
         self.operation = operation
         self.trace_id = trace_id or _rand_id()
         self.span_id = _rand_id()
@@ -143,9 +163,10 @@ class Span:
         # head sampling: roots decide once, children/remote hops inherit
         self.sampled = _sample_decision() if sampled is None else sampled
         self.path = path
+        self.tenant = tenant
         self.start = _clock.now()
         self.finish_ts: float | None = None
-        self.tags: dict = {}
+        self.tags: dict = {"tenant": tenant} if tenant else {}
         self.logs: list[tuple[float, str]] = []
         self.follows: list[dict] = []
         self._token = None
@@ -189,6 +210,14 @@ class Span:
         self.path = path
         return self
 
+    def set_tenant(self, tenant: str) -> "Span":
+        """Stamp the serving tenant (propagated in the header) so
+        slowtrace forensics can attribute tail latency to a tenant."""
+        if tenant:
+            self.tenant = tenant
+            self.tags["tenant"] = tenant
+        return self
+
     def link(self, ref: "SpanRef | Span | None") -> "Span":
         """Record a follows-from link: this span was caused by `ref`
         but is not its child (a drained batch follows every submitter)."""
@@ -228,8 +257,11 @@ class Span:
 
     # ---- propagation ----
     def header(self) -> str:
-        return (f"{self.trace_id}:{self.span_id}:"
-                f"{1 if self.sampled else 0}:{self.path}")
+        h = (f"{self.trace_id}:{self.span_id}:"
+             f"{1 if self.sampled else 0}:{self.path}")
+        if self.tenant:
+            h += f":{self.tenant}"
+        return h
 
 
 class _NoopSpan:
@@ -241,6 +273,7 @@ class _NoopSpan:
     parent_id = None
     sampled = False
     path = ""
+    tenant = ""
     operation = ""
     tags: dict = {}
     follows: list = []
@@ -258,6 +291,9 @@ class _NoopSpan:
         return self
 
     def set_path(self, path):
+        return self
+
+    def set_tenant(self, tenant):
         return self
 
     def link(self, ref):
@@ -292,7 +328,8 @@ def start_span(operation: str, links=()) -> "Span | _NoopSpan":
     parent = _current.get()
     if parent is not None:
         sp = Span(operation, parent.trace_id, parent.span_id,
-                  sampled=parent.sampled, path=parent.path)
+                  sampled=parent.sampled, path=parent.path,
+                  tenant=parent.tenant)
     else:
         sp = Span(operation)
     for ref in links:
@@ -300,30 +337,41 @@ def start_span(operation: str, links=()) -> "Span | _NoopSpan":
     return sp
 
 
-def path_span(path: str, operation: str | None = None) -> "Span | _NoopSpan":
+def path_span(path: str, operation: str | None = None,
+              tenant: str | None = None) -> "Span | _NoopSpan":
     """Span for a hot-path entry point: child of the active request
     span (the RPC hop) when one exists, else a fresh root. Stamps the
     `path` request family consumed by every stage() beneath it — and
     back-stamps an un-labelled enclosing hop span, so the serving RPC
-    root records the end-to-end "total" sample on finish."""
+    root records the end-to-end "total" sample on finish. The serving
+    tenant (explicit, context-bound, or inherited from the hop span)
+    rides along as a span tag and a propagated header field."""
+    if tenant is None:
+        tenant = _tenant.get()
     parent = _current.get()
-    if parent is not None and not parent.path:
-        parent.set_path(path)
+    if parent is not None:
+        if not parent.path:
+            parent.set_path(path)
+        if tenant and not parent.tenant:
+            parent.set_tenant(tenant)
+        elif not tenant:
+            tenant = parent.tenant
     sp = start_span(operation or path)
-    return sp.set_path(path)
+    return sp.set_path(path).set_tenant(tenant)
 
 
 def from_header(operation: str, header: str | None) -> "Span | _NoopSpan":
     if not enabled():
         return NOOP
     if header:
-        parts = header.split(":", 3)
+        parts = header.split(":", 4)
         if len(parts) >= 2 and parts[0]:
             trace_id, parent_id = parts[0], parts[1]
             sampled = parts[2] != "0" if len(parts) >= 3 else True
             path = parts[3] if len(parts) >= 4 else ""
+            tenant = parts[4] if len(parts) >= 5 else ""
             return Span(operation, trace_id, parent_id,
-                        sampled=sampled, path=path)
+                        sampled=sampled, path=path, tenant=tenant)
     return Span(operation)
 
 

@@ -9,3 +9,36 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0xC0DEC)
+
+
+class _StillTracker:
+    """Empty SLO snapshot: the gate sees a healthy system."""
+
+    def snapshot(self):
+        return {}
+
+
+@pytest.fixture(autouse=True)
+def _qos_burn_isolated():
+    """Pin the process-global QoS gate to a burn-free tracker per test.
+
+    `qos.DEFAULT` closes the loop on `slo.DEFAULT_TRACKER`, which
+    windows the process-global stage histogram — so slow samples
+    observed by one test (chaos drills, injected RTTs) would brown out
+    the gate and change behavior in unrelated tests minutes later
+    (suppressed cache fills, shrunken repair steps). Tests that want
+    the burn coupling build a private gate + tracker or use
+    `force_level`, which this fixture leaves alone (and unpins)."""
+    from cubefs_tpu.utils import qos
+
+    saved_tracker = qos.DEFAULT._tracker
+    saved_levels = qos.DEFAULT._levels
+    saved_forced = dict(qos.DEFAULT._forced)
+    qos.DEFAULT._tracker = _StillTracker()
+    qos.DEFAULT._levels = {}
+    qos.DEFAULT._last_refresh = float("-inf")
+    yield
+    qos.DEFAULT._tracker = saved_tracker
+    qos.DEFAULT._levels = saved_levels
+    qos.DEFAULT._forced = saved_forced
+    qos.DEFAULT._last_refresh = float("-inf")

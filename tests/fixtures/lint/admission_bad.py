@@ -1,0 +1,17 @@
+"""admission-discipline fixture: unshaped side doors (CFQ001/CFQ002)."""
+
+
+class Handler:
+    def do_DELETE(self):  # CFQ001: never reaches admission
+        bucket, key, _ = self._split()
+        self.fs.unlink(bucket, key)
+        self._reply(204)
+
+    def _helper(self):  # CFQ002: second admission choke point
+        with self.gate.admit("s3.get", tenant="t"):
+            return self.fs.read()
+
+
+class Access:
+    def rpc_put(self, args, body):  # CFQ001: bypasses the admitted door
+        return self._put_raw(body)

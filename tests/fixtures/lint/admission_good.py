@@ -1,0 +1,38 @@
+"""admission-discipline fixture: clean front doors (no violations)."""
+
+
+class Handler:
+    def do_PUT(self):
+        # verb handler routes through the auth+admission door
+        begun = self._begin()
+        if begun is None:
+            return
+        self.serve(begun)
+
+    def do_GET(self):
+        if not self._admit_qos():
+            return
+        self.serve(None)
+
+    def do_OPTIONS(self):
+        # allowlisted: CORS preflight, no data path
+        self._reply(200)
+
+    def _admit_qos(self):
+        # the one sanctioned choke point may call .admit(
+        self._admission = self.gate.admit("s3.get", tenant="t")
+        return True
+
+
+class Access:
+    def rpc_put(self, args, body):
+        # routes through the admitted public door
+        return self.put(body, tenant=args.get("tenant"))
+
+    def rpc_health(self, args, body):
+        # allowlisted: monitors must not be shed
+        return {"ok": True}
+
+    def put(self, data, tenant=None):
+        with self.qos.admit("blob.put", tenant=tenant, cost=len(data)):
+            return self._put(data)

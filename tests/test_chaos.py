@@ -1150,3 +1150,36 @@ def test_flashnode_death_and_az_blackout_reads_stay_exact(tmp_path):
     assert f1["breaker_open"] and f1["injected_errors"] == 3
     assert f1["cross_az_serves"] == 3
     assert f1["local_resumed_serves"] == 3
+
+
+# ---------------- noisy-neighbor QoS drill (PR 11) ----------------
+
+def test_noisy_neighbor_brownout_drill_is_reproducible():
+    """The PR 11 overload drill: 2000 simulated clients share one
+    FIFO backend; 1600 bully PUT clients saturate it while 400 victim
+    readers hold a 250ms p99 SLO. With the QoS gate on (per-tenant
+    quota + burn-rate brownout) the victim stays within budget and the
+    bully still progresses at its quota; the identical seed with the
+    gate off violates the SLO by an order of magnitude. Both legs are
+    byte-for-byte reproducible on FakeClock."""
+    from cubefs_tpu.tool.loadgen import noisy_neighbor_leg
+
+    on1 = noisy_neighbor_leg(29, True)
+    on2 = noisy_neighbor_leg(29, True)
+    assert on1 == on2                      # digest AND every fact
+    assert on1["victim"]["within_budget"]
+    assert on1["victim"]["reads"] > 1000
+
+    off1 = noisy_neighbor_leg(29, False)
+    off2 = noisy_neighbor_leg(29, False)
+    assert off1 == off2
+    assert not off1["victim"]["within_budget"]
+    assert off1["victim"]["p99_s"] > 4 * on1["victim"]["p99_s"]
+
+    # the gate sheds the bully, not the victim, and is not a brick
+    # wall: admitted bully cost stays near the configured quota
+    assert on1["bully"]["shed"] > 0
+    assert on1["bully"]["cost_admitted"] > 0
+    assert on1["shed_total"] == on1["bully"]["shed"]
+    # the two legs saw the same arrival process up to the first shed
+    assert on1["digest"] != off1["digest"]

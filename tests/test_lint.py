@@ -18,6 +18,7 @@ import sys
 import pytest
 
 from tool.lint import cli, core
+from tool.lint.checkers.admission_discipline import AdmissionDisciplineChecker
 from tool.lint.checkers.batch_discipline import BatchDisciplineChecker
 from tool.lint.checkers.fanout_discipline import FanoutDisciplineChecker
 from tool.lint.checkers.fs_placement import FsPlacementChecker
@@ -326,6 +327,41 @@ def test_cli_entrypoint_exits_clean():
         [sys.executable, "-m", "tool.lint", "-q"],
         cwd=core.REPO_ROOT, capture_output=True, text=True, timeout=120)
     assert rc.returncode == 0, rc.stdout + rc.stderr
+
+
+# ---------------- admission-discipline ----------------
+
+def test_admission_discipline_true_positives_s3():
+    # do_DELETE bypasses _begin/_admit_qos; _helper is a second admit
+    mod = _module("admission_bad.py", "cubefs_tpu/fs/objectnode.py")
+    found = AdmissionDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFQ001", "CFQ002"]
+    assert "do_DELETE" in found[0].message
+
+
+def test_admission_discipline_true_positives_access():
+    # the SAME source under the access front door: rpc_put bypasses
+    # the admitted public methods; do_DELETE is not a handler here
+    mod = _module("admission_bad.py", "cubefs_tpu/blob/access.py")
+    found = AdmissionDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFQ001", "CFQ002"]
+    assert any("rpc_put" in v.message for v in found)
+
+
+def test_admission_discipline_true_negative_both_doors():
+    for relpath in ("cubefs_tpu/fs/objectnode.py",
+                    "cubefs_tpu/blob/access.py"):
+        mod = _module("admission_good.py", relpath)
+        assert AdmissionDisciplineChecker().check(mod) == []
+
+
+def test_admission_discipline_scoped_to_front_doors():
+    c = AdmissionDisciplineChecker()
+    assert c.applies("cubefs_tpu/fs/objectnode.py")
+    assert c.applies("cubefs_tpu/blob/access.py")
+    # internal services are not client-facing front doors
+    assert not c.applies("cubefs_tpu/fs/master.py")
+    assert not c.applies("cubefs_tpu/blob/worker.py")
 
 
 # ---------------- fanout-discipline ----------------

@@ -1,0 +1,379 @@
+"""Per-tenant QoS gate (utils/qos.py): admission, brownout, the door.
+
+Covers the gate's decision order directly (priority shed, queue-depth
+bound, tenant shaping/over-quota), the burn-rate coupling to the PR 9
+SLO tracker, the degradation hooks (flash fill suppression, repair
+step scaling), tenant identity threading into trace spans and the
+audit log, and the CUBEFS_QOS=0 door — including a two-cluster FSM
+bit-identity check proving the off-path is exactly the pre-QoS path.
+
+Every gate under test gets its own FakeClock and a stub tracker, so
+nothing here depends on wall time or the process-global tracker.
+"""
+
+import hashlib
+import json
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob.access import AccessConfig, AccessHandler
+from cubefs_tpu.utils import auditlog, metrics, qos, slo
+from cubefs_tpu.utils import trace as tracelib
+from cubefs_tpu.utils.qos import (FOREGROUND, REPAIR, SCRUB, NOOP_ADMISSION,
+                                  QosGate, QosRejected)
+from cubefs_tpu.utils.retry import FakeClock
+
+from tests.test_blob_e2e import Cluster
+from cubefs_tpu.codec import codemode as cmode
+
+
+@pytest.fixture(autouse=True)
+def _clean_tenant_context():
+    """Deliberately-unreleased admissions in the tests above leak the
+    tenant contextvar into later tests; pin it per test."""
+    token = tracelib.set_tenant("")
+    yield
+    tracelib.reset_tenant(token)
+
+
+class _Tracker:
+    """Stub SLO tracker: snapshot() returns whatever burn rates the
+    test pins, on the gate's refresh cadence."""
+
+    def __init__(self, burn=None):
+        self.burn = dict(burn or {})
+
+    def snapshot(self):
+        return {path: {"burn_rate": b} for path, b in self.burn.items()}
+
+
+def _gate(**kw):
+    fc = FakeClock()
+    kw.setdefault("tracker", _Tracker())
+    kw.setdefault("clock", fc)
+    return QosGate(**kw), fc
+
+
+# ------------------------------------------------------- decision order
+
+def test_unconfigured_tenant_is_work_conserving():
+    g, _ = _gate()
+    with g.admit("blob.put", tenant="t1", cost=10 << 20) as adm:
+        assert adm.throttle_s == 0.0
+        assert g.snapshot()["inflight"]["blob.put"] == 1
+    assert g.snapshot()["inflight"]["blob.put"] == 0
+    assert g.snapshot()["counts"] == {"admitted": 1, "shed": 0,
+                                      "throttled": 0}
+
+
+def test_configured_tenant_is_shaped_within_timeout():
+    g, fc = _gate(shaping_timeout=0.25)
+    g.configure("t1", rate=100, burst=100)
+    assert g.admit("blob.put", tenant="t1", cost=100).throttle_s == 0.0
+    # 10 more units: 0.1s of debt <= shaping_timeout -> throttled, and
+    # a blocking gate sleeps the wait on its own clock
+    adm = g.admit("blob.put", tenant="t1", cost=10)
+    assert adm.throttle_s == 0.1
+    assert fc.sleeps == [0.1]
+    assert g.snapshot()["counts"]["throttled"] == 1
+
+
+def test_over_quota_is_shed_with_retry_after():
+    g, _ = _gate(shaping_timeout=0.25)
+    g.configure("t1", rate=100, burst=100)
+    g.admit("blob.put", tenant="t1", cost=100)
+    with pytest.raises(QosRejected) as ei:
+        g.admit("blob.put", tenant="t1", cost=100)  # 1.0s debt > 0.25
+    assert ei.value.code == 429
+    assert ei.value.reason == "over_quota"
+    assert 0.05 <= ei.value.retry_after <= 5.0
+    # the shed released its inflight slot and reserved nothing
+    snap = g.snapshot()
+    assert snap["inflight"]["blob.put"] == 1  # only the first admission
+    assert snap["counts"]["shed"] == 1
+
+
+def test_nonblocking_gate_reports_throttle_without_sleeping():
+    g, fc = _gate(blocking=False)
+    g.configure("t1", rate=100, burst=100)
+    g.admit("blob.put", tenant="t1", cost=100)
+    adm = g.admit("blob.put", tenant="t1", cost=10)
+    assert adm.throttle_s == 0.1  # simulator adds it to modeled latency
+    assert fc.sleeps == []
+
+
+def test_queue_depth_bound_scales_with_priority():
+    g, _ = _gate(max_inflight=4)
+    # scrub's share is 50%: slots 0 and 1 admit, the third sheds
+    a = g.admit("blob.get", priority=SCRUB)
+    b = g.admit("blob.get", priority=SCRUB)
+    with pytest.raises(QosRejected) as ei:
+        g.admit("blob.get", priority=SCRUB)
+    assert ei.value.reason == "queue_depth"
+    # ...but foreground still has headroom at the same depth
+    c = g.admit("blob.get", priority=FOREGROUND)
+    d = g.admit("blob.get", priority=FOREGROUND)
+    with pytest.raises(QosRejected):  # 4 inflight = foreground bound
+        g.admit("blob.get", priority=FOREGROUND)
+    for adm in (a, b, c, d):
+        adm.release()
+    assert g.snapshot()["inflight"]["blob.get"] == 0
+
+
+def test_release_is_idempotent_and_exception_safe():
+    g, _ = _gate()
+    with pytest.raises(RuntimeError):
+        with g.admit("blob.get", tenant="t1"):
+            raise RuntimeError("handler blew up")
+    assert g.snapshot()["inflight"]["blob.get"] == 0
+    adm = g.admit("blob.get", tenant="t1")
+    adm.release()
+    adm.release()  # second release is a no-op, not a double decrement
+    assert g.snapshot()["inflight"]["blob.get"] == 0
+
+
+def test_priority_is_clamped_not_keyerrored():
+    g, _ = _gate()
+    g.admit("blob.get", tenant="t1", priority=99).release()
+    g.admit("blob.get", tenant="t1", priority=-3).release()
+
+
+# ---------------------------------------------------- burn-rate brownout
+
+def test_brownout_sheds_scrub_then_repair_never_foreground():
+    g, _ = _gate()
+    g.force_level("blob.put", 1)
+    with pytest.raises(QosRejected) as ei:
+        g.admit("blob.put", priority=SCRUB)
+    assert ei.value.reason == "brownout"
+    g.admit("blob.put", priority=REPAIR).release()   # warn keeps repair
+    g.force_level("blob.put", 2)
+    with pytest.raises(QosRejected):
+        g.admit("blob.put", priority=REPAIR)
+    g.admit("blob.put", priority=FOREGROUND).release()  # never burn-shed
+    g.force_level("blob.put", None)
+    g.admit("blob.put", priority=SCRUB).release()
+
+
+def test_burn_rate_drives_levels_via_tracker():
+    tr = _Tracker({"blob.get": 0.2})
+    g, fc = _gate(tracker=tr, refresh_s=1.0, burn_warn=1.0,
+                  burn_critical=4.0)
+    assert g.level("blob.get") == 0
+    tr.burn["blob.get"] = 2.0
+    assert g.level("blob.get") == 0   # cached: refresh_s not elapsed
+    fc.advance(1.1)
+    assert g.level("blob.get") == 1   # warn
+    tr.burn["blob.get"] = 5.0
+    fc.advance(1.1)
+    assert g.level("blob.get") == 2   # critical
+    assert g.max_level() == 2
+    tr.burn["blob.get"] = 0.5
+    fc.advance(1.1)
+    assert g.level("blob.get") == 0
+
+
+def test_brownout_clamps_configured_tenant_with_zero_grace():
+    g, _ = _gate()
+    g.configure("t1", rate=100, burst=100)
+    g.force_level("blob.put", 1)
+    g.admit("blob.put", tenant="t1", cost=100).release()  # burst ok
+    with pytest.raises(QosRejected) as ei:
+        # would be a 0.1s shaped wait while healthy; under brownout
+        # max_wait drops to zero and the debt sheds instead
+        g.admit("blob.put", tenant="t1", cost=10)
+    assert ei.value.reason == "over_quota"
+
+
+def test_brownout_quota_clamps_unconfigured_tenants_opt_in():
+    g, _ = _gate(brownout_quota=(100, 100))
+    g.admit("blob.put", tenant="abuser", cost=10 << 20).release()  # healthy
+    g.force_level("blob.put", 1)
+    g.admit("blob.put", tenant="abuser", cost=100).release()
+    with pytest.raises(QosRejected) as ei:
+        g.admit("blob.put", tenant="abuser", cost=100)
+    assert ei.value.reason == "over_quota"
+    # default gates have no brownout quota: unconfigured foreground
+    # tenants are never over-quota even while browned out
+    g2, _ = _gate()
+    g2.force_level("blob.put", 1)
+    g2.admit("blob.put", tenant="abuser", cost=10 << 20).release()
+
+
+# ----------------------------------------------------- degradation hooks
+
+@pytest.fixture
+def forced_default_level():
+    """Pin DEFAULT's brownout level for the module-level hooks, and
+    always unpin afterwards (DEFAULT is process-global)."""
+    def force(level):
+        qos.DEFAULT.force_level("_test.path", level)
+    yield force
+    qos.DEFAULT.force_level("_test.path", None)
+
+
+def test_fill_suppression_and_repair_scale_follow_max_level(
+        forced_default_level, monkeypatch):
+    monkeypatch.delenv("CUBEFS_QOS", raising=False)
+    assert not qos.fill_suppressed()
+    assert qos.repair_step_scale() == 1.0
+    forced_default_level(1)
+    assert qos.fill_suppressed()
+    assert qos.repair_step_scale() == 0.5
+    forced_default_level(2)
+    assert qos.repair_step_scale() == 0.25
+    # the door wins over any level
+    monkeypatch.setenv("CUBEFS_QOS", "0")
+    assert not qos.fill_suppressed()
+    assert qos.repair_step_scale() == 1.0
+
+
+def test_scheduler_drain_plan_carries_qos_scale(tmp_path,
+                                               forced_default_level,
+                                               monkeypatch):
+    monkeypatch.delenv("CUBEFS_QOS", raising=False)
+    monkeypatch.delenv("CUBEFS_CODEC_STEP_BYTES", raising=False)
+    cluster = Cluster(tmp_path)
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 150_000, dtype=np.uint8).tobytes()
+    cluster.access.put(data, codemode=cmode.CodeMode.EC6P3)
+    vol = cluster.cm.get_volume(1)
+    victim = vol.units[0]
+    cluster.node_of(victim.node_addr).break_disk(victim.disk_id)
+    plan_healthy = cluster.sched.plan_disk_drain(victim.disk_id)
+    assert plan_healthy["qos_scale"] == 1.0
+    forced_default_level(2)
+    plan_browned = cluster.sched.plan_disk_drain(victim.disk_id)
+    assert plan_browned["qos_scale"] == 0.25
+    assert plan_browned["step_bytes"] <= plan_healthy["step_bytes"]
+
+
+# --------------------------------------------- tenant identity threading
+
+def test_admission_binds_tenant_into_trace_context():
+    g, _ = _gate()
+    assert tracelib.current_tenant() == ""
+    with g.admit("blob.put", tenant="acme"):
+        assert tracelib.current_tenant() == "acme"
+        # and a path_span opened inside the admission carries it
+        sp = tracelib.path_span("blob.put")
+        assert getattr(sp, "tenant", "") in ("acme", "")  # "" if tracing off
+    assert tracelib.current_tenant() == ""
+
+
+def test_span_header_roundtrips_tenant(monkeypatch):
+    monkeypatch.setenv("CUBEFS_TRACE", "1")
+    monkeypatch.delenv("CUBEFS_TRACE_SAMPLE", raising=False)
+    with g_admit_span() as (sp, hdr):
+        assert hdr.count(":") == 4 and hdr.endswith(":acme")
+        child = tracelib.from_header("hop", hdr)
+        assert child.tenant == "acme"
+        assert child.tags.get("tenant") == "acme"
+        child.finish()
+
+
+def g_admit_span():
+    class _Ctx:
+        def __enter__(self):
+            self.g, _ = _gate()
+            self.adm = self.g.admit("blob.put", tenant="acme")
+            self.sp = tracelib.path_span("blob.put")
+            return self.sp, self.sp.header()
+
+        def __exit__(self, *exc):
+            self.sp.finish()
+            self.adm.release()
+    return _Ctx()
+
+
+def test_audit_record_carries_tenant(tmp_path):
+    log = auditlog.AuditLogger(str(tmp_path / "audit.log"))
+    log.record("access", "put", 200, 0.01, tenant="acme")
+    log.record("access", "get", 200, 0.01)  # anonymous: field omitted
+    log.close()
+    lines = [json.loads(l) for l in
+             open(tmp_path / "audit.log", encoding="utf-8")]
+    assert lines[0]["tenant"] == "acme"
+    assert "tenant" not in lines[1]
+
+
+# ---------------------------------------------------- the CUBEFS_QOS door
+
+def test_door_off_returns_shared_noop(monkeypatch):
+    monkeypatch.setenv("CUBEFS_QOS", "0")
+    g, _ = _gate(max_inflight=0)  # would shed everything if consulted
+    adm = g.admit("blob.put", tenant="t1", cost=1 << 30)
+    assert adm is NOOP_ADMISSION
+    with adm:
+        pass
+    adm.release()
+    assert g.snapshot()["counts"] == {"admitted": 0, "shed": 0,
+                                      "throttled": 0}
+
+
+def _cluster_digest(tmp_path, monkeypatch, qos_env):
+    """Run the same seeded put/get workload through a fresh cluster and
+    digest every byte the FSM stored plus every byte served back."""
+    if qos_env is None:
+        monkeypatch.delenv("CUBEFS_QOS", raising=False)
+    else:
+        monkeypatch.setenv("CUBEFS_QOS", qos_env)
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    cluster = Cluster(tmp_path)
+    rng = np.random.default_rng(11)
+    h = hashlib.sha256()
+    locs = []
+    for n in (100_000, 5_000, 200_000):
+        data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+        locs.append((cluster.access.put(
+            data, codemode=cmode.CodeMode.EC6P3), data))
+    for loc, data in locs:
+        got = cluster.access.get(loc)
+        assert got == data
+        h.update(got)
+    # chunk-level FSM state: every shard of every volume unit, in
+    # stable (vid, unit index, bid) order
+    for vid in sorted(cluster.cm.volumes):
+        vol = cluster.cm.get_volume(vid)
+        for u in vol.units:
+            node = cluster.node_of(u.node_addr)
+            for bid, size, crc in node.list_chunk(u.disk_id, u.chunk_id):
+                h.update(f"{vid}|{u.index}|{u.disk_id}|{u.chunk_id}|"
+                         f"{bid}|{size}|{crc}\n".encode())
+                h.update(node.get_shard(u.disk_id, u.chunk_id, bid)[0])
+    return h.hexdigest()
+
+
+def test_door_off_is_bit_identical_to_qos_on_no_overload(tmp_path,
+                                                         monkeypatch):
+    """With no quotas configured and no overload, the admitted path and
+    the door-off path must produce byte-identical cluster state: the
+    gate is work-conserving and invisible to the FSM."""
+    d_on = _cluster_digest(tmp_path / "on", monkeypatch, None)
+    d_off = _cluster_digest(tmp_path / "off", monkeypatch, "0")
+    assert d_on == d_off
+
+
+# -------------------------------------------------- access-layer wiring
+
+def test_access_put_is_shed_through_private_gate(tmp_path, monkeypatch):
+    monkeypatch.delenv("CUBEFS_QOS", raising=False)
+    g, _ = _gate()
+    g.configure("bully", rate=10, burst=10)
+    cluster = Cluster(tmp_path)
+    handler = AccessHandler(
+        cluster.cm_client, cluster.pool,
+        AccessConfig(blob_size=64 << 10, qos_gate=g),
+        repair_queue=cluster.repair_q, delete_queue=cluster.delete_q)
+    data = bytes(5_000)
+    # first put rides the burst into negative balance (oversized-IO
+    # shaping); the second sees a 500s debt >> shaping_timeout -> shed
+    handler.put(data, codemode=cmode.CodeMode.EC6P3, tenant="bully")
+    with pytest.raises(QosRejected) as ei:
+        handler.put(data, codemode=cmode.CodeMode.EC6P3, tenant="bully")
+    assert ei.value.reason == "over_quota"
+    loc = handler.put(data, codemode=cmode.CodeMode.EC6P3,
+                      tenant="victim")  # unconfigured: admitted
+    assert handler.get(loc, tenant="victim") == data
+    assert g.snapshot()["counts"]["shed"] == 1

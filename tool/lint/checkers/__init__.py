@@ -1,5 +1,6 @@
 """Checker registry: every family the suite ships, in report order."""
 
+from .admission_discipline import AdmissionDisciplineChecker
 from .batch_discipline import BatchDisciplineChecker
 from .fanout_discipline import FanoutDisciplineChecker
 from .fs_placement import FsPlacementChecker
@@ -21,4 +22,5 @@ ALL_CHECKERS = (
     FsPlacementChecker,
     BatchDisciplineChecker,
     FanoutDisciplineChecker,
+    AdmissionDisciplineChecker,
 )

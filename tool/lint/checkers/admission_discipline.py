@@ -1,0 +1,104 @@
+"""QoS admission-discipline checker (rule: admission-discipline, CFQ0xx).
+
+Overload protection only works if every external-facing request
+handler passes through the QoS gate (utils/qos.py): one handler that
+skips admission is an unshaped side door an abusive tenant will find,
+and its traffic is invisible to the per-tenant counters and the
+burn-rate brownout logic. The two front doors are the objectnode/S3
+verb handlers (`do_*`) and the blob access RPC surface (`rpc_*`).
+
+  CFQ001  an external-facing handler whose body never reaches the
+          admission layer — objectnode `do_*` must call `_begin()` /
+          `_admit_qos()` (the per-request auth+admission door), access
+          `rpc_*` must call `.admit(` or route through the admitted
+          public methods (`self.put` / `self.get` / `self.delete`)
+  CFQ002  `.admit(` called outside the sanctioned door functions —
+          each front door has ONE admission choke point; a second
+          admit in a helper double-counts the inflight slot and can
+          deadlock the queue-depth bound
+
+Health/metrics-style endpoints are allowlisted (`do_OPTIONS` CORS
+preflight, `rpc_health` / `rpc_stats` / `rpc_metrics`): shedding a
+probe would flap monitors exactly when the operator needs them.
+
+The analysis is syntactic (call names inside the handler body), like
+the other discipline families: new handlers must either route through
+an existing door or be added here deliberately.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Checker, Module, Violation
+
+# endpoints exempt from admission: no data path / must not be shed
+_ALLOWLIST = {"do_OPTIONS", "rpc_health", "rpc_stats", "rpc_metrics"}
+
+# calls that count as "reached the admission layer" per front door
+_S3_DOORS = {"_begin", "_admit_qos"}
+_ACCESS_DOORS = {"admit", "put", "get", "delete"}
+
+# functions allowed to call .admit( directly (the choke points)
+_ADMIT_SANCTUMS = {"_admit_qos", "put", "get", "delete", "admit"}
+
+_S3_HANDLER = re.compile(r"^do_[A-Z]+$")
+
+
+def _called_names(fn_node: ast.AST) -> set[str]:
+    """Bare/attribute call names appearing anywhere in a function body
+    (nested defs included — a handler may admit inside a closure)."""
+    names: set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                names.add(f.attr)
+            elif isinstance(f, ast.Name):
+                names.add(f.id)
+    return names
+
+
+class AdmissionDisciplineChecker(Checker):
+    rule = "admission-discipline"
+    dirs = ("cubefs_tpu/fs/objectnode.py", "cubefs_tpu/blob/access.py")
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+        is_s3 = mod.relpath.endswith("objectnode.py")
+
+        def handler_kind(name: str) -> str | None:
+            if is_s3 and _S3_HANDLER.match(name):
+                return "s3"
+            if not is_s3 and name.startswith("rpc_"):
+                return "access"
+            return None
+
+        def visit(node: ast.AST, fn: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                kind = handler_kind(node.name)
+                if kind and node.name not in _ALLOWLIST:
+                    doors = _S3_DOORS if kind == "s3" else _ACCESS_DOORS
+                    if not (_called_names(node) & doors):
+                        out.append(self.violation(
+                            mod, "CFQ001", node,
+                            f"external-facing handler `{node.name}` never "
+                            f"reaches QoS admission — route through "
+                            f"{', '.join(sorted(doors))} or allowlist it "
+                            f"as a health endpoint"))
+                fn = node.name
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "admit" \
+                    and fn not in _ADMIT_SANCTUMS:
+                out.append(self.violation(
+                    mod, "CFQ002", node,
+                    f".admit() in `{fn or '<module>'}` is a second "
+                    f"admission choke point — each front door admits "
+                    f"exactly once ({', '.join(sorted(_ADMIT_SANCTUMS))})"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, fn)
+
+        visit(mod.tree, "")
+        return out
