@@ -102,9 +102,10 @@ class AccessHandler:
 
     # ------------------------------ PUT ------------------------------
     def put(self, data: bytes, codemode: int | None = None, *,
-            tenant: str | None = None) -> Location:
+            tenant: str | None = None,
+            priority: int | None = None) -> Location:
         with self.qos.admit("blob.put", tenant=tenant, cost=len(data),
-                            svc="access"):
+                            priority=priority, svc="access"):
             with tracelib.path_span("blob.put", "access.put") as sp:
                 sp.set_tag("svc", "access").set_tag("bytes", len(data))
                 return self._put(data, codemode)
@@ -231,9 +232,10 @@ class AccessHandler:
             return bid, unit.index, e
 
     # ------------------------------ GET ------------------------------
-    def get(self, loc: Location, *, tenant: str | None = None) -> bytes:
+    def get(self, loc: Location, *, tenant: str | None = None,
+            priority: int | None = None) -> bytes:
         with self.qos.admit("blob.get", tenant=tenant, cost=loc.size,
-                            svc="access"):
+                            priority=priority, svc="access"):
             with tracelib.path_span("blob.get", "access.get") as sp:
                 sp.set_tag("svc", "access").set_tag("bytes", loc.size)
                 return self._get(loc)
@@ -429,10 +431,12 @@ class AccessHandler:
                     got[j] = local[pos].tobytes()
 
     # ----------------------------- DELETE -----------------------------
-    def delete(self, loc: Location, *, tenant: str | None = None) -> None:
+    def delete(self, loc: Location, *, tenant: str | None = None,
+               priority: int | None = None) -> None:
         """Mark-delete: enqueue async deletion (proxy/mq analog); the
         consumer (scheduler blob_deleter) performs the actual unlink."""
-        with self.qos.admit("blob.delete", tenant=tenant, svc="access"):
+        with self.qos.admit("blob.delete", tenant=tenant,
+                            priority=priority, svc="access"):
             if self.delete_queue is None:
                 self._delete_now(loc)
                 return

@@ -56,15 +56,20 @@ class BlobClient:
                 if e.code != 429 or not r.tick(reason="throttled"):
                     raise
 
-    def put(self, data: bytes, codemode: int | None = None) -> dict:
-        """Store bytes; returns a JSON-serializable location."""
+    def put(self, data: bytes, codemode: int | None = None,
+            priority: int | None = None) -> dict:
+        """Store bytes; returns a JSON-serializable location. Background
+        callers (cold-tier migration) pass priority=qos.SCRUB so the
+        gate sheds them first under brownout — they can never starve
+        foreground traffic."""
         return self._shaped(self._h.put, data, codemode,
-                            tenant=self.tenant).to_dict()
+                            tenant=self.tenant,
+                            priority=priority).to_dict()
 
-    def get(self, location: dict) -> bytes:
+    def get(self, location: dict, priority: int | None = None) -> bytes:
         return self._shaped(self._h.get, Location.from_dict(location),
-                            tenant=self.tenant)
+                            tenant=self.tenant, priority=priority)
 
-    def delete(self, location: dict) -> None:
+    def delete(self, location: dict, priority: int | None = None) -> None:
         self._shaped(self._h.delete, Location.from_dict(location),
-                     tenant=self.tenant)
+                     tenant=self.tenant, priority=priority)

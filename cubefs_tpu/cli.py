@@ -303,6 +303,34 @@ def _qos_view(text: str) -> dict:
     }
 
 
+def _tiering_view(text: str) -> dict:
+    """The cold-tier digest: migration outcomes (did transitions land,
+    get fenced by racing writes, or fail verification), bytes moved in
+    each direction, read-through and re-heat activity, and the orphan
+    backlog — nonzero `blob_freelist_pending` between a rollback and
+    the next reaper sweep is normal; a growing one is not."""
+    series = _parse_metrics(text)
+
+    def by_label(name, label):
+        return {lb.get(label, ""): v for n, lb, v in series if n == name}
+
+    def total(name):
+        return sum(v for n, _, v in series if n == name)
+
+    freelist = [v for n, _, v in series
+                if n == "cubefs_tiering_blob_freelist"]
+    return {
+        "transitions": by_label("cubefs_tiering_transitions_total",
+                                "outcome"),
+        "bytes": by_label("cubefs_tiering_bytes_total", "direction"),
+        "cold_reads": total("cubefs_tiering_cold_reads_total"),
+        "untiered": by_label("cubefs_tiering_untiered_total", "outcome"),
+        "orphans_reaped": total("cubefs_tiering_orphans_reaped_total"),
+        "blob_freelist_pending": freelist[0] if freelist else 0,
+        "scan_errors": total("cubefs_lc_scan_errors_total"),
+    }
+
+
 def _slo_view(text: str) -> dict:
     """The tail-latency digest: per-path quantiles from the sliding
     window, SLO burn rate, and remaining error budget (scraping
@@ -460,7 +488,7 @@ def main(argv=None):
     p_metrics = sub.add_parser("metrics")  # node observability views
     p_metrics.add_argument("action",
                            choices=["write-path", "codec", "repair", "slo",
-                                    "read-path", "qos", "raw"])
+                                    "read-path", "qos", "tiering", "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
 
@@ -753,6 +781,8 @@ def main(argv=None):
             print(json.dumps(_read_path_view(text), indent=2))
         elif args.action == "qos":
             print(json.dumps(_qos_view(text), indent=2))
+        elif args.action == "tiering":
+            print(json.dumps(_tiering_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
 

@@ -547,6 +547,64 @@ class MetaWrapper:
             "op": "truncate", "ino": ino, "size": size, "ts": time.time()}})
         return res[0]["result"].get("extents", [])
 
+    # ---- cold-tier migration FSM (fs/tiering.py is the sole driver;
+    # each step is one idempotent op_id-stamped submit, so WAL replay
+    # and transport retries land exactly once) ----
+    def tiering_prepare(self, ino: int) -> dict:
+        res = self._call(self._mp_for(ino), "submit", {"record": {
+            "op": "tiering_prepare", "ino": ino, "ts": time.time()}})
+        return res[0]["result"]
+
+    def tiering_blob_written(self, ino: int, gen: int, location: dict) -> dict:
+        res = self._call(self._mp_for(ino), "submit", {"record": {
+            "op": "tiering_blob_written", "ino": ino, "gen": gen,
+            "location": location, "ts": time.time()}})
+        return res[0]["result"]
+
+    def tiering_commit(self, ino: int, gen: int) -> dict:
+        res = self._call(self._mp_for(ino), "submit", {"record": {
+            "op": "tiering_commit", "ino": ino, "gen": gen,
+            "ts": time.time()}})
+        return res[0]["result"]
+
+    def tiering_finish(self, ino: int) -> dict:
+        res = self._call(self._mp_for(ino), "submit", {"record": {
+            "op": "tiering_finish", "ino": ino, "ts": time.time()}})
+        return res[0]["result"]
+
+    def tiering_abort(self, ino: int) -> dict:
+        res = self._call(self._mp_for(ino), "submit", {"record": {
+            "op": "tiering_abort", "ino": ino, "ts": time.time()}})
+        return res[0]["result"]
+
+    def untier_commit(self, ino: int, gen: int, extents: list[dict]) -> dict:
+        res = self._call(self._mp_for(ino), "submit", {"record": {
+            "op": "untier_commit", "ino": ino, "gen": gen,
+            "extents": extents, "ts": time.time()}})
+        return res[0]["result"]
+
+    def blob_freelist_all(self) -> dict[str, dict]:
+        """Pending deferred blob deletions across all partitions, keyed
+        `pid:key` (reaper input; fsck counts these as referenced)."""
+        out: dict[str, dict] = {}
+        for mp in self.mps:
+            try:
+                fl = self._call(
+                    mp, "blob_freelist", {})[0]["blob_freelist"]
+            except (FsError, rpc.RpcError):
+                continue
+            for k, v in fl.items():
+                out[f"{mp['pid']}:{k}"] = v
+        return out
+
+    def blob_free_done(self, pid: int, key: str) -> None:
+        for mp in self.mps:
+            if mp["pid"] == pid:
+                self._call(mp, "submit", {"record": {
+                    "op": "blob_free_done", "key": key}})
+                return
+        raise FsError(mn.ENOENT, f"no meta partition {pid}")
+
     # ---- rename (atomic; metanode/transaction.go analog) ----
     def rename_local(self, src_parent: int, src_name: str,
                      dst_parent: int, dst_name: str, ino: int,
@@ -720,9 +778,25 @@ class ExtentClient:
         """Write through the inode's open extent, rolling to fresh
         extents at the cap — a single huge write spans several extent
         keys, like the streamer's packet pipeline."""
+        if not data:
+            # empty write: no extent to allocate, but the mtime/gen stamp
+            # must still land (an empty overwrite fences a tiering commit
+            # like any other data mutation)
+            meta.append_extents(ino, [], size=file_offset)
+            return
         if len(data) <= self.TINY_THRESHOLD and file_offset == 0:
             self._write_tiny(meta, ino, data)
             return
+        extent_keys = self.write_extents(ino, file_offset, data)
+        meta.append_extents(ino, extent_keys, size=file_offset + len(data))
+
+    def write_extents(self, ino: int, file_offset: int,
+                      data: bytes) -> list[dict]:
+        """Write payload bytes to datanode extents WITHOUT registering
+        them on the metanode — the caller owns the commit. write() pairs
+        this with append_extents; the tiering engine's un-tier path
+        instead lands the keys through one fenced untier_commit apply,
+        so a racing write can atomically reject the whole re-heat."""
         extent_keys: list[dict] = []
         done = 0
         while done < len(data):
@@ -753,7 +827,7 @@ class ExtentClient:
             with self._lock:
                 self._streams[ino] = (dp, eid, ext_off + seg)
             done += seg
-        meta.append_extents(ino, extent_keys, size=file_offset + len(data))
+        return extent_keys
 
     def _write_tiny(self, meta: MetaWrapper, ino: int, data: bytes) -> None:
         """Append a whole small file into the shared tiny extent; the
@@ -961,12 +1035,14 @@ class FileSystem:
     QUOTA_TTL = 30.0  # seconds between quota-table refreshes
 
     def __init__(self, vol_view: dict, node_pool, master_addr: str | None = None,
-                 *, flash_fgm=None, client_az: str | None = None):
+                 *, flash_fgm=None, client_az: str | None = None,
+                 blob_client=None):
         self.meta = MetaWrapper(vol_view, node_pool)
         self.data = ExtentClient(vol_view, node_pool)
         self.vol_name = vol_view.get("name")
         self.nodes = node_pool
         self.master_addr = master_addr
+        self.client_az = client_az
         # A/B door for the AZ-local hot-read tier: CUBEFS_READ_CACHE=1
         # (plus a flash ring handle) routes reads through CachedReader;
         # off (default) is byte-for-byte the plain ExtentClient path.
@@ -984,6 +1060,18 @@ class FileSystem:
             self.read_cache = CachedReader(
                 self.data, flash_fgm, node_pool, client_az=client_az,
                 hotness_threshold=hot)
+        # A/B door for transparent cold-tier read-through:
+        # CUBEFS_TIERING=1 (plus a blob client) routes extent-less
+        # cold.location inodes to the blob plane; off (default) is
+        # byte-for-byte the pre-tiering path.
+        self.tiering = None
+        try:
+            td = int(os.environ.get("CUBEFS_TIERING", "0") or "0")
+        except ValueError:
+            td = 0
+        if td > 0 and blob_client is not None:
+            from .tiering import TieringEngine
+            self.tiering = TieringEngine(self, blob_client)
         # dir_ino -> [qid]: files created under a quota dir inherit its
         # ids (master_quota_manager.go analog); long-lived clients with a
         # master configured re-pull the table every QUOTA_TTL, so quotas
@@ -1120,6 +1208,11 @@ class FileSystem:
         else:
             # pread(2) semantics: reads at/past EOF return short/empty
             length = max(0, min(length, inode["size"] - offset))
+        if (self.tiering is not None and not inode["extents"]
+                and inode["xattr"].get("cold.location")):
+            # cold tier: extents released, payload lives in the blob
+            # plane — read through it (AZ-local degraded reads inside)
+            return self.tiering.read_cold(inode, offset, length)
         if self.read_cache is not None:
             return self.read_cache.read(inode, offset, length)
         return self.data.read(inode, offset, length)

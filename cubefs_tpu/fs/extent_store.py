@@ -82,6 +82,8 @@ class ExtentStore:
 
     def write(self, extent_id: int, offset: int, data: bytes | np.ndarray) -> None:
         buf = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
+        if not buf:
+            return  # es_write dereferences the payload even at len 0
         with self._lock:
             # lint: allow[CFL003] lock IS the close() guard — es_* on a freed handle is use-after-free; bounded local disk I/O, no cross-plane reader
             if self._lib.es_write(self._handle(), extent_id, offset, buf,

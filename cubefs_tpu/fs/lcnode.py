@@ -2,20 +2,52 @@
 
 Role parity: lcnode/ — scans volume metadata against lifecycle rules
 (lc_scanner.go) and applies expiration actions; the reference also
-transitions storage classes (lc_transition.go), which here maps to
-re-writing a file's payload into the EC blob plane (cold tier) and
-recording the blob location in an xattr.
+transitions storage classes (lc_transition.go), which here delegates to
+fs/tiering.py's TieringEngine: a crash-safe two-phase migration state
+machine instead of the old read->put->truncate sequence (which could
+lose bytes if the node died between the put and the truncate, and
+rescanned empty files forever).
+
+A scan pass now does four jobs:
+  1. resume any migration a previous (crashed) run left mid-flight
+     (tiering.state xattr present) — roll forward or roll back,
+  2. start new transitions / expirations per the rules,
+  3. promote re-heated cold files back to hot extents,
+  4. reap orphaned blobs off the metanode's deferred blob freelist.
+
+Time is injected (utils/retry.py Clock protocol) so lifecycle aging is
+testable on a FakeClock without sleeping; the default is wall time
+because rule age math compares against inode mtimes, which are epoch
+stamps.
 """
 
 from __future__ import annotations
 
-import fnmatch
+import fnmatch  # noqa: F401  (rule prefixes may grow glob support)
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 
+from ..utils import faultinject, metrics
+from ..utils.retry import Clock
 from . import metanode as mn
 from .client import FileSystem, FsError
+from .tiering import TieringEngine
+
+log = logging.getLogger("cubefs.lcnode")
+
+
+class _WallClock(Clock):
+    """Epoch-time clock: lifecycle ages are computed against inode
+    mtimes (time.time() stamps), so the scheduler clock must share
+    their origin — unlike utils.retry.MONOTONIC."""
+
+    def now(self) -> float:
+        return time.time()
+
+
+WALL = _WallClock()
 
 
 @dataclass
@@ -32,13 +64,22 @@ class ScanReport:
     scanned: int = 0
     expired: int = 0
     transitioned: int = 0
+    resumed: int = 0  # half-done migrations rolled forward/back
+    untiered: int = 0  # cold files promoted back to hot
+    reaped: int = 0  # orphan blobs deleted off the deferred freelist
     errors: list = field(default_factory=list)
 
 
 class LcNode:
-    def __init__(self, fs: FileSystem, blob_access=None):
+    def __init__(self, fs: FileSystem, blob_access=None, *,
+                 clock: Clock | None = None,
+                 engine: TieringEngine | None = None,
+                 codemode: int | None = None):
         self.fs = fs
-        self.blob = blob_access  # AccessHandler-compatible (cold tier)
+        self.clock = clock or WALL
+        if engine is None and blob_access is not None:
+            engine = TieringEngine(fs, blob_access, codemode=codemode)
+        self.engine = engine
         self.rules: list[LifecycleRule] = []
         self._stop = threading.Event()
 
@@ -79,11 +120,20 @@ class LcNode:
 
     def scan_once(self) -> ScanReport:
         report = ScanReport()
-        now = time.time()
+        now = self.clock.now()
         self._walk("/", mn.ROOT_INO, now, report)
+        if self.engine is not None:
+            for ino in self.engine.hot_candidates():
+                try:
+                    if self.engine.untier(ino) == "promoted":
+                        report.untiered += 1
+                except FsError as e:
+                    report.errors.append((f"ino:{ino}", str(e)))
+            report.reaped = self.engine.reap_orphans()
         return report
 
-    def _walk(self, path: str, ino: int, now: float, report: ScanReport) -> None:
+    def _walk(self, path: str, ino: int, now: float,
+              report: ScanReport) -> None:
         try:
             entries = self.fs.meta.readdir(ino)
         except FsError:
@@ -98,38 +148,43 @@ class LcNode:
                 self._walk(cpath, child, now, report)
                 continue
             report.scanned += 1
-            for rule in self.rules:
-                if not rule.enabled or not cpath.startswith(rule.prefix):
-                    continue
-                age = now - inode["mtime"]
-                try:
-                    if rule.expire_after_s is not None and age > rule.expire_after_s:
-                        self.fs.unlink(cpath)
-                        report.expired += 1
-                        break
-                    if (rule.transition_after_s is not None
-                            and age > rule.transition_after_s
-                            and self.blob is not None
-                            and not inode["xattr"].get("cold.location")):
-                        self._transition(cpath, inode, report)
-                        break
-                except FsError as e:
-                    report.errors.append((cpath, str(e)))
+            self._apply_rules(cpath, child, inode, now, report)
         return
 
-    def _transition(self, path: str, inode: dict, report: ScanReport) -> None:
-        """Cold-tier transition: payload moves to the EC blob plane; the
-        hot-tier extents are released and the location pinned in xattr
-        (the FS<->blob bridge, sdk/data/blobstore writer role)."""
-        data = self.fs.read_file(path)
-        loc = self.blob.put(data) if data else None
-        if loc is not None:
-            self.fs.meta.set_xattr(inode["ino"], "cold.location",
-                                   __import__("json").dumps(loc.to_dict()))
-            self.fs.meta.truncate(inode["ino"], 0)
-            self.fs.meta.set_attr(inode["ino"], size=len(data))
-            # hot extents ride the metanode freelist (deferred deletion)
-            report.transitioned += 1
+    def _apply_rules(self, cpath: str, child: int, inode: dict,
+                     now: float, report: ScanReport) -> None:
+        if (self.engine is not None
+                and inode["xattr"].get("tiering.state") is not None):
+            # a previous run died mid-migration: recover FIRST,
+            # regardless of rule matching or age
+            try:
+                out = self.engine.migrate(child)
+            except FsError as e:
+                report.errors.append((cpath, str(e)))
+            else:
+                report.resumed += 1
+                if out == "resumed":
+                    report.transitioned += 1
+            return
+        for rule in self.rules:
+            if not rule.enabled or not cpath.startswith(rule.prefix):
+                continue
+            age = now - inode["mtime"]
+            try:
+                if (rule.expire_after_s is not None
+                        and age > rule.expire_after_s):
+                    self.fs.unlink(cpath)
+                    report.expired += 1
+                    break
+                if (rule.transition_after_s is not None
+                        and age > rule.transition_after_s
+                        and self.engine is not None
+                        and not inode["xattr"].get("cold.location")):
+                    if self.engine.migrate(child) == "migrated":
+                        report.transitioned += 1
+                    break
+            except FsError as e:
+                report.errors.append((cpath, str(e)))
 
     def read_through(self, path: str) -> bytes:
         """Read a possibly-cold file: hot extents if present, else fetch
@@ -137,11 +192,9 @@ class LcNode:
         inode = self.fs.meta.inode_get(self.fs.resolve(path))
         if inode["extents"]:
             return self.fs.data.read(inode, 0, inode["size"])
-        cold = inode["xattr"].get("cold.location")
-        if cold:
-            from ..blob.types import Location
-
-            return self.blob.get(Location.from_dict(__import__("json").loads(cold)))
+        if (self.engine is not None
+                and inode["xattr"].get("cold.location")):
+            return self.engine.read_cold(inode, 0, inode["size"])
         return b""
 
     def start(self, interval: float = 60.0) -> None:
@@ -149,8 +202,13 @@ class LcNode:
             while not self._stop.wait(interval):
                 try:
                     self.scan_once()
+                except faultinject.InjectedCrash:
+                    raise  # a drill kill takes the whole node down
                 except Exception:
-                    pass
+                    # a broken scan must not silently kill the
+                    # lifecycle loop: count it, log it, keep scanning
+                    metrics.lc_scan_errors.inc()
+                    log.exception("lifecycle scan failed; will retry")
 
         threading.Thread(target=loop, daemon=True).start()
 

@@ -75,6 +75,13 @@ class MetaPartition:
         # retires entries via the free_done op. A client crash right
         # after unlink can no longer leak datanode space.
         self.freelist: dict[str, dict] = {}  # key -> {extents, ts}
+        # deferred blob deletion (the cold-tier mirror of the extent
+        # freelist): any apply that makes a blob location unreachable —
+        # fenced migration, overwrite, unlink of a cold file — queues it
+        # HERE instead of trusting a client to delete it. The tiering
+        # engine's orphan reaper deletes from the blob plane and retires
+        # entries via blob_free_done, so no crash point strands a blob.
+        self.blob_freelist: dict[str, dict] = {}  # key -> {location, ts}
         self.apply_id = 0
         self._next_ino = start
         self._dirty: set[str] = set(self._SEGMENTS)
@@ -218,6 +225,7 @@ class MetaPartition:
             "tx_pending": self.tx_pending,
             "tx_committed": self.tx_committed,
             "freelist": self.freelist,
+            "blob_freelist": self.blob_freelist,
         }
 
     def _load_state_dict(self, st: dict) -> None:
@@ -228,6 +236,7 @@ class MetaPartition:
         self.tx_pending = st.get("tx_pending", {})
         self.tx_committed = st.get("tx_committed", {})
         self.freelist = st.get("freelist", {})
+        self.blob_freelist = st.get("blob_freelist", {})
 
     def export_state(self) -> tuple[bytes, int]:
         """(serialized state, apply_id) captured under ONE lock
@@ -321,6 +330,10 @@ class MetaPartition:
             # the rename gets spurious ENOENT)
             self._mirror_dentry(r["dst_parent"], r["dst_name"])
             self._mirror_dentry(r["src_parent"], r["src_name"])
+            # the apply bumps the moved inode's gen (tiering fence)
+            moved = self.dentries.get(r["dst_parent"], {}).get(r["dst_name"])
+            if moved is not None:
+                self._mirror_inode(moved)
         elif op in ("append_extents", "set_attr", "set_xattr", "truncate"):
             self._mirror_inode(r["ino"])
         elif op == "tx_commit":
@@ -350,12 +363,19 @@ class MetaPartition:
         "dec_nlink": {"inodes", "dentries", "freelist"},
         "mk_dentry": {"dentries"},
         "rm_dentry": {"dentries"},
-        "rename_local": {"dentries"},
+        "rename_local": {"dentries", "inodes"},  # gen bump fences tiering
         "append_extents": {"inodes"},
         "set_attr": {"inodes"},
         "set_xattr": {"inodes"},
         "truncate": {"inodes", "freelist"},
         "free_done": {"freelist"},
+        "blob_free_done": {"freelist"},
+        "tiering_prepare": {"inodes"},
+        "tiering_blob_written": {"inodes", "freelist"},
+        "tiering_commit": {"inodes", "freelist"},
+        "tiering_finish": {"inodes"},
+        "tiering_abort": {"inodes", "freelist"},
+        "untier_commit": {"inodes", "freelist"},
         "tx_prepare": {"tx"},
         "tx_abort": {"tx"},
         "tx_finish": {"tx"},
@@ -369,7 +389,8 @@ class MetaPartition:
         if name == "dentries":
             return {"dentries": {str(k): v for k, v in self.dentries.items()}}
         if name == "freelist":
-            return {"freelist": self.freelist}
+            return {"freelist": self.freelist,
+                    "blob_freelist": self.blob_freelist}
         return {"tx_pending": self.tx_pending,
                 "tx_committed": self.tx_committed}
 
@@ -537,6 +558,8 @@ class MetaPartition:
             # (MetaNode._free_scan) owns reclaiming these from datanodes
             self.freelist[str(ino)] = {
                 "extents": deferred, "ts": r.get("ts", 0.0)}
+        if inode is not None:
+            self._reap_inode_blobs(inode, r.get("ts", 0.0))
         return {"extents": exts, "deferred": bool(deferred)}
 
     def _apply_mk_dentry(self, r: dict) -> dict:
@@ -615,6 +638,7 @@ class MetaPartition:
         if deferred:
             self.freelist[str(ino)] = {
                 "extents": deferred, "ts": r.get("ts", 0.0)}
+        self._reap_inode_blobs(inode, r.get("ts", 0.0))
         return {"ino": ino, "extents": exts, "deferred": bool(deferred),
                 "removed": True}
 
@@ -821,6 +845,11 @@ class MetaPartition:
         if victim == ino:
             victim = None
         dd[dn] = ino
+        moved = self.inodes.get(ino)
+        if moved is not None:
+            # namespace identity changed: fence any in-flight migration
+            # that resolved this inode by its old path
+            moved["gen"] = moved.get("gen", 0) + 1
         return {"victim": victim}
 
     def tx_status(self, tx_id: str) -> str:
@@ -844,6 +873,10 @@ class MetaPartition:
         inode["extents"].extend(r["extents"])
         inode["size"] = max(inode["size"], r.get("size", inode["size"]))
         inode["mtime"] = r.get("ts", time.time())
+        # generation counter: every data mutation bumps it, so a tiering
+        # commit prepared against an older gen fences instead of
+        # dropping this write (`.get` keeps pre-gen snapshots loadable)
+        inode["gen"] = inode.get("gen", 0) + 1
         return {}
 
     def _apply_set_attr(self, r: dict) -> dict:
@@ -853,6 +886,8 @@ class MetaPartition:
         for k in ("mode", "uid", "gid", "size", "mtime", "atime", "nlink"):
             if k in r:
                 inode[k] = r[k]
+        if "size" in r:  # length change is a data mutation: fence tiering
+            inode["gen"] = inode.get("gen", 0) + 1
         inode["ctime"] = r.get("ts", time.time())
         return {}
 
@@ -872,10 +907,16 @@ class MetaPartition:
             raise MetaError(ENOENT, f"inode {r['ino']}")
         size = r["size"]
         inode["size"] = size
+        inode["gen"] = inode.get("gen", 0) + 1
         if size == 0:
             old = inode["extents"]
             inode["extents"] = []
             self._defer_free(r["ino"], old, r.get("ts", 0.0))
+            # truncating a cold (or mid-migration) file to zero makes
+            # its blob copy unreachable: queue it for the orphan reaper
+            self._reap_inode_blobs(inode, r.get("ts", 0.0))
+            for k in ("tiering.state", "tiering.gen", "tiering.ts"):
+                inode["xattr"].pop(k, None)
             return {"extents": old}
         # shrink: drop keys entirely past the new EOF (freed for GC) and
         # clip a straddling key's mapped length — reads in [size, later
@@ -913,6 +954,179 @@ class MetaPartition:
     def freelist_entries(self) -> list[tuple[str, dict]]:
         with self._lock:
             return [(k, dict(v)) for k, v in self.freelist.items()]
+
+    # ---------------- cold-tier two-phase migration FSM ----------------
+    # The fs->blob bridge persists its state IN the inode (xattrs), so
+    # WAL replay, raft failover, and lcnode restarts all see exactly
+    # where a migration stopped:
+    #
+    #   (hot) --tiering_prepare--> PREPARE --tiering_blob_written-->
+    #   BLOB_WRITTEN --tiering_commit--> COMMITTED --tiering_finish-->
+    #   (cold: cold.location set, extents released)
+    #
+    # Every step fences on the generation counter captured at prepare:
+    # a write/truncate/rename racing the migration bumps gen, and the
+    # fenced step queues the now-orphaned blob onto blob_freelist and
+    # rolls the inode back to hot — the RACING WRITE WINS, the blob
+    # copy loses. Fence failures mutate state (rollback + blob enqueue)
+    # and must therefore RETURN {"ok": False} instead of raising:
+    # apply() skips segment dirtying on MetaError, so a mutate-then-
+    # raise would leave checkpoints missing the rollback.
+
+    _TIER_XATTRS = ("tiering.state", "tiering.gen", "tiering.ts")
+
+    def _defer_blob_free(self, ino: int, location, ts: float) -> None:
+        """Queue one unreachable blob location for the orphan reaper.
+        Keyed by apply_id (FSM state) so repeated enqueues for one
+        inode never collide and replicas agree on the key."""
+        if not location or location.get("empty"):
+            return  # empty-file sentinel: nothing stored in the blob plane
+        self.blob_freelist[f"{ino}:b{self.apply_id}"] = {
+            "location": location, "ts": ts}
+
+    def _reap_inode_blobs(self, inode: dict, ts: float) -> None:
+        """Queue every blob an inode references (committed cold.location
+        and/or mid-migration tiering.pending) onto blob_freelist —
+        called from any apply that makes the payload unreachable."""
+        xa = inode.get("xattr") or {}
+        cold = xa.pop("cold.location", None)
+        if cold:
+            self._defer_blob_free(
+                inode["ino"],
+                json.loads(cold) if isinstance(cold, str) else cold, ts)
+        pending = xa.pop("tiering.pending", None)
+        if pending:
+            self._defer_blob_free(inode["ino"], pending, ts)
+
+    def _clear_tiering(self, inode: dict) -> None:
+        for k in self._TIER_XATTRS:
+            inode["xattr"].pop(k, None)
+
+    def _apply_tiering_prepare(self, r: dict) -> dict:
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            raise MetaError(ENOENT, f"inode {r['ino']}")
+        if inode["type"] != FILE:
+            raise MetaError(EISDIR, f"inode {r['ino']} is not a file")
+        xa = inode["xattr"]
+        st = xa.get("tiering.state")
+        if st is not None:
+            raise MetaError(EBUSY, f"inode {r['ino']} migration in {st}")
+        if xa.get("cold.location"):
+            raise MetaError(EEXIST, f"inode {r['ino']} already cold")
+        gen = inode.get("gen", 0)
+        xa["tiering.state"] = "PREPARE"
+        xa["tiering.gen"] = gen
+        xa["tiering.ts"] = r.get("ts", 0.0)
+        return {"gen": gen, "size": inode["size"]}
+
+    def _apply_tiering_blob_written(self, r: dict) -> dict:
+        """Phase 2: the blob copy is durable (and CRC-verified by the
+        engine); pin its location as tiering.pending. A fence failure
+        (racing write bumped gen, or the file vanished) queues the blob
+        for reaping and rolls back — the hot data was never touched."""
+        ts = r.get("ts", 0.0)
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            self._defer_blob_free(r["ino"], r["location"], ts)
+            return {"ok": False, "reason": "unlinked"}
+        xa = inode["xattr"]
+        if (xa.get("tiering.state") != "PREPARE"
+                or inode.get("gen", 0) != r["gen"]):
+            self._defer_blob_free(r["ino"], r["location"], ts)
+            self._clear_tiering(inode)
+            return {"ok": False, "reason": "fenced"}
+        xa["tiering.state"] = "BLOB_WRITTEN"
+        xa["tiering.pending"] = r["location"]
+        return {"ok": True}
+
+    def _apply_tiering_commit(self, r: dict) -> dict:
+        """Phase 3, the point of no return — in ONE atomic apply: the
+        pending location becomes cold.location and the hot extents move
+        to the deferred freelist. Until this apply lands, every crash
+        leaves the hot copy fully intact; after it, the blob copy is
+        the single source of truth."""
+        ts = r.get("ts", 0.0)
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            # unlink raced: _reap_inode_blobs already queued the pending
+            return {"ok": False, "reason": "unlinked"}
+        xa = inode["xattr"]
+        st = xa.get("tiering.state")
+        if st == "COMMITTED":
+            # crash between commit and finish; the rescan just finishes
+            return {"ok": True, "already": True}
+        if st != "BLOB_WRITTEN" or inode.get("gen", 0) != r["gen"]:
+            pending = xa.pop("tiering.pending", None)
+            if pending:
+                self._defer_blob_free(r["ino"], pending, ts)
+            self._clear_tiering(inode)
+            return {"ok": False, "reason": "fenced"}
+        pending = xa.pop("tiering.pending")
+        xa["cold.location"] = json.dumps(pending)
+        old = inode["extents"]
+        inode["extents"] = []
+        self._defer_free(r["ino"], old, ts)
+        xa["tiering.state"] = "COMMITTED"
+        return {"ok": True, "released": len(old)}
+
+    def _apply_tiering_finish(self, r: dict) -> dict:
+        """Clear the transition markers, keeping cold.location — pure
+        bookkeeping, idempotent at any point past commit."""
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            return {"ok": True}
+        if inode["xattr"].get("tiering.state") == "COMMITTED":
+            self._clear_tiering(inode)
+        return {"ok": inode["xattr"].get("tiering.state") is None}
+
+    def _apply_tiering_abort(self, r: dict) -> dict:
+        """Roll an uncommitted migration back to hot; queues any pending
+        blob for reaping. Refuses past the commit point (the hot extents
+        are already on the freelist — the caller finishes instead)."""
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            return {"ok": True}
+        xa = inode["xattr"]
+        if xa.get("tiering.state") == "COMMITTED":
+            return {"ok": False, "reason": "committed"}
+        pending = xa.pop("tiering.pending", None)
+        if pending:
+            self._defer_blob_free(r["ino"], pending, r.get("ts", 0.0))
+        self._clear_tiering(inode)
+        return {"ok": True}
+
+    def _apply_untier_commit(self, r: dict) -> dict:
+        """Re-heat: attach freshly-written (unregistered) hot extents
+        and release the blob copy, in one atomic apply. Fenced on gen
+        like the forward path; a fence failure reclaims the extents the
+        engine just wrote (they were never visible)."""
+        ts = r.get("ts", 0.0)
+        inode = self.inodes.get(r["ino"])
+        if inode is None:
+            self._defer_free(r["ino"], r["extents"], ts)
+            return {"ok": False, "reason": "unlinked"}
+        xa = inode["xattr"]
+        cold = xa.get("cold.location")
+        if (cold is None or inode.get("gen", 0) != r["gen"]
+                or inode["extents"]):
+            self._defer_free(r["ino"], r["extents"], ts)
+            return {"ok": False, "reason": "fenced"}
+        inode["extents"] = list(r["extents"])
+        inode["gen"] = inode.get("gen", 0) + 1
+        xa.pop("cold.location")
+        self._defer_blob_free(
+            r["ino"], json.loads(cold) if isinstance(cold, str) else cold,
+            ts)
+        return {"ok": True}
+
+    def _apply_blob_free_done(self, r: dict) -> dict:
+        self.blob_freelist.pop(r["key"], None)
+        return {}
+
+    def blob_freelist_entries(self) -> list[tuple[str, dict]]:
+        with self._lock:
+            return [(k, dict(v)) for k, v in self.blob_freelist.items()]
 
     # ---------------- reads (no apply) ----------------
     def inode_get(self, ino: int) -> dict:
@@ -1613,6 +1827,14 @@ class MetaNode:
         mp = self._mp_leader(args["pid"])
         with mp._lock:
             return {"freelist": {k: v for k, v in mp.freelist.items()}}
+
+    def rpc_blob_freelist(self, args, body):
+        """Pending deferred blob deletions (the tiering orphan reaper
+        drains this; fsck counts these as referenced, not leaked)."""
+        mp = self._mp_leader(args["pid"])
+        with mp._lock:
+            return {"blob_freelist":
+                    {k: v for k, v in mp.blob_freelist.items()}}
 
     def rpc_list_inos(self, args, body):
         """All inode ids held by the partition (fsck's orphan-inode pass

@@ -32,12 +32,25 @@ cache_ops = metrics.DEFAULT.counter(
 
 
 class FlashNode:
-    """In-RAM LRU cache engine (tmpfs-class tier of the reference)."""
+    """In-RAM LRU cache engine (tmpfs-class tier of the reference).
 
-    def __init__(self, capacity_bytes: int = 256 << 20):
+    Eviction is burn-rate-informed: entries carry the request family
+    (`path`) that populated them, and when the budget is exceeded the
+    node samples the EVICT_SAMPLE oldest entries and evicts the one
+    whose path is healthiest (lowest brownout level) — a path that is
+    burning SLO budget keeps its working set warm at the expense of
+    paths with latency headroom. Untagged entries and an all-healthy
+    gate degrade to plain LRU (oldest wins every tie), so the default
+    behavior is bit-identical to the pre-change cache."""
+
+    EVICT_SAMPLE = 8
+
+    def __init__(self, capacity_bytes: int = 256 << 20, *, gate=None):
         self.capacity = capacity_bytes
+        self._gate = gate  # None -> qos.DEFAULT, lazily
         self._lock = threading.Lock()
         self._lru: OrderedDict[str, bytes] = OrderedDict()
+        self._paths: dict[str, str] = {}  # key -> populating path
         self._used = 0
 
     def get(self, key: str) -> bytes | None:
@@ -47,20 +60,44 @@ class FlashNode:
                 self._lru.move_to_end(key)
             return data
 
-    def put(self, key: str, data: bytes) -> None:
+    def _evict_one(self) -> None:
+        cands = []
+        for k in self._lru:  # OrderedDict iterates oldest-first
+            cands.append(k)
+            if len(cands) >= self.EVICT_SAMPLE:
+                break
+        victim = cands[0]
+        if len(cands) > 1 and any(self._paths.get(k) for k in cands):
+            if self._gate is None:
+                self._gate = qos.DEFAULT
+            best_lvl = None
+            for k in cands:
+                p = self._paths.get(k)
+                lvl = self._gate.level(p) if p else 0
+                if best_lvl is None or lvl < best_lvl:
+                    best_lvl, victim = lvl, k
+        evicted = self._lru.pop(victim)
+        self._paths.pop(victim, None)
+        self._used -= len(evicted)
+
+    def put(self, key: str, data: bytes, path: str | None = None) -> None:
         with self._lock:
             old = self._lru.pop(key, None)
             if old is not None:
                 self._used -= len(old)
             self._lru[key] = data
+            if path is not None:
+                self._paths[key] = path
+            else:
+                self._paths.pop(key, None)
             self._used += len(data)
             while self._used > self.capacity and self._lru:
-                _, evicted = self._lru.popitem(last=False)
-                self._used -= len(evicted)
+                self._evict_one()
 
     def delete(self, key: str) -> bool:
         with self._lock:
             old = self._lru.pop(key, None)
+            self._paths.pop(key, None)
             if old is not None:
                 self._used -= len(old)
             return old is not None
@@ -78,7 +115,7 @@ class FlashNode:
         return {}, data
 
     def rpc_cache_put(self, args, body):
-        self.put(args["key"], body)
+        self.put(args["key"], body, path=args.get("path"))
         return {}
 
     def rpc_cache_delete(self, args, body):
@@ -345,7 +382,8 @@ class CachedReader:
             if not self.breaker.allow(addr):
                 continue
             try:
-                self._flash_client(addr).cache_put(key, data)
+                self._flash_client(addr).cache_put(key, data,
+                                                   path="fs.read")
             except rpc.RpcError:
                 self.breaker.record_failure(addr)
                 continue

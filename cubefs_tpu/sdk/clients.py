@@ -289,8 +289,12 @@ class FlashClient(_Base):
     def cache_get(self, key: str) -> bytes:
         return self._call("cache_get", {"key": key})[1]
 
-    def cache_put(self, key: str, data: bytes) -> None:
-        self._call("cache_put", {"key": key}, data)
+    def cache_put(self, key: str, data: bytes,
+                  path: str | None = None) -> None:
+        args = {"key": key}
+        if path is not None:
+            args["path"] = path  # request family, for burn-aware eviction
+        self._call("cache_put", args, data)
 
     def cache_delete(self, key: str) -> bool:
         return self._call("cache_delete", {"key": key})[0]["deleted"]

@@ -50,6 +50,13 @@ KINDS = ("drop_before", "drop_after", "delay", "error", "corrupt",
          "stale", "duplicate")
 
 
+class InjectedCrash(Exception):
+    """Raised by FaultPlan.gate() at an in-process fault point — models
+    a process kill at that exact spot (the lcnode chaos drill arms these
+    at migration phase boundaries). Deliberately NOT an RpcError: no
+    retry layer may swallow it."""
+
+
 @dataclasses.dataclass
 class Rule:
     """One fault rule; matched in plan order, first terminal rule wins."""
@@ -282,6 +289,25 @@ class FaultPlan:
             503, f"{addr}/{method}: injected drop-after-execute "
                  f"(reply lost; retry must dedup via op_id)")
 
+    # ---- in-process fault points (non-RPC) ----
+    def gate(self, addr: str, method: str) -> None:
+        """One named in-process fault point — code that wants to be
+        killable mid-sequence (the tiering engine's phase boundaries)
+        calls ``plan.gate("lcnode", "phase:prepared")`` between durable
+        steps. Matching rules flow through the same seeded decision
+        engine and land in the same schedule/digest as transport
+        faults; `delay` sleeps, every other kind raises InjectedCrash
+        (a simulated process kill at exactly that boundary)."""
+        rule = self._decide(addr, method)
+        if rule is None:
+            return
+        if rule.kind == "delay":
+            self._sleep_for(rule, addr, method)
+            return
+        raise InjectedCrash(
+            f"{addr}/{method}: injected {rule.kind} (process killed "
+            f"at this phase boundary)")
+
 
 # ---------------- install / sender identity ----------------
 
@@ -304,6 +330,13 @@ def uninstall() -> None:
 
 def current() -> FaultPlan | None:
     return _PLAN
+
+
+def gate(addr: str, method: str) -> None:
+    """Module-level fault point: no-op (one None check) without an
+    installed plan, so production code can sprinkle these freely."""
+    if _PLAN is not None:
+        _PLAN.gate(addr, method)
 
 
 @contextlib.contextmanager

@@ -423,3 +423,36 @@ qos_brownout = DEFAULT.gauge(
     "burn-rate-driven degradation level per path: 0 healthy, 1 shed "
     "scrub + suppress flash fills + halve repair steps, 2 shed repair "
     "too and quarter repair steps", ("path",))
+
+# cold-data lifecycle tiering (fs/tiering.py + fs/lcnode.py): the
+# two-phase fs->blob migration FSM. `cubefs-cli metrics tiering`
+# renders these.
+tiering_transitions = DEFAULT.counter(
+    "cubefs_tiering_transitions_total",
+    "cold-tier migration attempts by outcome: `migrated` released the "
+    "hot extents after a verified blob copy, `fenced` lost the race to "
+    "a concurrent write/rename and rolled back, `resumed` finished a "
+    "half-done migration found by rescan, `aborted` rolled one back, "
+    "`verify_failed` rejected a corrupt blob copy before release, "
+    "`error` died mid-flight (state machine resumes it)", ("outcome",))
+tiering_bytes = DEFAULT.counter(
+    "cubefs_tiering_bytes_total",
+    "payload bytes moved across the fs<->blob bridge",
+    ("direction",))  # cold (migrate) / hot (untier) / read (read-through)
+tiering_cold_reads = DEFAULT.counter(
+    "cubefs_tiering_cold_reads_total",
+    "read-through requests served from the blob plane")
+tiering_untiered = DEFAULT.counter(
+    "cubefs_tiering_untiered_total",
+    "re-heat promotions back to datanode extents by outcome",
+    ("outcome",))
+tiering_orphans_reaped = DEFAULT.counter(
+    "cubefs_tiering_orphans_reaped_total",
+    "unreachable blob copies deleted by the deferred blob-free reaper")
+tiering_blob_freelist = DEFAULT.gauge(
+    "cubefs_tiering_blob_freelist",
+    "blob locations queued for deferred deletion (nonzero between a "
+    "rollback/overwrite/unlink and the next reaper sweep)")
+lc_scan_errors = DEFAULT.counter(
+    "cubefs_lc_scan_errors_total",
+    "lifecycle scan loop iterations that raised (loop stays alive)")

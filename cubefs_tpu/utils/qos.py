@@ -384,6 +384,17 @@ def fill_suppressed() -> bool:
     return DEFAULT.max_level() >= 1
 
 
+def scrub_suppressed() -> bool:
+    """Scrub-class background work (cold-tier migration, compaction)
+    stops at the first brownout level — the gate would shed its
+    admissions anyway (SCRUB is dropped at level >= 1), so schedulers
+    check this BEFORE reading payload bytes and skip the whole item
+    instead of burning a read + a 429."""
+    if not enabled():
+        return False
+    return DEFAULT.max_level() >= 1
+
+
 def repair_step_scale() -> float:
     """Brownout multiplier for the repair scheduler's drain step bytes
     (PR 8 weights): 1.0 healthy, 0.5 under warn, 0.25 under critical."""

@@ -1,0 +1,28 @@
+"""Fixture: fs-plane code that bypasses the tiering bridge (CFD001/2)."""
+import json
+
+import cubefs_tpu.blob.sdk  # CFD001: absolute blob-plane import
+from cubefs_tpu.blob.access import AccessHandler  # CFD001
+from ..blob.types import Location  # CFD001: relative blob-plane import
+
+
+class SideDoorLifecycle:
+    def __init__(self, fs, blob_access):
+        self.fs = fs
+        self.blob_access = blob_access
+
+    def transition(self, path, inode, blob):
+        # the old read->put->truncate shape: no fence, no verify
+        data = self.fs.read_file(path)
+        loc = blob.put(data)  # CFD002: bare blob receiver
+        self.fs.meta.set_xattr(inode["ino"], "cold.location",
+                               json.dumps(loc.to_dict()))
+        self.fs.meta.truncate(inode["ino"], 0)
+
+    def read_through(self, inode):
+        cold = inode["xattr"].get("cold.location")
+        return self.blob_access.get(  # CFD002: self.<blob> receiver
+            Location.from_dict(json.loads(cold)))
+
+    def drop(self, location):
+        self.blob_access.delete(location)  # CFD002

@@ -1,0 +1,24 @@
+"""Fixture: fs-plane code that stays on its side of the bridge."""
+from .tiering import TieringEngine  # fs-internal: the sanctioned bridge
+
+
+class DisciplinedLifecycle:
+    def __init__(self, fs, engine: TieringEngine):
+        self.fs = fs
+        self.engine = engine
+        self.state = {}
+
+    def transition(self, ino):
+        # all blob traffic flows through the state machine
+        return self.engine.migrate(ino)
+
+    def read_through(self, inode):
+        return self.engine.read_cold(inode, 0, inode["size"])
+
+    def bookkeeping(self, key, location):
+        # dict .get / registry .put-alikes on non-blob receivers are fine
+        cached = self.state.get(key)
+        if cached is None:
+            self.state[key] = location
+        self.fs.meta.inode_get(key)
+        return cached

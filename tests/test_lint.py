@@ -28,6 +28,7 @@ from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
 from tool.lint.checkers.rpc_idempotency import (RpcIdempotencyChecker,
                                                 is_mutating)
 from tool.lint.checkers.tier1_purity import Tier1PurityChecker
+from tool.lint.checkers.tiering_discipline import TieringDisciplineChecker
 from tool.lint.checkers.tracer_safety import (TraceClockChecker,
                                               TracerSafetyChecker)
 
@@ -383,4 +384,30 @@ def test_fanout_discipline_scope():
     assert c.applies("cubefs_tpu/fs/client.py")
     # data plane replication has its own door, not the meta coalescer
     assert not c.applies("cubefs_tpu/fs/datanode.py")
+    assert not c.applies("cubefs_tpu/blob/worker.py")
+
+
+# ---------------- tiering-discipline ----------------
+
+def test_tiering_discipline_true_positives():
+    mod = _module("tiering_bad.py", "cubefs_tpu/fs/lcnode.py")
+    found = TieringDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFD001", "CFD001", "CFD001",
+                             "CFD002", "CFD002", "CFD002"]
+    assert any("blob_access.get" in v.message for v in found)
+
+
+def test_tiering_discipline_true_negative():
+    mod = _module("tiering_good.py", "cubefs_tpu/fs/lcnode.py")
+    assert TieringDisciplineChecker().check(mod) == []
+
+
+def test_tiering_discipline_sanctions_only_the_bridge():
+    c = TieringDisciplineChecker()
+    assert c.applies("cubefs_tpu/fs/client.py")
+    assert c.applies("cubefs_tpu/fs/tiering.py")
+    # ...but the bridge module itself is exempt from its own rule
+    mod = _module("tiering_bad.py", "cubefs_tpu/fs/tiering.py")
+    assert c.check(mod) == []
+    # the blob plane talking to itself is out of scope
     assert not c.applies("cubefs_tpu/blob/worker.py")

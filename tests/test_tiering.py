@@ -1,0 +1,622 @@
+"""Crash-safe cold-data tiering: the two-phase fs->blob migration state
+machine (fs/tiering.py + the metanode `tiering_*` applies).
+
+Covers the whole robustness matrix the subsystem claims:
+
+  * basic migration + transparent read-through (engine and the
+    `CUBEFS_TIERING` FileSystem door)
+  * empty files migrate ONCE via the sentinel location (the old
+    `_transition` rescanned them forever)
+  * interleavings — write / rename / unlink racing a migration, with
+    the generation fence always letting the mutation win
+  * double-scan idempotency
+  * WAL replay of a half-committed transition (checkpoint + oplog
+    reload lands in the same state, and the resume path finishes it)
+  * re-heat: hot cold-files promote back to extents through the fenced
+    `untier_commit`
+  * the seeded chaos drill: a FaultPlan kills the lcnode at every phase
+    boundary while writes/renames/unlinks race; every surviving file
+    reads byte-identical, the orphan reaper leaves zero leaked blobs,
+    and the fault schedule digest reproduces across runs
+  * burn-rate-informed flashnode eviction (satellite)
+
+Everything runs on FakeClock — no wall-clock sleeps.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob.access import AccessConfig, AccessHandler
+from cubefs_tpu.blob.blobnode import BlobNode
+from cubefs_tpu.blob.clustermgr import ClusterMgr
+from cubefs_tpu.fs import metanode as mn
+from cubefs_tpu.fs.client import FileSystem, FsError
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.lcnode import LcNode, LifecycleRule
+from cubefs_tpu.fs.master import Master
+from cubefs_tpu.fs.metanode import MetaNode, MetaPartition
+from cubefs_tpu.fs.remotecache import FlashNode
+from cubefs_tpu.fs.tiering import TieringEngine, _AccessAdapter
+from cubefs_tpu.utils import faultinject, qos, rpc
+from cubefs_tpu.utils.retry import FakeClock
+from cubefs_tpu.utils.rpc import NodePool
+
+NOW = 1_000_000.0  # the drills' fake epoch
+
+
+class CountingBlob:
+    """Blob-client spy: records every put/delete so tests can prove the
+    zero-leaked-blobs invariant by accounting, not sampling."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.puts: list[dict] = []
+        self.deletes: list[dict] = []
+
+    def put(self, data, codemode=None, priority=None):
+        loc = self.inner.put(data, codemode, priority=priority)
+        self.puts.append(loc)
+        return loc
+
+    def get(self, location, priority=None):
+        return self.inner.get(location, priority=priority)
+
+    def delete(self, location, priority=None):
+        self.inner.delete(location, priority=priority)
+        self.deletes.append(location)
+
+
+def _key(loc: dict) -> str:
+    return json.dumps(loc, sort_keys=True)
+
+
+def _build_cluster(tmp_path, sub: str = "a"):
+    """fs cluster + one-node blob plane + counting tiering engine."""
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas, datas = [], []
+    for i in range(2):
+        node = MetaNode(i, addr=f"meta{i}", node_pool=pool)
+        pool.bind(f"meta{i}", node)
+        master.register_metanode(f"meta{i}")
+        metas.append(node)
+    for i in range(3):
+        node = DataNode(i, str(tmp_path / sub / f"d{i}"), f"data{i}", pool)
+        pool.bind(f"data{i}", node)
+        master.register_datanode(f"data{i}")
+        datas.append(node)
+    view = master.create_volume(f"tiervol{sub}", mp_count=1, dp_count=2)
+    fs = FileSystem(view, pool)
+
+    cm = ClusterMgr(allow_colocated_units=True)
+    bn = BlobNode(0, [str(tmp_path / sub / f"bd{i}") for i in range(9)],
+                  rpc.Client(cm), addr="bn0")
+    bn.register()
+    bn.send_heartbeat()
+    pool.bind("bn0", bn)
+    access = AccessHandler(rpc.Client(cm), pool,
+                           AccessConfig(blob_size=64 << 10))
+    blob = CountingBlob(_AccessAdapter(access))
+    engine = TieringEngine(fs, blob, untier_threshold=2)
+    return fs, view, pool, engine, blob, metas, datas
+
+
+@pytest.fixture
+def tiercluster(tmp_path):
+    fs, view, pool, engine, blob, metas, datas = _build_cluster(tmp_path)
+    yield fs, view, pool, engine, blob
+    for n in metas:
+        n.stop()
+    for d in datas:
+        d.stop()
+
+
+def _write_aged(fs, path: str, data: bytes, age: float = 7200.0) -> int:
+    ino = fs.write_file(path, data)
+    fs.meta.set_attr(ino, mtime=NOW - age)
+    return ino
+
+
+def _lc(fs, engine) -> LcNode:
+    lc = LcNode(fs, engine=engine, clock=FakeClock(start=NOW))
+    lc.set_rules([LifecycleRule("tier", prefix="/cold/",
+                                transition_after_s=3600)])
+    return lc
+
+
+def _assert_no_leaks(fs, blob):
+    """Every blob ever put is either deleted or referenced by a live
+    inode (cold.location / tiering.pending); the freelist is drained."""
+    assert fs.meta.blob_freelist_all() == {}
+    deleted = {_key(loc) for loc in blob.deletes}
+    live = set()
+    for mp in fs.meta.mps:
+        state = json.loads(fs.meta._call(mp, "export_state", {})[1])
+        for inode in state["inodes"].values():
+            xa = inode.get("xattr", {})
+            cold = xa.get("cold.location")
+            if cold:
+                loc = json.loads(cold) if isinstance(cold, str) else cold
+                live.add(_key(loc))
+            if xa.get("tiering.pending"):
+                live.add(_key(xa["tiering.pending"]))
+    for loc in blob.puts:
+        assert _key(loc) in deleted | live, "leaked blob copy"
+
+
+# ------------------------------------------------------------ basics
+
+def test_basic_migration_and_read_through(tiercluster, rng,
+                                          monkeypatch):
+    fs, view, pool, engine, blob = tiercluster
+    payload = rng.integers(0, 256, 150_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    ino = _write_aged(fs, "/cold/data.bin", payload)
+    lc = _lc(fs, engine)
+    report = lc.scan_once()
+    assert report.transitioned == 1 and report.errors == []
+    inode = fs.meta.inode_get(ino)
+    assert inode["extents"] == []
+    assert inode["xattr"].get("cold.location")
+    assert inode["xattr"].get("tiering.state") is None  # markers cleared
+    # engine read-through (full + ranged)
+    assert lc.read_through("/cold/data.bin") == payload
+    assert engine.read_cold(fs.meta.inode_get(ino), 1000, 5000) \
+        == payload[1000:6000]
+    # the CUBEFS_TIERING FileSystem door: transparent client reads
+    monkeypatch.setenv("CUBEFS_TIERING", "1")
+    fs2 = FileSystem(view, pool, blob_client=blob)
+    assert fs2.tiering is not None
+    assert fs2.read_file("/cold/data.bin") == payload
+    assert fs2.read_file("/cold/data.bin", offset=4096,
+                         length=8192) == payload[4096:4096 + 8192]
+    _assert_no_leaks(fs, blob)
+
+
+def test_door_off_keeps_tiering_disabled(tiercluster, monkeypatch):
+    fs, view, pool, engine, blob = tiercluster
+    monkeypatch.delenv("CUBEFS_TIERING", raising=False)
+    fs2 = FileSystem(view, pool, blob_client=blob)
+    assert fs2.tiering is None  # off by default even WITH a blob client
+    monkeypatch.setenv("CUBEFS_TIERING", "0")
+    fs3 = FileSystem(view, pool, blob_client=blob)
+    assert fs3.tiering is None
+
+
+def test_empty_file_migrates_once_via_sentinel(tiercluster):
+    fs, _, _, engine, blob = tiercluster
+    fs.mkdir("/cold")
+    ino = _write_aged(fs, "/cold/empty.log", b"")
+    lc = _lc(fs, engine)
+    assert lc.scan_once().transitioned == 1
+    inode = fs.meta.inode_get(ino)
+    loc = json.loads(inode["xattr"]["cold.location"])
+    assert loc.get("empty") is True
+    assert blob.puts == []  # nothing stored in the blob plane
+    # the old bug: empty files matched the rule on every scan forever
+    report = lc.scan_once()
+    assert report.transitioned == 0
+    assert lc.read_through("/cold/empty.log") == b""
+    assert fs.read_file("/cold/empty.log") == b""
+
+
+def test_double_scan_idempotent(tiercluster, rng):
+    fs, _, _, engine, blob = tiercluster
+    payload = rng.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    _write_aged(fs, "/cold/x.bin", payload)
+    lc = _lc(fs, engine)
+    assert lc.scan_once().transitioned == 1
+    puts = len(blob.puts)
+    for _ in range(3):
+        r = lc.scan_once()
+        assert r.transitioned == 0 and r.resumed == 0
+    assert len(blob.puts) == puts  # no re-migration traffic
+    assert lc.read_through("/cold/x.bin") == payload
+    _assert_no_leaks(fs, blob)
+
+
+# ------------------------------------------------- interleaved races
+
+def _crash_at(engine, phase: str):
+    """Run one migration with a kill armed at the given phase boundary;
+    returns the InjectedCrash the drill expects."""
+    plan = faultinject.FaultPlan(seed=7)
+    plan.on("lcnode", f"phase:{phase}", kind="error", times=1)
+    with faultinject.installed(plan):
+        with pytest.raises(faultinject.InjectedCrash):
+            engine.migrate(engine.fs.resolve("/cold/r.bin"))
+
+
+def test_write_during_migration_fences(tiercluster, rng):
+    fs, _, _, engine, blob = tiercluster
+    p1 = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    p2 = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    ino = _write_aged(fs, "/cold/r.bin", p1)
+    _crash_at(engine, "blob_written")  # killed with BLOB_WRITTEN durable
+    assert fs.meta.inode_get(ino)["xattr"]["tiering.state"] \
+        == "BLOB_WRITTEN"
+    fs.pwrite_file("/cold/r.bin", 0, p2)  # racing write bumps gen
+    assert engine.resume(ino) == "aborted"  # fence: the write won
+    inode = fs.meta.inode_get(ino)
+    assert inode["xattr"].get("tiering.state") is None
+    assert inode["xattr"].get("cold.location") is None
+    assert fs.read_file("/cold/r.bin") == p2
+    assert engine.reap_orphans() == 1  # the orphaned blob copy
+    _assert_no_leaks(fs, blob)
+
+
+def test_full_overwrite_during_migration_rolls_back_inline(tiercluster,
+                                                           rng):
+    """write_file truncates first: the truncate apply itself aborts the
+    in-flight migration and queues the pending blob — the rescan then
+    has nothing to do."""
+    fs, _, _, engine, blob = tiercluster
+    p1 = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    p2 = rng.integers(0, 256, 30_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    ino = _write_aged(fs, "/cold/r.bin", p1)
+    _crash_at(engine, "blob_written")
+    fs.write_file("/cold/r.bin", p2)  # truncate rolled the FSM back
+    inode = fs.meta.inode_get(ino)
+    assert inode["xattr"].get("tiering.state") is None
+    assert engine.resume(ino) == "noop"
+    assert fs.read_file("/cold/r.bin") == p2
+    assert engine.reap_orphans() == 1  # the orphaned blob copy
+    _assert_no_leaks(fs, blob)
+
+
+def test_rename_during_migration_fences(tiercluster, rng):
+    fs, _, _, engine, blob = tiercluster
+    p1 = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    ino = _write_aged(fs, "/cold/r.bin", p1)
+    _crash_at(engine, "blob_written")
+    fs.rename("/cold/r.bin", "/cold/moved.bin")  # bumps gen
+    assert engine.resume(ino) == "aborted"
+    assert fs.read_file("/cold/moved.bin") == p1  # bytes intact, hot
+    assert fs.meta.inode_get(ino)["extents"] != []
+    engine.reap_orphans()
+    _assert_no_leaks(fs, blob)
+
+
+def test_unlink_during_migration_reaps_pending(tiercluster, rng):
+    fs, _, _, engine, blob = tiercluster
+    p1 = rng.integers(0, 256, 40_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    _write_aged(fs, "/cold/r.bin", p1)
+    _crash_at(engine, "blob_written")
+    fs.unlink("/cold/r.bin")  # rm_inode queues tiering.pending
+    assert len(fs.meta.blob_freelist_all()) == 1
+    assert engine.reap_orphans() == 1
+    assert blob.deletes  # really deleted from the blob plane
+    _assert_no_leaks(fs, blob)
+
+
+def test_crash_after_prepare_rolls_back(tiercluster, rng):
+    fs, _, _, engine, blob = tiercluster
+    p1 = rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    ino = _write_aged(fs, "/cold/r.bin", p1)
+    _crash_at(engine, "prepared")
+    assert fs.meta.inode_get(ino)["xattr"]["tiering.state"] == "PREPARE"
+    assert engine.resume(ino) == "aborted"  # nothing durable to salvage
+    assert fs.read_file("/cold/r.bin") == p1
+    # and the file is still eligible: a later scan migrates it cleanly
+    lc = _lc(fs, engine)
+    assert lc.scan_once().transitioned == 1
+    assert lc.read_through("/cold/r.bin") == p1
+    _assert_no_leaks(fs, blob)
+
+
+def test_crash_after_commit_rolls_forward(tiercluster, rng):
+    fs, _, _, engine, blob = tiercluster
+    p1 = rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    ino = _write_aged(fs, "/cold/r.bin", p1)
+    _crash_at(engine, "committed")
+    inode = fs.meta.inode_get(ino)
+    assert inode["xattr"]["tiering.state"] == "COMMITTED"
+    assert inode["extents"] == []  # hot copy already released
+    assert engine.resume(ino) == "resumed"  # bookkeeping only
+    inode = fs.meta.inode_get(ino)
+    assert inode["xattr"].get("tiering.state") is None
+    assert engine.read_cold(inode, 0, len(p1)) == p1
+    _assert_no_leaks(fs, blob)
+
+
+def test_crash_after_blob_written_resumes_forward(tiercluster, rng):
+    """No race: gen unchanged, so the rescan VERIFIES and completes the
+    migration instead of re-uploading."""
+    fs, _, _, engine, blob = tiercluster
+    p1 = rng.integers(0, 256, 20_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    ino = _write_aged(fs, "/cold/r.bin", p1)
+    _crash_at(engine, "blob_written")
+    puts = len(blob.puts)
+    assert engine.resume(ino) == "resumed"
+    assert len(blob.puts) == puts  # rolled forward, no second upload
+    inode = fs.meta.inode_get(ino)
+    assert inode["extents"] == [] and inode["xattr"]["cold.location"]
+    assert engine.read_cold(inode, 0, len(p1)) == p1
+    _assert_no_leaks(fs, blob)
+
+
+# --------------------------------------------------------- re-heat
+
+def test_untier_on_reheat(tiercluster, rng):
+    fs, _, _, engine, blob = tiercluster
+    payload = rng.integers(0, 256, 80_000, dtype=np.uint8).tobytes()
+    fs.mkdir("/cold")
+    ino = _write_aged(fs, "/cold/hotagain.bin", payload)
+    lc = _lc(fs, engine)
+    assert lc.scan_once().transitioned == 1
+    # two cold reads cross the untier_threshold=2 fixture setting
+    assert fs.meta.inode_get(ino)["extents"] == []
+    assert lc.read_through("/cold/hotagain.bin") == payload
+    assert lc.read_through("/cold/hotagain.bin") == payload
+    assert engine.hot_candidates() == [ino]
+    report = lc.scan_once()
+    assert report.untiered == 1
+    inode = fs.meta.inode_get(ino)
+    assert inode["extents"] != []  # hot again
+    assert inode["xattr"].get("cold.location") is None
+    assert fs.read_file("/cold/hotagain.bin") == payload
+    # the now-orphaned cold copy was queued and reaped
+    _assert_no_leaks(fs, blob)
+
+
+# ------------------------------------------------------- WAL replay
+
+def test_wal_replay_of_half_committed_transition(tmp_path):
+    """A metanode crash with BLOB_WRITTEN durable: checkpoint + oplog
+    reload must land in the identical mid-flight state, and a second
+    crash AFTER the commit apply must reload as COMMITTED with the hot
+    extents on the freelist."""
+    d = str(tmp_path / "mp")
+    loc = {"vid": 1, "size": 4}
+    ext = [{"dp_id": 1, "extent_id": 2, "ext_offset": 0,
+            "file_offset": 0, "size": 4}]
+    mp = MetaPartition(0, 1, 1000, data_dir=d)
+    mp.submit({"op": "mk_inode", "ino": 5, "type": mn.FILE, "ts": 1.0})
+    mp.submit({"op": "append_extents", "ino": 5, "extents": ext,
+               "size": 4, "ts": 2.0})
+    prep = mp.submit({"op": "tiering_prepare", "ino": 5, "ts": 3.0})
+    res = mp.submit({"op": "tiering_blob_written", "ino": 5,
+                     "gen": prep["gen"], "location": loc, "ts": 4.0,
+                     "op_id": "bw-1"})
+    assert res["ok"]
+    del mp  # crash: no checkpoint since the writes -> pure oplog replay
+
+    mp2 = MetaPartition(0, 1, 1000, data_dir=d)
+    inode = mp2.inodes[5]
+    assert inode["xattr"]["tiering.state"] == "BLOB_WRITTEN"
+    assert inode["xattr"]["tiering.pending"] == loc
+    assert inode["extents"] == ext  # hot copy untouched mid-flight
+    # client retry of the half-flight op replays via op_id, not re-runs
+    again = mp2.submit({"op": "tiering_blob_written", "ino": 5,
+                        "gen": prep["gen"], "location": loc, "ts": 4.0,
+                        "op_id": "bw-1"})
+    assert again["ok"]
+    # roll forward: commit, then crash again (checkpointed this time)
+    res = mp2.submit({"op": "tiering_commit", "ino": 5,
+                      "gen": prep["gen"], "ts": 5.0})
+    assert res["ok"] and res["released"] == 1
+    mp2.snapshot()
+    del mp2
+
+    mp3 = MetaPartition(0, 1, 1000, data_dir=d)
+    inode = mp3.inodes[5]
+    assert inode["xattr"]["tiering.state"] == "COMMITTED"
+    assert json.loads(inode["xattr"]["cold.location"]) == loc
+    assert inode["extents"] == []
+    assert any(k.startswith("5:") for k in mp3.freelist), \
+        "released extents must await the free scan"
+    mp3.submit({"op": "tiering_finish", "ino": 5, "ts": 6.0})
+    assert mp3.inodes[5]["xattr"].get("tiering.state") is None
+
+
+def test_fenced_blob_written_queues_blob_on_replayed_state(tmp_path):
+    """Replay of a fenced transition: the blob lands on blob_freelist
+    (FSM state), survives reload, and blob_free_done retires it."""
+    d = str(tmp_path / "mp2")
+    loc = {"vid": 9, "size": 4}
+    mp = MetaPartition(0, 1, 1000, data_dir=d)
+    mp.submit({"op": "mk_inode", "ino": 7, "type": mn.FILE, "ts": 1.0})
+    prep = mp.submit({"op": "tiering_prepare", "ino": 7, "ts": 2.0})
+    # a racing write bumps gen before the blob_written lands
+    mp.submit({"op": "append_extents", "ino": 7,
+               "extents": [{"dp_id": 1, "extent_id": 3, "ext_offset": 0,
+                            "file_offset": 0, "size": 4}],
+               "size": 4, "ts": 3.0})
+    res = mp.submit({"op": "tiering_blob_written", "ino": 7,
+                     "gen": prep["gen"], "location": loc, "ts": 4.0})
+    assert not res["ok"]  # fenced, rolled back, blob queued
+    assert mp.inodes[7]["xattr"].get("tiering.state") is None
+    assert len(mp.blob_freelist) == 1
+    del mp
+    mp2 = MetaPartition(0, 1, 1000, data_dir=d)
+    (key, ent), = mp2.blob_freelist.items()
+    assert ent["location"] == loc
+    mp2.submit({"op": "blob_free_done", "key": key, "ts": 5.0})
+    assert mp2.blob_freelist == {}
+
+
+# ------------------------------------------------------ chaos drill
+
+def _run_drill(tmp_path, sub: str, seed: int):
+    fs, view, pool, engine, blob, metas, datas = _build_cluster(
+        tmp_path, sub)
+    rng = np.random.default_rng(seed)
+
+    def payload(n):
+        return rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+    fs.mkdir("/cold")
+    names = ["a.bin", "b.bin", "c.bin", "d.bin"]
+    expected = {}
+    for i, name in enumerate(names):
+        data = payload(30_000 + 10_000 * i)
+        _write_aged(fs, f"/cold/{name}", data)
+        expected[name] = data
+    lc = _lc(fs, engine)
+
+    plan = faultinject.FaultPlan(seed=seed)
+    # one kill at EVERY phase boundary of the two-phase machine
+    plan.on("lcnode", "phase:prepared", kind="error", times=1)
+    plan.on("lcnode", "phase:blob_written", kind="error", times=1)
+    plan.on("lcnode", "phase:blob_written", kind="error", after=2,
+            times=1)
+    plan.on("lcnode", "phase:committed", kind="error", times=1)
+
+    p_new = payload(25_000)
+
+    def race_write():
+        fs.write_file("/cold/a.bin", p_new)
+        fs.meta.set_attr(fs.resolve("/cold/a.bin"), mtime=NOW - 7200)
+        expected["a.bin"] = p_new
+
+    def race_rename():
+        fs.rename("/cold/b.bin", "/cold/zb.bin")
+        expected["zb.bin"] = expected.pop("b.bin")
+
+    def race_unlink():
+        fs.unlink("/cold/c.bin")
+        expected.pop("c.bin")
+
+    races = {1: race_write, 2: race_rename, 4: race_unlink}
+    crashes = 0
+    with faultinject.installed(plan):
+        for rnd in range(1, 16):
+            try:
+                lc.scan_once()
+            except faultinject.InjectedCrash:
+                crashes += 1  # the "process" died; next scan recovers
+            race = races.pop(rnd, None)
+            if race:
+                race()
+            if not races and crashes >= 4 and _converged(fs, expected):
+                lc.scan_once()  # one clean pass drains the reaper
+                break
+        else:
+            pytest.fail("drill did not converge")
+    assert crashes == 4, "every phase-boundary kill must have fired"
+    # byte-identical reads for every surviving file, cold or hot
+    for name, data in expected.items():
+        assert lc.read_through(f"/cold/{name}") == data, name
+    _assert_no_leaks(fs, blob)
+    digest = plan.schedule_digest()
+    content = {n: expected[n] for n in sorted(expected)}
+    for n in metas:
+        n.stop()
+    for d in datas:
+        d.stop()
+    return digest, content
+
+
+def _converged(fs, expected) -> bool:
+    for name in expected:
+        inode = fs.meta.inode_get(fs.resolve(f"/cold/{name}"))
+        if inode["xattr"].get("tiering.state") is not None:
+            return False
+        if not inode["xattr"].get("cold.location"):
+            return False
+    return True
+
+
+def test_chaos_drill_survives_every_phase_kill(tmp_path):
+    digest1, content1 = _run_drill(tmp_path, "run1", seed=1234)
+    assert digest1  # the kills really entered the schedule
+    # same seed, fresh cluster: bit-identical fault schedule and content
+    digest2, content2 = _run_drill(tmp_path, "run2", seed=1234)
+    assert digest1 == digest2
+    assert content1 == content2
+
+
+# ------------------------------------------- burn-aware flash eviction
+
+class _Still:
+    def snapshot(self):
+        return {}
+
+
+def test_flashnode_burn_aware_eviction():
+    gate = qos.QosGate(tracker=_Still())
+    gate.force_level("fs.read", 2)  # fs.read is burning SLO budget
+    fn = FlashNode(capacity_bytes=3000, gate=gate)
+    fn.put("k0", b"x" * 1000, path="fs.read")  # oldest, but burning
+    fn.put("k1", b"x" * 1000, path="scratch")
+    fn.put("k2", b"x" * 1000, path="scratch")
+    fn.put("k3", b"x" * 1000, path="scratch")  # forces one eviction
+    # plain LRU would evict k0; burn-aware keeps the burning path's
+    # entry and evicts the oldest HEALTHY entry instead
+    assert fn.get("k0") is not None
+    assert fn.get("k1") is None
+    assert fn.stats()["bytes"] <= 3000
+
+
+def test_flashnode_untagged_entries_stay_pure_lru():
+    gate = qos.QosGate(tracker=_Still())
+    gate.force_level("fs.read", 2)
+    fn = FlashNode(capacity_bytes=3000, gate=gate)
+    for i in range(5):
+        fn.put(f"k{i}", b"x" * 1000)  # no path tags anywhere
+    assert fn.get("k0") is None and fn.get("k1") is None
+    assert fn.get("k4") is not None
+
+
+# ------------------------------------------------------- CLI view
+
+def test_cli_tiering_view():
+    from cubefs_tpu.cli import _tiering_view
+
+    text = "\n".join([
+        'cubefs_tiering_transitions_total{outcome="migrated"} 5',
+        'cubefs_tiering_transitions_total{outcome="fenced"} 2',
+        'cubefs_tiering_bytes_total{direction="cold"} 123456',
+        'cubefs_tiering_bytes_total{direction="read"} 789',
+        'cubefs_tiering_cold_reads_total 7',
+        'cubefs_tiering_untiered_total{outcome="promoted"} 1',
+        'cubefs_tiering_orphans_reaped_total 3',
+        'cubefs_tiering_blob_freelist 2',
+        'cubefs_lc_scan_errors_total 1',
+    ]) + "\n"
+    view = _tiering_view(text)
+    assert view["transitions"] == {"migrated": 5.0, "fenced": 2.0}
+    assert view["bytes"]["cold"] == 123456.0
+    assert view["cold_reads"] == 7.0
+    assert view["untiered"] == {"promoted": 1.0}
+    assert view["orphans_reaped"] == 3.0
+    assert view["blob_freelist_pending"] == 2.0
+    assert view["scan_errors"] == 1.0
+
+
+# ------------------------------------------------ lcnode loop health
+
+def test_lcnode_scan_loop_survives_errors(tiercluster, monkeypatch):
+    """The old loop died silently on the first exception (bare
+    `except: pass`); now it counts, logs, and keeps scanning."""
+    from cubefs_tpu.utils import metrics
+
+    fs, _, _, engine, _ = tiercluster
+    lc = LcNode(fs, engine=engine, clock=FakeClock(start=NOW))
+    boom = {"n": 0}
+
+    def exploding_scan():
+        boom["n"] += 1
+        raise RuntimeError("scan exploded")
+
+    monkeypatch.setattr(lc, "scan_once", exploding_scan)
+    before = metrics.lc_scan_errors.value()
+    lc.start(interval=0.01)
+    import time as _time
+    deadline = _time.time() + 5.0
+    while boom["n"] < 3 and _time.time() < deadline:
+        _time.sleep(0.01)
+    lc.stop()
+    assert boom["n"] >= 3  # loop survived repeated failures
+    assert metrics.lc_scan_errors.value() - before >= 3

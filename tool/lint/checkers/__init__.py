@@ -9,6 +9,7 @@ from .placement_discipline import PlacementDisciplineChecker
 from .retry_discipline import RetryDisciplineChecker
 from .rpc_idempotency import RpcIdempotencyChecker
 from .tier1_purity import Tier1PurityChecker
+from .tiering_discipline import TieringDisciplineChecker
 from .tracer_safety import TraceClockChecker, TracerSafetyChecker
 
 ALL_CHECKERS = (
@@ -23,4 +24,5 @@ ALL_CHECKERS = (
     BatchDisciplineChecker,
     FanoutDisciplineChecker,
     AdmissionDisciplineChecker,
+    TieringDisciplineChecker,
 )

@@ -1,0 +1,91 @@
+"""Cold-tier fencing checker (rule: tiering-discipline, CFD0xx).
+
+The crash-safety story of the cold tier rests on ONE invariant: every
+blob-plane operation the fs plane performs goes through
+`cubefs_tpu/fs/tiering.py` (TieringEngine). That module is where the
+two-phase state machine lives — generation fencing, CRC verification
+before hot-extent release, and deferred blob deletion. A second code
+path that puts/gets/deletes blobs from the fs plane directly (the old
+lcnode `_transition` shape: read -> put -> truncate) silently bypasses
+all three and reintroduces the lost-bytes / leaked-blob windows the
+state machine closed.
+
+  CFD001  a blob-plane import (`cubefs_tpu.blob.*` / `..blob.*`)
+          anywhere in the fs plane outside the sanctioned bridge
+  CFD002  `.put()` / `.get()` / `.delete()` called on a blob-client
+          receiver (a name like `blob`, `blob_access`, `blob_client`)
+          outside the sanctioned bridge
+
+Like the other discipline families the analysis is syntactic: CFD002
+keys on the receiver NAME, so a blob client smuggled through an
+innocuous variable name escapes it — CFD001 (the import) is the
+backstop, since the client class has to come from somewhere.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+# the ONE module allowed to talk to the blob plane from the fs plane
+_SANCTIONED = "cubefs_tpu/fs/tiering.py"
+
+# receiver names that denote a blob client (self.X attribute or bare)
+_BLOB_NAMES = {"blob", "blob_access", "blob_client", "_blob"}
+
+_BLOB_OPS = {"put", "get", "delete"}
+
+
+def _receiver_name(func: ast.Attribute) -> str | None:
+    """`X.put` -> "X", `self.X.put` -> "X", else None."""
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+            and v.value.id == "self":
+        return v.attr
+    return None
+
+
+class TieringDisciplineChecker(Checker):
+    rule = "tiering-discipline"
+    dirs = ("cubefs_tpu/fs/",)
+
+    def check(self, mod: Module) -> list[Violation]:
+        if mod.relpath == _SANCTIONED:
+            return []
+        out: list[Violation] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith("cubefs_tpu.blob"):
+                        out.append(self.violation(
+                            mod, "CFD001", node,
+                            f"blob-plane import `{a.name}` in the fs "
+                            f"plane — only {_SANCTIONED} may cross the "
+                            f"fs->blob bridge (fencing + verify + "
+                            f"deferred delete live there)"))
+            elif isinstance(node, ast.ImportFrom):
+                m = node.module or ""
+                # absolute `cubefs_tpu.blob...` or relative `..blob...`
+                # (level >= 2 from cubefs_tpu/fs/* resolves to the pkg root)
+                if m.startswith("cubefs_tpu.blob") or (
+                        node.level >= 2
+                        and (m == "blob" or m.startswith("blob."))):
+                    out.append(self.violation(
+                        mod, "CFD001", node,
+                        f"blob-plane import `{'.' * node.level}{m}` in "
+                        f"the fs plane — route through {_SANCTIONED}"))
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _BLOB_OPS:
+                recv = _receiver_name(node.func)
+                if recv in _BLOB_NAMES:
+                    out.append(self.violation(
+                        mod, "CFD002", node,
+                        f"direct blob-plane `{recv}.{node.func.attr}()` "
+                        f"in the fs plane bypasses the tiering state "
+                        f"machine (no gen fence, no CRC verify, no "
+                        f"deferred delete) — use TieringEngine"))
+        return out
