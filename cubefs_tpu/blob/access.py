@@ -14,6 +14,7 @@ device sees large contiguous arrays.
 from __future__ import annotations
 
 import contextvars
+import os
 import threading
 import time
 import uuid
@@ -333,6 +334,9 @@ class AccessHandler:
                     self._local_reconstruct(enc, vol, bid, got, errs)
                 if all(i in got for i in range(t.n)):
                     self._file_repairs(vol, bid, got, errs, t.n)
+                    self._read_repair(
+                        vol, bid, {i: got[i] for i in errs if i in got},
+                        errs)
                     metrics.reconstruct_reads.inc(path="local")
                     data = b"".join(got[i] for i in range(t.n))
                     return data[:payload_len]
@@ -363,8 +367,43 @@ class AccessHandler:
             # corrupt the decode
             all_bad = [i for i in range(t.n + t.m) if i not in got]
             enc.reconstruct_data(stripe, all_bad)
+        self._read_repair(
+            vol, bid,
+            {i: stripe[i].tobytes() for i in all_bad if i in errs and i < t.n},
+            errs)
         data = np.ascontiguousarray(stripe[: t.n]).reshape(-1)[:payload_len]
         return data.tobytes()
+
+    def _read_repair(self, vol: VolumeInfo, bid: int,
+                     repaired: dict[int, bytes], errs: dict) -> None:
+        """Transparent blob-plane read-repair: a shard whose read came
+        back 409 (at-rest CRC mismatch) and that EC-reconstruction just
+        recovered is rewritten in place, synchronously and best-effort
+        — the caller already has good bytes, so a failed rewrite only
+        counts a metric and the queued shard_repair still covers it.
+        Only CRC refusals qualify: an absent or unreachable shard is a
+        repair-queue problem, rewriting it here would race the repairer.
+        Door: CUBEFS_VERIFY_READS=0 skips the rewrite (detection still
+        409s; FSM-digest-identical because no FSM records are
+        written)."""
+        if os.environ.get("CUBEFS_VERIFY_READS", "1") == "0":
+            return
+        for i, data in sorted(repaired.items()):
+            if getattr(errs.get(i), "code", None) != 409:
+                continue
+            u = vol.units[i]
+            with tracelib.path_span("blob.get",
+                                    "integrity.read_repair") as sp:
+                sp.set_tag("vid", vol.vid).set_tag("bid", bid)
+                sp.set_tag("index", i)
+                try:
+                    self.nodes.get(u.node_addr).call(
+                        "put_shard",
+                        {"disk_id": u.disk_id, "chunk_id": u.chunk_id,
+                         "bid": bid, "heal_source": "read"},
+                        data, timeout=10.0)
+                except (rpc.RpcError, OSError):
+                    metrics.integrity_repair_failures.inc(plane="blob")
 
     def _file_repairs(self, vol: VolumeInfo, bid: int, got: dict,
                       errs: dict, n: int) -> None:

@@ -18,7 +18,9 @@ import numpy as np
 
 from ..codec.batcher import admit
 from ..utils import metrics, rpc
-from .chunkstore import ChunkStore, ChunkStoreError, CrcMismatchError, ShardNotFoundError
+from ..utils.diskhealth import DiskHealthTracker
+from .chunkstore import (ChunkStore, ChunkStoreError, CrcMismatchError,
+                         ShardNotFoundError, verified_get_shard)
 
 
 class BlobNode:
@@ -39,6 +41,9 @@ class BlobNode:
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
         self._broken: set[int] = set()
+        # limping-disk quarantine (soft: served, never newly allocated);
+        # the heartbeat carries the list so clustermgr flips DiskStatus
+        self.health = DiskHealthTracker(addr or str(node_id), [])
 
     # ---------------- lifecycle ----------------
     def register(self) -> None:
@@ -68,14 +73,43 @@ class BlobNode:
 
     def send_heartbeat(self) -> None:
         live = [d for d in self.disk_ids if not self._disk_down(d)]
+        # quarantine probes ride the heartbeat cadence (the breaker's
+        # half-open leg): cooldown elapsed -> one real write+fsync
+        for d in live:
+            if self.health.probe_due(d):
+                self.health.probe_result(d, self._io_probe_ok(d))
         if live and self.cm is not None:
-            hb = {"disk_ids": live}
+            hb = {"disk_ids": live,
+                  "quarantined": [d for d in self.health.quarantined()
+                                  if d in live]}
             if self.az:
                 # heartbeats re-assert labels so a relabeled node
                 # converges without re-registering its disks
                 hb["az"] = self.az
                 hb["rack"] = self.rack
             self.cm.call("heartbeat", hb)
+
+    def _io_probe_ok(self, disk_id: int) -> bool:
+        """Quarantine probe on the disk's store directory: write+fsync
+        scored pass/fail (ENOSPC is full, not sick)."""
+        import errno as errno_mod
+        import os
+        import uuid as uuid_mod
+
+        store = self.stores.get(disk_id)
+        if store is None:
+            return False
+        probe = os.path.join(store.directory,
+                             f".quarantine_probe.{uuid_mod.uuid4().hex[:8]}")
+        try:
+            with open(probe, "wb") as f:
+                f.write(b"ok")
+                f.flush()
+                os.fsync(f.fileno())
+            os.unlink(probe)
+            return True
+        except OSError as pe:
+            return pe.errno in (errno_mod.ENOSPC, errno_mod.EDQUOT)
 
     def stop(self) -> None:
         self._hb_stop.set()
@@ -108,11 +142,36 @@ class BlobNode:
         except KeyError:
             raise rpc.RpcError(404, f"disk {disk_id} not on node {self.node_id}") from None
 
-    def put_shard(self, disk_id: int, chunk_id: int, bid: int, data: bytes) -> int:
-        return self._store(disk_id).put_shard(chunk_id, bid, data)
+    def put_shard(self, disk_id: int, chunk_id: int, bid: int,
+                  data: bytes) -> int:
+        store = self._store(disk_id)
+        t0 = time.monotonic()
+        try:
+            crc = store.put_shard(chunk_id, bid, data)
+            self.health.record_io(disk_id, time.monotonic() - t0)
+        except (OSError, ChunkStoreError):
+            self.health.record_io(disk_id, time.monotonic() - t0, ok=False)
+            raise
+        return crc
 
-    def get_shard(self, disk_id: int, chunk_id: int, bid: int) -> tuple[bytes, int]:
-        return self._store(disk_id).get_shard(chunk_id, bid)
+    def get_shard(self, disk_id: int, chunk_id: int, bid: int,
+                  source: str = "read") -> tuple[bytes, int]:
+        store = self._store(disk_id)
+        t0 = time.monotonic()
+        try:
+            out = verified_get_shard(
+                store, chunk_id, bid,
+                node_addr=self.addr or str(self.node_id),
+                disk_id=disk_id, source=source)
+            self.health.record_io(disk_id, time.monotonic() - t0)
+            return out
+        except CrcMismatchError:
+            raise  # data integrity, not disk death: 409 path upstream
+        except ShardNotFoundError:
+            raise  # absence is not a health signal either
+        except (OSError, ChunkStoreError):
+            self.health.record_io(disk_id, time.monotonic() - t0, ok=False)
+            raise
 
     def delete_shard(self, disk_id: int, chunk_id: int, bid: int) -> None:
         self._store(disk_id).delete_shard(chunk_id, bid)
@@ -136,7 +195,10 @@ class BlobNode:
         row = np.asarray([coeff], dtype=np.uint8)
         shards: list[bytes] = []
         for bid in bids:
-            data, _ = store.get_shard(chunk_id, bid)  # CRC-checked read
+            data, _ = verified_get_shard(  # CRC-checked + at-rest gate
+                store, chunk_id, bid,
+                node_addr=self.addr or str(self.node_id),
+                disk_id=disk_id, source="repair")
             if len(data) % alpha:
                 raise rpc.RpcError(
                     409, f"bid {bid}: shard size {len(data)} not "
@@ -159,12 +221,23 @@ class BlobNode:
 
     # ---------------- RPC surface ----------------
     def rpc_put_shard(self, args, body):
-        crc = self.put_shard(args["disk_id"], args["chunk_id"], args["bid"], body)
+        crc = self.put_shard(args["disk_id"], args["chunk_id"], args["bid"],
+                             body)
+        plan = rpc._fault
+        if plan is not None and plan.heal_rot(
+                self.addr or str(self.node_id), args["disk_id"],
+                f"c{args['chunk_id']}:b{args['bid']}"):
+            # the rewrite replaced a genuinely rotten shard (heal_rot is
+            # False for rewrites of clean shards — zero false repairs)
+            metrics.integrity_corruptions_healed.inc(
+                plane="blob", source=args.get("heal_source") or "repair")
         return {"crc": crc}
 
     def rpc_get_shard(self, args, body):
         try:
-            data, crc = self.get_shard(args["disk_id"], args["chunk_id"], args["bid"])
+            data, crc = self.get_shard(args["disk_id"], args["chunk_id"],
+                                       args["bid"],
+                                       source=args.get("source", "read"))
         except ShardNotFoundError as e:
             raise rpc.RpcError(404, str(e)) from None
         except CrcMismatchError as e:
@@ -201,7 +274,8 @@ class BlobNode:
         return {
             "node_id": self.node_id,
             "disks": {
-                str(d): {"broken": d in self._broken}
+                str(d): {"broken": d in self._broken,
+                         "quarantined": self.health.is_quarantined(d)}
                 for d in self.disk_ids
             },
         }

@@ -113,6 +113,36 @@ class ChunkStore:
             raise ChunkStoreError(self._err())
 
 
+def verified_get_shard(store: ChunkStore, chunk_id: int, bid: int,
+                       max_size: int = 16 << 20, *,
+                       node_addr: str | None = None, disk_id: int = 0,
+                       source: str = "read") -> tuple[bytes, int]:
+    """The ONE sanctioned at-rest shard read outside this module (lint
+    family CFI): the native per-shard CRC check runs on every read,
+    planted at-rest chaos faults surface the same way, and every
+    mismatch lands in
+    cubefs_integrity_corruptions_detected_total{plane="blob"} before the
+    CrcMismatchError propagates to the 409 EC-reconstruction path."""
+    from ..utils import faultinject, metrics
+
+    if node_addr is not None:
+        plan = faultinject.current()
+        if plan is not None:
+            unit = f"c{chunk_id}:b{bid}"
+            kind = plan.at_rest_fault(node_addr, disk_id, unit)
+            if kind is not None:
+                metrics.integrity_corruptions_detected.inc(
+                    plane="blob", source=source)
+                raise CrcMismatchError(
+                    f"shard {unit}: at-rest {kind}")
+    try:
+        return store.get_shard(chunk_id, bid, max_size)
+    except CrcMismatchError:
+        metrics.integrity_corruptions_detected.inc(
+            plane="blob", source=source)
+        raise
+
+
 def cpu_crc32(data: bytes) -> int:
     """Native slicing-by-8 CRC32 — the CPU baseline for the TPU kernel."""
     return rt.load().cs_crc32(data, len(data))

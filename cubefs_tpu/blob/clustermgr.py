@@ -130,10 +130,13 @@ class ClusterMgr(ReplicatedFsm):
         return disk_id
 
     def heartbeat(self, disk_ids: list[int], chunk_counts: dict | None = None,
-                  az: str | None = None, rack: str | None = None) -> None:
+                  az: str | None = None, rack: str | None = None,
+                  quarantined: list[int] | None = None) -> None:
         now = time.time()
         relabel = []
+        flips = []  # (disk_id, new_status) quarantine transitions
         with self._lock:
+            qset = set(quarantined or [])
             for d in disk_ids:
                 if d in self.disks:
                     self.disks[d].last_heartbeat = now
@@ -143,6 +146,14 @@ class ClusterMgr(ReplicatedFsm):
                             self.disks[d].az != az
                             or (rack is not None and self.disks[d].rack != rack)):
                         relabel.append(d)
+                    # node-reported quarantine: NORMAL<->QUARANTINED only
+                    # (never overrides BROKEN/REPAIRING — those are
+                    # harder states with their own lifecycle)
+                    st = self.disks[d].status
+                    if d in qset and st == DiskStatus.NORMAL:
+                        flips.append((d, int(DiskStatus.QUARANTINED)))
+                    elif d not in qset and st == DiskStatus.QUARANTINED:
+                        flips.append((d, int(DiskStatus.NORMAL)))
         # label changes are replicated state — go through the FSM door,
         # never mutated in the volatile heartbeat path above. Best
         # effort: a follower receiving a stray heartbeat drops the
@@ -150,6 +161,12 @@ class ClusterMgr(ReplicatedFsm):
         for d in relabel:
             try:
                 self.relabel_disk(d, az, rack)
+            except Exception:
+                break
+        # quarantine flips take the same FSM door + best-effort stance
+        for d, st in flips:
+            try:
+                self.set_disk_status(d, st)
             except Exception:
                 break
 
@@ -580,7 +597,8 @@ class ClusterMgr(ReplicatedFsm):
 
     def rpc_heartbeat(self, args, body):
         self.heartbeat(args["disk_ids"], args.get("chunk_counts"),
-                       az=args.get("az"), rack=args.get("rack"))
+                       az=args.get("az"), rack=args.get("rack"),
+                       quarantined=args.get("quarantined"))
         return {}
 
     def rpc_topology_view(self, args, body):

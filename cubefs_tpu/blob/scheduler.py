@@ -643,11 +643,6 @@ class Scheduler:
         group; inconsistent or unreadable units become repair tasks."""
         if not self.switch.enabled("volume_inspect"):
             return {"checked": 0, "bad": 0}
-        import numpy as np
-
-        from ..codec import codemode as cmode
-        from ..codec.encoder import CodecConfig, new_encoder
-
         checked = bad = 0
         with self._lock:
             all_vids = sorted(self.cm.volumes)
@@ -659,64 +654,170 @@ class Scheduler:
             vids = (all_vids[start:] + all_vids[:start])[:max_volumes]
             self._inspect_cursor = (start + len(vids)) % len(all_vids)
         for vid in vids:
-            vol = self.cm.get_volume(vid)
-            # 'auto': the scrub sweep inherits the measured crossover
-            # policy and its batched parity recompute coalesces with
-            # foreground PUT/repair work in the admission layer
-            enc = new_encoder(CodecConfig(mode=cmode.CodeMode(vol.codemode),
-                                          engine="auto"))
-            t = enc.t
-            listings: dict[int, dict[int, tuple[int, int]]] = {}
-            for u in vol.units:
-                try:
-                    meta, _ = self.nodes.get(u.node_addr).call(
-                        "list_chunk", {"disk_id": u.disk_id, "chunk_id": u.chunk_id}
-                    )
-                    listings[u.index] = {b: (s, c) for b, s, c in meta["shards"]}
-                except rpc.RpcError:
-                    listings[u.index] = {}
-            bids = sorted(set().union(*[set(l) for l in listings.values()]))[:max_bids]
-            by_size: dict[int, list[int]] = {}
-            for bid in bids:
-                sizes = {listings[i][bid][0] for i in listings if bid in listings[i]}
-                if len(sizes) == 1:
-                    by_size.setdefault(sizes.pop(), []).append(bid)
-            for size, group in by_size.items():
-                stripes = np.zeros((len(group), t.total, size), dtype=np.uint8)
-                missing: dict[int, set[int]] = {}  # group idx -> unit idxs
-                for gi, bid in enumerate(group):
-                    for u in vol.units:
-                        try:
-                            _, payload = self.nodes.get(u.node_addr).call(
-                                "get_shard",
-                                {"disk_id": u.disk_id, "chunk_id": u.chunk_id,
-                                 "bid": bid},
-                            )
-                            stripes[gi, u.index] = np.frombuffer(payload, np.uint8)
-                        except rpc.RpcError:
-                            missing.setdefault(gi, set()).add(u.index)
-                checked += len(group)
-                # one batched device parity recompute, per-stripe verdicts
-                parity = enc.codec.encode_parity(stripes[:, : t.n], t.m)
-                mismatch = (parity != stripes[:, t.n : t.n + t.m]).any(axis=-1)
-                for gi, bid in enumerate(group):
-                    miss = missing.get(gi, set())
-                    for idx in miss:
-                        self._queue_unit_repair(vol.vid, idx,
-                                                reason=f"inspect: bid {bid} missing")
-                    if mismatch[gi].any() and not miss:
-                        bad += 1
-                        culprit = self._isolate_corrupt_unit(enc, stripes[gi])
-                        if culprit is not None:
-                            # never "repair" parity from possibly-corrupt
-                            # data: repair exactly the unit whose exclusion
-                            # makes the stripe a consistent codeword
-                            self._queue_unit_repair(
-                                vol.vid, culprit,
-                                reason=f"inspect: bid {bid} corrupt unit")
-                        # multi-corruption: leave for operators; repairing
-                        # any single unit could cement wrong data
+            rep = self._inspect_volume(vid, max_bids=max_bids)
+            checked += rep["checked"]
+            bad += rep["bad"]
         return {"checked": checked, "bad": bad}
+
+    def _inspect_volume(self, vid: int, max_bids: int = 64) -> dict:
+        """Verify one volume's stripes against recomputed parity (the
+        per-volume body shared by inspect_volumes and the continuous
+        scrubber): batched device parity recompute, unique-culprit
+        isolation, repair tasks for missing/corrupt units."""
+        import numpy as np
+
+        from ..codec import codemode as cmode
+        from ..codec.encoder import CodecConfig, new_encoder
+
+        checked = bad = missing_units = 0
+        vol = self.cm.get_volume(vid)
+        # 'auto': the scrub sweep inherits the measured crossover
+        # policy and its batched parity recompute coalesces with
+        # foreground PUT/repair work in the admission layer
+        enc = new_encoder(CodecConfig(mode=cmode.CodeMode(vol.codemode),
+                                      engine="auto"))
+        t = enc.t
+        listings: dict[int, dict[int, tuple[int, int]]] = {}
+        for u in vol.units:
+            try:
+                meta, _ = self.nodes.get(u.node_addr).call(
+                    "list_chunk", {"disk_id": u.disk_id, "chunk_id": u.chunk_id}
+                )
+                listings[u.index] = {b: (s, c) for b, s, c in meta["shards"]}
+            except rpc.RpcError:
+                listings[u.index] = {}
+        bids = sorted(set().union(*[set(l) for l in listings.values()]))[:max_bids]
+        by_size: dict[int, list[int]] = {}
+        for bid in bids:
+            sizes = {listings[i][bid][0] for i in listings if bid in listings[i]}
+            if len(sizes) == 1:
+                by_size.setdefault(sizes.pop(), []).append(bid)
+        for size, group in by_size.items():
+            stripes = np.zeros((len(group), t.total, size), dtype=np.uint8)
+            missing: dict[int, set[int]] = {}  # group idx -> unit idxs
+            for gi, bid in enumerate(group):
+                for u in vol.units:
+                    try:
+                        _, payload = self.nodes.get(u.node_addr).call(
+                            "get_shard",
+                            {"disk_id": u.disk_id, "chunk_id": u.chunk_id,
+                             "bid": bid, "source": "scrub"},
+                        )
+                        stripes[gi, u.index] = np.frombuffer(payload, np.uint8)
+                    except rpc.RpcError:
+                        missing.setdefault(gi, set()).add(u.index)
+            checked += len(group)
+            # one batched device parity recompute, per-stripe verdicts
+            parity = enc.codec.encode_parity(stripes[:, : t.n], t.m)
+            mismatch = (parity != stripes[:, t.n : t.n + t.m]).any(axis=-1)
+            for gi, bid in enumerate(group):
+                miss = missing.get(gi, set())
+                for idx in miss:
+                    missing_units += 1
+                    self._queue_unit_repair(vol.vid, idx,
+                                            reason=f"inspect: bid {bid} missing")
+                if mismatch[gi].any() and not miss:
+                    bad += 1
+                    culprit = self._isolate_corrupt_unit(enc, stripes[gi])
+                    if culprit is not None:
+                        # never "repair" parity from possibly-corrupt
+                        # data: repair exactly the unit whose exclusion
+                        # makes the stripe a consistent codeword
+                        self._queue_unit_repair(
+                            vol.vid, culprit,
+                            reason=f"inspect: bid {bid} corrupt unit")
+                    # multi-corruption: leave for operators; repairing
+                    # any single unit could cement wrong data
+        return {"checked": checked, "bad": bad, "missing": missing_units}
+
+    # ---------------- continuous scrub (full-cursor) ----------------
+    def make_scrubber(self, clock=None, rate: float = 0.0):
+        """Build (or rebuild) the blob-plane continuous scrubber: the
+        full-cursor extension of inspect_volumes — every volume, up to
+        4096 bids each, verified through the same batched parity path,
+        admitted at SCRUB priority (brownout sheds it), cursor persisted
+        like task checkpoints (data_dir file or cm KV)."""
+        from ..utils import qos as qoslib
+        from ..utils import scrub as scrublib
+        from ..utils.retry import MONOTONIC
+
+        def list_units() -> list:
+            return sorted(self.cm.volumes)
+
+        def scrub_unit(vid) -> str:
+            try:
+                with qoslib.admit("blob.scrub", priority=qoslib.SCRUB,
+                                  svc="scheduler"):
+                    rep = self._inspect_volume(int(vid), max_bids=4096)
+            except qoslib.QosRejected:
+                return "skipped"  # brownout: give way to foreground
+            return "corrupt" if (rep["bad"] or rep["missing"]) else "clean"
+
+        def cursor_load():
+            if self.data_dir:
+                path = os.path.join(self.data_dir, "scrub_cursor.json")
+                if os.path.exists(path):
+                    return json.load(open(path)).get("cursor")
+                return None
+            if self._cm_kv:
+                raw = self.cm.kv_get("sched/scrub_cursor")
+                return json.loads(raw).get("cursor") if raw else None
+            return None
+
+        def cursor_save(cursor) -> None:
+            if self.data_dir:
+                tmp = os.path.join(self.data_dir, "scrub_cursor.json.tmp")
+                with open(tmp, "w") as f:
+                    json.dump({"cursor": cursor}, f)
+                os.replace(tmp, os.path.join(self.data_dir,
+                                             "scrub_cursor.json"))
+            elif self._cm_kv:
+                self.cm.kv_set("sched/scrub_cursor",
+                               json.dumps({"cursor": cursor}))
+
+        self.scrubber = scrublib.Scrubber(
+            "blob", list_units, scrub_unit,
+            clock=clock or MONOTONIC, rate=rate,
+            cursor_load=cursor_load, cursor_save=cursor_save)
+        return self.scrubber
+
+    def collect_quarantined_disks(self) -> list[int]:
+        """Quarantine → drain: every disk a blobnode heartbeat flipped
+        to QUARANTINED gets ONE plan_disk_drain kick (existing data
+        migrates off the limping disk; topology's NORMAL filter already
+        stopped new allocations). Tracked so repeat sweeps don't
+        re-plan; a disk probed back to NORMAL re-arms the kick."""
+        kicked = []
+        with self._lock:
+            seen = getattr(self, "_quarantine_kicked", None)
+            if seen is None:
+                seen = self._quarantine_kicked = set()
+            for d in list(self.cm.disks.values()):
+                if d.status == DiskStatus.QUARANTINED:
+                    if d.disk_id not in seen:
+                        seen.add(d.disk_id)
+                        kicked.append(d.disk_id)
+                else:
+                    seen.discard(d.disk_id)
+        for disk_id in kicked:
+            try:
+                self.plan_disk_drain(disk_id)
+            except Exception:
+                pass  # planning is advisory; next quarantine re-kicks
+        return kicked
+
+    def rpc_scrub_status(self, args, body):
+        s = getattr(self, "scrubber", None)
+        return {"scrub": s.status() if s is not None else None}
+
+    def rpc_scrub_run(self, args, body):
+        s = getattr(self, "scrubber", None)
+        if s is None:
+            s = self.make_scrubber()
+        if args.get("full"):
+            return {"result": s.run_full_pass()}
+        return {"result": s.run_once(
+            max_units=int(args.get("max_units", 8)))}
 
     @staticmethod
     def _isolate_corrupt_unit(enc, stripe) -> int | None:
@@ -869,6 +970,7 @@ class Scheduler:
                         continue
                     self.collect_broken_disks()
                     self.collect_dead_shardnodes()
+                    self.collect_quarantined_disks()
                     self.consume_repair_msgs()
                     self.consume_delete_msgs()
                     self._ticks = getattr(self, "_ticks", 0) + 1
@@ -876,6 +978,14 @@ class Scheduler:
                         self.rebalance_sweep()
                     if self._ticks % 60 == 0:  # periodic space reclaim
                         self.compact_chunks()
+                    if self._ticks % 10 == 0 and self.switch.enabled("scrub"):
+                        # continuous integrity scrub: a small slice per
+                        # tick; the Scrubber itself handles QoS shedding,
+                        # the CUBEFS_SCRUB door and cursor resume
+                        s = getattr(self, "scrubber", None)
+                        if s is None:
+                            s = self.make_scrubber()
+                        s.run_once(max_units=2)
                 except Exception:
                     pass  # leader loop must survive transient errors
 
@@ -903,7 +1013,7 @@ class Scheduler:
         return {}
 
     TASK_KINDS = ("disk_repair", "shard_repair", "blob_delete", "balance",
-                  "rebalance", "volume_inspect", "compact")
+                  "rebalance", "volume_inspect", "compact", "scrub")
 
     def rpc_task_switch(self, args, body):
         """Runtime kill-switches per background task kind (taskswitch

@@ -16,6 +16,10 @@ class DiskStatus(enum.IntEnum):
     REPAIRING = 3
     REPAIRED = 4
     DROPPED = 5
+    # limping disk (IO errors / latency outlier): serves existing data
+    # but gets no new allocations — topology's NORMAL filter excludes
+    # it from placement; probe-based return to NORMAL via heartbeat
+    QUARANTINED = 6
 
 
 class VolumeStatus(enum.IntEnum):

@@ -331,6 +331,48 @@ def _tiering_view(text: str) -> dict:
     }
 
 
+def _integrity_view(text: str) -> dict:
+    """The silent-corruption digest: corruptions caught vs healed (by
+    plane and by which reader tripped over them), repair attempts that
+    could not heal, WAL torn-tail truncations, scrubber progress per
+    plane, and the disk-quarantine picture. A healthy cluster shows
+    healed == detected and zero repair_failures; a `detected` that
+    outruns `healed` means the healer is losing ground."""
+    series = _parse_metrics(text)
+
+    def by_labels(name, *labels):
+        out = {}
+        for n, lb, v in series:
+            if n == name:
+                key = "/".join(lb.get(x, "") for x in labels)
+                out[key] = out.get(key, 0) + v
+        return out
+
+    def total(name):
+        return sum(v for n, _, v in series if n == name)
+
+    return {
+        "detected": by_labels("cubefs_integrity_corruptions_detected_total",
+                              "plane", "source"),
+        "healed": by_labels("cubefs_integrity_corruptions_healed_total",
+                            "plane", "source"),
+        "repair_failures": by_labels(
+            "cubefs_integrity_repair_failures_total", "plane"),
+        "wal_torn_tails": total("cubefs_wal_torn_tail_total"),
+        "scrub_items": by_labels("cubefs_scrub_items_total",
+                                 "plane", "outcome"),
+        "scrub_last_full_pass_seconds": by_labels(
+            "cubefs_scrub_last_full_pass_seconds", "plane"),
+        "scrub_cursor": by_labels("cubefs_scrub_cursor_position", "plane"),
+        "disks_quarantined": by_labels("cubefs_disk_quarantine_active",
+                                       "node"),
+        "quarantine_transitions": by_labels(
+            "cubefs_disk_quarantine_transitions_total", "node", "event"),
+        "orphans_reconciled": total(
+            "cubefs_tiering_orphans_reconciled_total"),
+    }
+
+
 def _slo_view(text: str) -> dict:
     """The tail-latency digest: per-path quantiles from the sliding
     window, SLO burn rate, and remaining error budget (scraping
@@ -488,9 +530,19 @@ def main(argv=None):
     p_metrics = sub.add_parser("metrics")  # node observability views
     p_metrics.add_argument("action",
                            choices=["write-path", "codec", "repair", "slo",
-                                    "read-path", "qos", "tiering", "raw"])
+                                    "read-path", "qos", "tiering",
+                                    "integrity", "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
+
+    p_scrub = sub.add_parser("scrub")  # continuous integrity sweep
+    p_scrub.add_argument("action", choices=["status", "run"])
+    p_scrub.add_argument("--scheduler", required=True,
+                         help="blob scheduler addr")
+    p_scrub.add_argument("--full", action="store_true",
+                         help="run a complete pass instead of one slice")
+    p_scrub.add_argument("--max-units", type=int, default=8,
+                         help="units to scrub this slice (run)")
 
     p_trace = sub.add_parser("trace")  # distributed-trace forensics
     p_trace.add_argument("action", choices=["show", "slow", "list"])
@@ -783,8 +835,19 @@ def main(argv=None):
             print(json.dumps(_qos_view(text), indent=2))
         elif args.action == "tiering":
             print(json.dumps(_tiering_view(text), indent=2))
+        elif args.action == "integrity":
+            print(json.dumps(_integrity_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
+
+    elif args.group == "scrub":
+        sched = rpc.Client(args.scheduler)
+        if args.action == "run":
+            out = sched.call("scrub_run", {
+                "full": args.full, "max_units": args.max_units})[0]
+        else:
+            out = sched.call("scrub_status", {})[0]
+        print(json.dumps(out, indent=2))
 
     elif args.group == "trace":
         if args.action == "show":

@@ -605,6 +605,15 @@ class MetaWrapper:
                 return
         raise FsError(mn.ENOENT, f"no meta partition {pid}")
 
+    def blob_reconcile_enqueue(self, location: dict) -> None:
+        """Inventory-reconciliation sink: a blob-plane location that no
+        inode references rides the blob_freelist so the existing reaper
+        deletes it (satellite: closes the put->blob_written leak
+        window)."""
+        self._call(self.mps[0], "submit", {"record": {
+            "op": "blob_reconcile_enqueue", "location": location,
+            "ts": time.time()}})
+
     # ---- rename (atomic; metanode/transaction.go analog) ----
     def rename_local(self, src_parent: int, src_name: str,
                      dst_parent: int, dst_name: str, ino: int,
@@ -923,6 +932,7 @@ class ExtentClient:
                            0 if a == dp["leader"] else 1),
         )
         last_err = None
+        crc_failed: list[str] = []  # replicas that served a CRC 409
         for addr in order:
             t0 = time.monotonic()
             try:
@@ -935,13 +945,48 @@ class ExtentClient:
                     )
             except rpc.RpcError as e:
                 last_err = e
+                # a 409 that is NOT a short read is a CRC/integrity
+                # refusal: remember the replica for read-repair once a
+                # healthy copy answers (short reads are laggards, not
+                # rot — repairing them would be a false repair)
+                if e.code == 409 and "short read" not in str(e):
+                    crc_failed.append(addr)
                 # heavy penalty so failed replicas sort last for a while
                 self._latency[addr] = self._latency.get(addr, 0.0) * 0.7 + 0.3 * 5.0
                 continue
             dt = time.monotonic() - t0
             self._latency[addr] = self._latency.get(addr, dt) * 0.7 + 0.3 * dt
+            if crc_failed:
+                self._read_repair(dp, eid, addr, crc_failed)
             return data
         raise FsError(5, f"all replicas failed for dp {dp['dp_id']}: {last_err}")
+
+    def _read_repair(self, dp: dict, eid: int, healthy_addr: str,
+                     bad_addrs: list[str]) -> None:
+        """Transparent fs-plane read-repair: the replica that refused a
+        read with a CRC 409 gets rewritten in place from the replica
+        that just served the bytes, through the ONE sanctioned healer
+        (DataNode.sync_extent_from — same path scrub and fsck --heal
+        use). Synchronous and best-effort: the client already has good
+        bytes, so a repair failure only counts a metric. Door:
+        CUBEFS_VERIFY_READS=0 turns repair off (detection still 409s;
+        the door is FSM-digest-identical because repairs never write
+        FSM records)."""
+        if os.environ.get("CUBEFS_VERIFY_READS", "1") == "0":
+            return
+        for bad in bad_addrs:
+            with tracelib.path_span("fs.read", "integrity.read_repair") as sp:
+                sp.set_tag("dp_id", dp["dp_id"])
+                sp.set_tag("extent_id", eid)
+                sp.set_tag("bad", bad)
+                try:
+                    self.nodes.get(bad).call(
+                        "sync_extent_from",
+                        {"dp_id": dp["dp_id"], "extent_id": eid,
+                         "src_addr": healthy_addr, "source": "read"},
+                        timeout=30.0)
+                except (rpc.RpcError, OSError):
+                    _metrics.integrity_repair_failures.inc(plane="fs")
 
     def _leader_write(self, dp: dict, eid: int, off: int,
                       data: bytes) -> None:

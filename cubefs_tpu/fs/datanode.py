@@ -23,9 +23,11 @@ import os
 import threading
 import time
 
-from ..utils import rpc
+from ..utils import metrics, rpc
+from ..utils.diskhealth import DiskHealthTracker
 from ..utils.retry import RetryPolicy
-from .extent_store import BlockCrcError, ExtentError, ExtentStore
+from .extent_store import (BlockCrcError, ExtentError, ExtentStore,
+                           verified_read)
 
 
 class DataPartition:
@@ -106,6 +108,10 @@ class DataNode:
         self.disks = [os.path.abspath(d) for d in (disks or [root_dir])]
         self.disk_broken: set[str] = set()  # sticky per-disk health
         self.dp_disk: dict[int, str] = {}  # dp_id -> disk path
+        # softer-than-broken quarantine (limping disk): keyed by disk
+        # INDEX into self.disks; tests may swap in a FakeClock tracker
+        self.health = DiskHealthTracker(addr or str(node_id),
+                                        range(len(self.disks)))
         self.addr = addr
         self.nodes = node_pool  # addr -> rpc client (for chain forward)
         # client-facing IO shaping (datanode/limit.go): raft applies and
@@ -207,10 +213,17 @@ class DataNode:
 
     def _pick_disk(self) -> str:
         """Healthy disk with the fewest partitions (space_manager.go
-        placement role)."""
+        placement role). Quarantined disks (limping, not dead) get no
+        NEW allocations while any unquarantined disk remains — but a
+        fully-quarantined node still allocates rather than 503s, since
+        quarantine is a soft signal."""
         healthy = [d for d in self.disks if d not in self.disk_broken]
         if not healthy:
             raise rpc.RpcError(503, f"all disks broken on {self.addr}")
+        unquarantined = [d for d in healthy
+                         if not self.health.is_quarantined(
+                             self.disks.index(d))]
+        healthy = unquarantined or healthy
         counts = {d: 0 for d in healthy}
         for disk in self.dp_disk.values():
             if disk in counts:
@@ -383,13 +396,39 @@ class DataNode:
                                 if int(buf[i]) in self.dp_disk]
         for disk in failed_disks:
             self._probe_disk(disk)
+        # quarantine probe rides the heartbeat cadence (breaker
+        # half-open analog): cooldown elapsed -> one real write+fsync
+        # decides pass/fail
+        for idx, disk in enumerate(self.disks):
+            if self.health.probe_due(idx):
+                self.health.probe_result(idx, self._io_probe_ok(disk))
         with self._lock:
             out = {}
-            for d in self.disks:
+            for idx, d in enumerate(self.disks):
                 out[d] = {"broken": d in self.disk_broken,
+                          "quarantined": self.health.is_quarantined(idx),
                           "dps": sorted(i for i, dd in self.dp_disk.items()
                                         if dd == d)}
             return out
+
+    def _io_probe_ok(self, disk: str) -> bool:
+        """Quarantine probe: same write+fsync as _probe_disk but scored
+        pass/fail instead of sticky-breaking (ENOSPC still passes)."""
+        import errno as errno_mod
+        import uuid
+
+        probe = os.path.join(disk, f".quarantine_probe.{uuid.uuid4().hex[:8]}")
+        try:
+            with open(probe, "wb") as f:
+                f.write(b"ok")
+                f.flush()
+                os.fsync(f.fileno())
+            os.unlink(probe)
+            return True
+        except OSError as pe:
+            if pe.errno in (errno_mod.ENOSPC, errno_mod.EDQUOT):
+                return True
+            return False
 
     # ---------------- write path (chain replication) ----------------
     def write(self, dp_id: int, extent_id: int, offset: int, data: bytes,
@@ -404,10 +443,7 @@ class DataNode:
         and a raft overwrite in different orders."""
         dp = self._dp(dp_id)
         if not chain:
-            try:
-                dp.store.write(extent_id, offset, data)
-            except (OSError, ExtentError) as e:
-                self._disk_io_guard(dp_id, e)
+            self._timed_store_write(dp, dp_id, extent_id, offset, data)
             return
         if dp.leader and dp.leader != self.addr:
             if hops <= 0:
@@ -433,11 +469,21 @@ class DataNode:
                         503, f"dp {dp_id} raft reconfiguring; retry")
                 self._random_write(dp, extent_id, offset, data)
                 return
-            try:
-                dp.store.write(extent_id, offset, data)
-            except (OSError, ExtentError) as e:
-                self._disk_io_guard(dp_id, e)
+            self._timed_store_write(dp, dp_id, extent_id, offset, data)
             self._chain_forward(dp, extent_id, offset, data)
+
+    def _timed_store_write(self, dp: DataPartition, dp_id: int,
+                           extent_id: int, offset: int, data: bytes) -> None:
+        """Local store write with latency/error fed to the quarantine
+        tracker (every local IO is a health sample)."""
+        disk_idx = self._disk_index(dp_id)
+        t0 = time.monotonic()
+        try:
+            dp.store.write(extent_id, offset, data)
+            self.health.record_io(disk_idx, time.monotonic() - t0)
+        except (OSError, ExtentError) as e:
+            self.health.record_io(disk_idx, time.monotonic() - t0, ok=False)
+            self._disk_io_guard(dp_id, e)
 
     def _chain_forward(self, dp: DataPartition, extent_id: int, offset: int,
                        data: bytes) -> None:
@@ -575,6 +621,15 @@ class DataNode:
             break
         raise rpc.RpcError(503, f"dp {dp.dp_id} random write failed: {last}")
 
+    def _disk_index(self, dp_id: int) -> int:
+        disk = self.dp_disk.get(dp_id)
+        return self.disks.index(disk) if disk in self.disks else 0
+
+    @staticmethod
+    def _rot_unit(dp_id: int, extent_id: int) -> str:
+        """At-rest fault-plan unit key for one replica's extent copy."""
+        return f"dp{dp_id}:e{extent_id}"
+
     def read(self, dp_id: int, extent_id: int, offset: int, length: int,
              internal: bool = False) -> bytes:
         """internal=True (replica repair) bypasses client QoS — throttling
@@ -582,11 +637,20 @@ class DataNode:
         dp = self._dp(dp_id)
         if self.qos is not None and not internal:
             self.qos.acquire_read(length)
+        disk_idx = self._disk_index(dp_id)
+        t0 = time.monotonic()
         try:
-            return dp.store.read(extent_id, offset, length)
+            data = verified_read(
+                dp.store, extent_id, offset, length,
+                node_addr=self.addr or str(self.node_id),
+                disk_id=disk_idx, unit=self._rot_unit(dp_id, extent_id),
+                source="repair" if internal else "read")
+            self.health.record_io(disk_idx, time.monotonic() - t0)
+            return data
         except BlockCrcError:
             raise  # data integrity, not disk death: 409 path upstream
         except (OSError, ExtentError) as e:
+            self.health.record_io(disk_idx, time.monotonic() - t0, ok=False)
             self._disk_io_guard(dp_id, e)
 
     # ---------------- repair (CRC fingerprint diff) ----------------
@@ -595,11 +659,28 @@ class DataNode:
         size = dp.store.size(extent_id)
         if size == 0:  # absent or empty extent: nothing to fingerprint
             return 0, 0
-        return size, dp.store.extent_crc(extent_id)
+        crc = dp.store.extent_crc(extent_id)
+        # a planted at-rest fault must diverge this replica's fingerprint
+        # exactly like real rot would, so scrub/fsck replica-compare
+        # spots it without the simulation touching native store bytes
+        plan = rpc._fault
+        if plan is not None:
+            kind = plan.at_rest_fault(self.addr or str(self.node_id),
+                                      self._disk_index(dp_id),
+                                      self._rot_unit(dp_id, extent_id))
+            if kind == "torn_write":
+                return max(size - 1, 1), crc ^ 0x0F0F0F0F
+            if kind is not None:  # bitflip / stale_crc
+                return size, crc ^ 0xA5A5A5A5
+        return size, crc
 
-    def sync_extent_from(self, dp_id: int, extent_id: int, src_addr: str) -> None:
+    def sync_extent_from(self, dp_id: int, extent_id: int, src_addr: str,
+                         source: str = "repair") -> None:
         """Pull a full extent from a healthy replica (streamed in 1MiB
-        spans) — the repair executor for CRC/size-diverged replicas."""
+        spans) — the repair executor for CRC/size-diverged replicas AND
+        the one in-place rewrite the fs-plane read-repair / scrub / fsck
+        healers all route through. ``source`` labels who triggered it
+        ("read" | "scrub" | "fsck" | "repair") in the healed metric."""
         dp = self._dp(dp_id)
         meta, _ = self.nodes.get(src_addr).call(
             "extent_fingerprint", {"dp_id": dp_id, "extent_id": extent_id}
@@ -614,6 +695,14 @@ class DataNode:
                                   "length": min(span, size - off)},
             )
             dp.store.write(extent_id, off, chunk)
+        plan = rpc._fault
+        if plan is not None and plan.heal_rot(
+                self.addr or str(self.node_id), self._disk_index(dp_id),
+                self._rot_unit(dp_id, extent_id)):
+            # the rewrite replaced a genuinely rotten copy (heal_rot is
+            # False for rewrites of clean units — zero false repairs)
+            metrics.integrity_corruptions_healed.inc(plane="fs",
+                                                     source=source)
 
     # ---------------- RPC surface ----------------
     def rpc_create_partition(self, args, body):
@@ -694,7 +783,9 @@ class DataNode:
         return {}
 
     def rpc_sync_extent_from(self, args, body):
-        self.sync_extent_from(args["dp_id"], args["extent_id"], args["src_addr"])
+        self.sync_extent_from(args["dp_id"], args["extent_id"],
+                              args["src_addr"],
+                              source=args.get("source", "repair"))
         return {}
 
     def rpc_dp_raft_status(self, args, body):

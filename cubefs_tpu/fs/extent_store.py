@@ -157,3 +157,32 @@ class ExtentStore:
             # lint: allow[CFL003] lock IS the close() guard — es_* on a freed handle is use-after-free; bounded local disk I/O, no cross-plane reader
             if self._lib.es_sync(self._handle(), extent_id) != 0:
                 raise ExtentError(self._err())
+
+
+def verified_read(store: ExtentStore, extent_id: int, offset: int,
+                  length: int, *, node_addr: str | None = None,
+                  disk_id: int = 0, unit: str | None = None,
+                  source: str = "read") -> bytes:
+    """The ONE sanctioned at-rest payload read outside this module
+    (lint family CFI): the native per-128KiB-block CRC check runs on
+    every read, planted at-rest chaos faults surface the same way, and
+    every mismatch lands in
+    cubefs_integrity_corruptions_detected_total{plane="fs"} before the
+    BlockCrcError propagates to the 409 failover path."""
+    from ..utils import faultinject, metrics
+
+    if node_addr is not None and unit is not None:
+        plan = faultinject.current()
+        if plan is not None:
+            kind = plan.at_rest_fault(node_addr, disk_id, unit)
+            if kind is not None:
+                metrics.integrity_corruptions_detected.inc(
+                    plane="fs", source=source)
+                raise BlockCrcError(
+                    f"extent {extent_id}: at-rest {kind} on {unit}")
+    try:
+        return store.read(extent_id, offset, length)
+    except BlockCrcError:
+        metrics.integrity_corruptions_detected.inc(
+            plane="fs", source=source)
+        raise

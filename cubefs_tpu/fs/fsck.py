@@ -30,6 +30,8 @@ class FsckReport:
     pending_free: int = 0  # freelist entries awaiting the deletion scan
     reclaimed_extents: int = 0
     reclaimed_inodes: int = 0
+    healed_extents: list = field(default_factory=list)  # (dp_id, eid) via --heal
+    deduped_mismatches: int = 0  # already healed by the scrubber
 
     @property
     def clean(self) -> bool:
@@ -49,23 +51,51 @@ class FsckReport:
             "pending_free": self.pending_free,
             "reclaimed_extents": self.reclaimed_extents,
             "reclaimed_inodes": self.reclaimed_inodes,
+            "healed_extents": len(self.healed_extents),
+            "deduped_mismatches": self.deduped_mismatches,
             "clean": self.clean,
         }
 
 
 def fsck(fs: FileSystem, node_pool, check_orphans: bool = True,
-         reclaim: bool = False, orphan_grace: float = 3600.0) -> FsckReport:
+         reclaim: bool = False, orphan_grace: float = 3600.0,
+         scrubber=None, heal: bool = False) -> FsckReport:
     """Meta-tree coherence plus the meta<->data reachability pass:
     datanode extents referenced by no inode AND no freelist entry are
     orphans (a leak the deferred-deletion design makes impossible for
     crashes after unlink, but disk swaps / partial rebuilds can still
     manufacture). `reclaim` deletes orphan extents from datanodes and
     funnels orphan inodes through rm_inode (whose extents then ride the
-    freelist, so reclaim never races the free scan)."""
+    freelist, so reclaim never races the free scan).
+
+    `scrubber` (an fs.scrub.FsScrubber) dedups replica mismatches the
+    continuous scrubber already healed — they'd otherwise double-report
+    while the heal propagates. `heal=True` routes each remaining
+    mismatch through scrub.heal_extent: the SAME sanctioned healer the
+    scrubber and client read-repair use, never a second repair path."""
     report = FsckReport()
     referenced: set[tuple[int, int]] = set()
     seen_inos: set[int] = set()
     _walk(fs, node_pool, "/", mn.ROOT_INO, report, referenced, seen_inos)
+    if scrubber is not None and report.replica_mismatches:
+        healed = getattr(scrubber, "healed", set())
+        kept = [m for m in report.replica_mismatches
+                if (m[1]["dp_id"], m[1]["extent_id"]) not in healed]
+        report.deduped_mismatches = (len(report.replica_mismatches)
+                                     - len(kept))
+        report.replica_mismatches = kept
+    if heal and report.replica_mismatches:
+        from .scrub import heal_extent
+
+        still_bad = []
+        for cpath, ek, fps in report.replica_mismatches:
+            key = (ek["dp_id"], ek["extent_id"])
+            try:
+                heal_extent(fs, node_pool, key[0], key[1], source="fsck")
+                report.healed_extents.append(key)
+            except (FsError, rpc.RpcError, OSError):
+                still_bad.append((cpath, ek, fps))
+        report.replica_mismatches = still_bad
     # freed-but-not-yet-deleted extents are NOT orphans: the metanode
     # free scan owns them
     pending = fs.meta.freelist_all()
@@ -184,6 +214,25 @@ def _walk(fs, pool, path, ino, report: FsckReport,
                 report.replica_mismatches.append((cpath, ek, fps))
             else:
                 report.bytes_checked += ek["size"]
+
+
+def list_referenced_extents(fs) -> list[tuple[int, int]]:
+    """Every (dp_id, extent_id) any inode references — the cheap subset
+    of the fsck walk (no fingerprinting), reused as the fs-plane
+    scrubber's work list."""
+    out: list[tuple[int, int]] = []
+    seen: set[tuple[int, int]] = set()
+    for ino in sorted(fs.meta.list_inos()):
+        try:
+            inode = fs.meta.inode_get(ino)
+        except (FsError, rpc.RpcError, OSError):
+            continue
+        for ek in inode.get("extents", []):
+            key = (ek["dp_id"], ek["extent_id"])
+            if key not in seen:
+                seen.add(key)
+                out.append(key)
+    return out
 
 
 def _find_orphan_extents(fs, pool, referenced, report: FsckReport) -> None:

@@ -370,6 +370,7 @@ class MetaPartition:
         "truncate": {"inodes", "freelist"},
         "free_done": {"freelist"},
         "blob_free_done": {"freelist"},
+        "blob_reconcile_enqueue": {"freelist"},
         "tiering_prepare": {"inodes"},
         "tiering_blob_written": {"inodes", "freelist"},
         "tiering_commit": {"inodes", "freelist"},
@@ -1123,6 +1124,14 @@ class MetaPartition:
     def _apply_blob_free_done(self, r: dict) -> dict:
         self.blob_freelist.pop(r["key"], None)
         return {}
+
+    def _apply_blob_reconcile_enqueue(self, r: dict) -> dict:
+        """Inventory reconciliation found a blob-plane object no inode
+        references (the put->blob_written crash window): queue it on the
+        freelist so the existing reaper deletes it. Keyed by apply_id
+        via _defer_blob_free (ino 0 = no owner), so replicas agree."""
+        self._defer_blob_free(0, r["location"], r.get("ts", 0.0))
+        return {"ok": True}
 
     def blob_freelist_entries(self) -> list[tuple[str, dict]]:
         with self._lock:

@@ -304,3 +304,116 @@ class TieringEngine:
             metrics.tiering_orphans_reaped.inc(reaped)
         metrics.tiering_blob_freelist.set(len(entries) - reaped)
         return reaped
+
+    # ------------------------------------- inventory reconciliation
+    # Closes the residual put->blob_written leak window documented in
+    # the module docstring: a blob whose PUT landed but whose
+    # blob_written record never did is referenced by NOTHING — no
+    # xattr, no freelist entry — and only a bucket-level cross-check of
+    # blob-plane listings against metadata can find it.
+
+    def _referenced_bids(self) -> set[tuple[int, int]]:
+        """Every (vid, bid) the metadata plane can still reach: inode
+        cold.location, mid-migration tiering.pending, and blob_freelist
+        entries awaiting the reaper."""
+        refs: set[tuple[int, int]] = set()
+
+        def add_location(loc) -> None:
+            loc = _loc_of(loc)
+            if not loc or loc.get("empty"):
+                return
+            for sl in loc.get("slices", []):
+                for k in range(sl["count"]):
+                    refs.add((sl["vid"], sl["min_bid"] + k))
+
+        for ino in self.fs.meta.list_inos():
+            try:
+                xa = self.fs.meta.inode_get(ino).get("xattr") or {}
+            except Exception:
+                continue
+            if xa.get("cold.location"):
+                add_location(xa["cold.location"])
+            if xa.get("tiering.pending"):
+                add_location(xa["tiering.pending"])
+        for ent in self.fs.meta.blob_freelist_all().values():
+            add_location(ent.get("location"))
+        return refs
+
+    def reconcile_inventory(self, listing: dict) -> int:
+        """One reconciliation sweep against a blob-plane listing (see
+        blob_plane_listing). A bid must show up leaked in TWO
+        consecutive sweeps before it is enqueued: a PUT that landed
+        between the metadata snapshot and the listing looks exactly
+        like a leak for one sweep, and deleting it would eat live data.
+        Confirmed leaks are grouped into per-volume synthetic locations
+        and enqueued through blob_reconcile_enqueue, so they ride the
+        SAME blob_freelist reaper as every other orphan. Returns the
+        number of bids enqueued this sweep."""
+        refs = self._referenced_bids()
+        leaked: set[tuple[int, int]] = set()
+        sizes: dict[tuple[int, int], int] = {}
+        for vid, info in listing.items():
+            for bid, size in info["bids"].items():
+                key = (int(vid), int(bid))
+                if key not in refs:
+                    leaked.add(key)
+                    sizes[key] = size
+        pending = getattr(self, "_reconcile_pending", set())
+        confirmed = leaked & pending
+        self._reconcile_pending = leaked - confirmed
+        if not confirmed:
+            return 0
+        # group confirmed bids into contiguous runs per volume — one
+        # synthetic Location per run keeps the freelist compact
+        by_vid: dict[int, list[int]] = {}
+        for vid, bid in confirmed:
+            by_vid.setdefault(vid, []).append(bid)
+        enqueued = 0
+        for vid, bids in sorted(by_vid.items()):
+            mode = listing[vid]["codemode"]
+            bids.sort()
+            run_start = prev = bids[0]
+            runs = []
+            for b in bids[1:]:
+                if b == prev + 1:
+                    prev = b
+                    continue
+                runs.append((run_start, prev))
+                run_start = prev = b
+            runs.append((run_start, prev))
+            for lo, hi in runs:
+                count = hi - lo + 1
+                blob_size = max(sizes.get((vid, b), 1) for b in
+                                range(lo, hi + 1))
+                self.fs.meta.blob_reconcile_enqueue({
+                    "cluster_id": 1, "codemode": mode,
+                    "size": sum(sizes.get((vid, b), 0)
+                                for b in range(lo, hi + 1)),
+                    "slices": [{"min_bid": lo, "vid": vid, "count": count,
+                                "blob_size": max(blob_size, 1)}],
+                    "crc": 0})
+                enqueued += count
+        metrics.tiering_orphans_reconciled.inc(enqueued)
+        return enqueued
+
+
+def blob_plane_listing(cm, node_pool) -> dict:
+    """Bucket-level inventory of the blob plane: {vid: {"codemode",
+    "bids": {bid: shard_size}}}, from each volume's first listable
+    unit (every unit of a volume holds a shard for every bid, so one
+    healthy listing per volume is a complete bid census)."""
+    out: dict[int, dict] = {}
+    for vid in sorted(cm.volumes):
+        vol = cm.get_volume(vid)
+        bids: dict[int, int] = {}
+        for u in vol.units:
+            try:
+                meta, _ = node_pool.get(u.node_addr).call(
+                    "list_chunk",
+                    {"disk_id": u.disk_id, "chunk_id": u.chunk_id})
+            except Exception:
+                continue  # unreachable unit: try the next replica column
+            bids = {int(b): int(s) for b, s, _ in meta["shards"]}
+            break
+        out[vid] = {"codemode": int(vol.codemode), "bids": bids}
+    return out

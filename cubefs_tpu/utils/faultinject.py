@@ -49,6 +49,17 @@ _SENDER: contextvars.ContextVar[str | None] = contextvars.ContextVar(
 KINDS = ("drop_before", "drop_after", "delay", "error", "corrupt",
          "stale", "duplicate")
 
+# at-rest faults: data already ON DISK goes bad, keyed like disk faults
+# by (node_addr, disk_id) plus a unit key naming the payload —
+# "dp{dp}:e{eid}" for datanode extents, "c{chunk}:b{bid}" for blobnode
+# shards. All three manifest at the verifying read helper as a CRC
+# mismatch (that is the point: the CRC door catches every flavor), but
+# the planted kind names WHAT went bad for the schedule/digest:
+#   bitflip    — payload bytes flipped under a correct-looking CRC table
+#   torn_write — the tail of the payload never made it to the platter
+#   stale_crc  — the payload is fine but the stored CRC lies
+AT_REST_KINDS = ("bitflip", "torn_write", "stale_crc")
+
 
 class InjectedCrash(Exception):
     """Raised by FaultPlan.gate() at an in-process fault point — models
@@ -98,6 +109,8 @@ class FaultPlan:
         self._partitions: list[tuple[frozenset, frozenset]] = []
         self._isolated: set[str] = set()
         self._broken_disks: set[tuple[str, int]] = set()
+        # (node_addr, disk_id, unit) -> at-rest fault kind
+        self._at_rest: dict[tuple[str, int, str], str] = {}
 
     # ---- authoring ----
     def on(self, addr: str = "*", method: str = "*",
@@ -143,6 +156,47 @@ class FaultPlan:
         key = (str(node_addr), int(disk_id))
         with self._lock:
             return key in self._broken_disks or ("*", int(disk_id)) in self._broken_disks
+
+    # ---- at-rest faults (bit-rot on stored payloads) ----
+    def plant_rot(self, node_addr: str, disk_id: int, unit: str,
+                  kind: str = "bitflip") -> "FaultPlan":
+        """Corrupt one at-rest payload: subsequent verified reads of
+        `unit` on (node_addr, disk_id) surface a CRC mismatch until a
+        rewrite of that unit heals it (heal_rot). Planted faults land in
+        the schedule/digest like transport faults."""
+        if kind not in AT_REST_KINDS:
+            raise ValueError(
+                f"unknown at-rest kind {kind!r}; one of {AT_REST_KINDS}")
+        key = (str(node_addr), int(disk_id), str(unit))
+        with self._lock:
+            self._at_rest[key] = kind
+            self._log(kind, key[0], f"at_rest:{unit}", key[1])
+        return self
+
+    def heal_rot(self, node_addr: str, disk_id: int, unit: str) -> bool:
+        """A rewrite of the unit landed: clear its planted rot. Returns
+        whether rot was actually present — the store wrappers use this
+        to count a HEAL, so a rewrite of a clean unit (which would be a
+        false repair) never inflates the healed counter."""
+        key = (str(node_addr), int(disk_id), str(unit))
+        with self._lock:
+            kind = self._at_rest.pop(key, None)
+            if kind is not None:
+                self._log("rot_healed", key[0], f"at_rest:{unit}", key[1])
+            return kind is not None
+
+    def at_rest_fault(self, node_addr: str, disk_id: int,
+                      unit: str) -> str | None:
+        key = (str(node_addr), int(disk_id), str(unit))
+        with self._lock:
+            return (self._at_rest.get(key)
+                    or self._at_rest.get(("*", int(disk_id), str(unit))))
+
+    def rot_remaining(self) -> int:
+        """Planted at-rest faults not yet healed (the chaos drill's
+        '100% healed' assertion is rot_remaining() == 0)."""
+        with self._lock:
+            return len(self._at_rest)
 
     # ---- determinism ----
     def _draw(self, addr: str, method: str, index: int, salt: str) -> float:

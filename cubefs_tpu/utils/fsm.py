@@ -43,8 +43,52 @@ from __future__ import annotations
 import json
 import os
 import threading
+import zlib
 
-from . import rpc
+from . import metrics, rpc
+
+
+class WalCorruptError(Exception):
+    """A WAL record in the MIDDLE of the log failed its CRC/length
+    check. Unlike a torn tail (the expected crash artifact — the last
+    record never fully hit the platter, so replay truncates it), a bad
+    record with VALID records after it means the medium lied: replaying
+    past it would silently diverge this replica, so replay refuses.
+    Recovery: re-snapshot from a healthy peer (raft hosts get this for
+    free via InstallSnapshot; standalone hosts feed a peer's
+    `_state_bytes()` to `fsm_recover_from_state`)."""
+
+
+class SnapshotCorruptError(WalCorruptError):
+    """The snapshot file's whole-file digest does not match its payload
+    — same refusal/recovery contract as a corrupt-middle WAL record."""
+
+
+def _frame(payload: str) -> str:
+    """One framed WAL line: `!<crc32:08x><len:08x>|<json>`. The CRC is
+    over the json payload bytes; the length disambiguates a torn write
+    that happens to end on a newline. Legacy bare-JSON lines (pre-CRC
+    WALs) still replay."""
+    raw = payload.encode()
+    return f"!{zlib.crc32(raw):08x}{len(raw):08x}|{payload}\n"
+
+
+def _parse_frame(line: bytes) -> dict:
+    """Decode one WAL line (framed or legacy); raises ValueError on any
+    framing/CRC/JSON failure."""
+    if line.startswith(b"!"):
+        if len(line) < 18 or line[17:18] != b"|":
+            raise ValueError("truncated frame header")
+        crc = int(line[1:9], 16)
+        length = int(line[9:17], 16)
+        payload = line[18:]
+        if len(payload) != length:
+            raise ValueError(
+                f"frame length {len(payload)} != header {length}")
+        if zlib.crc32(payload) != crc:
+            raise ValueError("frame crc mismatch")
+        return json.loads(payload)
+    return json.loads(line)
 
 
 class ReplicatedFsm:
@@ -156,7 +200,7 @@ class ReplicatedFsm:
                 if self._segmented:
                     self._fsm_dirty.update(self._segments_of(record))
                 if self._wal is not None:
-                    self._wal.write(json.dumps(record) + "\n")
+                    self._wal.write(_frame(json.dumps(record)))
                     self._wal.flush()
             return out
         from ..parallel.raft import NotLeaderError
@@ -189,7 +233,7 @@ class ReplicatedFsm:
                         self._fsm_dirty.update(self._segments_of(r))
                 if self._wal is not None and ok:
                     self._wal.write(
-                        "".join(json.dumps(r) + "\n" for r in ok))
+                        "".join(_frame(json.dumps(r)) for r in ok))
                     self._wal.flush()
             return outs
         from ..parallel.raft import NotLeaderError
@@ -229,24 +273,94 @@ class ReplicatedFsm:
         # migration into the segment store — while it exists it stays
         # authoritative (a crash mid-migration leaves a PARTIAL store)
         if os.path.exists(self._snap_path()):
-            self._load_state_dict(json.load(open(self._snap_path())))
+            self._load_state_dict(self._read_snapshot())
         elif self._segmented and os.path.isdir(self._seg_dir()):
             kv = self._open_seg_store()
             for k, v in kv.scan():
                 self._load_segment_state(k.decode(), json.loads(v))
         if os.path.exists(self._wal_path()):
-            for line in open(self._wal_path()):
-                line = line.strip()
-                if line:
+            self._replay_wal()
+
+    def _read_snapshot(self) -> dict:
+        doc = json.load(open(self._snap_path()))
+        if isinstance(doc, dict) and doc.get("__wal_snap__") == 2:
+            # digest-carrying envelope: crc32 over the serialized state
+            payload = doc["payload"]
+            if zlib.crc32(payload.encode()) != doc["crc"]:
+                metrics.integrity_corruptions_detected.inc(
+                    plane="wal", source="replay")
+                raise SnapshotCorruptError(
+                    f"{self._snap_path()}: snapshot digest mismatch")
+            return json.loads(payload)
+        return doc  # legacy digest-less snapshot
+
+    def _replay_wal(self) -> None:
+        """Replay the op WAL with per-record CRC verification. The whole
+        file is VALIDATED before anything applies, so a corrupt-middle
+        refusal leaves the FSM state untouched for peer recovery."""
+        path = self._wal_path()
+        with open(path, "rb") as f:
+            raw = f.read()
+        records: list[dict] = []
+        offset = 0
+        bad_at: int | None = None  # byte offset of the first bad record
+        corrupt_middle = False
+        for line in raw.split(b"\n"):
+            if line:
+                if bad_at is None:
                     try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        break  # torn tail
-                    self._apply_deduped(rec)
-                    if self._segmented:
-                        # replayed ops must re-dirty their segments: the
-                        # store's copy predates them
-                        self._fsm_dirty.update(self._segments_of(rec))
+                        records.append(_parse_frame(line))
+                    except (ValueError, json.JSONDecodeError):
+                        bad_at = offset
+                else:
+                    try:
+                        _parse_frame(line)
+                    except (ValueError, json.JSONDecodeError):
+                        pass  # trailing garbage keeps the tear a tear
+                    else:
+                        corrupt_middle = True  # valid record AFTER the bad one
+                        break
+            offset += len(line) + 1
+        if corrupt_middle:
+            metrics.integrity_corruptions_detected.inc(
+                plane="wal", source="replay")
+            raise WalCorruptError(
+                f"{path}: corrupt record at byte {bad_at} with valid "
+                f"records after it — refusing replay (re-snapshot from "
+                f"a healthy peer)")
+        if bad_at is not None:
+            # torn tail: the crash artifact the framing exists to make
+            # provably-safe to drop. Truncate so the append stream never
+            # concatenates onto half a record.
+            with open(path, "r+b") as f:
+                f.truncate(bad_at)
+            metrics.wal_torn_tail.inc()
+        for rec in records:
+            self._apply_deduped(rec)
+            if self._segmented:
+                # replayed ops must re-dirty their segments: the
+                # store's copy predates them
+                self._fsm_dirty.update(self._segments_of(rec))
+
+    def fsm_recover_from_state(self, data: bytes) -> None:
+        """Corrupt-middle recovery door: replace this host's state with
+        a healthy peer's `_state_bytes()` (the raft InstallSnapshot
+        payload shape), discard the poisoned WAL, and persist a fresh
+        digest-carrying snapshot. The op_id cache resets with the state
+        — exactly what a raft InstallSnapshot does on a lagging
+        follower."""
+        with self._wal_lock:
+            self._fsm_op_cache.clear()
+            self._restore_bytes(data)
+            if self._segmented:
+                # every segment must land in the store: its current
+                # contents predate (or were poisoned alongside) the WAL
+                self._fsm_dirty.update(self._all_segments())
+            if self._wal is not None:
+                self._wal.close()
+            open(self._wal_path(), "w").close()
+            self._wal = open(self._wal_path(), "a")
+        self.snapshot()
 
     def snapshot(self) -> int:
         """Standalone mode: persist state and rotate the wal (raft mode
@@ -282,8 +396,14 @@ class ReplicatedFsm:
                     os.remove(self._snap_path())  # legacy file migrated
             else:
                 tmp = self._snap_path() + ".tmp"
+                payload = json.dumps(self._state_dict())
                 with open(tmp, "w") as f:
-                    json.dump(self._state_dict(), f)
+                    # whole-file digest envelope: a flipped bit anywhere
+                    # in the state payload refuses the load instead of
+                    # silently restoring corrupt metadata
+                    json.dump({"__wal_snap__": 2,
+                               "crc": zlib.crc32(payload.encode()),
+                               "payload": payload}, f)
                 os.replace(tmp, self._snap_path())
             if self._wal is not None:
                 self._wal.close()

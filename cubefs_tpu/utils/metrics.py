@@ -453,6 +453,53 @@ tiering_blob_freelist = DEFAULT.gauge(
     "cubefs_tiering_blob_freelist",
     "blob locations queued for deferred deletion (nonzero between a "
     "rollback/overwrite/unlink and the next reaper sweep)")
+tiering_orphans_reconciled = DEFAULT.counter(
+    "cubefs_tiering_orphans_reconciled_total",
+    "leaked blob bids found by inventory reconciliation (the "
+    "put->blob_written crash window) and enqueued for the reaper")
 lc_scan_errors = DEFAULT.counter(
     "cubefs_lc_scan_errors_total",
     "lifecycle scan loop iterations that raised (loop stays alive)")
+
+# silent-corruption defense (utils/fsm.py WAL framing, store-level
+# verified reads with read-repair, utils/scrub.py sweeps, disk
+# quarantine). `cubefs-cli metrics integrity` renders these.
+integrity_corruptions_detected = DEFAULT.counter(
+    "cubefs_integrity_corruptions_detected_total",
+    "at-rest corruptions caught by a CRC check, by plane (fs/blob/wal) "
+    "and source (`read` = foreground verified read, `scrub` = "
+    "background sweep, `replay` = WAL replay)", ("plane", "source"))
+integrity_corruptions_healed = DEFAULT.counter(
+    "cubefs_integrity_corruptions_healed_total",
+    "corrupt copies rewritten in place from a healthy replica (fs) or "
+    "EC reconstruction (blob), by plane and source", ("plane", "source"))
+integrity_repair_failures = DEFAULT.counter(
+    "cubefs_integrity_repair_failures_total",
+    "read-repair attempts that could not heal the bad copy (left for "
+    "the scrubber / repair queue)", ("plane",))
+wal_torn_tail = DEFAULT.counter(
+    "cubefs_wal_torn_tail_total",
+    "WAL replays that truncated a torn trailing record (the expected "
+    "crash artifact; corrupt-MIDDLE records refuse replay instead)")
+scrub_items = DEFAULT.counter(
+    "cubefs_scrub_items_total",
+    "scrubbed units by plane and outcome: `clean`, `corrupt` (detected "
+    "and queued/healed), `skipped` (brownout or rate limit deferred)",
+    ("plane", "outcome"))
+scrub_last_full_pass = DEFAULT.gauge(
+    "cubefs_scrub_last_full_pass_seconds",
+    "wall seconds the most recent COMPLETED full scrub pass took, per "
+    "plane (0 until a first pass completes)", ("plane",))
+scrub_cursor = DEFAULT.gauge(
+    "cubefs_scrub_cursor_position",
+    "resumable sweep cursor position within the current pass",
+    ("plane",))
+disk_quarantined = DEFAULT.gauge(
+    "cubefs_disk_quarantine_active",
+    "disks currently quarantined (no new allocations; probe-based "
+    "unquarantine pending)", ("node",))
+disk_quarantine_transitions = DEFAULT.counter(
+    "cubefs_disk_quarantine_transitions_total",
+    "disk health state transitions: `quarantine` (io-error or latency "
+    "outlier tripped), `probe_pass` (probe healed it back), "
+    "`probe_fail` (probe kept it quarantined)", ("node", "event"))

@@ -1,0 +1,20 @@
+"""Fixture: node code that reads at-rest payloads raw (CFI001/2)."""
+
+
+class SideDoorReader:
+    def __init__(self, store, chunkstore):
+        self.store = store
+        self.chunkstore = chunkstore
+
+    def serve_extent(self, extent_id, offset, length):
+        # CFI002: raw extent read — no CRC check, no detection counter
+        return self.store.read(extent_id, offset, length)
+
+    def serve_shard(self, chunk_id, bid):
+        # CFI001: raw shard read on a self.<store> receiver
+        return self.chunkstore.get_shard(chunk_id, bid)
+
+    def repair_pull(self, store, chunk_id, bid):
+        # CFI001: even a repair writer must see detection-checked bytes
+        data, crc = store.get_shard(chunk_id, bid)
+        return data

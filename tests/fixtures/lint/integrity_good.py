@@ -1,0 +1,28 @@
+"""Fixture: node code that reads at-rest payloads through the fence."""
+from .chunkstore import verified_get_shard
+from .extent_store import verified_read
+
+
+class DisciplinedReader:
+    def __init__(self, store, chunkstore):
+        self.store = store
+        self.chunkstore = chunkstore
+
+    def serve_extent(self, extent_id, offset, length):
+        # the ONE sanctioned at-rest extent read: CRC-checked, counted
+        return verified_read(self.store, extent_id, offset, length)
+
+    def serve_shard(self, chunk_id, bid):
+        return verified_get_shard(self.chunkstore, chunk_id, bid)
+
+    def rpc_get_shard(self, args):
+        # dispatching to the node's OWN verified wrapper is fine
+        return self.get_shard(args["chunk_id"], args["bid"])
+
+    def get_shard(self, chunk_id, bid):
+        return verified_get_shard(self.chunkstore, chunk_id, bid)
+
+    def bookkeeping(self, path):
+        # file-object .read() on a non-store receiver is fine
+        with open(path, "rb") as f:
+            return f.read()

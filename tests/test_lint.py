@@ -22,6 +22,8 @@ from tool.lint.checkers.admission_discipline import AdmissionDisciplineChecker
 from tool.lint.checkers.batch_discipline import BatchDisciplineChecker
 from tool.lint.checkers.fanout_discipline import FanoutDisciplineChecker
 from tool.lint.checkers.fs_placement import FsPlacementChecker
+from tool.lint.checkers.integrity_discipline import (
+    IntegrityDisciplineChecker)
 from tool.lint.checkers.lock_discipline import LockDisciplineChecker
 from tool.lint.checkers.placement_discipline import PlacementDisciplineChecker
 from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
@@ -411,3 +413,32 @@ def test_tiering_discipline_sanctions_only_the_bridge():
     assert c.check(mod) == []
     # the blob plane talking to itself is out of scope
     assert not c.applies("cubefs_tpu/blob/worker.py")
+
+
+# ---------------- integrity-discipline ----------------
+
+def test_integrity_discipline_true_positives():
+    mod = _module("integrity_bad.py", "cubefs_tpu/blob/blobnode.py")
+    found = IntegrityDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFI001", "CFI001", "CFI002"]
+    assert any("verified_get_shard" in v.message for v in found)
+    assert any("verified_read" in v.message for v in found)
+
+
+def test_integrity_discipline_true_negative():
+    mod = _module("integrity_good.py", "cubefs_tpu/blob/blobnode.py")
+    assert IntegrityDisciplineChecker().check(mod) == []
+
+
+def test_integrity_discipline_sanctions_the_store_modules():
+    c = IntegrityDisciplineChecker()
+    assert c.applies("cubefs_tpu/fs/datanode.py")
+    assert c.applies("cubefs_tpu/blob/blobnode.py")
+    # the store modules' own raw reads sit under the CRC checks
+    for sanctioned in ("cubefs_tpu/fs/extent_store.py",
+                      "cubefs_tpu/blob/chunkstore.py"):
+        mod = _module("integrity_bad.py", sanctioned)
+        assert c.check(mod) == []
+    # outside the two planes the rule has no opinion
+    assert not c.applies("cubefs_tpu/utils/fsm.py")
+    assert not c.applies("tests/test_fx.py")

@@ -4,6 +4,7 @@ from .admission_discipline import AdmissionDisciplineChecker
 from .batch_discipline import BatchDisciplineChecker
 from .fanout_discipline import FanoutDisciplineChecker
 from .fs_placement import FsPlacementChecker
+from .integrity_discipline import IntegrityDisciplineChecker
 from .lock_discipline import LockDisciplineChecker
 from .placement_discipline import PlacementDisciplineChecker
 from .retry_discipline import RetryDisciplineChecker
@@ -25,4 +26,5 @@ ALL_CHECKERS = (
     FanoutDisciplineChecker,
     AdmissionDisciplineChecker,
     TieringDisciplineChecker,
+    IntegrityDisciplineChecker,
 )

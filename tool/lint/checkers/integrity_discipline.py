@@ -1,0 +1,79 @@
+"""Verified-read fencing checker (rule: integrity-discipline, CFI0xx).
+
+The silent-corruption defense rests on every at-rest payload read in
+the fs and blob planes flowing through the verifying helpers —
+`extent_store.verified_read` and `chunkstore.verified_get_shard` —
+which CRC-check the bytes, count detections, and let the read-repair
+path heal the bad copy. A raw `store.read()` / `store.get_shard()`
+outside the store modules hands corrupt bytes straight to a caller
+(or worse, to a repair writer) with no detection and no heal.
+
+  CFI001  `.get_shard()` called on anything but the node's own wrapper
+          outside the store modules — use
+          `chunkstore.verified_get_shard`
+  CFI002  `.read()` called on a store-named receiver outside the store
+          modules — use `extent_store.verified_read`
+
+Like the other discipline families the analysis is syntactic. CFI002
+keys on the receiver NAME (`store`, `_store`, `extent_store`, ...)
+because `.read()` is too common a method to flag unconditionally;
+CFI001 flags every `.get_shard()` attribute call (the name is unique
+to chunkstores) except `self.get_shard(...)`, a node's own verified
+wrapper dispatching for its RPC surface.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+# the store modules themselves: raw reads live here, under the CRC
+# checks that make the verified helpers verified
+_SANCTIONED = {
+    "cubefs_tpu/fs/extent_store.py",
+    "cubefs_tpu/blob/chunkstore.py",
+}
+
+# receiver names that denote an at-rest store
+_STORE_NAMES = {"store", "_store", "extent_store", "chunkstore", "es"}
+
+
+def _terminal_name(func: ast.Attribute) -> str | None:
+    """`X.read` -> "X", `self.X.read` -> "X", `a.b.read` -> "b"."""
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    return None
+
+
+class IntegrityDisciplineChecker(Checker):
+    rule = "integrity-discipline"
+    dirs = ("cubefs_tpu/fs/", "cubefs_tpu/blob/")
+
+    def check(self, mod: Module) -> list[Violation]:
+        if mod.relpath in _SANCTIONED:
+            return []
+        out: list[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            recv = _terminal_name(node.func)
+            if node.func.attr == "get_shard" and recv != "self":
+                out.append(self.violation(
+                    mod, "CFI001", node,
+                    f"raw `{recv}.get_shard()` bypasses the CRC check — "
+                    f"at-rest shard reads must flow through "
+                    f"chunkstore.verified_get_shard (detection + "
+                    f"read-repair accounting live there)"))
+            elif node.func.attr == "read" and recv in _STORE_NAMES:
+                out.append(self.violation(
+                    mod, "CFI002", node,
+                    f"raw `{recv}.read()` bypasses the CRC check — "
+                    f"at-rest extent reads must flow through "
+                    f"extent_store.verified_read (detection + "
+                    f"read-repair accounting live there)"))
+        return out
