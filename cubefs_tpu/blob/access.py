@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import contextvars
 import os
-import threading
 import time
 import uuid
 import zlib
@@ -26,7 +25,7 @@ import numpy as np
 
 from ..codec import codemode as cm
 from ..codec.encoder import CodecConfig, new_encoder
-from ..utils import metrics, qos, rpc
+from ..utils import lockwitness, metrics, qos, rpc
 from ..utils import trace as tracelib
 from .types import Location, Slice, VolumeInfo
 
@@ -78,7 +77,7 @@ class AccessHandler:
         self.delete_queue = delete_queue
         self._pool = ThreadPoolExecutor(max_workers=self.cfg.max_workers)
         self._encoders: dict[int, object] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("AccessHandler._lock")
         # phase timestamps of the most recent put() on this handler
         # (encode_admitted / alloc_done / encode_done / quorum_done),
         # observable by tests asserting the encode overlaps allocation

@@ -11,13 +11,12 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 
 import sys
 
 from ..codec import codemode as cm
-from ..utils import metrics, rpc
+from ..utils import lockwitness, metrics, rpc
 from ..utils.fsm import ReplicatedFsm
 from . import topology
 from .topology import NoAvailableDisks  # noqa: F401  (re-export: legacy import site)
@@ -34,7 +33,7 @@ class ClusterMgr(ReplicatedFsm):
         self.cluster_id = cluster_id
         self.data_dir = data_dir
         self.allow_colocated_units = allow_colocated_units
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("ClusterMgr._lock")
         self.disks: dict[int, DiskInfo] = {}
         self.volumes: dict[int, VolumeInfo] = {}
         self.services: dict[str, list[str]] = {}
@@ -110,8 +109,12 @@ class ClusterMgr(ReplicatedFsm):
         # op_id dedups transport retries — without it a retried register
         # mints a second disk_id for the same physical disk.
         with self._propose_lock:
+            # clock read happens HERE (proposer) and rides the record:
+            # an apply-side time.time() would stamp replay/replica
+            # applies with "now", marking a long-dead disk as freshly
+            # heartbeated after every restart (fsm-purity CFM001)
             rec = {"op": "register_disk", "node_addr": node_addr,
-                   "path": path}
+                   "path": path, "ts": time.time()}
             if az:
                 rec["az"] = az
             if rack:
@@ -121,11 +124,12 @@ class ClusterMgr(ReplicatedFsm):
             return self._commit(rec)
 
     def _apply_register_disk(self, node_addr: str, path: str,
-                             az: str = "", rack: str = "") -> int:
+                             az: str = "", rack: str = "",
+                             ts: float = 0.0) -> int:
         disk_id = self._next_disk
         self._next_disk += 1
         self.disks[disk_id] = DiskInfo(disk_id, node_addr, path,
-                                       last_heartbeat=time.time(),
+                                       last_heartbeat=ts,
                                        az=az, rack=rack)
         return disk_id
 

@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import json
 import os
-import threading
+
+from ..utils import lockwitness
 
 
 class MessageQueue:
@@ -26,7 +27,7 @@ class MessageQueue:
     COMPACT_THRESHOLD = 4096
 
     def __init__(self, path: str | None = None, topic: str = "q"):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("MessageQueue._lock")
         self._mem: list[dict] = []  # messages from absolute index _base
         self._base = 0  # absolute index of _mem[0]
         self._offset = 0  # absolute ack watermark (next to deliver)
@@ -203,7 +204,7 @@ class ReplicatedQueue:
         self.fsms: list[_PartitionFsm] = []
         self.rafts: list = []
         self._rr = 0
-        self._rr_lock = threading.Lock()
+        self._rr_lock = lockwitness.make_lock("ReplicatedQueue._rr_lock")
         for p in range(n_partitions):
             fsm = _PartitionFsm()
             node = raftlib.RaftNode(
@@ -345,7 +346,7 @@ class QueueProducer:
         self.pool = pool
         self.n = n_partitions
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("QueueProducer._lock")
 
     def put(self, msg: dict) -> None:
         with self._lock:

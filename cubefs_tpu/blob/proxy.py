@@ -9,10 +9,9 @@ path doesn't pay a control-plane round trip per blob.
 
 from __future__ import annotations
 
-import threading
 import uuid
 
-from ..utils import rpc
+from ..utils import lockwitness, rpc
 from .types import VolumeInfo
 
 
@@ -22,7 +21,7 @@ class ProxyAllocator:
 
     def __init__(self, cm_client: rpc.Client):
         self.cm = cm_client
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("ProxyAllocator._lock")
         self._bid_next = 0
         self._bid_end = 0
         self._vols: dict[int, tuple[VolumeInfo, int]] = {}  # mode -> (vol, blobs)

@@ -17,7 +17,7 @@ import time
 import uuid
 
 from ..codec import codemode as cmode
-from ..utils import metrics, qos, rpc
+from ..utils import lockwitness, metrics, qos, rpc
 from ..utils.retry import RetryPolicy
 
 # shard deletes: 2 quick retries on node-level blips, tightly bounded —
@@ -33,7 +33,7 @@ class TaskSwitch:
 
     def __init__(self):
         self._off: set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("TaskSwitch._lock")
 
     def enable(self, kind: str) -> None:
         with self._lock:
@@ -60,7 +60,7 @@ class Scheduler:
         self.delete_queue = delete_queue
         self.nodes = node_pool
         self.switch = TaskSwitch()
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("Scheduler._lock")
         self.tasks: dict[str, dict] = {}  # task_id -> record
         self._done_units: dict[int, set[int]] = {}  # disk -> unit indexes done
         self.last_drain_plan: dict = {}  # most recent plan_disk_drain result
@@ -216,9 +216,13 @@ class Scheduler:
                 n += 1
             if n == 0:
                 self.cm.set_disk_status(disk_id, DiskStatus.REPAIRED)
-            else:
-                self.plan_disk_drain(disk_id)
-            return n
+        if n:
+            # planning measures drain sizes over the network — it must
+            # run AFTER the lock is dropped (with the RLock held here it
+            # would reenter and hold it across every list_chunk RPC,
+            # stalling lease/complete/heartbeat for the whole survey)
+            self.plan_disk_drain(disk_id)
+        return n
 
     def _unit_bytes(self, vid: int, unit_index: int) -> int:
         """Drain size of one failed slot, measured from any surviving
@@ -272,6 +276,22 @@ class Scheduler:
         # to foreground IO (1.0 healthy / 0.5 warn / 0.25 critical)
         qos_scale = qos.repair_step_scale()
         step_bytes = max(1, int(step_bytes * qos_scale))
+        # Two-phase so the survey RPCs never run under self._lock (the
+        # interprocedural lint, CFL101, flagged the old single-phase
+        # shape: _drain_bytes -> _unit_bytes -> list_chunk per task
+        # while every lease/complete/heartbeat waited on the lock).
+        # Phase 1: snapshot which open tasks still need measuring.
+        with self._lock:
+            unmeasured = [(t["task_id"], t["vid"], t["unit_index"])
+                          for t in self.tasks.values()
+                          if t.get("src_disk") == disk_id
+                          and t["state"] in ("pending", "leased")
+                          and t.get("drain_bytes") is None]
+        # Phase 2: measure over the network, lock dropped.
+        measured = {task_id: self._drain_bytes(vid, unit_index)
+                    for task_id, vid, unit_index in unmeasured}
+        # Phase 3: re-acquire, re-check task state (a task may have
+        # completed or been cancelled during the survey), then pack.
         with self._lock:
             open_tasks = [t for t in self.tasks.values()
                           if t.get("src_disk") == disk_id
@@ -280,8 +300,10 @@ class Scheduler:
             for t in open_tasks:
                 b = t.get("drain_bytes")
                 if b is None:
-                    b = t["drain_bytes"] = self._drain_bytes(
-                        t["vid"], t["unit_index"])
+                    if t["task_id"] in measured:
+                        b = t["drain_bytes"] = measured[t["task_id"]]
+                    else:
+                        b = 0  # queued mid-survey: next re-plan measures
                 total += b
                 if acc and acc + b > step_bytes:
                     step, acc = step + 1, 0

@@ -29,10 +29,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 
 from ..parallel import raft as raftlib
-from ..utils import rpc
+from ..utils import lockwitness, rpc
 
 
 class Shard:
@@ -45,7 +44,7 @@ class Shard:
         self.shard_id = shard_id
         self.start = start
         self.end = end
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("Shard._lock")
         self.on_split = None  # set by the hosting ShardNode
         self.on_range_change = None  # set by the hosting ShardNode
         if data_dir:
@@ -207,7 +206,7 @@ class ShardNode:
         self.rafts: dict[int, raftlib.RaftNode] = {}
         self.extra_routes: dict = {}
         self._peers: dict[int, list[str]] = {}
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("ShardNode._lock")
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
             self._load_manifest()
@@ -468,7 +467,7 @@ class Catalog:
     clustermgr's catalog manager). Routes keys to shard replica sets."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("Catalog._lock")
         self.spaces: dict[str, list[dict]] = {}  # name -> [{shard_id, start, end, addrs}]
 
     def create_space(self, name: str, shards: list[dict]) -> None:

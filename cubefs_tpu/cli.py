@@ -555,6 +555,15 @@ def main(argv=None):
     p_trace.add_argument("--json", action="store_true",
                          help="raw JSON instead of the rendered tree")
 
+    p_san = sub.add_parser("sanitize")  # concurrency sanitizer evidence
+    p_san.add_argument("action", choices=["status"])
+    p_san.add_argument("--path", default=None,
+                       help="witness dump (default: artifacts/"
+                            "SANITIZE_WITNESS.json from the last "
+                            "CUBEFS_SANITIZE=1 run)")
+    p_san.add_argument("--json", action="store_true",
+                       help="raw dump instead of the rendered summary")
+
     p_auth = sub.add_parser("auth")
     p_auth.add_argument("action", choices=["register", "ticket"])
     p_auth.add_argument("--authnode", required=True)
@@ -874,6 +883,36 @@ def main(argv=None):
         else:  # list
             out = _fetch_json(args.addr, "/traces")
             print(json.dumps(out.get("trace_ids", []), indent=2))
+
+    elif args.group == "sanitize":
+        import os
+
+        from .utils import lockwitness
+
+        path = args.path or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "artifacts", "SANITIZE_WITNESS.json")
+        if not os.path.exists(path):
+            sys.exit(f"no witness dump at {path} — run the suite with "
+                     "CUBEFS_SANITIZE=1 first (tests/conftest.py dumps "
+                     "the evidence at session end)")
+        data = json.load(open(path))
+        if args.json:
+            print(json.dumps(data, indent=2))
+        else:
+            live = "on" if lockwitness.enabled() else "off"
+            edges = data.get("edges", [])
+            print(f"lock witness (this process: CUBEFS_SANITIZE {live})")
+            print(f"  acquisitions      {data.get('acquisitions', 0)}")
+            print(f"  max held depth    {data.get('max_held_depth', 0)}")
+            print(f"  rpc checks        {data.get('rpc_checks', 0)}")
+            print(f"  instance overlaps {data.get('instance_overlaps', 0)}")
+            print(f"  locks seen        {len(data.get('locks_seen', []))}")
+            print(f"  order edges       {len(edges)}")
+            for e in edges:
+                print(f"    {e['src']} -> {e['dst']}  "
+                      f"(thread {e.get('thread', '?')!r}, "
+                      f"acquired at {e.get('acquired_at', '?')})")
 
     elif args.group == "auth":
         import base64

@@ -17,10 +17,9 @@ import hmac
 import json
 import os
 import secrets
-import threading
 import time
 
-from ..utils import rpc
+from ..utils import lockwitness, rpc
 
 
 class AuthError(Exception):
@@ -36,7 +35,7 @@ class KeyStore:
     replication parity with the other FSMs."""
 
     def __init__(self, data_dir: str | None = None):
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("KeyStore._lock")
         self.keys: dict[str, str] = {}  # id -> b64 key
         self.data_dir = data_dir
         self._wal = None
@@ -165,7 +164,7 @@ class UserStore:
     """AK/SK user registry with per-volume grants (master/user.go role)."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("UserStore._lock")
         self.users: dict[str, dict] = {}  # ak -> {sk, user_id, policies}
 
     def create_user(self, user_id: str) -> dict:

@@ -11,7 +11,8 @@ from __future__ import annotations
 import concurrent.futures
 import hashlib
 import os
-import threading
+
+from ..utils import lockwitness
 from collections import OrderedDict
 
 
@@ -20,7 +21,7 @@ class BlockCache:
                  spill_dir: str | None = None):
         self.capacity = capacity_bytes
         self.spill_dir = spill_dir
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("BlockCache._lock")
         self._lru: OrderedDict[str, bytes | None] = OrderedDict()
         # spilled entries hold None in the LRU; their payload size is
         # tracked here so the capacity budget covers the spill dir too
@@ -125,7 +126,7 @@ class CachingExtentClient:
         # racing fetch's put a no-op after a write invalidation
         self._inflight: dict[str, concurrent.futures.Future] = {}
         self._gen: dict[int, int] = {}
-        self._pf_lock = threading.Lock()
+        self._pf_lock = lockwitness.make_lock("CachingExtentClient._pf_lock")
 
     def write(self, meta, ino: int, file_offset: int, data: bytes) -> None:
         self.inner.write(meta, ino, file_offset, data)

@@ -15,6 +15,7 @@ import time
 
 import uuid
 
+from ..utils import lockwitness
 from ..utils import metrics as _metrics
 from ..utils import packet as pkt
 from ..utils import rpc
@@ -92,7 +93,7 @@ class SubmitFanout:
     def __init__(self, wrapper: "MetaWrapper", k: int):
         self.wrapper = wrapper
         self.k = k
-        self._mu = threading.Lock()
+        self._mu = lockwitness.make_lock("SubmitFanout._mu")
         self._queues: dict[int, list[_FanoutWaiter]] = {}
         self._busy: set[int] = set()
         self._scheduled: set[int] = set()  # pids with a drain task queued
@@ -230,7 +231,7 @@ class MetaWrapper:
         self.mps = vol_view["mps"]
         self.nodes = node_pool
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("MetaWrapper._lock")
         # binary meta plane (manager_op.go): metanodes that advertise a
         # packet address serve the hot ops over persistent TCP; HTTP
         # stays as the per-address fallback (same negative-cache
@@ -764,7 +765,7 @@ class ExtentClient:
         self._packet_clients: dict[str, object] = {}
         self._packet_down: dict[str, float] = {}  # plane addr -> retry ts
         self._rr = 0
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("ExtentClient._lock")
         # per-inode open extent: ino -> (dp, extent_id, next_offset)
         self._streams: dict[int, tuple[dict, int, int]] = {}
         # shared tiny-extent stream (datanode storage_tinyfile role):
@@ -773,7 +774,7 @@ class ExtentClient:
         # RESERVATION only (the stream is shared across inodes); the
         # writes themselves run concurrently on disjoint ranges.
         self._tiny: tuple[dict, int, int] | None = None
-        self._tiny_lock = threading.Lock()
+        self._tiny_lock = lockwitness.make_lock("ExtentClient._tiny_lock")
         self._latency: dict[str, float] = {}  # addr -> EWMA seconds
 
     def _pick_dp(self) -> dict:

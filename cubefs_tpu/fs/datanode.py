@@ -23,7 +23,7 @@ import os
 import threading
 import time
 
-from ..utils import metrics, rpc
+from ..utils import lockwitness, metrics, rpc
 from ..utils.diskhealth import DiskHealthTracker
 from ..utils.retry import RetryPolicy
 from .extent_store import (BlockCrcError, ExtentError, ExtentStore,
@@ -39,7 +39,7 @@ class DataPartition:
         self.leader = leader
         self.raft = None  # per-dp raft group for the random-write path
         self._meta_path = os.path.join(path, "dp_meta.json")
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("DataPartition._lock")
         self.next_extent = 1
         if os.path.exists(self._meta_path):
             meta = json.load(open(self._meta_path))
@@ -72,7 +72,7 @@ class DataPartition:
         with self._lock:
             if not hasattr(self, "_ext_locks"):
                 self._ext_locks = {}
-            return self._ext_locks.setdefault(extent_id, threading.Lock())
+            return self._ext_locks.setdefault(extent_id, lockwitness.make_lock("DataPartition._ext_lock"))
 
     def alloc_extent(self, op_id: str | None = None) -> int:
         """Mint the next extent id. A transport retry must get the same
@@ -120,7 +120,7 @@ class DataNode:
         self.qos = qos if isinstance(qos, DiskQos) else DiskQos.from_config(qos)
         self.partitions: dict[int, DataPartition] = {}
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("DataNode._lock")
         self._broken = False
         # native C++ read plane (runtime/src/dataserve.cc): serves
         # OP_READ from the same extent-store handles, GIL-free
@@ -145,7 +145,7 @@ class DataNode:
         # repair thread re-syncs until it completes a pass whose gen is
         # still current, so writes landing mid-repair are never lost
         self.pending_repairs: dict[tuple[int, int, str], dict] = {}
-        self._repair_lock = threading.Lock()
+        self._repair_lock = lockwitness.make_lock("DataNode._repair_lock")
         for d in self.disks:
             os.makedirs(d, exist_ok=True)
         # reopen partitions found on every disk (raft rejoins via its
@@ -207,7 +207,7 @@ class DataNode:
                 return
             disk = self.dp_disk.get(dp.dp_id)
             serving = 0 if disk in self.disk_broken else 1
-            # lint: allow[CFL003] cold registration: the dp serves nothing until it is added; lock guards _native_h lifecycle
+            # lint: allow[CFL003,CFL101] cold registration: the dp serves nothing until it is added; local native call, no network; lock guards _native_h lifecycle
             self._native_lib.ds_add_partition(
                 self._native_h, dp.dp_id, dp.store.handle, serving)
 

@@ -17,13 +17,13 @@ from __future__ import annotations
 
 import ctypes
 import os
-import threading
 import time
 import zlib
 
 import numpy as np
 
 from ..runtime import build as rt
+from ..utils import lockwitness
 
 BLOCK_SIZE = 128 * 1024
 
@@ -39,7 +39,8 @@ class BlockCrcError(ExtentError):
 class ExtentStore:
     def __init__(self, directory: str):
         self._lib = rt.load()
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("ExtentStore._lock")
+        # lint: allow[CFL101] local-disk open, no network; DataNode holds its lock here precisely to make registration atomic with the open
         self._h = self._lib.es_open(directory.encode())
         if not self._h:
             raise ExtentError(f"cannot open extent store at {directory}")
@@ -47,6 +48,7 @@ class ExtentStore:
 
     def _err(self) -> str:
         # caller holds self._lock with the handle verified live
+        # lint: allow[CFL101] es_last_error is a pure in-memory errno formatter — safe under any lock
         return (self._lib.es_last_error(self._h) or b"").decode()
 
     def _handle(self):

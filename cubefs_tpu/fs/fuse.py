@@ -21,6 +21,8 @@ import os
 import stat as stat_mod
 import struct
 import threading
+
+from ..utils import lockwitness
 import time
 
 from . import metanode as mn
@@ -76,7 +78,7 @@ class FuseMount:
         self._thread: threading.Thread | None = None
         self._write_buffers: dict[int, int] = {}  # fh -> ino (open handles)
         self._next_fh = 1
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("FuseMount._lock")
 
     # ---------------- mount / unmount ----------------
     def mount(self) -> "FuseMount":

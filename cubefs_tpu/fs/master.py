@@ -24,7 +24,7 @@ import threading
 import time
 
 from . import topology
-from ..utils import metrics, rpc
+from ..utils import lockwitness, metrics, rpc
 from ..utils.fsm import ReplicatedFsm
 from .topology import SELECTORS  # noqa: F401  (public selector registry)
 
@@ -52,7 +52,7 @@ class Master(ReplicatedFsm):
                               f"have {sorted(SELECTORS)}")
         self.selector = selector
         self._selector_state: dict = {}
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("Master._lock")
         self.datanodes: dict[str, dict] = {}  # addr -> info (heartbeat-local)
         self.metanodes: dict[str, dict] = {}
         self.volumes: dict[str, dict] = {}
@@ -617,6 +617,7 @@ class Master(ReplicatedFsm):
         # idempotently re-creatable partitions behind.
         for m in mps:
             for a in m["addrs"]:
+                # lint: allow[CFL101] _propose_lock (never _lock) deliberately spans these creates: the dup-name check must stay atomic with the commit, and only concurrent volume creates queue on it
                 self.nodes.get(a).call(
                     "create_partition",
                     {"pid": m["pid"], "start": m["start"], "end": m["end"],

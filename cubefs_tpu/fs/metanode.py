@@ -18,7 +18,7 @@ import time
 import uuid
 import zlib
 
-from ..utils import rpc
+from ..utils import lockwitness, rpc
 from ..utils import trace as tracelib
 
 ROOT_INO = 1
@@ -60,7 +60,7 @@ class MetaPartition:
         self.pid = pid
         self.start = start
         self.end = end
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("MetaPartition._lock")
         self.inodes: dict[int, dict] = {}
         self.dentries: dict[int, dict[str, int]] = {}  # parent -> name -> ino
         # two-phase transactions (metanode/transaction.go analog):
@@ -116,7 +116,13 @@ class MetaPartition:
     def submit(self, record: dict) -> dict:
         """Validate + apply + log one mutation; returns the result.
         Auto-checkpoints every SNAPSHOT_EVERY records so oplog replay
-        stays bounded without O(partition) work per external call."""
+        stays bounded without O(partition) work per external call.
+
+        The wall clock is read HERE (proposer side) and travels in the
+        record: apply handlers must never read it themselves, or
+        replicas/WAL replays stamp divergent mtimes (fsm-purity CFM001).
+        Records arriving via oplog replay or raft already carry ts."""
+        record.setdefault("ts", time.time())
         with self._lock:
             result = self.apply(record)
             if self._oplog is not None:
@@ -139,6 +145,9 @@ class MetaPartition:
         its own apply-id — a batch is a commit-door optimization, not a
         WAL format, so crash replay is identical to N separate submits.
         Returns per-op outcomes [[result, None] | [None, [code, msg]]]."""
+        now = time.time()
+        for rec in records:
+            rec.setdefault("ts", now)  # one proposer-side clock read
         with self._lock:
             outs = []
             lines = []
@@ -265,6 +274,7 @@ class MetaPartition:
 
     def _mirror_full(self) -> None:
         lib, h = self._mir
+        # lint: allow[CFL101] ms_* mirror writes are local-memory ops, no blocking IO; the partition lock is what keeps the native read plane atomic with the FSM
         lib.ms_clear(h, self.pid)
         for ino in self.inodes:
             self._mirror_inode(ino)
@@ -303,6 +313,7 @@ class MetaPartition:
             ino = r["ino"] if op == "mk_inode" else result["ino"]
             self._mirror_inode(ino)
             if r["type"] == DIR:
+                # lint: allow[CFL101] ms_* mirror writes are local-memory ops, no blocking IO; the partition lock is what keeps the native read plane atomic with the FSM
                 lib.ms_ensure_dir(h, self.pid, ino)
             if op == "mknod":
                 self._mirror_dentry(r["parent"], r["name"])
@@ -534,7 +545,7 @@ class MetaPartition:
         ino = r["ino"]
         if ino in self.inodes:
             raise MetaError(EEXIST, f"inode {ino} exists")
-        now = r.get("ts", time.time())
+        now = r.get("ts", 0.0)
         self.inodes[ino] = {
             "ino": ino, "type": r["type"], "mode": r.get("mode", 0o644),
             "size": 0, "nlink": 2 if r["type"] == DIR else 1,
@@ -595,7 +606,7 @@ class MetaPartition:
             raise MetaError(28, f"mp {self.pid} inode range exhausted")
         ino = self._next_ino
         self._next_ino += 1
-        now = r.get("ts", time.time())
+        now = r.get("ts", 0.0)
         self.inodes[ino] = {
             "ino": ino, "type": r["type"], "mode": r.get("mode", 0o644),
             "size": 0, "nlink": 2 if r["type"] == DIR else 1,
@@ -629,7 +640,7 @@ class MetaPartition:
         if inode["type"] != DIR and inode.get("nlink", 1) > 1:
             # other hardlinks remain: drop this dentry + one link only
             inode["nlink"] -= 1
-            inode["ctime"] = r.get("ts", time.time())
+            inode["ctime"] = r.get("ts", 0.0)
             return {"ino": ino, "extents": [], "deferred": False,
                     "removed": False}
         self.inodes.pop(ino)
@@ -655,7 +666,7 @@ class MetaPartition:
             raise MetaError(EPERM,
                             "hardlinks to directories are not allowed")
         inode["nlink"] = inode.get("nlink", 1) + 1
-        inode["ctime"] = r.get("ts", time.time())
+        inode["ctime"] = r.get("ts", 0.0)
         return {"nlink": inode["nlink"]}
 
     def _apply_dec_nlink(self, r: dict) -> dict:
@@ -668,7 +679,7 @@ class MetaPartition:
             raise MetaError(ENOENT, f"inode {ino}")
         if inode["type"] != DIR and inode.get("nlink", 1) > 1:
             inode["nlink"] -= 1
-            inode["ctime"] = r.get("ts", time.time())
+            inode["ctime"] = r.get("ts", 0.0)
             return {"removed": False, "nlink": inode["nlink"]}
         return {"removed": True, **self._apply_rm_inode(r)}
 
@@ -734,7 +745,7 @@ class MetaPartition:
         its scanner can push the decision and only GC the commit record
         once every participant has resolved."""
         tx_id = r["tx_id"]
-        now = r.get("ts", time.time())
+        now = r.get("ts", 0.0)
         self._gc_tx(now)
         if tx_id in self.tx_pending or tx_id in self.tx_committed:
             return {}  # idempotent retry
@@ -796,7 +807,7 @@ class MetaPartition:
                     victims.append(old)
                 d[op["name"]] = op["ino"]
         self.tx_committed[tx_id] = {
-            "victims": victims, "ts": r.get("ts", time.time()),
+            "victims": victims, "ts": r.get("ts", 0.0),
             "parts": tx.get("parts"),
         }
         return {"victims": victims}
@@ -873,7 +884,7 @@ class MetaPartition:
             raise MetaError(ENOENT, f"inode {r['ino']}")
         inode["extents"].extend(r["extents"])
         inode["size"] = max(inode["size"], r.get("size", inode["size"]))
-        inode["mtime"] = r.get("ts", time.time())
+        inode["mtime"] = r.get("ts", 0.0)
         # generation counter: every data mutation bumps it, so a tiering
         # commit prepared against an older gen fences instead of
         # dropping this write (`.get` keeps pre-gen snapshots loadable)
@@ -889,7 +900,7 @@ class MetaPartition:
                 inode[k] = r[k]
         if "size" in r:  # length change is a data mutation: fence tiering
             inode["gen"] = inode.get("gen", 0) + 1
-        inode["ctime"] = r.get("ts", time.time())
+        inode["ctime"] = r.get("ts", 0.0)
         return {}
 
     def _apply_set_xattr(self, r: dict) -> dict:
@@ -1243,7 +1254,7 @@ class _SubmitBatcher:
     def __init__(self, node: "MetaNode", pid: int):
         self.node = node
         self.pid = pid
-        self._mu = threading.Lock()
+        self._mu = lockwitness.make_lock("_SubmitBatcher._mu")
         self._queue: list[_SubmitWaiter] = []
         self._busy = False
 
@@ -1346,7 +1357,7 @@ class MetaNode:
         self._coalesce = os.environ.get("CUBEFS_META_COALESCE", "1") != "0"
         self.dp_view_fn = None  # set_dp_view: enables the free scan
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("MetaNode._lock")
         self._stop = threading.Event()
         # native read plane (runtime/src/metaserve.cc): the C++ tree
         # mirror + GIL-free packet server for the hot read ops. Falls
@@ -1472,6 +1483,7 @@ class MetaNode:
     def _submit_local(self, pid: int, record: dict):
         """Push a record through the partition's commit door (raft if
         replicated, direct submit otherwise)."""
+        record.setdefault("ts", time.time())  # proposer-side stamp
         raft_node = self.rafts.get(pid)
         if raft_node is None:
             return self._mp(pid).submit(record)
@@ -1674,6 +1686,10 @@ class MetaNode:
     def rpc_submit(self, args, body):
         pid = args["pid"]
         raft_node = self.rafts.get(pid)
+        # ts is stamped at THIS door — before the record enters raft —
+        # so every replica (and every WAL replay) applies the same
+        # timestamp; apply handlers never read the clock (CFM001)
+        args["record"].setdefault("ts", time.time())
         try:
             self._mp(pid).check_limits(args["record"])
             if raft_node is None:
@@ -1715,6 +1731,9 @@ class MetaNode:
         replays cached results instead of re-applying."""
         pid = args["pid"]
         records = list(args["records"])
+        now = time.time()  # one proposer-side stamp for the whole batch
+        for rec in records:
+            rec.setdefault("ts", now)
         raft_node = self.rafts.get(pid)
         mp = self._mp(pid)
         outs: list = [None] * len(records)

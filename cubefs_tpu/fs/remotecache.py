@@ -20,7 +20,7 @@ import threading
 import time
 from collections import OrderedDict
 
-from ..utils import metrics, qos, rpc, trace
+from ..utils import lockwitness, metrics, qos, rpc, trace
 from ..utils.fsm import ReplicatedFsm
 from ..utils.retry import CircuitBreaker
 
@@ -48,7 +48,7 @@ class FlashNode:
     def __init__(self, capacity_bytes: int = 256 << 20, *, gate=None):
         self.capacity = capacity_bytes
         self._gate = gate  # None -> qos.DEFAULT, lazily
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("FlashNode._lock")
         self._lru: OrderedDict[str, bytes] = OrderedDict()
         self._paths: dict[str, str] = {}  # key -> populating path
         self._used = 0
@@ -139,7 +139,7 @@ class FlashGroupManager(ReplicatedFsm):
 
     def __init__(self, data_dir: str | None = None, me: str | None = None,
                  peers: list[str] | None = None, node_pool=None):
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("FlashGroupManager._lock")
         self.groups: dict[int, dict] = {}  # gid -> {addrs, status}
         self.epoch = 0
         self._hb: dict[str, float] = {}  # flashnode addr -> last heartbeat
@@ -334,7 +334,7 @@ class CachedReader:
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         self.hits = 0
         self.misses = 0
-        self._sf_lock = threading.Lock()
+        self._sf_lock = lockwitness.make_lock("CachedReader._sf_lock")
         self._inflight: dict[str, _Flight] = {}
         self._heat: OrderedDict[str, int] = OrderedDict()
 

@@ -12,7 +12,8 @@ import base64
 import calendar
 import hashlib
 import hmac
-import threading
+
+from ..utils import lockwitness
 import time
 import urllib.parse
 
@@ -324,7 +325,7 @@ class MasterUserStore:
     def __init__(self, master_client):
         self._c = master_client
         self._cache: dict[str, tuple[float, dict | None]] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("MasterUserStore._lock")
 
     def _info(self, ak: str) -> dict | None:
         from ..utils import rpc as _rpc

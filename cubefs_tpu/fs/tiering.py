@@ -48,13 +48,12 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import zlib
 from collections import OrderedDict
 
 from ..blob.access import AccessHandler
 from ..blob.types import Location
-from ..utils import faultinject, metrics, qos
+from ..utils import faultinject, lockwitness, metrics, qos
 from ..utils import trace as tracelib
 
 
@@ -101,7 +100,7 @@ class TieringEngine:
             except ValueError:
                 untier_threshold = 3
         self.untier_threshold = max(1, untier_threshold)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("TieringEngine._lock")
         # cold-read hotness, same discipline as CachedReader._heat: an
         # LRU-bounded counter per inode; crossing the threshold marks
         # the inode a re-heat candidate the lifecycle scan promotes

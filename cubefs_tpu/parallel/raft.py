@@ -60,6 +60,7 @@ import threading
 import time
 
 from ..utils import faultinject as _fi
+from ..utils import lockwitness
 from ..utils import metrics as _metrics
 from ..utils import trace as _trace
 
@@ -120,7 +121,7 @@ class RaftNode:
         self.pool = pool
         self.data_dir = data_dir
 
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("RaftNode._lock")
         # synchronous role/leader-change hook (e.g. the native meta read
         # plane's serving flag): invoked UNDER the node lock, so
         # listeners must be non-blocking and must never call back into
@@ -151,7 +152,7 @@ class RaftNode:
         # here; whichever caller finds the batcher idle drains the whole
         # queue as ONE log append / WAL write / replication round.
         # CUBEFS_RAFT_GROUP_COMMIT=0 keeps the per-call path (A/B knob).
-        self._prop_mu = threading.Lock()
+        self._prop_mu = lockwitness.make_lock("RaftNode._prop_mu")
         self._prop_queue: list[_ProposeWaiter] = []
         self._prop_busy = False
         self._group_commit = (
@@ -162,7 +163,7 @@ class RaftNode:
         # group-commit state: records are WRITTEN+flushed under the node
         # lock, fsync'd OUTSIDE it by _wal_sync (concurrent acks share
         # one disk flush). _wal_mu guards the handle vs rewrite swaps.
-        self._wal_mu = threading.Lock()
+        self._wal_mu = lockwitness.make_lock("RaftNode._wal_mu")
         self._sync_cv = threading.Condition()
         self._sync_active = False
         self._wal_written = 0  # abs idx written+flushed
@@ -656,7 +657,7 @@ class RaftNode:
             self._last_heard = time.monotonic()
             self._election_due = self._rand_timeout()
         votes = 1
-        vlock = threading.Lock()
+        vlock = lockwitness.make_lock("RaftNode.vlock")
         done = threading.Event()
         majority = (len(self.peers) + 1) // 2 + 1
         if votes >= majority:  # single-node group
@@ -1171,7 +1172,7 @@ class HeartbeatMux:
     entry replication (the repl threads) can never starve liveness."""
 
     _BY_POOL: dict[int, "HeartbeatMux"] = {}
-    _BY_POOL_LOCK = threading.Lock()
+    _BY_POOL_LOCK = lockwitness.make_lock("HeartbeatMux._BY_POOL_LOCK")
 
     @classmethod
     def get(cls, pool) -> "HeartbeatMux":
@@ -1183,7 +1184,7 @@ class HeartbeatMux:
 
     def __init__(self, pool):
         self.pool = pool
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("HeartbeatMux._lock")
         self.nodes: dict[tuple[str, str], RaftNode] = {}  # (gid, me) -> node
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -1288,7 +1289,7 @@ class ReplMux:
     8); a dead address blocks only its own lane."""
 
     _BY_POOL: dict[int, "ReplMux"] = {}
-    _BY_POOL_LOCK = threading.Lock()
+    _BY_POOL_LOCK = lockwitness.make_lock("ReplMux._BY_POOL_LOCK")
 
     @classmethod
     def get(cls, pool) -> "ReplMux":
@@ -1305,7 +1306,7 @@ class ReplMux:
                 os.environ.get("CUBEFS_RAFT_MUX_SENDERS", "8") or "8"))
         except ValueError:
             self.senders_per_addr = 8
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("ReplMux._lock")
         self.nodes: dict[tuple[str, str], RaftNode] = {}  # (gid, me) ->
         self._dirty: set[RaftNode] = set()
         self._ev = threading.Event()
@@ -1445,7 +1446,7 @@ class TickMux:
     by a per-node busy flag so a slow election can't be double-fired."""
 
     _BY_POOL: dict[int, "TickMux"] = {}
-    _BY_POOL_LOCK = threading.Lock()
+    _BY_POOL_LOCK = lockwitness.make_lock("TickMux._BY_POOL_LOCK")
 
     @classmethod
     def get(cls, pool) -> "TickMux":
@@ -1457,7 +1458,7 @@ class TickMux:
 
     def __init__(self, pool):
         self.pool = pool
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("TickMux._lock")
         self.nodes: dict[tuple[str, str], RaftNode] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None

@@ -42,10 +42,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import zlib
 
-from . import metrics, rpc
+from . import lockwitness, metrics, rpc
 
 
 class WalCorruptError(Exception):
@@ -98,8 +97,17 @@ class ReplicatedFsm:
                   me: str | None, peers: list[str] | None, node_pool) -> None:
         self._fsm_data_dir = data_dir
         self._wal = None
-        self._wal_lock = threading.Lock()  # apply+wal-append atomicity
-        self._propose_lock = threading.Lock()  # serializes decide+commit
+        # apply+wal-append atomicity
+        self._wal_lock = lockwitness.make_lock("ReplicatedFsm._wal_lock")
+        # serializes decide+commit: the raft propose (and, in the
+        # master's volume create, the planned partition-create RPCs)
+        # deliberately runs UNDER it so the duplicate-check stays atomic
+        # with the commit — only concurrent proposers queue here, never
+        # readers, so the witness's held-across-RPC rule is waived.
+        self._propose_lock = lockwitness.make_lock(
+            "ReplicatedFsm._propose_lock",
+            allow_block="propose serialization spans the commit "
+                        "RPC/raft round by design")
         self._fsm_op_cache: dict[str, tuple] = {}  # op_id -> (result, exc)
         self.raft = None
         self.extra_routes: dict = {}

@@ -19,6 +19,7 @@ import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from . import lockwitness
 from .retry import CircuitBreaker, RetryPolicy
 
 # Chaos hook (utils/faultinject.py): None in production, a FaultPlan in
@@ -330,6 +331,8 @@ def call(
     The chaos harness (utils/faultinject.py) interposes here when a
     FaultPlan is installed; drop-after-execute faults simulate exactly
     the lost-reply case the contract above covers."""
+    if lockwitness._active is not None:  # sanitizer door (same pattern)
+        lockwitness.note_rpc(addr, method)
     if _fault is not None:
         return _fault.around_http(addr, method, args, body, timeout,
                                   _http_call)
@@ -473,6 +476,13 @@ class Client:
     REDIRECT = 421
 
     def _invoke_direct(self, method: str, args, body):
+        # in-process transport is still "the network" to the sanitizer:
+        # chaos clusters are in-process, and a lock held here would be
+        # held across real HTTP in production
+        if lockwitness._active is not None:
+            lockwitness.note_rpc(
+                self._fault_addr or f"<{type(self._target).__name__}>",
+                method)
         fn = resolve_route(self._target, method)
         if fn is None:
             raise RpcError(404, f"no such method {method!r}")

@@ -2,6 +2,8 @@
 testenv.py, which pytest.ini loads as a `-p` plugin before capture and
 before any jax import — see its docstring for why it can't live here."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -42,3 +44,19 @@ def _qos_burn_isolated():
     qos.DEFAULT._levels = saved_levels
     qos.DEFAULT._forced = saved_forced
     qos.DEFAULT._last_refresh = float("-inf")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """When the run executed under CUBEFS_SANITIZE=1, persist the lock
+    witness's evidence (order graph edges, acquisition counters, RPC
+    checks) so `cubefs-cli sanitize status` — and the chaos-drill
+    acceptance gate — can read what the dynamic sanitizer actually saw.
+    A raise-free run with zero edges would mean the witness watched
+    nothing; the dump makes that auditable instead of silent."""
+    from cubefs_tpu.utils import lockwitness
+
+    w = lockwitness.active()
+    if w is None:
+        return
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    w.dump(os.path.join(root, "artifacts", "SANITIZE_WITNESS.json"))

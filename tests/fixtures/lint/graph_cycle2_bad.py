@@ -1,0 +1,23 @@
+"""Known-bad: two locks acquired in opposite orders on two code paths —
+the classic AB/BA deadlock, detectable purely statically (CFL102)."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._map_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.members = {}
+        self.stats = {}
+
+    def update(self, k, v):
+        with self._map_lock:
+            self.members[k] = v
+            with self._stats_lock:
+                self.stats["n"] = len(self.members)
+
+    def report(self):
+        with self._stats_lock:
+            n = self.stats.get("n", 0)
+            with self._map_lock:
+                return n, dict(self.members)

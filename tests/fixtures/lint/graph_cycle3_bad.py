@@ -1,0 +1,26 @@
+"""Known-bad: three-lock deadlock cycle A->B->C->A where each method is
+individually consistent (no single method reverses an order) — only the
+whole-program order graph sees the loop."""
+import threading
+
+
+class Trio:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self._c_lock = threading.Lock()
+
+    def ab(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def bc(self):
+        with self._b_lock:
+            with self._c_lock:
+                pass
+
+    def ca(self):
+        with self._c_lock:
+            with self._a_lock:
+                pass

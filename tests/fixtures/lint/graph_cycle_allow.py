@@ -1,0 +1,22 @@
+"""Known-good-by-justification: a 2-lock cycle where one edge carries an
+allow[CFL102] with a reason — the whole cycle is suppressed, because a
+justified edge means the reversal is intentional (e.g. guarded by a
+trylock or a startup-only path)."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._x_lock = threading.Lock()
+        self._y_lock = threading.Lock()
+
+    def forward(self):
+        with self._x_lock:
+            with self._y_lock:
+                pass
+
+    def backward(self):
+        with self._y_lock:
+            # lint: allow[CFL102] startup-only path, runs before any forward() caller exists
+            with self._x_lock:
+                pass

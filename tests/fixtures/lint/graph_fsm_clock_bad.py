@@ -1,0 +1,20 @@
+"""Known-bad: an FSM apply handler reads the wall clock through a
+helper. Replays and replicas run at different times, so state diverges
+(CFM001 — the chain must show root -> helper -> time.time site)."""
+import time
+
+
+class ReplicatedFsm:
+    pass
+
+
+class InodeFsm(ReplicatedFsm):
+    def __init__(self):
+        self.inodes = {}
+
+    def _now(self):
+        return time.time()  # the effect site, one frame below the root
+
+    def _apply_touch(self, record):
+        ino = record["ino"]
+        self.inodes[ino] = self._now()  # CFM001 via _now

@@ -1,0 +1,18 @@
+"""Known-bad: randomness hiding in a default-arg expression of an apply
+handler. Evaluated once per process at import — every replica freezes a
+DIFFERENT value, the sneakiest flavor of divergence (CFM002 with the
+default-arg suffix)."""
+import uuid
+
+
+class ReplicatedFsm:
+    pass
+
+
+class MintFsm(ReplicatedFsm):
+    def __init__(self):
+        self.ops = {}
+
+    def _apply_mint(self, record, op_id=uuid.uuid4().hex):
+        self.ops[op_id] = record
+        return op_id

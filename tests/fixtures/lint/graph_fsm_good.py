@@ -1,0 +1,28 @@
+"""Known-good: the sanctioned determinism pattern — time comes from the
+record (stamped at the propose door), ids are proposer-minted, and the
+injected clock is only read OUTSIDE apply. Zero CFM findings."""
+
+
+class ReplicatedFsm:
+    pass
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self._t = t
+
+    def now(self):
+        return self._t
+
+
+class CleanFsm(ReplicatedFsm):
+    def __init__(self, clock=None):
+        self.clock = clock or FakeClock()
+        self.inodes = {}
+
+    def propose_touch(self, ino):
+        # clock read happens on the PROPOSER, stamped into the record
+        return {"op": "touch", "ino": ino, "ts": self.clock.now()}
+
+    def _apply_touch(self, record):
+        self.inodes[record["ino"]] = record.get("ts", 0.0)

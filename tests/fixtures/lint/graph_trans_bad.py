@@ -1,0 +1,35 @@
+"""Known-bad: blocking effects reached TRANSITIVELY under a held lock.
+
+Neither `with` body contains a direct sleep or RPC — the effect is one
+or two call frames down, which is exactly what the interprocedural
+engine (tool/lint/graph.py) exists to catch.
+"""
+import threading
+import time
+
+from ..utils import rpc
+
+
+def _pause():
+    time.sleep(0.01)
+
+
+class Repairer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.addr = "n1:17010"
+
+    def _measure(self):
+        meta, _ = rpc.call(self.addr, "list_chunk", {})
+        return meta
+
+    def _helper(self):
+        _pause()  # sleep two frames below the lock
+
+    def plan(self):
+        with self._lock:
+            self._helper()  # CFL101: transitive sleep under Repairer._lock
+
+    def survey(self):
+        with self._lock:
+            return self._measure()  # CFL101: transitive RPC under lock

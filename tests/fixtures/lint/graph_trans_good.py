@@ -1,0 +1,32 @@
+"""Known-good twin of graph_trans_bad: same helpers, but every blocking
+call runs OUTSIDE the lock (snapshot-under-lock, block-outside)."""
+import threading
+import time
+
+from ..utils import rpc
+
+
+def _pause():
+    time.sleep(0.01)
+
+
+class Repairer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.addr = "n1:17010"
+        self.pending = []
+
+    def _measure(self):
+        meta, _ = rpc.call(self.addr, "list_chunk", {})
+        return meta
+
+    def plan(self):
+        with self._lock:
+            todo = list(self.pending)  # snapshot under the lock
+        _pause()  # blocking work outside
+        return todo
+
+    def survey(self):
+        with self._lock:
+            addr = self.addr
+        return self._measure()  # RPC after release

@@ -1,0 +1,15 @@
+"""Known-bad: raw lock allocations in a concurrent plane — invisible to
+the runtime lock witness (CFS001 x3: attribute form, RLock form, and a
+from-imported constructor)."""
+import threading
+from threading import RLock
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._index_lock = RLock()
+
+
+def make_guard():
+    return threading.RLock()

@@ -1,0 +1,13 @@
+"""Known-good: every lock comes from the lockwitness factories (named
+after their static lock-order graph node), and Condition wraps an
+already-witnessed lock instead of allocating its own."""
+import threading
+
+from ..utils import lockwitness
+
+
+class Cache:
+    def __init__(self):
+        self._lock = lockwitness.make_lock("Cache._lock")
+        self._index_lock = lockwitness.make_rlock("Cache._index_lock")
+        self._cv = threading.Condition(self._lock)

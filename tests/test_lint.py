@@ -18,13 +18,16 @@ import sys
 import pytest
 
 from tool.lint import cli, core
+from tool.lint import graph as graphlib
 from tool.lint.checkers.admission_discipline import AdmissionDisciplineChecker
 from tool.lint.checkers.batch_discipline import BatchDisciplineChecker
 from tool.lint.checkers.fanout_discipline import FanoutDisciplineChecker
 from tool.lint.checkers.fs_placement import FsPlacementChecker
+from tool.lint.checkers.fsm_purity import FsmPurityChecker, apply_roots
 from tool.lint.checkers.integrity_discipline import (
     IntegrityDisciplineChecker)
 from tool.lint.checkers.lock_discipline import LockDisciplineChecker
+from tool.lint.checkers.lock_graph import LockGraphChecker
 from tool.lint.checkers.placement_discipline import PlacementDisciplineChecker
 from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
 from tool.lint.checkers.rpc_idempotency import (RpcIdempotencyChecker,
@@ -33,6 +36,7 @@ from tool.lint.checkers.tier1_purity import Tier1PurityChecker
 from tool.lint.checkers.tiering_discipline import TieringDisciplineChecker
 from tool.lint.checkers.tracer_safety import (TraceClockChecker,
                                               TracerSafetyChecker)
+from tool.lint.checkers.witness_discipline import WitnessDisciplineChecker
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
 
@@ -442,3 +446,163 @@ def test_integrity_discipline_sanctions_the_store_modules():
     # outside the two planes the rule has no opinion
     assert not c.applies("cubefs_tpu/utils/fsm.py")
     assert not c.applies("tests/test_fx.py")
+
+
+# ---------------- lock-graph (interprocedural, CFL1xx) ----------------
+
+def _graph(*pairs):
+    """Build a linked ProjectGraph from (fixture, relpath) pairs."""
+    modules = {rp: _module(fx, rp) for fx, rp in pairs}
+    g = graphlib.ProjectGraph.build(modules, cache_dir=None, parallel=False)
+    return g, modules
+
+
+def test_lock_graph_transitive_blocking_fires():
+    g, mods = _graph(("graph_trans_bad.py", "cubefs_tpu/fs/fx.py"))
+    found = LockGraphChecker().check_project(g, mods)
+    assert _codes(found) == ["CFL101", "CFL101"]
+    msgs = " | ".join(v.message for v in found)
+    # the chain is rendered down to the blocking site, helper included
+    assert "Repairer._lock" in msgs
+    assert "_helper" in msgs and "_pause" in msgs
+    assert "_measure" in msgs
+
+
+def test_lock_graph_transitive_blocking_true_negative():
+    g, mods = _graph(("graph_trans_good.py", "cubefs_tpu/fs/fx.py"))
+    assert LockGraphChecker().check_project(g, mods) == []
+
+
+def test_lock_graph_two_lock_cycle():
+    g, mods = _graph(("graph_cycle2_bad.py", "cubefs_tpu/fs/fx.py"))
+    found = LockGraphChecker().check_project(g, mods)
+    assert _codes(found) == ["CFL102"]
+    msg = found[0].message
+    assert "Pool._map_lock" in msg and "Pool._stats_lock" in msg
+
+
+def test_lock_graph_three_lock_cycle():
+    g, mods = _graph(("graph_cycle3_bad.py", "cubefs_tpu/fs/fx.py"))
+    found = LockGraphChecker().check_project(g, mods)
+    assert _codes(found) == ["CFL102"]
+    msg = found[0].message
+    for lock in ("Trio._a_lock", "Trio._b_lock", "Trio._c_lock"):
+        assert lock in msg
+
+
+def test_lock_graph_cycle_allow_on_one_edge_suppresses():
+    g, mods = _graph(("graph_cycle_allow.py", "cubefs_tpu/fs/fx.py"))
+    assert LockGraphChecker().check_project(g, mods) == []
+
+
+def test_lock_graph_scope():
+    c = LockGraphChecker()
+    assert c.applies("cubefs_tpu/parallel/raft.py")
+    assert c.applies("cubefs_tpu/utils/fsm.py")
+    assert not c.applies("cubefs_tpu/utils/rpc.py")
+    assert not c.applies("tests/test_fx.py")
+
+
+# ---------------- fsm-purity (CFM00x) ----------------
+
+def test_fsm_purity_clock_via_helper():
+    g, mods = _graph(("graph_fsm_clock_bad.py", "cubefs_tpu/fs/fakefsm.py"))
+    found = FsmPurityChecker().check_project(g, mods)
+    assert _codes(found) == ["CFM001"]
+    msg = found[0].message
+    # chain shows WHY the helper is in the blast radius
+    assert "_apply_touch" in msg and "_now" in msg
+
+
+def test_fsm_purity_random_in_default_arg():
+    g, mods = _graph(("graph_fsm_default_bad.py", "cubefs_tpu/fs/fakefsm.py"))
+    found = FsmPurityChecker().check_project(g, mods)
+    assert _codes(found) == ["CFM002"]
+    assert "default-arg" in found[0].message
+
+
+def test_fsm_purity_injected_clock_is_clean():
+    g, mods = _graph(("graph_fsm_good.py", "cubefs_tpu/fs/fakefsm.py"))
+    # the root IS detected (base matched by final name) ...
+    assert any(q.endswith("._apply_touch") for q in apply_roots(g))
+    # ... but record-carried ts + injected clock leave nothing to report
+    assert FsmPurityChecker().check_project(g, mods) == []
+
+
+# ---------------- witness-discipline (CFS001) ----------------
+
+def test_witness_discipline_true_positives():
+    mod = _module("witness_bad.py", "cubefs_tpu/fs/fx.py")
+    found = WitnessDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFS001", "CFS001", "CFS001"]
+
+
+def test_witness_discipline_true_negative():
+    mod = _module("witness_good.py", "cubefs_tpu/fs/fx.py")
+    assert WitnessDisciplineChecker().check(mod) == []
+
+
+def test_witness_discipline_scope():
+    c = WitnessDisciplineChecker()
+    assert c.applies("cubefs_tpu/parallel/raft.py")
+    assert c.applies("cubefs_tpu/utils/fsm.py")
+    # rpc.py's pools live outside the witnessed planes (the witness
+    # itself must not recurse into the transport's own locks) ...
+    assert not c.applies("cubefs_tpu/utils/rpc.py")
+    # ... and the witness module is exempt from its own rule
+    assert not c.applies("cubefs_tpu/utils/lockwitness.py")
+
+
+# ---------------- baseline ordering + summary cache + wall time ----------------
+
+def test_update_baseline_sorted_by_position(tmp_path):
+    import json
+
+    vs = [
+        core.Violation("CFZ001", "r", "b.py", 12, "m"),
+        core.Violation("CFZ002", "r", "a.py", 1, "m"),
+        core.Violation("CFZ001", "r", "b.py", 3, "m"),
+        core.Violation("CFZ001", "r", "a.py", 9, "m"),
+    ]
+    path = str(tmp_path / "baseline.json")
+    core.save_baseline(vs, path)
+    fps = json.load(open(path))["violations"]
+    # (path, code, line) with the LINE compared numerically: b.py:3
+    # precedes b.py:12 even though "12" < "3" as text
+    assert fps == [vs[3].fingerprint, vs[1].fingerprint,
+                   vs[2].fingerprint, vs[0].fingerprint]
+
+
+def test_graph_summary_cache_round_trip(tmp_path):
+    cache = str(tmp_path / "cache")
+    pairs = (("graph_trans_bad.py", "cubefs_tpu/fs/fx.py"),
+             ("graph_fsm_clock_bad.py", "cubefs_tpu/fs/fakefsm.py"))
+    mods1 = {rp: _module(fx, rp) for fx, rp in pairs}
+    g1 = graphlib.ProjectGraph.build(mods1, cache_dir=cache, parallel=False)
+    assert [f for f in os.listdir(cache) if f.endswith(".json")], \
+        "summary cache was not populated"
+    # a second build (fresh parse) must land on the cache and agree
+    mods2 = {rp: _module(fx, rp) for fx, rp in pairs}
+    g2 = graphlib.ProjectGraph.build(mods2, cache_dir=cache, parallel=False)
+    assert set(g1.funcs) == set(g2.funcs)
+    for q, f in g1.funcs.items():
+        assert g2.funcs[q].effects == f.effects
+    # the cached build finds the same violations
+    assert _codes(LockGraphChecker().check_project(g2, mods2)) == \
+        _codes(LockGraphChecker().check_project(g1, mods1))
+
+
+def test_lint_wall_time_within_budget():
+    """Perf gate for the interprocedural engine: a full lint of the
+    tree (summary cache warm or cold) must stay within 1.2x of the
+    pre-engine wall time measured on this tier (8.7s -> 10.4s budget).
+    The engine's one-parse-pass + content-hash cache keeps the real
+    figure far below that; this guards against an accidental
+    per-checker re-parse creeping back in."""
+    import time
+
+    t0 = time.perf_counter()
+    violations, errors = cli.run_lint()
+    elapsed = time.perf_counter() - t0
+    assert errors == []
+    assert elapsed < 10.4, f"lint took {elapsed:.1f}s (budget 10.4s)"

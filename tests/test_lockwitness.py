@@ -1,0 +1,305 @@
+"""Unit tests for the runtime lock witness (utils/lockwitness.py) — the
+dynamic half of the concurrency sanitizer.
+
+The zero-overhead contract is load-bearing: with CUBEFS_SANITIZE off,
+make_lock/make_rlock must return PLAIN threading primitives (identical
+class, no wrapper), so production and the default test tier pay nothing.
+With a witness installed, the order graph must raise on the first
+observed inversion and on any lock held across an RPC door.
+"""
+
+import json
+import threading
+
+import pytest
+
+from cubefs_tpu.utils import lockwitness, rpc
+from cubefs_tpu.utils.lockwitness import WitnessViolation
+
+
+# ---------------- off: the no-op contract ----------------
+
+def test_off_returns_plain_threading_primitives():
+    # tier-1 runs without CUBEFS_SANITIZE, so the module door is off
+    # unless a test installed a witness; pin the state to be sure
+    lockwitness.uninstall()
+    lk = lockwitness.make_lock("X._lock")
+    rl = lockwitness.make_rlock("X._rlock")
+    assert type(lk) is type(threading.Lock())
+    assert type(rl) is type(threading.RLock())
+    assert not lockwitness.enabled()
+    # the rpc door is a pure no-op too
+    lockwitness.note_rpc("n1", "anything")
+
+
+def test_dead_scope_lock_degrades_to_passthrough():
+    with lockwitness.installed():
+        lk = lockwitness.make_lock("Dead._lock")
+    # its witness is no longer active: plain acquire/release, no raises
+    with lk:
+        pass
+    assert lk.acquire(False)
+    lk.release()
+
+
+# ---------------- cycle detection ----------------
+
+def test_lock_order_cycle_raises_with_both_chains():
+    with lockwitness.installed():
+        a = lockwitness.make_lock("A._lock")
+        b = lockwitness.make_lock("B._lock")
+        with a:
+            with b:
+                pass  # records A -> B
+        with b:
+            with pytest.raises(WitnessViolation) as exc:
+                a.acquire()
+        msg = str(exc.value)
+        assert "lock-order cycle" in msg
+        # both sides: this thread's held stack AND the remembered sample
+        assert "A._lock" in msg and "B._lock" in msg
+        assert "held at" in msg and "acquired at" in msg
+
+
+def test_transitive_cycle_through_third_lock():
+    with lockwitness.installed():
+        a = lockwitness.make_lock("A._lock")
+        b = lockwitness.make_lock("B._lock")
+        c = lockwitness.make_lock("C._lock")
+        with a:
+            with b:
+                pass  # A -> B
+        with b:
+            with c:
+                pass  # B -> C
+        with c:
+            with pytest.raises(WitnessViolation) as exc:
+                a.acquire()  # C -> A closes A -> B -> C -> A
+        assert "B._lock" in str(exc.value)  # the path is spelled out
+
+
+def test_consistent_order_never_raises():
+    with lockwitness.installed() as w:
+        a = lockwitness.make_lock("A._lock")
+        b = lockwitness.make_lock("B._lock")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert w.acquisitions == 6
+        assert w.max_depth == 2
+        assert [(e["src"], e["dst"]) for e in w.stats()["edges"]] == [
+            ("A._lock", "B._lock")]
+
+
+def test_cross_thread_inversion_is_caught():
+    """Thread 1 takes A then B; thread 2 takes B then A. No deadlock in
+    this sequential run — the witness still raises on the back-edge."""
+    with lockwitness.installed():
+        a = lockwitness.make_lock("A._lock")
+        b = lockwitness.make_lock("B._lock")
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=forward)
+        t.start()
+        t.join()
+
+        err: list = []
+
+        def backward():
+            try:
+                with b:
+                    with a:
+                        pass
+            except WitnessViolation as e:
+                err.append(e)
+
+        t2 = threading.Thread(target=backward)
+        t2.start()
+        t2.join()
+        assert err and "lock-order cycle" in str(err[0])
+
+
+def test_same_name_instances_count_overlap_not_edge():
+    """A per-instance ladder (two DataPartition._ext_lock held together)
+    must not self-edge — it is counted as an instance_overlap stat."""
+    with lockwitness.installed() as w:
+        e1 = lockwitness.make_lock("DP._ext_lock")
+        e2 = lockwitness.make_lock("DP._ext_lock")
+        with e1:
+            with e2:
+                pass
+        s = w.stats()
+        assert s["instance_overlaps"] == 1
+        assert s["edges"] == []
+
+
+def test_rlock_reentrancy_is_silent():
+    with lockwitness.installed() as w:
+        rl = lockwitness.make_rlock("M._lock")
+        with rl:
+            with rl:
+                pass
+        assert w.stats()["edges"] == []
+
+
+# ---------------- the RPC door ----------------
+
+def test_lock_held_across_rpc_raises():
+    with lockwitness.installed() as w:
+        lk = lockwitness.make_lock("Scheduler._lock")
+        with lk:
+            with pytest.raises(WitnessViolation) as exc:
+                lockwitness.note_rpc("n1:17010", "list_chunk")
+        msg = str(exc.value)
+        assert "lock held across RPC" in msg
+        assert "Scheduler._lock" in msg and "list_chunk" in msg
+        assert w.rpc_checks == 1
+
+
+def test_allow_block_justification_waives_rpc_check():
+    with lockwitness.installed() as w:
+        lk = lockwitness.make_lock(
+            "ReplicatedFsm._propose_lock",
+            allow_block="propose serialization spans the commit round")
+        with lk:
+            lockwitness.note_rpc("n1:17010", "submit")  # no raise
+        assert w.rpc_checks == 1
+
+
+def test_rpc_client_direct_transport_hits_the_door():
+    """The in-process transport is still 'the network' to the sanitizer:
+    a witnessed lock held across Client.call must raise."""
+
+    class Svc:
+        def rpc_ping(self, args, body):
+            return {"ok": True}
+
+    with lockwitness.installed():
+        cli = rpc.Client(Svc())
+        resp, _ = cli.call("ping")  # no lock held: fine
+        assert resp["ok"]
+        lk = lockwitness.make_lock("Caller._lock")
+        with lk:
+            with pytest.raises(WitnessViolation):
+                cli.call("ping")
+
+
+# ---------------- Condition protocol ----------------
+
+def test_condition_over_witnessed_lock():
+    with lockwitness.installed():
+        lk = lockwitness.make_lock("Q._lock")
+        cv = threading.Condition(lk)
+        ready: list = []
+
+        def producer():
+            with cv:
+                ready.append(1)
+                cv.notify()
+
+        with cv:
+            t = threading.Thread(target=producer)
+            t.start()
+            # wait releases the witnessed lock (held stack drops to 0),
+            # the producer takes it, then wait reacquires
+            assert cv.wait_for(lambda: ready, timeout=5.0)
+        t.join()
+
+
+def test_condition_over_witnessed_rlock_reentrant():
+    with lockwitness.installed():
+        rl = lockwitness.make_rlock("Q._lock")
+        cv = threading.Condition(rl)
+        with rl:  # outer reentrant hold
+            with cv:
+                assert rl._is_owned()
+
+
+def test_condition_wait_releases_held_stack():
+    """While cv.wait() parks, the thread must not appear to hold the
+    lock — an RPC on ANOTHER thread is unaffected, and this thread's
+    held stack is empty during the park."""
+    with lockwitness.installed() as w:
+        lk = lockwitness.make_lock("Q._lock")
+        cv = threading.Condition(lk)
+        depth_during_wait: list = []
+
+        def producer():
+            depth_during_wait.append(len(w.held_names()))
+            with cv:
+                cv.notify()
+
+        with cv:
+            t = threading.Thread(target=producer)
+            t.start()
+            cv.wait(timeout=5.0)
+        t.join()
+        assert depth_during_wait == [0]
+        # after the with: fully released on this thread too
+        assert w.held_names() == []
+
+
+# ---------------- reporting ----------------
+
+def test_stats_and_dump(tmp_path):
+    with lockwitness.installed() as w:
+        a = lockwitness.make_lock("A._lock")
+        b = lockwitness.make_lock("B._lock")
+        with a:
+            with b:
+                pass
+        out = tmp_path / "witness.json"
+        w.dump(str(out))
+    data = json.loads(out.read_text())
+    assert data["enabled"] is True
+    assert data["locks_seen"] == ["A._lock", "B._lock"]
+    assert data["acquisitions"] == 2
+    assert data["edges"][0]["src"] == "A._lock"
+    assert data["edges"][0]["dst"] == "B._lock"
+    # samples carry enough to print the other side of a future cycle
+    assert "acquired_at" in data["edges"][0]
+    assert "held_at" in data["edges"][0]
+
+
+# ---------------- observe, never alter ----------------
+
+def test_sanitizer_legs_are_fsm_digest_identical():
+    """Acceptance gate: the witness observes, it never alters. The same
+    op sequence (fixed ts, so proposer-side stamping is out of the
+    picture) must leave byte-identical FSM state with the sanitizer on
+    and off."""
+    import hashlib
+
+    from cubefs_tpu.fs.metanode import MetaPartition
+
+    def leg(sanitize):
+        try:
+            if sanitize:
+                ctx = lockwitness.installed()
+                ctx.__enter__()
+            p = MetaPartition(1, 1000, 2000)
+            p.submit({"op": "mk_inode", "ino": 1000, "type": "dir",
+                      "ts": 1.0})
+            for i in range(1, 16):
+                p.submit({"op": "mk_inode", "ino": 1000 + i,
+                          "type": "file", "ts": 1.0 + i})
+                p.submit({"op": "mk_dentry", "parent": 1000,
+                          "name": f"f{i}", "ino": 1000 + i,
+                          "ts": 1.0 + i})
+            p.submit({"op": "set_attr", "ino": 1003,
+                      "attrs": {"mode": 0o600}, "ts": 40.0})
+            p.submit({"op": "rm_dentry", "parent": 1000, "name": "f9",
+                      "ts": 41.0})
+            return hashlib.sha256(p.state_bytes()).hexdigest()
+        finally:
+            if sanitize:
+                ctx.__exit__(None, None, None)
+
+    off = leg(False)
+    on = leg(True)
+    assert on == off

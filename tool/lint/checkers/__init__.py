@@ -4,14 +4,17 @@ from .admission_discipline import AdmissionDisciplineChecker
 from .batch_discipline import BatchDisciplineChecker
 from .fanout_discipline import FanoutDisciplineChecker
 from .fs_placement import FsPlacementChecker
+from .fsm_purity import FsmPurityChecker
 from .integrity_discipline import IntegrityDisciplineChecker
 from .lock_discipline import LockDisciplineChecker
+from .lock_graph import LockGraphChecker
 from .placement_discipline import PlacementDisciplineChecker
 from .retry_discipline import RetryDisciplineChecker
 from .rpc_idempotency import RpcIdempotencyChecker
 from .tier1_purity import Tier1PurityChecker
 from .tiering_discipline import TieringDisciplineChecker
 from .tracer_safety import TraceClockChecker, TracerSafetyChecker
+from .witness_discipline import WitnessDisciplineChecker
 
 ALL_CHECKERS = (
     TracerSafetyChecker,
@@ -27,4 +30,12 @@ ALL_CHECKERS = (
     AdmissionDisciplineChecker,
     TieringDisciplineChecker,
     IntegrityDisciplineChecker,
+    WitnessDisciplineChecker,
+)
+
+# Checkers that need the whole-program graph (tool/lint/graph.py); the
+# cli runs them once over the linked project, not per module.
+PROJECT_CHECKERS = (
+    LockGraphChecker,
+    FsmPurityChecker,
 )

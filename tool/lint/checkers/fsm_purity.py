@@ -1,0 +1,109 @@
+"""FSM-purity checker (rule: fsm-purity, codes CFM00x).
+
+Every replica — and every WAL replay, and soon every geo-replication
+follower — must apply the same record stream to byte-identical state.
+That breaks the moment an apply handler (or ANYTHING it calls) reads a
+source that differs across processes. This checker walks everything
+reachable from the FSM apply roots via the interprocedural engine:
+
+  roots:  `_apply` / `_apply_*` methods on classes inheriting
+          ReplicatedFsm (fs master, blob clustermgr, flash-group
+          manager), plus MetaPartition.apply / MetaPartition._apply_*
+          (the metanode partition FSM, which fronts raft directly).
+
+  CFM001  wall-clock read reachable from an apply root (time.time,
+          monotonic, datetime.now, ...) — stamp `ts` at the PROPOSE
+          door instead; apply must use the record's value
+  CFM002  randomness reachable (random.*, uuid4, os.urandom,
+          secrets.*) — mint ids on the proposer, never in apply
+  CFM003  os.environ / os.getenv reachable — config must be captured
+          at construction, not re-read divergently mid-apply
+  CFM004  iteration over a set reachable — PYTHONHASHSEED randomizes
+          str hashing, so set order differs across replicas; anything
+          order-dependent (serialization, first-match picks) diverges
+
+Each finding anchors at the offending line in the offending file and
+prints the root -> ... -> site chain, so the reader sees WHY a helper
+three frames from any `_apply_` is in the blast radius. The sanctioned
+pattern is dependency injection: a `clock=` / record-carried `ts` /
+proposer-minted `op_id` is invisible to this checker by construction.
+"""
+
+from __future__ import annotations
+
+from .. import graph as graphlib
+from ..core import Checker, Module, Violation
+
+_EFFECT_CODE = {
+    "reads_wallclock": ("CFM001", "reads the wall clock"),
+    "reads_random": ("CFM002", "reads a randomness source"),
+    "reads_environ": ("CFM003", "reads os.environ"),
+    "unordered_iter": ("CFM004", "iterates a set (hash-randomized "
+                                 "order across replicas)"),
+}
+
+
+def apply_roots(g: graphlib.ProjectGraph) -> list[str]:
+    """Qnames of every FSM apply handler in the project."""
+    roots: list[str] = []
+    fsm_hosts: set[tuple[str, str]] = set()  # (relpath, class)
+    for relpath, summary in g.modules.items():
+        for cname, cinfo in summary["classes"].items():
+            bases = {b.split(".")[-1] for b in cinfo["bases"]}
+            if "ReplicatedFsm" in bases:
+                fsm_hosts.add((relpath, cname))
+            if cname == "MetaPartition":
+                fsm_hosts.add((relpath, cname))
+    for f in g.funcs.values():
+        if f.cls is None:
+            continue
+        if (f.relpath, f.cls) not in fsm_hosts:
+            continue
+        if f.name == "_apply" or f.name.startswith("_apply_") or (
+                f.cls == "MetaPartition" and f.name == "apply"):
+            roots.append(f.qname)
+    return sorted(roots)
+
+
+class FsmPurityChecker(Checker):
+    rule = "fsm-purity"
+    dirs = ("cubefs_tpu/",)
+    project_wide = True
+
+    def check_project(self, g: graphlib.ProjectGraph,
+                      modules: dict[str, Module]) -> list[Violation]:
+        out: list[Violation] = []
+        reported: set[tuple] = set()  # (site relpath, line, effect)
+        for root in apply_roots(g):
+            f = g.funcs[root]
+            for effect, (code, label) in _EFFECT_CODE.items():
+                if effect not in f.effects:
+                    continue
+                chain = g.effect_chain(root, effect)
+                if not chain:
+                    continue
+                site_q, site_line = chain[-1]
+                site = g.funcs.get(site_q)
+                site_path = site.relpath if site else f.relpath
+                key = (site_path, site_line, effect)
+                if key in reported:
+                    continue
+                reported.add(key)
+                suffix = ""
+                if site is not None and \
+                        site.default_effects.get(effect) == site_line and \
+                        site.direct.get(effect) != site_line:
+                    suffix = (" [in a default-arg expression: evaluated "
+                              "once per process, then frozen]")
+                rendered = " -> ".join(
+                    f"{graphlib.short(q)}:{ln}" for q, ln in chain)
+                out.append(Violation(
+                    code, self.rule, site_path, site_line,
+                    f"apply path {label}{suffix}: reachable from FSM "
+                    f"root {graphlib.short(root)} (chain: {rendered}) — "
+                    "replicas/replays diverge; inject it at the propose "
+                    "door instead"))
+        return out
+
+    def check(self, mod: Module) -> list[Violation]:
+        return []

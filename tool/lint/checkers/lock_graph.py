@@ -1,0 +1,151 @@
+"""Interprocedural lock-discipline (rule: lock-graph, codes CFL1xx).
+
+PR 1's CFL001–003 are lexical: they see `time.sleep` only when it sits
+TEXTUALLY inside a `with lock:` block. A helper that sleeps two frames
+down — the exact shape that sank the raft heartbeat — was invisible.
+These checkers ride the interprocedural engine (tool/lint/graph.py):
+
+  CFL101  a call made while holding a lock reaches a blocking effect
+          (time.sleep / blocking RPC / native-plane ctypes call)
+          somewhere in its transitive callee tree; the message prints
+          the call chain down to the blocking site
+  CFL102  the static lock-order graph has a cycle: two (or more) code
+          paths acquire the same locks in opposite orders — a potential
+          deadlock. Both acquisition chains are printed. Suppress with
+          `allow[CFL102] <why>` on ANY acquisition edge of the cycle
+          (one justification covers the whole cycle).
+
+False-positive bounds (see graph.py's docstring): calls the resolver
+can't pin contribute nothing, so an unjustified CFL101 is a real
+reachable blocking path modulo dead branches. Lock identity is static
+(`Class.attr`), so two instances of one class merge into one node —
+which is precisely what a lock-ORDER discipline wants.
+"""
+
+from __future__ import annotations
+
+from .. import graph as graphlib
+from ..core import Checker, Module, Violation
+
+_EFFECT_LABEL = {
+    "sleeps": "time.sleep()",
+    "blocking_rpc": "a blocking RPC/socket call",
+    "native_call": "a native-plane (ctypes) call",
+}
+
+
+class LockGraphChecker(Checker):
+    """Project-wide checker: run once over the linked graph, not per
+    module. The cli hands it the graph + the parsed module table."""
+
+    rule = "lock-graph"
+    dirs = ("cubefs_tpu/fs/", "cubefs_tpu/blob/", "cubefs_tpu/parallel/",
+            "cubefs_tpu/utils/fsm.py")
+    project_wide = True
+
+    def check_project(self, g: graphlib.ProjectGraph,
+                      modules: dict[str, Module]) -> list[Violation]:
+        out: list[Violation] = []
+        out.extend(self._transitive_blocking(g, modules))
+        out.extend(self._cycles(g, modules))
+        return out
+
+    # ---- CFL101 ----
+    def _transitive_blocking(self, g: graphlib.ProjectGraph,
+                             modules: dict[str, Module]) -> list[Violation]:
+        out: list[Violation] = []
+        seen: set[tuple] = set()
+        for f in g.funcs.values():
+            if not self.applies(f.relpath):
+                continue
+            for line, targets, held in f.resolved:
+                if not held:
+                    continue
+                for t in targets:
+                    callee = g.funcs.get(t)
+                    if callee is None:
+                        continue
+                    for eff in graphlib.BLOCKING_EFFECTS:
+                        if eff not in callee.effects:
+                            continue
+                        key = (f.relpath, line, eff)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        chain = g.effect_chain(t, eff)
+                        # An allow[CFL101] at the DIRECT effect site
+                        # suppresses every path reaching it: that is
+                        # where "this native op is local-memory/bounded,
+                        # safe under any lock" style invariants live,
+                        # and one justification there beats N identical
+                        # ones at every caller.
+                        if chain and self._site_allowed(
+                                g, modules, *chain[-1]):
+                            continue
+                        rendered = " -> ".join(
+                            f"{graphlib.short(q)}:{ln}" for q, ln in chain)
+                        out.append(self._v(
+                            f.relpath, line, "CFL101",
+                            f"`{graphlib.short(t)}()` called while "
+                            f"holding `{held[-1]}` reaches "
+                            f"{_EFFECT_LABEL[eff]} "
+                            f"(chain: {rendered or t})"))
+        return out
+
+    def _site_allowed(self, g: graphlib.ProjectGraph,
+                      modules: dict[str, Module],
+                      site_q: str, site_line: int) -> bool:
+        site = g.funcs.get(site_q)
+        if site is None:
+            return False
+        mod = modules.get(site.relpath)
+        if mod is None:
+            return False
+        allow = mod.allow_at(site_line)
+        if not allow:
+            return False
+        return any(k in ("CFL101", self.rule, "*") and why
+                   for k, why in allow.items())
+
+    # ---- CFL102 ----
+    def _cycles(self, g: graphlib.ProjectGraph,
+                modules: dict[str, Module]) -> list[Violation]:
+        out: list[Violation] = []
+        for edges in g.lock_cycles():
+            if not any(self.applies(e.relpath) for e in edges):
+                continue
+            # one justification anywhere on the cycle covers it
+            if any(self._edge_allowed(e, modules) for e in edges):
+                continue
+            nodes = " -> ".join([e.src for e in edges] + [edges[0].src])
+            chains = []
+            for e in edges:
+                via = f" via {graphlib.short(e.via)}" if e.via else ""
+                chains.append(
+                    f"{e.src} then {e.dst} in "
+                    f"{graphlib.short(e.func)} ({e.relpath}:{e.line}{via})")
+            anchor = edges[0]
+            out.append(self._v(
+                anchor.relpath, anchor.line, "CFL102",
+                f"lock-order cycle {nodes} — potential deadlock; "
+                "acquisition chains: " + "; ".join(chains)))
+        return out
+
+    def _edge_allowed(self, e: graphlib.LockEdge,
+                      modules: dict[str, Module]) -> bool:
+        mod = modules.get(e.relpath)
+        if mod is None:
+            return False
+        allow = mod.allow_at(e.line)
+        if not allow:
+            return False
+        return any(k in ("CFL102", self.rule, "*") and why
+                   for k, why in allow.items())
+
+    def _v(self, relpath: str, line: int, code: str,
+           message: str) -> Violation:
+        return Violation(code, self.rule, relpath, line, message)
+
+    # project_wide checkers don't run the per-module interface
+    def check(self, mod: Module) -> list[Violation]:
+        return []

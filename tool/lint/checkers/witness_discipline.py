@@ -1,0 +1,65 @@
+"""Lock-witness discipline (rule: witness-discipline, codes CFS00x).
+
+The runtime half of the concurrency sanitizer
+(`cubefs_tpu/utils/lockwitness.py`) can only watch locks that were
+allocated through its factories — `make_lock(name)` / `make_rlock(name)`
+return plain `threading.Lock`/`RLock` objects when `CUBEFS_SANITIZE` is
+off (zero overhead) and witness-wrapped ones when it's on. A raw
+`threading.Lock()` allocation in the concurrent planes is a blind spot:
+every chaos drill would silently skip it in the dynamic deadlock hunt.
+
+  CFS001  raw threading.Lock()/RLock() allocation in fs/ blob/
+          parallel/ (or utils/fsm.py) — route it through
+          utils/lockwitness.make_lock("Class.attr") so CUBEFS_SANITIZE
+          runs witness it; the name should match the static lock-order
+          graph's node (`Class.attr`)
+
+`threading.Condition(existing_lock)` is fine — the witness wrapper
+implements the Condition protocol. A bare `threading.Condition()`
+allocates its own invisible RLock; pass it a witnessed lock.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+_EXEMPT = ("cubefs_tpu/utils/lockwitness.py",)
+
+
+class WitnessDisciplineChecker(Checker):
+    rule = "witness-discipline"
+    dirs = ("cubefs_tpu/fs/", "cubefs_tpu/blob/", "cubefs_tpu/parallel/",
+            "cubefs_tpu/utils/fsm.py")
+
+    def applies(self, relpath: str) -> bool:
+        return super().applies(relpath) and relpath not in _EXEMPT
+
+    def check(self, mod: Module) -> list[Violation]:
+        threading_aliases = {a for a, full in mod.import_aliases.items()
+                             if full == "threading"} | {"threading"}
+        ctor_names = {alias for alias, full in mod.from_imports.items()
+                      if full in ("threading.Lock", "threading.RLock")}
+        out: list[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            kind = None
+            if isinstance(func, ast.Attribute) and func.attr in (
+                    "Lock", "RLock"):
+                if isinstance(func.value, ast.Name) and \
+                        func.value.id in threading_aliases:
+                    kind = func.attr
+            elif isinstance(func, ast.Name) and func.id in ctor_names:
+                kind = mod.from_imports[func.id].rsplit(".", 1)[-1]
+            if kind is None:
+                continue
+            factory = "make_rlock" if kind == "RLock" else "make_lock"
+            out.append(self.violation(
+                mod, "CFS001", node,
+                f"raw threading.{kind}() is invisible to the lock "
+                f"witness — allocate via lockwitness.{factory}"
+                f"(\"Class.attr\") so CUBEFS_SANITIZE=1 runs watch it"))
+        return out

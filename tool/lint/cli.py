@@ -6,61 +6,189 @@ Usage:
   python -m tool.lint --no-baseline  strict mode: report EVERYTHING
   python -m tool.lint --update-baseline
                                      re-record current findings as the
-                                     accepted baseline
-  python -m tool.lint --select CFL001,rpc-idempotency
+                                     accepted baseline (entries sorted
+                                     by path, code, line)
+  python -m tool.lint --select CFL101,fsm-purity
                                      only the named codes/rules
+  python -m tool.lint --report json  machine-readable report (findings,
+                                     lock-order graph edges + cycles,
+                                     suppression counts) written to
+                                     artifacts/LINT_REPORT_r16.json
+  python -m tool.lint --no-cache     skip the per-module summary cache
 
 Exit status: 0 = no non-baselined violations, 1 = findings, 2 = a file
 failed to parse (always fatal: an unparseable file is unlinted code).
+
+The run is ONE parse pass: every file is parsed once into a
+core.Module, the per-module (lexical) checkers consume it directly, and
+the same objects feed the interprocedural engine (tool/lint/graph.py)
+that backs the project-wide families (lock-graph CFL1xx, fsm-purity
+CFM). Engine summaries are cached under tool/lint/.cache/ keyed by
+content hash, so re-runs skip re-extraction for unchanged files.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+import time
 
-from . import core
-from .checkers import ALL_CHECKERS
+from . import core, graph as graphlib
+from .checkers import ALL_CHECKERS, PROJECT_CHECKERS
 
 DEFAULT_ROOTS = ("cubefs_tpu", "tests", "tool")
 
+# The engine only models the package itself — tests and tooling are not
+# part of the concurrency/determinism surface the graph families check.
+GRAPH_PREFIX = "cubefs_tpu/"
 
-def run_lint(paths: list[str] | None = None,
-             select: set[str] | None = None
-             ) -> tuple[list[core.Violation], list[str]]:
-    """(violations after inline suppressions, parse-error strings)."""
-    checkers = [cls() for cls in ALL_CHECKERS]
-    violations: list[core.Violation] = []
-    errors: list[str] = []
-    for relpath in core.iter_py_files(list(paths or DEFAULT_ROOTS)):
+
+def _parse_modules(relpaths: list[str]) -> tuple[dict, list[str]]:
+    """relpath -> core.Module for every parseable file, + error strings.
+    Reading+parsing fans out across threads (I/O overlaps; parse itself
+    is GIL-bound but cheap next to checking)."""
+    import concurrent.futures
+
+    def load(relpath):
         try:
             with open(os.path.join(core.REPO_ROOT, relpath),
                       encoding="utf-8") as f:
                 source = f.read()
-            mod = core.Module(relpath, source)
+            return relpath, core.Module(relpath, source), None
         except (SyntaxError, UnicodeDecodeError) as e:
-            errors.append(f"{relpath}: {type(e).__name__}: {e}")
-            continue
+            return relpath, None, f"{relpath}: {type(e).__name__}: {e}"
+
+    modules: dict[str, core.Module] = {}
+    errors: list[str] = []
+    if len(relpaths) > 4:
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(8, (os.cpu_count() or 2))) as pool:
+            results = list(pool.map(load, relpaths))
+    else:
+        results = [load(p) for p in relpaths]
+    for relpath, mod, err in results:
+        if err is not None:
+            errors.append(err)
+        else:
+            modules[relpath] = mod
+    return modules, errors
+
+
+def run_lint(paths: list[str] | None = None,
+             select: set[str] | None = None,
+             use_cache: bool = True,
+             collect_graph: bool = False):
+    """(violations after inline suppressions, parse-error strings).
+    With collect_graph=True returns (violations, errors, stats) where
+    stats carries the engine graph + suppression counts for --report."""
+    checkers = [cls() for cls in ALL_CHECKERS]
+    project_checkers = [cls() for cls in PROJECT_CHECKERS]
+    relpaths = core.iter_py_files(list(paths or DEFAULT_ROOTS))
+    modules, errors = _parse_modules(relpaths)
+
+    violations: list[core.Violation] = []
+    suppressed_count = 0
+    for relpath in sorted(modules):
+        mod = modules[relpath]
         found: list[core.Violation] = []
         for checker in checkers:
             if checker.applies(relpath):
                 found.extend(checker.check(mod))
         found.extend(core.bare_allow_violations(mod))
-        violations.extend(v for v in found if not mod.suppressed(v))
+        for v in found:
+            if mod.suppressed(v):
+                suppressed_count += 1
+            else:
+                violations.append(v)
+
+    # ---- whole-program pass ----
+    # The engine wants the full package picture even when the user lints
+    # a single file, so graph modules are loaded independently of the
+    # requested paths (summary cache keeps this cheap).
+    graph_stats: dict = {}
+    g = None
+    if project_checkers:
+        graph_modules = {p: m for p, m in modules.items()
+                         if p.startswith(GRAPH_PREFIX)}
+        missing = [p for p in core.iter_py_files([GRAPH_PREFIX.rstrip("/")])
+                   if p not in graph_modules]
+        if missing:
+            extra, extra_errs = _parse_modules(missing)
+            graph_modules.update(extra)
+            errors.extend(extra_errs)
+        t0 = time.perf_counter()
+        g = graphlib.ProjectGraph.build(
+            graph_modules,
+            cache_dir=graphlib.default_cache_dir() if use_cache else None)
+        graph_stats["graph_build_seconds"] = round(
+            time.perf_counter() - t0, 4)
+        graph_stats["functions"] = len(g.funcs)
+        only_requested = {p for p in modules}
+        for checker in project_checkers:
+            for v in checker.check_project(g, graph_modules):
+                if v.path not in only_requested:
+                    continue  # user linted specific paths: stay scoped
+                mod = graph_modules.get(v.path) or modules.get(v.path)
+                if mod is not None and mod.suppressed(v):
+                    suppressed_count += 1
+                else:
+                    violations.append(v)
+
     if select:
         violations = [v for v in violations
                       if v.code in select or v.rule in select]
     violations.sort(key=lambda v: (v.path, v.line, v.code))
+    if collect_graph:
+        graph_stats["inline_suppressions_honored"] = suppressed_count
+        graph_stats["graph"] = g
+        return violations, errors, graph_stats
     return violations, errors
+
+
+def write_report(path: str, violations, fresh, errors, stats) -> None:
+    g = stats.get("graph")
+    payload = {
+        "generated_by": "python -m tool.lint --report json",
+        "findings": [
+            {"code": v.code, "rule": v.rule, "path": v.path,
+             "line": v.line, "message": v.message,
+             "baselined": v not in fresh}
+            for v in violations],
+        "counts": {
+            "total": len(violations),
+            "fresh": len(fresh),
+            "baselined": len(violations) - len(fresh),
+            "inline_suppressions_honored":
+                stats.get("inline_suppressions_honored", 0),
+            "parse_errors": len(errors),
+        },
+        "lock_order_graph": {
+            "edges": g.edges_json() if g is not None else [],
+            "cycles": [
+                [{"src": e.src, "dst": e.dst,
+                  "at": f"{e.relpath}:{e.line}"} for e in cyc]
+                for cyc in (g.lock_cycles() if g is not None else [])],
+        },
+        "engine": {
+            "functions": stats.get("functions", 0),
+            "graph_build_seconds": stats.get("graph_build_seconds"),
+        },
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
 
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="cubefs-tpu-lint",
         description="repo-specific static analysis "
-                    "(tracer-safety, lock-discipline, rpc-idempotency, "
-                    "retry-discipline, tier1-purity)")
+                    "(tracer-safety, lock-discipline + interprocedural "
+                    "lock-graph, fsm-purity, rpc-idempotency, "
+                    "retry-discipline, tier1-purity, witness-discipline)")
     p.add_argument("paths", nargs="*", help="files/dirs to lint "
                    f"(default: {', '.join(DEFAULT_ROOTS)})")
     p.add_argument("--no-baseline", action="store_true",
@@ -71,13 +199,23 @@ def main(argv: list[str] | None = None) -> int:
                    help="alternate baseline file path")
     p.add_argument("--select", default=None,
                    help="comma-separated codes/rules to report")
+    p.add_argument("--report", choices=("json",), default=None,
+                   help="also write a machine-readable report")
+    p.add_argument("--report-path",
+                   default=os.path.join(core.REPO_ROOT, "artifacts",
+                                        "LINT_REPORT_r16.json"),
+                   help="where --report json writes")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the per-module summary cache")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the per-violation listing")
     args = p.parse_args(argv)
 
     select = (set(s.strip() for s in args.select.split(",") if s.strip())
               if args.select else None)
-    violations, errors = run_lint(args.paths or None, select)
+    violations, errors, stats = run_lint(
+        args.paths or None, select, use_cache=not args.no_cache,
+        collect_graph=True)
 
     for err in errors:
         print(f"PARSE ERROR {err}", file=sys.stderr)
@@ -92,6 +230,11 @@ def main(argv: list[str] | None = None) -> int:
     else:
         fresh = core.apply_baseline(
             violations, core.load_baseline(args.baseline))
+
+    if args.report == "json":
+        write_report(args.report_path, violations, fresh, errors, stats)
+        if not args.quiet:
+            print(f"report written: {args.report_path}")
 
     if not args.quiet:
         for v in fresh:

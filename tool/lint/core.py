@@ -87,7 +87,23 @@ class Module:
         return False
 
     def segment(self, node: ast.AST) -> str:
-        return ast.get_source_segment(self.source, node) or ""
+        # Slice from the cached line list instead of
+        # ast.get_source_segment, which re-splits the whole source on
+        # every call (it dominated lint wall time at ~60%). Form feeds
+        # make str.splitlines disagree with ast line numbers, so those
+        # rare files take the slow path.
+        if "\f" in self.source:
+            return ast.get_source_segment(self.source, node) or ""
+        try:
+            lo, hi = node.lineno, node.end_lineno
+            if hi == lo:
+                return self.lines[lo - 1][node.col_offset:node.end_col_offset]
+            parts = [self.lines[lo - 1][node.col_offset:]]
+            parts.extend(self.lines[lo:hi - 1])
+            parts.append(self.lines[hi - 1][:node.end_col_offset])
+            return "\n".join(parts)
+        except (AttributeError, IndexError, TypeError):
+            return ast.get_source_segment(self.source, node) or ""
 
 
 class Checker:
@@ -162,11 +178,16 @@ def load_baseline(path: str | None = None) -> dict[str, int]:
 
 
 def save_baseline(violations: list[Violation], path: str | None = None) -> None:
+    """Entries are sorted by (path, code, line) — NOT lexically on the
+    fingerprint string, where line numbers sort as text ("12" < "3") and
+    a one-line shift reshuffles the whole file's block. Deterministic
+    positional order keeps baseline diffs minimal and reviewable."""
     path = path or baseline_path()
     payload = {
         "comment": "Pre-existing lint findings recorded, not blocking. "
                    "Regenerate with: python -m tool.lint --update-baseline",
-        "violations": sorted(v.fingerprint for v in violations),
+        "violations": [v.fingerprint for v in sorted(
+            violations, key=lambda v: (v.path, v.code, v.line))],
     }
     with open(path, "w") as f:
         json.dump(payload, f, indent=2)

@@ -1,0 +1,868 @@
+"""Interprocedural analysis engine: project call graph + effect summaries.
+
+One pass over the parsed modules builds, per function, a summary of the
+effects it performs *directly* —
+
+  sleeps          time.sleep() / <clock>.sleep()
+  blocking_rpc    rpc.call / rpc.call_replicas / pool.get(..).call /
+                  socket.create_connection
+  native_call     lib.ms_* / cfs_* / es_* ... ctypes-plane calls
+  reads_wallclock time.time()/monotonic()/datetime.now()/...
+  reads_random    random.* / uuid.uuid4 / os.urandom / secrets.*
+  reads_environ   os.environ / os.getenv
+  unordered_iter  iterating a set (hash-randomized order across replicas)
+
+— plus the lock sites it acquires and every call it makes (with the
+lock stack held at that call site). A bounded, cycle-safe fixpoint then
+propagates effects and lock acquisitions over the call graph, so a
+checker can ask "can anything reachable from this statement block /
+read the clock?" instead of only "does this line, textually?".
+
+Call resolution is deliberately conservative and documented here:
+
+  * bare names        -> same-module function (incl. the enclosing
+                         function's nested defs) or a project
+                         from-import; a class name resolves to __init__
+  * self.method       -> same class, then project base classes (MRO by
+                         declared base names)
+  * alias.func        -> project module function via the import table
+  * getattr(self, f"_apply_{..}")
+                      -> every self method with that prefix (the FSM
+                         dispatch idiom)
+  * recv.method       -> a PROJECT-defined method iff the name is
+                         defined by exactly one project class and is
+                         not a generic container/file verb
+
+Anything else contributes no effects: the analysis under-approximates
+(a missed edge can hide a finding, never invent one). Lock identity is
+static: ``self.X`` in class C is the node ``C.X``; a receiver-variable
+acquire ``mp._lock`` is normalized to ``C._lock`` when exactly one
+class owns a lock attr of that name, else it stays a distinct
+``mp._lock`` node. Per-instance locks of one class intentionally merge
+into one node — that is what a lock-ORDER graph measures.
+
+Per-module summaries are cached in ``tool/lint/.cache/`` keyed by
+content hash (satellite: keeps tier-1 lint wall time flat), and
+extraction runs across a thread pool.
+"""
+
+from __future__ import annotations
+
+import ast
+import concurrent.futures
+import hashlib
+import json
+import os
+import re
+
+from .core import REPO_ROOT, Module
+
+ENGINE_VERSION = 3  # bump to invalidate cached summaries
+
+EFFECTS = ("sleeps", "blocking_rpc", "native_call", "reads_wallclock",
+           "reads_random", "reads_environ", "unordered_iter")
+BLOCKING_EFFECTS = ("sleeps", "blocking_rpc", "native_call")
+
+_NATIVE_PREFIX_RE = re.compile(r"^(?:ms|cfs|cs|ds|es|kv|bp|gf|rt)_")
+_LIBLIKE_RE = re.compile(r"(?:^|_)lib$|^lib|_lib\b")
+_LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|locks?|mu|mutex)$", re.IGNORECASE)
+
+_WALLCLOCK_TIME_ATTRS = {"time", "time_ns", "monotonic", "monotonic_ns",
+                         "perf_counter", "perf_counter_ns"}
+_WALLCLOCK_DT_ATTRS = {"now", "utcnow", "today"}
+
+# recv.method unique-match resolution skips generic verbs that stdlib
+# containers/files/threads also expose — a `buf.write()` must not
+# resolve to some project class's `write` by coincidence.
+_GENERIC_METHOD_NAMES = {
+    "get", "put", "set", "add", "pop", "append", "extend", "remove",
+    "clear", "copy", "update", "items", "keys", "values", "index",
+    "count", "sort", "read", "write", "close", "open", "flush", "seek",
+    "send", "recv", "join", "run", "name", "encode", "decode", "strip",
+    "split", "format", "replace", "startswith", "endswith", "lower",
+    "upper", "acquire", "release", "wait", "notify", "notify_all",
+    "isoformat", "total_seconds", "result", "done", "cancel",
+}
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _final_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    name = _final_name(expr)
+    return bool(name) and (_LOCK_NAME_RE.search(name) is not None
+                           or "lock" in name.lower())
+
+
+def _walk_no_nested_defs(root: ast.AST):
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _module_name(relpath: str) -> str:
+    return relpath[:-3].replace("/", ".") if relpath.endswith(".py") \
+        else relpath.replace("/", ".")
+
+
+def _resolve_relative(relpath: str, module: str | None, level: int) -> str:
+    """'from ..utils import rpc' in cubefs_tpu/fs/x.py -> cubefs_tpu.utils."""
+    if level == 0:
+        return module or ""
+    pkg = _module_name(relpath).split(".")[:-level]
+    return ".".join(pkg + ([module] if module else []))
+
+
+# ---------------- per-module summary extraction ----------------
+
+class _FuncExtractor(ast.NodeVisitor):
+    """Walks ONE function body (nested defs excluded) collecting direct
+    effects, lock acquisitions (with the stack held at the acquire) and
+    call sites (with the stack held at the call)."""
+
+    def __init__(self, mod_meta: dict, cls: str | None):
+        self.meta = mod_meta
+        self.cls = cls
+        self.direct: dict[str, int] = {}
+        self.default_effects: dict[str, int] = {}
+        self.acquires: list[list] = []   # [lock, line, held-before]
+        self.calls: list[list] = []      # [line, kind, arg, held]
+        self._held: list[str] = []
+
+    # -- lock naming --
+    def lock_node(self, expr: ast.AST) -> str:
+        if isinstance(expr, ast.Call):  # with self._lock_for(x): ...
+            expr = expr.func
+        if isinstance(expr, ast.Attribute):
+            recv = expr.value
+            if isinstance(recv, ast.Name) and recv.id == "self" and self.cls:
+                return f"{self.cls}.{expr.attr}"
+            head = _final_name(recv)
+            return f"{head or '?'}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            return f"{self.meta['modbase']}.{expr.id}"
+        return "?"
+
+    def _effect(self, name: str, line: int) -> None:
+        self.direct.setdefault(name, line)
+
+    # -- traversal --
+    def walk_body(self, stmts) -> None:
+        for s in stmts:
+            self._visit(s)
+
+    def _visit(self, node) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(node, ast.With):
+            self._visit_with(node)
+            return
+        self._scan_node(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    def _visit_with(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            self._visit(item.context_expr)
+            if _is_lockish(item.context_expr):
+                lock = self.lock_node(item.context_expr)
+                self.acquires.append([lock, node.lineno, list(self._held)])
+                self._held.append(lock)
+                pushed += 1
+        for stmt in node.body:
+            self._visit(stmt)
+        for _ in range(pushed):
+            self._held.pop()
+
+    # -- per-node effect/call scan --
+    def _scan_node(self, node) -> None:
+        meta = self.meta
+        if isinstance(node, ast.Attribute):
+            if (_dotted(node) in meta["environ_names"]
+                    and not isinstance(getattr(node, "ctx", None), ast.Store)):
+                self._effect("reads_environ", node.lineno)
+            return
+        if isinstance(node, (ast.For, ast.comprehension)):
+            it = node.iter
+            if isinstance(it, ast.Set) or (
+                    isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                    and it.func.id in ("set", "frozenset")):
+                line = getattr(node, "lineno", getattr(it, "lineno", 0))
+                self._effect("unordered_iter", line)
+            return
+        if not isinstance(node, ast.Call):
+            return
+        line = node.lineno
+        func = node.func
+        dotted = _dotted(func)
+        head = dotted.split(".", 1)[0] if dotted else ""
+
+        # ---- direct effects ----
+        if dotted:
+            tail = dotted.rsplit(".", 1)[-1]
+            if tail == "sleep":
+                recv = dotted.rsplit(".", 1)[0].split(".")[-1]
+                if recv in meta["time_aliases"] or "clock" in recv.lower():
+                    self._effect("sleeps", line)
+            if (head in meta["time_aliases"]
+                    and tail in _WALLCLOCK_TIME_ATTRS):
+                self._effect("reads_wallclock", line)
+            if head in meta["datetime_aliases"] and tail in _WALLCLOCK_DT_ATTRS:
+                self._effect("reads_wallclock", line)
+            if head in meta["random_aliases"] or head in meta["secrets_aliases"]:
+                self._effect("reads_random", line)
+            if dotted in ("os.urandom",) or dotted in ("uuid.uuid4",
+                                                       "uuid.uuid1"):
+                self._effect("reads_random", line)
+            if dotted in ("os.getenv",):
+                self._effect("reads_environ", line)
+            if dotted.endswith("socket.create_connection"):
+                self._effect("blocking_rpc", line)
+        if isinstance(func, ast.Name):
+            full = meta["from_imports"].get(func.id, "")
+            if full == "time.sleep":
+                self._effect("sleeps", line)
+            elif full in ("time.time", "time.monotonic", "time.time_ns",
+                          "time.perf_counter", "datetime.datetime.now",
+                          "datetime.datetime.utcnow", "datetime.date.today"):
+                self._effect("reads_wallclock", line)
+            elif full in ("os.urandom", "uuid.uuid4", "uuid.uuid1") \
+                    or full.startswith(("random.", "secrets.")):
+                self._effect("reads_random", line)
+            elif full == "os.getenv":
+                self._effect("reads_environ", line)
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if (_NATIVE_PREFIX_RE.match(attr)
+                    and _LIBLIKE_RE.search(_final_name(func.value) or "")):
+                self._effect("native_call", line)
+            if attr == "call" and isinstance(func.value, ast.Call):
+                inner = func.value.func
+                if isinstance(inner, ast.Attribute) and inner.attr in (
+                        "get", "get_direct"):
+                    self._effect("blocking_rpc", line)  # pool.get(a).call()
+            if attr in ("call", "call_replicas") and head in meta["rpc_aliases"]:
+                self._effect("blocking_rpc", line)
+
+        # ---- call-site record ----
+        held = list(self._held)
+        if isinstance(func, ast.Name):
+            self.calls.append([line, "bare", func.id, held])
+        elif isinstance(func, ast.Attribute):
+            if (isinstance(func.value, ast.Name) and func.value.id == "self"):
+                self.calls.append([line, "self", func.attr, held])
+            elif dotted:
+                self.calls.append([line, "dotted", dotted, held])
+            else:
+                self.calls.append([line, "method", f"?.{func.attr}", held])
+        elif isinstance(func, ast.Call):
+            # getattr(self, f"_apply_{op}")(record) — the FSM dispatch
+            prefix = _getattr_self_prefix(func)
+            if prefix is not None:
+                self.calls.append([line, "prefix_self", prefix, held])
+
+    def scan_defaults(self, fn: ast.AST) -> None:
+        """Effects in default-arg exprs run once at import and FREEZE a
+        per-process value — nondeterministic across replicas."""
+        for default in list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None]:
+            sub = _FuncExtractor(self.meta, self.cls)
+            sub._visit(default)
+            for eff, line in sub.direct.items():
+                self.default_effects.setdefault(eff, line)
+
+
+def _getattr_self_prefix(call: ast.Call) -> str | None:
+    f = call.func
+    if not (isinstance(f, ast.Name) and f.id == "getattr"
+            and len(call.args) >= 2):
+        return None
+    target, name = call.args[0], call.args[1]
+    if not (isinstance(target, ast.Name) and target.id == "self"):
+        return None
+    if isinstance(name, ast.JoinedStr) and name.values and isinstance(
+            name.values[0], ast.Constant):
+        return str(name.values[0].value)
+    if isinstance(name, ast.BinOp) and isinstance(name.left, ast.Constant):
+        return str(name.left.value)
+    if isinstance(name, ast.Constant):
+        return str(name.value)
+    return None
+
+
+def extract_module_summary(mod: Module) -> dict:
+    """The cacheable per-module half of the analysis: imports, classes
+    and per-function {effects, acquires, calls} — everything link +
+    fixpoint need, with no AST objects inside."""
+    relpath = mod.relpath
+    modbase = os.path.basename(relpath)[:-3]
+    # alias maps (absolute module names, relative imports resolved)
+    imports: dict[str, str] = {}
+    from_imports: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = _resolve_relative(relpath, node.module, node.level)
+            for a in node.names:
+                full = f"{base}.{a.name}" if base else a.name
+                from_imports[a.asname or a.name] = full
+
+    meta = {
+        "modbase": modbase,
+        "from_imports": from_imports,
+        "time_aliases": {a for a, f in imports.items() if f == "time"}
+        | {"time"},
+        "datetime_aliases": {a for a, f in imports.items()
+                             if f == "datetime"} | {"datetime"}
+        | {a for a, f in from_imports.items()
+           if f in ("datetime.datetime", "datetime.date")},
+        "random_aliases": {a for a, f in imports.items() if f == "random"}
+        | {"random"},
+        "secrets_aliases": {a for a, f in imports.items() if f == "secrets"}
+        | {"secrets"},
+        "rpc_aliases": {a for a, f in imports.items()
+                        if f.endswith("rpc")} | {"rpc"}
+        | {a for a, f in from_imports.items() if f.endswith(".rpc")},
+        "environ_names": {"os.environ"} | {
+            a + ".environ" for a, f in imports.items() if f == "os"}
+        | {a for a, f in from_imports.items() if f == "os.environ"},
+    }
+
+    classes: dict[str, dict] = {}
+    funcs: list[dict] = []
+
+    def handle_function(fn, cls: str | None, prefix: str = ""):
+        q = (f"{cls}.{prefix}{fn.name}" if cls else f"{prefix}{fn.name}")
+        ex = _FuncExtractor(meta, cls)
+        ex.scan_defaults(fn)
+        ex.walk_body(fn.body)
+        # nested defs: register under the enclosing function so bare
+        # calls inside the parent resolve to them
+        nested = {}
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt is not fn:
+                inner_q = (f"{cls}.{stmt.name}@{fn.name}" if cls
+                           else f"{stmt.name}@{fn.name}")
+                nested[stmt.name] = inner_q
+                inner_ex = _FuncExtractor(meta, cls)
+                inner_ex.scan_defaults(stmt)
+                inner_ex.walk_body(stmt.body)
+                funcs.append({
+                    "q": inner_q, "line": stmt.lineno, "cls": cls,
+                    "direct": inner_ex.direct,
+                    "default_effects": inner_ex.default_effects,
+                    "acquires": inner_ex.acquires,
+                    "calls": inner_ex.calls, "locals": {},
+                })
+        funcs.append({
+            "q": q, "line": fn.lineno, "cls": cls,
+            "direct": ex.direct, "default_effects": ex.default_effects,
+            "acquires": ex.acquires, "calls": ex.calls, "locals": nested,
+        })
+
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            handle_function(node, None)
+        elif isinstance(node, ast.ClassDef):
+            bases = [_dotted(b) or _final_name(b) for b in node.bases]
+            methods = []
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.append(item.name)
+                    handle_function(item, node.name)
+            classes[node.name] = {"bases": bases, "methods": methods,
+                                  "line": node.lineno}
+
+    return {"version": ENGINE_VERSION, "imports": imports,
+            "from_imports": from_imports, "classes": classes,
+            "funcs": funcs}
+
+
+# ---------------- summary cache ----------------
+
+def default_cache_dir() -> str:
+    return os.path.join(REPO_ROOT, "tool", "lint", ".cache")
+
+
+def _cached_summary(relpath: str, source: str,
+                    cache_dir: str | None) -> dict | None:
+    if not cache_dir:
+        return None
+    h = hashlib.sha256(
+        f"{ENGINE_VERSION}\n{relpath}\n".encode() + source.encode()
+    ).hexdigest()
+    path = os.path.join(cache_dir, f"{h}.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") == ENGINE_VERSION:
+            return data
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _store_summary(relpath: str, source: str, summary: dict,
+                   cache_dir: str | None) -> None:
+    if not cache_dir:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        h = hashlib.sha256(
+            f"{ENGINE_VERSION}\n{relpath}\n".encode() + source.encode()
+        ).hexdigest()
+        tmp = os.path.join(cache_dir, f".{h}.tmp.{os.getpid()}")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(summary, f, default=sorted)  # sets -> sorted lists
+        os.replace(tmp, os.path.join(cache_dir, f"{h}.json"))
+    except OSError:
+        pass  # cache is best-effort
+
+
+def _thaw(summary: dict) -> dict:
+    """JSON round-trips the alias sets as lists; extraction-time code
+    paths never run on a cache hit so only link-time fields matter."""
+    return summary
+
+
+# ---------------- the linked project graph ----------------
+
+class Func:
+    __slots__ = ("qname", "relpath", "cls", "name", "line", "direct",
+                 "default_effects", "acquires", "calls", "locals",
+                 "effects", "effect_via", "acquires_all", "resolved")
+
+    def __init__(self, relpath: str, rec: dict):
+        self.qname = f"{relpath}::{rec['q']}"
+        self.relpath = relpath
+        self.cls = rec.get("cls")
+        self.name = rec["q"].rsplit(".", 1)[-1].split("@")[0]
+        self.line = rec["line"]
+        self.direct = dict(rec.get("direct") or {})
+        self.default_effects = dict(rec.get("default_effects") or {})
+        self.acquires = [tuple(a) if not isinstance(a, tuple) else a
+                         for a in (rec.get("acquires") or [])]
+        self.calls = rec.get("calls") or []
+        self.locals = rec.get("locals") or {}
+        # filled by link/fixpoint:
+        self.effects: set[str] = set(self.direct) | set(self.default_effects)
+        self.effect_via: dict[str, tuple] = {
+            e: (ln, None) for e, ln in self.direct.items()}
+        for e, ln in self.default_effects.items():
+            self.effect_via.setdefault(e, (ln, "<default-arg>"))
+        self.acquires_all: dict[str, tuple] = {}
+        self.resolved: list[tuple] = []  # (line, (qnames...), held-tuple)
+
+
+class LockEdge:
+    __slots__ = ("src", "dst", "relpath", "line", "func", "via")
+
+    def __init__(self, src, dst, relpath, line, func, via=None):
+        self.src, self.dst = src, dst
+        self.relpath, self.line, self.func, self.via = relpath, line, func, via
+
+    def key(self):
+        return (self.src, self.dst)
+
+
+class ProjectGraph:
+    def __init__(self):
+        self.funcs: dict[str, Func] = {}
+        self.modules: dict[str, dict] = {}   # relpath -> summary
+        self.lock_edges: dict[tuple, LockEdge] = {}
+        self.lock_sites: dict[str, set] = {}  # lock -> {(relpath, line)}
+        self._method_index: dict[str, list[str]] = {}
+        self._class_index: dict[str, list[tuple[str, dict]]] = {}
+        self._mod_by_name: dict[str, str] = {}
+
+    # -------- build --------
+    @classmethod
+    def build(cls, modules: dict[str, Module],
+              cache_dir: str | None = None,
+              parallel: bool = True) -> "ProjectGraph":
+        """modules: relpath -> parsed core.Module (the cli's single
+        parse pass). Summary extraction is cached by content hash and
+        fanned across threads; link + fixpoint always run (cheap)."""
+        g = cls()
+        items = sorted(modules.items())
+
+        def summarize(item):
+            relpath, mod = item
+            cached = _cached_summary(relpath, mod.source, cache_dir)
+            if cached is not None:
+                return relpath, cached, True
+            summary = extract_module_summary(mod)
+            # normalize sets for parity with the JSON round-trip
+            summary = json.loads(json.dumps(summary, default=sorted))
+            _store_summary(relpath, mod.source, summary, cache_dir)
+            return relpath, summary, False
+
+        if parallel and len(items) > 4:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(8, (os.cpu_count() or 2))) as pool:
+                results = list(pool.map(summarize, items))
+        else:
+            results = [summarize(i) for i in items]
+        for relpath, summary, _hit in results:
+            g.modules[relpath] = summary
+        g._link()
+        g._fixpoint()
+        g._build_lock_graph()
+        return g
+
+    # -------- link --------
+    def _link(self) -> None:
+        for relpath, summary in self.modules.items():
+            self._mod_by_name[_module_name(relpath)] = relpath
+            for rec in summary["funcs"]:
+                f = Func(relpath, rec)
+                self.funcs[f.qname] = f
+            for cname, cinfo in summary["classes"].items():
+                self._class_index.setdefault(cname, []).append(
+                    (relpath, cinfo))
+                for m in cinfo["methods"]:
+                    if m not in _GENERIC_METHOD_NAMES:
+                        self._method_index.setdefault(m, []).append(
+                            f"{relpath}::{cname}.{m}")
+        for f in self.funcs.values():
+            summary = self.modules[f.relpath]
+            for call in f.calls:
+                line, kind, arg, held = call
+                targets = self._resolve(f, summary, kind, arg)
+                if targets:
+                    f.resolved.append((line, tuple(targets), tuple(held)))
+
+    def _project_module(self, modname: str) -> str | None:
+        """Module name -> relpath, accepting package inits."""
+        if modname in self._mod_by_name:
+            return self._mod_by_name[modname]
+        return None
+
+    def _module_attr(self, modname: str, attr: str) -> list[str]:
+        rel = self._project_module(modname)
+        if rel is None:
+            return []
+        summary = self.modules[rel]
+        q = f"{rel}::{attr}"
+        if q in self.funcs:
+            return [q]
+        if attr in summary["classes"]:
+            init = f"{rel}::{attr}.__init__"
+            return [init] if init in self.funcs else []
+        return []
+
+    def _class_methods(self, relpath: str, cname: str, mname: str,
+                       depth: int = 0) -> list[str]:
+        """Resolve a method on class `cname` (declared in relpath),
+        walking declared project bases, bounded depth."""
+        if depth > 6:
+            return []
+        summary = self.modules.get(relpath)
+        if summary is None or cname not in summary["classes"]:
+            return []
+        cinfo = summary["classes"][cname]
+        if mname in cinfo["methods"]:
+            return [f"{relpath}::{cname}.{mname}"]
+        out: list[str] = []
+        for base in cinfo["bases"]:
+            basename = base.split(".")[-1]
+            # resolve the base class's module via this module's imports
+            full = summary["from_imports"].get(base) or \
+                summary["from_imports"].get(basename)
+            if full:
+                mod, _, cls2 = full.rpartition(".")
+                rel2 = self._project_module(mod)
+                if rel2:
+                    out.extend(self._class_methods(rel2, cls2, mname,
+                                                   depth + 1))
+                    continue
+            if basename in summary["classes"]:
+                out.extend(self._class_methods(relpath, basename, mname,
+                                               depth + 1))
+                continue
+            for rel2, _info in self._class_index.get(basename, []):
+                out.extend(self._class_methods(rel2, basename, mname,
+                                               depth + 1))
+        return out
+
+    def _resolve(self, f: Func, summary: dict, kind: str,
+                 arg: str) -> list[str]:
+        if kind == "bare":
+            if arg in f.locals:
+                q = f"{f.relpath}::{f.locals[arg]}"
+                return [q] if q in self.funcs else []
+            q = f"{f.relpath}::{arg}"
+            if q in self.funcs:
+                return [q]
+            if arg in summary["classes"]:
+                init = f"{f.relpath}::{arg}.__init__"
+                return [init] if init in self.funcs else []
+            full = summary["from_imports"].get(arg)
+            if full:
+                mod, _, attr = full.rpartition(".")
+                if self._project_module(full):
+                    return []  # imported module used as a callable? no
+                out = self._module_attr(mod, attr)
+                if out:
+                    return out
+                # from-import of a class: constructor
+                rel2 = self._project_module(mod)
+                if rel2 and attr in self.modules[rel2]["classes"]:
+                    init = f"{rel2}::{attr}.__init__"
+                    return [init] if init in self.funcs else []
+            return []
+        if kind == "self":
+            if f.cls:
+                return self._class_methods(f.relpath, f.cls, arg)
+            return []
+        if kind == "prefix_self":
+            if not f.cls:
+                return []
+            out = []
+            for q, g2 in self.funcs.items():
+                if (g2.relpath == f.relpath and g2.cls == f.cls
+                        and g2.name.startswith(arg)):
+                    out.append(q)
+            return out
+        if kind == "dotted":
+            parts = arg.split(".")
+            head = parts[0]
+            if head == "self" and len(parts) >= 3:
+                # self.attr.method(...) — receiver type unknown; fall
+                # through to unique-method match on the final attr
+                return self._unique_method(parts[-1])
+            full_head = summary["imports"].get(head) \
+                or summary["from_imports"].get(head)
+            if full_head:
+                if len(parts) == 2:
+                    out = self._module_attr(full_head, parts[1])
+                    if out:
+                        return out
+                    # alias.Class(...) matched at call position means
+                    # attribute call like raftlib.register_routes — or a
+                    # class ctor
+                    rel2 = self._project_module(full_head)
+                    if rel2 and parts[1] in self.modules[rel2]["classes"]:
+                        init = f"{rel2}::{parts[1]}.__init__"
+                        return [init] if init in self.funcs else []
+                    return []
+                if len(parts) == 3:
+                    # pkg.mod.func or mod.Class.method
+                    out = self._module_attr(f"{full_head}.{parts[1]}",
+                                            parts[2])
+                    if out:
+                        return out
+                    rel2 = self._project_module(full_head)
+                    if rel2:
+                        return self._class_methods(rel2, parts[1], parts[2])
+                    return []
+                return []
+            # ClassName.method(...) in the same module
+            if head in summary["classes"]:
+                return self._class_methods(f.relpath, head, parts[-1])
+            # receiver variable: recv.method — unique project match
+            return self._unique_method(parts[-1])
+        if kind == "method":
+            return self._unique_method(arg.rsplit(".", 1)[-1])
+        return []
+
+    def _unique_method(self, mname: str) -> list[str]:
+        cands = self._method_index.get(mname, [])
+        return list(cands) if len(cands) == 1 else []
+
+    # -------- fixpoint --------
+    def _fixpoint(self) -> None:
+        """Propagate effects + transitive lock acquisitions. Bounded:
+        each pass only adds effects/locks, the lattice is finite, and a
+        hard pass cap keeps pathological graphs terminating."""
+        for f in self.funcs.values():
+            for lock, line, _held in f.acquires:
+                f.acquires_all.setdefault(lock, (line, None))
+        for _pass in range(80):
+            changed = False
+            for f in self.funcs.values():
+                for line, targets, _held in f.resolved:
+                    for t in targets:
+                        g = self.funcs.get(t)
+                        if g is None or g is f:
+                            continue
+                        for e in g.effects:
+                            if e not in f.effects:
+                                f.effects.add(e)
+                                f.effect_via[e] = (line, t)
+                                changed = True
+                        for lock in g.acquires_all:
+                            if lock not in f.acquires_all:
+                                f.acquires_all[lock] = (line, t)
+                                changed = True
+            if not changed:
+                break
+
+    # -------- lock-order graph --------
+    def _normalize_lock(self, lock: str) -> str:
+        return self._lock_alias.get(lock, lock)
+
+    def _build_lock_graph(self) -> None:
+        # owner normalization: "mp._lock" -> "MetaPartition._lock" when
+        # exactly one class acquires a self-lock named "_lock"
+        owners: dict[str, set[str]] = {}
+        class_names = set(self._class_index)
+        for f in self.funcs.values():
+            for lock, _line, _held in f.acquires:
+                head, _, attr = lock.partition(".")
+                if head in class_names:
+                    owners.setdefault(attr, set()).add(head)
+        self._lock_alias: dict[str, str] = {}
+        for f in self.funcs.values():
+            for lock, _l, _h in f.acquires:
+                head, _, attr = lock.partition(".")
+                if head not in class_names and attr and \
+                        len(owners.get(attr, ())) == 1:
+                    owner = next(iter(owners[attr]))
+                    self._lock_alias[lock] = f"{owner}.{attr}"
+
+        def add_edge(src, dst, relpath, line, func, via=None):
+            src, dst = self._normalize_lock(src), self._normalize_lock(dst)
+            if src == dst:
+                return
+            self.lock_edges.setdefault(
+                (src, dst), LockEdge(src, dst, relpath, line, func, via))
+
+        for f in self.funcs.values():
+            for lock, line, held in f.acquires:
+                self.lock_sites.setdefault(
+                    self._normalize_lock(lock), set()).add((f.relpath, line))
+                for h in held:
+                    add_edge(h, lock, f.relpath, line, f.qname)
+            for line, targets, held in f.resolved:
+                if not held:
+                    continue
+                held_norm = {self._normalize_lock(h) for h in held}
+                for t in targets:
+                    g = self.funcs.get(t)
+                    if g is None:
+                        continue
+                    for lock in g.acquires_all:
+                        if self._normalize_lock(lock) in held_norm:
+                            continue
+                        for h in held:
+                            add_edge(h, lock, f.relpath, line, f.qname,
+                                     via=t)
+
+    # -------- queries --------
+    def func_at(self, relpath: str, qual: str) -> Func | None:
+        return self.funcs.get(f"{relpath}::{qual}")
+
+    def effect_chain(self, qname: str, effect: str,
+                     limit: int = 12) -> list[tuple[str, int]]:
+        """[(qname, line), ...] from `qname` down to the direct site."""
+        chain: list[tuple[str, int]] = []
+        seen = set()
+        cur = self.funcs.get(qname)
+        while cur is not None and len(chain) < limit:
+            via = cur.effect_via.get(effect)
+            if via is None or cur.qname in seen:
+                break
+            seen.add(cur.qname)
+            line, callee = via
+            chain.append((cur.qname, line))
+            if callee is None or callee == "<default-arg>":
+                break
+            cur = self.funcs.get(callee)
+        return chain
+
+    def acquire_chain(self, qname: str, lock: str,
+                      limit: int = 12) -> list[tuple[str, int]]:
+        chain: list[tuple[str, int]] = []
+        seen = set()
+        cur = self.funcs.get(qname)
+        while cur is not None and len(chain) < limit:
+            via = cur.acquires_all.get(lock)
+            if via is None or cur.qname in seen:
+                break
+            seen.add(cur.qname)
+            line, callee = via
+            chain.append((cur.qname, line))
+            if callee is None:
+                break
+            cur = self.funcs.get(callee)
+        return chain
+
+    def lock_cycles(self) -> list[list[LockEdge]]:
+        """Simple cycles in the lock-order graph, deduped by node set.
+        Each cycle is returned as its edge list (A->B, B->..., ->A)."""
+        adj: dict[str, list[str]] = {}
+        for (src, dst) in self.lock_edges:
+            adj.setdefault(src, []).append(dst)
+        cycles: list[list[LockEdge]] = []
+        seen_sets: set[frozenset] = set()
+        for start in sorted(adj):
+            # BFS back to start
+            parent: dict[str, str] = {}
+            queue = [start]
+            visited = {start}
+            found = None
+            while queue and found is None:
+                node = queue.pop(0)
+                for nxt in sorted(adj.get(node, [])):
+                    if nxt == start:
+                        found = node
+                        break
+                    if nxt not in visited:
+                        visited.add(nxt)
+                        parent[nxt] = node
+                        queue.append(nxt)
+            if found is None:
+                continue
+            path = [found]
+            while path[-1] != start:
+                path.append(parent[path[-1]])
+            path.reverse()  # start .. found
+            nodes = frozenset(path)
+            if nodes in seen_sets:
+                continue
+            seen_sets.add(nodes)
+            edges = []
+            for i, node in enumerate(path):
+                nxt = path[(i + 1) % len(path)]
+                edges.append(self.lock_edges[(node, nxt)])
+            cycles.append(edges)
+        return cycles
+
+    def edges_json(self) -> list[dict]:
+        return [{"src": e.src, "dst": e.dst, "at": f"{e.relpath}:{e.line}",
+                 "func": e.func.split("::")[-1],
+                 "via": (e.via.split("::")[-1] if e.via else None)}
+                for (_s, _d), e in sorted(self.lock_edges.items())]
+
+
+def short(qname: str) -> str:
+    """'cubefs_tpu/fs/x.py::C.m' -> 'x.C.m' for chain rendering."""
+    relpath, _, qual = qname.partition("::")
+    return f"{os.path.basename(relpath)[:-3]}.{qual}"
