@@ -263,6 +263,49 @@ def _read_path_view(text: str) -> dict:
     }
 
 
+def _wire_view(text: str) -> dict:
+    """The binary packet-plane digest: frame and byte traffic on both
+    sides of the wire, live mux sessions with their in-flight streams,
+    how long chunks queued behind other streams for the shared send
+    slot, and CRC stream drops (a nonzero drop count with the conn
+    still up is the per-stream failure isolation working; a climbing
+    one means a flaky path). streams/conn >> 1 is the multiplexing
+    win — the legacy serial plane pins it at <= 1."""
+    series = _parse_metrics(text)
+
+    def by_labels(name, *labels):
+        out = {}
+        for n, lb, v in series:
+            if n == name:
+                key = "/".join(lb.get(x, "") for x in labels)
+                out[key] = out.get(key, 0) + v
+        return out
+
+    def total(name):
+        return sum(v for n, _, v in series if n == name)
+
+    conns = total("cubefs_pkt_mux_conns")
+    streams = total("cubefs_pkt_mux_streams")
+    wait_sum = total("cubefs_pkt_mux_queue_wait_seconds_sum")
+    wait_cnt = total("cubefs_pkt_mux_queue_wait_seconds_count")
+    return {
+        "frames": by_labels("cubefs_pkt_frames_total", "side", "dir"),
+        "bytes": by_labels("cubefs_pkt_chunk_bytes_total", "side",
+                           "dir"),
+        "mux": {
+            "conns": conns,
+            "inflight_streams": streams,
+            "streams_per_conn": round(streams / conns, 2)
+            if conns else None,
+            "send_queue_wait_avg_ms":
+                round(1000 * wait_sum / wait_cnt, 3) if wait_cnt else None,
+            "send_queue_waits": wait_cnt,
+        },
+        "stream_drops": by_labels("cubefs_pkt_stream_drops_total",
+                                  "side"),
+    }
+
+
 def _qos_view(text: str) -> dict:
     """The overload-protection digest: per-tenant admit/shed/throttle
     counters, shaping waits, and burn-rate brownout state per path —
@@ -531,7 +574,7 @@ def main(argv=None):
     p_metrics.add_argument("action",
                            choices=["write-path", "codec", "repair", "slo",
                                     "read-path", "qos", "tiering",
-                                    "integrity", "raw"])
+                                    "integrity", "wire", "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
 
@@ -846,6 +889,8 @@ def main(argv=None):
             print(json.dumps(_tiering_view(text), indent=2))
         elif args.action == "integrity":
             print(json.dumps(_integrity_view(text), indent=2))
+        elif args.action == "wire":
+            print(json.dumps(_wire_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
 

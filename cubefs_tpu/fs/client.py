@@ -35,6 +35,7 @@ _META_PACKET_OPS = {"lookup": pkt.OP_META_LOOKUP,
                     "inode_get": pkt.OP_META_INODE_GET,
                     "readdir": pkt.OP_META_READDIR,
                     "submit": pkt.OP_META_SUBMIT,
+                    "submit_batch": pkt.OP_META_SUBMIT_BATCH,
                     "dentry_count": pkt.OP_META_DENTRY_COUNT,
                     "alloc_ino": pkt.OP_META_ALLOC_INO,
                     "walk": pkt.OP_META_WALK}
@@ -42,6 +43,19 @@ _META_PACKET_OPS = {"lookup": pkt.OP_META_LOOKUP,
 # read ops additionally served by the metanode's native C++ read plane
 # (runtime/src/metaserve.cc) when the view advertises meta_read_addrs
 _META_READ_OPS = {"lookup", "inode_get", "readdir", "dentry_count", "walk"}
+
+
+def _op_ids_stamped(method: str, payload: dict) -> bool:
+    """Does every mutation in this meta packet call carry the op_id the
+    metanode FSM dedups? Gates idempotent=True on the binary transport:
+    a reconnect-resend is exactly-once ONLY through that window."""
+    if method == "submit":
+        return "op_id" in payload.get("record", {})
+    if method == "submit_batch":
+        return all("op_id" in r for r in payload.get("records") or ())
+    if method == "alloc_ino":
+        return "op_id" in payload
+    return False
 
 
 
@@ -95,9 +109,15 @@ class SubmitFanout:
         self.k = k
         self._mu = lockwitness.make_lock("SubmitFanout._mu")
         self._queues: dict[int, list[_FanoutWaiter]] = {}
-        self._busy: set[int] = set()
-        self._scheduled: set[int] = set()  # pids with a drain task queued
-        self._gate = threading.Semaphore(k)
+        self._busy: dict[int, int] = {}  # pid -> batches on the wire
+        self._scheduled: dict[int, int] = {}  # pid -> drain tasks queued
+        # per-partition window: with the mux transport (one shared
+        # connection, req_id-demuxed) up to CUBEFS_PKT_WINDOW batches
+        # per partition pipeline on that connection; the legacy serial
+        # transport keeps the one-batch-per-partition discipline (each
+        # extra batch would cost another pooled socket, not a stream)
+        self.window = pkt.window_size() if pkt.mux_enabled() else 1
+        self._gate = threading.Semaphore(max(k, k * self.window))
         self._pool = None  # lazy; only submit_async needs threads
 
     def submit(self, mp: dict, record: dict, timeout: float = 30.0):
@@ -115,16 +135,25 @@ class SubmitFanout:
         pid = mp["pid"]
         with self._mu:
             self._queues.setdefault(pid, []).append(w := _FanoutWaiter(record))
-            schedule = pid not in self._scheduled
+            # one drain task per in-flight slot: up to `window` tasks
+            # per partition keep that many batches pipelined on the mux
+            # connection (legacy window=1 restores one-task-per-burst)
+            cnt = self._scheduled.get(pid, 0)
+            schedule = cnt < self.window
             if schedule:
-                self._scheduled.add(pid)
+                self._scheduled[pid] = cnt + 1
         if schedule:
             self._ensure_pool().submit(self._drain_scheduled, mp)
         return w
 
     def _drain_scheduled(self, mp: dict) -> None:
+        pid = mp["pid"]
         with self._mu:
-            self._scheduled.discard(mp["pid"])
+            n = self._scheduled.get(pid, 1) - 1
+            if n:
+                self._scheduled[pid] = n
+            else:
+                self._scheduled.pop(pid, None)
         self._drain_if_idle(mp)
 
     def close(self) -> None:
@@ -146,7 +175,7 @@ class SubmitFanout:
                 from concurrent.futures import ThreadPoolExecutor
 
                 self._pool = ThreadPoolExecutor(
-                    max_workers=self.k,
+                    max_workers=max(self.k, min(32, self.k * self.window)),
                     thread_name_prefix="meta-fanout")
             return self._pool
 
@@ -155,17 +184,21 @@ class SubmitFanout:
         while True:
             with self._mu:
                 batch = self._queues.get(pid)
-                if not batch or pid in self._busy:
+                if not batch or self._busy.get(pid, 0) >= self.window:
                     return
-                self._busy.add(pid)
+                self._busy[pid] = self._busy.get(pid, 0) + 1
                 self._queues[pid] = []
-                inflight = len(self._busy)
+                inflight = sum(self._busy.values())
             try:
                 _metrics.meta_fanout_inflight.observe(inflight)
                 self._land(mp, batch)
             finally:
                 with self._mu:
-                    self._busy.discard(pid)
+                    n = self._busy.get(pid, 1) - 1
+                    if n:
+                        self._busy[pid] = n
+                    else:
+                        self._busy.pop(pid, None)
             # records queued while we were on the wire ride the next
             # spin (unless another caller already claimed the drain)
 
@@ -335,8 +368,18 @@ class MetaWrapper:
             if cli is None:
                 cli = self._packet_clients[plane] = pkt.PacketClient(
                     plane, timeout=10.0, connect_timeout=2.0)
+            op = _META_PACKET_OPS[method]
+            idem = op in pkt.IDEMPOTENT_OPS
+            if not idem:
+                # mutations are retry-safe on this transport only
+                # because _call_wire / inode_create stamped op_ids
+                # BEFORE the replica loop — assert the contract here,
+                # where the idempotent flag is minted
+                assert _op_ids_stamped(method, payload), \
+                    f"unstamped mutating meta op {method!r}"
+                idem = True
             try:
-                rargs, _ = cli.call(_META_PACKET_OPS[method], args=payload)
+                rargs, _ = cli.call(op, args=payload, idempotent=idem)
                 return rargs
             except pkt.PacketError as e:
                 if e.code is not None:
@@ -825,11 +868,7 @@ class ExtentClient:
                 dp, eid, ext_off = stream
                 leader = self.nodes.get(dp["leader"])
             seg = min(len(data) - done, self.EXTENT_CAP - ext_off)
-            written = 0
-            while written < seg:
-                piece = data[done + written : done + min(written + self.PACKET, seg)]
-                self._leader_write(dp, eid, ext_off + written, piece)
-                written += len(piece)
+            self._write_pieces(dp, eid, ext_off, memoryview(data), done, seg)
             extent_keys.append({
                 "dp_id": dp["dp_id"], "extent_id": eid, "ext_offset": ext_off,
                 "file_offset": file_offset + done, "size": seg,
@@ -989,6 +1028,91 @@ class ExtentClient:
                 except (rpc.RpcError, OSError):
                     _metrics.integrity_repair_failures.inc(plane="fs")
 
+    def _write_pieces(self, dp: dict, eid: int, ext_off: int,
+                      data: memoryview, done: int, seg: int) -> None:
+        """Ship one extent segment as PACKET-granularity pieces. On the
+        mux transport up to CUBEFS_PKT_WINDOW pieces pipeline in flight
+        on the shared connection (the streamer's packet-pipeline shape);
+        the legacy/RPC paths keep the serial piece loop. Pieces land at
+        disjoint absolute offsets, so in-window reordering is harmless —
+        the datanode's per-extent lock orders overlapping writes."""
+        cli, paddr = self._write_plane(dp)
+        if cli is None or not cli.mux:
+            written = 0
+            while written < seg:
+                piece = data[done + written
+                             : done + min(written + self.PACKET, seg)]
+                self._leader_write(dp, eid, ext_off + written, piece)
+                written += len(piece)
+            return
+        from ..utils import packet as pkt
+
+        window = pkt.window_size()
+        futs: list[tuple] = []  # (future, piece offset)
+        written = 0
+        try:
+            while written < seg:
+                piece = data[done + written
+                             : done + min(written + self.PACKET, seg)]
+                off = ext_off + written
+                # absolute bytes at a fixed (extent, offset): a
+                # reconnect-resend rewrites the identical range (the
+                # rpc_allowlist 'write_replica' justification family)
+                fut = cli.call_async(
+                    pkt.OP_WRITE, partition=dp["dp_id"], extent=eid,
+                    offset=off, payload=piece, idempotent=True)
+                futs.append((fut, off))
+                written += len(piece)
+                if len(futs) >= window:
+                    self._collect_write(futs.pop(0), dp, paddr)
+            while futs:
+                self._collect_write(futs.pop(0), dp, paddr)
+        finally:
+            # a failed window must not leave unreaped in-flight pieces
+            for fut, _ in futs:
+                try:
+                    fut.result(cli.timeout)
+                except Exception:
+                    pass
+
+    def _collect_write(self, ent: tuple, dp: dict, paddr: str) -> None:
+        """Resolve one pipelined write piece, mapping failures exactly
+        like the serial `_leader_write` packet leg."""
+        from ..utils import packet as pkt
+
+        fut, off = ent
+        addr = dp["leader"]
+        try:
+            fut.result()
+        except pkt.PacketError as e:
+            raise rpc.RpcError(500, f"packet write: {e}") from None
+        except TimeoutError:
+            self._packet_down[paddr] = time.monotonic() + 30.0
+            raise rpc.RpcError(
+                504, f"packet write to {addr} timed out; "
+                     f"possibly still executing") from None
+        except (ConnectionError, OSError) as e:
+            # unlike the serial leg there is no same-call RPC fallback
+            # mid-window (earlier pieces already rode the packet plane);
+            # negative-cache the plane and surface — the caller owns
+            # the retry, and its next attempt takes the RPC path
+            self._packet_down[paddr] = time.monotonic() + 30.0
+            raise rpc.RpcError(503, f"packet write: {e}") from None
+
+    def _write_plane(self, dp: dict):
+        """The leader's usable packet-plane client, or (None, None) when
+        none is advertised / the plane is in negative-cache cooldown."""
+        paddr = self.packet_addrs.get(dp["leader"])
+        if not paddr or time.monotonic() < self._packet_down.get(paddr, 0.0):
+            return None, None
+        from ..utils import packet as pkt
+
+        cli = self._packet_clients.get(paddr)
+        if cli is None:
+            cli = self._packet_clients[paddr] = pkt.PacketClient(
+                paddr, timeout=30.0, connect_timeout=2.0)
+        return cli, paddr
+
     def _leader_write(self, dp: dict, eid: int, off: int,
                       data: bytes) -> None:
         """One write leg to the designated leader: the binary packet
@@ -1008,8 +1132,9 @@ class ExtentClient:
                 cli = self._packet_clients[paddr] = pkt.PacketClient(
                     paddr, timeout=30.0, connect_timeout=2.0)
             try:
+                # absolute bytes at a fixed (extent, offset): replay-safe
                 cli.call(pkt.OP_WRITE, partition=dp["dp_id"], extent=eid,
-                         offset=off, payload=data)
+                         offset=off, payload=data, idempotent=True)
                 return
             except pkt.PacketError as e:
                 raise rpc.RpcError(500, f"packet write: {e}") from None

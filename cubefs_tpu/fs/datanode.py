@@ -818,7 +818,8 @@ class DataNode:
     # onto the same write/read/repair logic, so both transports share
     # one consistency story (leader routing, raft overwrites, chain).
     def serve_packets(self, host: str = "127.0.0.1",
-                      port: int = 0, audit=None) -> "packet.PacketServer":
+                      port: int = 0, audit=None,
+                      workers: int | None = None) -> "packet.PacketServer":
         from ..utils import packet
 
         def op_write(hdr, args, payload):
@@ -859,7 +860,14 @@ class DataNode:
             packet.OP_FINGERPRINT: op_fingerprint,
             packet.OP_ALLOC_EXTENT: op_alloc,
             packet.OP_PING: op_ping,
-        }, host=host, port=port, service="datanode", audit=audit).start()
+        }, host=host, port=port, service="datanode", audit=audit,
+           workers=workers,
+           # one client's pipelined piece train must apply in arrival
+           # order per extent: write() classifies append-vs-overwrite
+           # by the extent's current size, so pool reordering would
+           # misread disjoint in-window appends as overlap and divert
+           # them through raft (a ~6x write-throughput cliff)
+           ordered_ops={packet.OP_WRITE, packet.OP_WRITE_REPLICA}).start()
         self.packet_addr = srv.addr
         self._packet_srv = srv
         return srv

@@ -1935,7 +1935,8 @@ class MetaNode:
     # methods, so both transports share one semantics (leader redirect,
     # errno encoding, idempotent submits).
     def serve_packets(self, host: str = "127.0.0.1",
-                      port: int = 0, audit=None) -> "packet.PacketServer":
+                      port: int = 0, audit=None,
+                      workers: int | None = None) -> "packet.PacketServer":
         from ..utils import packet
 
         def wrap(rpc_method):
@@ -1959,9 +1960,10 @@ class MetaNode:
             packet.OP_META_INODE_GET: wrap(self.rpc_inode_get),
             packet.OP_META_READDIR: wrap(self.rpc_readdir),
             packet.OP_META_SUBMIT: wrap(self.rpc_submit),
+            packet.OP_META_SUBMIT_BATCH: wrap(self.rpc_submit_batch),
             packet.OP_META_DENTRY_COUNT: wrap(self.rpc_dentry_count),
             packet.OP_META_ALLOC_INO: wrap(self.rpc_alloc_ino),
             packet.OP_META_WALK: wrap(self.rpc_walk),
             packet.OP_PING: lambda hdr, a, p: ({}, b""),
-        }, host, port, service="metanode", audit=audit)
+        }, host, port, service="metanode", audit=audit, workers=workers)
         return srv.start()

@@ -4,9 +4,10 @@ wire, instead of hand-rolled method-name strings at call sites."""
 
 from .clients import (AccessClient, AuthClient, ClusterMgrClient,
                       ConsoleClient, FlashClient, FlashGroupClient,
-                      MasterClient, MetaNodeClient, SchedulerClient)
+                      MasterClient, MetaNodeClient, SchedulerClient,
+                      WireClient)
 
 __all__ = ["MasterClient", "SchedulerClient", "ClusterMgrClient",
-           "MetaNodeClient",
+           "MetaNodeClient", "WireClient",
            "AccessClient", "AuthClient", "FlashClient", "FlashGroupClient",
            "ConsoleClient"]

@@ -251,6 +251,107 @@ class MetaNodeClient(_Base):
         return self._call("stat")[0]
 
 
+class WireClient:
+    """Packet-plane client surface (sdk/data streamer analog): the
+    sanctioned home for raw binary-plane connections outside the fs
+    client internals (lint family CFX fences `PacketClient(...)`
+    construction to here and the fs/client plumbing).
+
+    One persistent mux connection per target; `window` requests ride it
+    in flight (CUBEFS_PKT_WINDOW by default, 1 when the mux door is
+    closed so the legacy serial path keeps its shape). `submit_many`
+    is the windowed meta-mutation pump loadgen's wire mode drives."""
+
+    def __init__(self, addr: str, timeout: float = 30.0,
+                 window: int | None = None):
+        from ..utils import packet as pkt
+
+        self._pkt = pkt
+        self._c = pkt.PacketClient(addr, timeout=timeout)
+        self.window = (window if window is not None
+                       else (pkt.window_size() if self._c.mux else 1))
+
+    def call(self, opcode: int, **kw):
+        return self._c.call(opcode, **kw)
+
+    def call_async(self, opcode: int, **kw):
+        """Submit one request, returning its PacketFuture — the open-
+        loop surface for callers that manage their own in-flight set
+        (loadgen's wire workers) instead of the `pipeline` window."""
+        return self._c.call_async(opcode, **kw)
+
+    def ping(self) -> dict:
+        args, _ = self._c.call(self._pkt.OP_PING)
+        return args
+
+    def pipeline(self, reqs: list[dict]) -> list:
+        """Issue `reqs` (kwargs for PacketClient.call) keeping up to
+        `window` in flight on the shared connection. Returns per-request
+        (args, payload) | Exception in submission order — one failed
+        stream does not abort its neighbours."""
+        out: list = [None] * len(reqs)
+        futs: list[tuple[int, object]] = []
+
+        def reap(slot: int, fut) -> None:
+            try:
+                out[slot] = fut.result()
+            except Exception as e:  # caller triages per-slot
+                out[slot] = e
+
+        for i, req in enumerate(reqs):
+            futs.append((i, self._c.call_async(**req)))
+            if len(futs) >= self.window:
+                reap(*futs.pop(0))
+        while futs:
+            reap(*futs.pop(0))
+        return out
+
+    def submit_batched(self, pid: int, records: list[dict],
+                       batch: int = 64) -> list:
+        """The saturation pump: records grouped into submit_batch
+        frames, `window` batches in flight on the shared connection —
+        batching amortizes the per-op wire cost, the mux window hides
+        the round trip. Returns per-record [result, None] |
+        [None, [errno, msg]] pairs in submission order."""
+        stamped = []
+        for r in records:
+            r = dict(r)
+            r.setdefault("op_id", uuid.uuid4().hex)
+            stamped.append(r)
+        reqs = [{"opcode": self._pkt.OP_META_SUBMIT_BATCH,
+                 "args": {"pid": pid, "records": stamped[i:i + batch]},
+                 "idempotent": True}
+                for i in range(0, len(stamped), batch)]
+        res: list = []
+        for got in self.pipeline(reqs):
+            if isinstance(got, Exception):
+                raise got
+            res.extend(got[0]["results"])
+        return res
+
+    def submit_many(self, pid: int, records: list[dict]) -> list:
+        """Pipeline many single-record meta mutations over one mux
+        connection. op_ids are stamped client-side (MetaNodeClient's
+        exactly-once discipline), which is what makes idempotent=True
+        — and therefore reconnect-retry — safe for these mutations."""
+        reqs = []
+        for r in records:
+            r = dict(r)
+            r.setdefault("op_id", uuid.uuid4().hex)
+            reqs.append({"opcode": self._pkt.OP_META_SUBMIT,
+                         "args": {"pid": pid, "record": r},
+                         "idempotent": True})
+        res = []
+        for got in self.pipeline(reqs):
+            if isinstance(got, Exception):
+                raise got
+            res.append(got[0]["result"])
+        return res
+
+    def close(self) -> None:
+        self._c.close()
+
+
 class AuthClient(_Base):
     """Ticket service surface (sdk/auth/api.go analog): key
     registration and ticket issue against a running authnode role. The

@@ -186,20 +186,29 @@ def _create_proc(view, parent_ino, secs, threads, q, tag):
             i += 1
             counts[t] += 1
 
+    import resource
+
+    cpu0 = resource.getrusage(resource.RUSAGE_SELF)
     pool = ThreadPoolExecutor(threads)
     list(pool.map(worker, range(threads)))
     pool.shutdown()
-    q.put(sum(counts))
+    cpu1 = resource.getrusage(resource.RUSAGE_SELF)
+    q.put({"ops": sum(counts),
+           "cpu_s": round((cpu1.ru_utime - cpu0.ru_utime)
+                          + (cpu1.ru_stime - cpu0.ru_stime), 3)})
 
 
 def saturated_create(view, procs: int = 8, threads: int = 8,
-                     secs: float = 3.0) -> float:
+                     secs: float = 3.0) -> dict:
     """Aggregate file-create ops/s from `procs` client processes — the
     write-side capacity number (mdtest file-creation shape). Every
     create is one replicated mknod commit against the same parent
     directory, so per-op replication rounds vs group commit is exactly
-    what this measures. The bench tree is left in place: removal is as
-    expensive as creation and this runs against throwaway clusters."""
+    what this measures. Each client process reports its own rusage CPU
+    seconds, so the artifact can show whether the measurement was
+    client-bound or server-bound. The bench tree is left in place:
+    removal is as expensive as creation and this runs against
+    throwaway clusters."""
     import multiprocessing as mp_mod
     import uuid
 
@@ -217,11 +226,12 @@ def saturated_create(view, procs: int = 8, threads: int = 8,
     t0 = time.perf_counter()
     for p in ps:
         p.start()
-    total = sum(q.get() for _ in ps)
+    got = [q.get() for _ in ps]
     for p in ps:
         p.join()
     dt = time.perf_counter() - t0
-    return round(total / dt, 1)
+    return {"create_ops": round(sum(g["ops"] for g in got) / dt, 1),
+            "loadgen_cpu_s": sorted(g["cpu_s"] for g in got)}
 
 
 def server_create_capacity(threads: int = 384, secs: float = 4.0) -> dict:
@@ -355,7 +365,7 @@ def write_ab(workdir: str, procs: int = 8, threads: int = 8,
                         break
                     except Exception:
                         time.sleep(0.5)
-                ops = saturated_create(view, procs=procs,
+                sat = saturated_create(view, procs=procs,
                                        threads=threads, secs=secs)
                 digests = {}
                 for addr in state["roles"].get("metanode", []):
@@ -364,7 +374,9 @@ def write_ab(workdir: str, procs: int = 8, threads: int = 8,
                     except Exception:
                         pass
                 out[leg] = {"server_capacity": cap,
-                            "deployed": {"create_ops": ops,
+                            "deployed": {"create_ops": sat["create_ops"],
+                                         "loadgen_cpu_s":
+                                             sat["loadgen_cpu_s"],
                                          "write_path": digests}}
             finally:
                 c.down()
@@ -394,6 +406,362 @@ def write_ab(workdir: str, procs: int = 8, threads: int = 8,
         "server_capacity_vs_r05_create": round(cap_gc / 821.0, 1),
     }
     return out
+
+
+def _wire_fs_cluster(workdir: str, n_data: int = 3, n_meta: int = 2):
+    """In-process master/meta/data cluster whose hot paths listen on
+    real-TCP binary packet planes (serve_packets on BOTH node kinds), so
+    a FileSystem client built from the view routes meta submits and
+    extent reads/writes over the wire — the transport the mux door
+    gates. Returns (fs, view, metas, datas, psrvs)."""
+    from ..fs.client import FileSystem
+    from ..fs.datanode import DataNode
+    from ..fs.master import Master
+    from ..fs.metanode import MetaNode
+    from ..utils.rpc import NodePool
+
+    pool = NodePool()
+    master = Master(pool)
+    pool.bind("master", master)
+    metas, datas, psrvs = [], [], []
+    for i in range(n_meta):
+        addr = f"meta{i}"
+        node = MetaNode(i, addr=addr, node_pool=pool)
+        pool.bind(addr, node)
+        psrv = node.serve_packets()
+        psrvs.append(psrv)
+        master.register_metanode(addr, packet_addr=psrv.addr)
+        metas.append(node)
+    for i in range(n_data):
+        addr = f"data{i}"
+        node = DataNode(i, os.path.join(workdir, f"d{i}"), addr, pool)
+        pool.bind(addr, node)
+        psrv = node.serve_packets()
+        psrvs.append(psrv)
+        master.register_datanode(addr, packet_addr=psrv.addr)
+        datas.append(node)
+    master.create_volume("bench", mp_count=2, dp_count=3)
+    view = master.client_view("bench")
+    return FileSystem(view, pool), view, metas, datas, psrvs
+
+
+# The deterministic mutation tape for the wire FSM-identity proof:
+# fixed names, types, timestamps and op_ids, issued SERIALLY over the
+# packet plane. Serial on purpose — mknod allocates inos in ARRIVAL
+# order, so a windowed (reorderable) pipeline would legitimately build
+# a different FSM; the claim under test is that the TRANSPORT (mux
+# framing, chunked CRC, reader-thread demux) never perturbs what the
+# server applies, and a serial tape isolates exactly that.
+def _wire_digest_tape(n: int = 256) -> list[dict]:
+    return [{"op": "mknod", "parent": 1, "name": f"wid_{i}",
+             "type": "file" if i % 3 else "dir", "mode": 0o644,
+             "ts": 1000.0 + i, "op_id": f"wire-ident-{i}"}
+            for i in range(n)]
+
+
+def _wire_sat_server_main(conn, workdir: str) -> None:
+    """Saturated-create server PROCESS: a two-node replicated metanode
+    pair (real raft WAL + fsyncs) whose leader serves the binary packet
+    plane. Lives in its own process so `getrusage(RUSAGE_SELF)` is the
+    server's CPU and nothing else — the honest half of the
+    server-is-bottleneck evidence."""
+    import resource
+
+    from ..fs.metanode import MetaNode
+    from ..utils.rpc import NodePool
+
+    pool = NodePool()
+    addrs = ["wsat0", "wsat1"]
+    nodes = []
+    for i, a in enumerate(addrs):
+        node = MetaNode(800 + i, data_dir=os.path.join(workdir, a),
+                        addr=a, node_pool=pool)
+        pool.bind(a, node)
+        nodes.append(node)
+    for node in nodes:
+        node.create_partition(9, 1, 1 << 20, peers=addrs)
+    leader = None
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and leader is None:
+        for node in nodes:
+            if node.rafts[9].status()["role"] == "leader":
+                leader = node
+        if leader is None:
+            time.sleep(0.02)
+    if leader is None:
+        conn.send({"error": "no leader"})
+        return
+    srv = leader.serve_packets()
+    cpu0 = resource.getrusage(resource.RUSAGE_SELF)
+    conn.send({"addr": srv.addr})
+    conn.recv()  # block until the driver says stop
+    cpu1 = resource.getrusage(resource.RUSAGE_SELF)
+    srv.stop()
+    for node in nodes:
+        node.stop()
+    conn.send({"cpu_s": round((cpu1.ru_utime - cpu0.ru_utime)
+                              + (cpu1.ru_stime - cpu0.ru_stime), 3)})
+
+
+def _wire_sat_worker_main(widx: int, addr: str, n_records: int,
+                          batch: int, q) -> None:
+    """Saturated-create loadgen PROCESS: pumps `n_records` mknods over
+    ONE mux connection via submit_batched (the OP_META_SUBMIT_BATCH
+    frames, `window` batches in flight). Reports its own rusage CPU.
+    Always posts a result — a worker that died silently would park the
+    driver on q.get() forever."""
+    import resource
+
+    from ..sdk import WireClient
+    from ..utils import packet as pkt
+
+    try:
+        cli = WireClient(addr, timeout=30.0)
+        cpu0 = resource.getrusage(resource.RUSAGE_SELF)
+        t0 = time.perf_counter()
+        ok = 0
+        for lo in range(0, n_records, 2048):
+            recs = [{"op": "mknod", "parent": 1,
+                     "name": f"ws{widx}_{i}", "type": "file",
+                     "mode": 0o644, "op_id": f"wsat-{widx}-{i}"}
+                    for i in range(lo, min(lo + 2048, n_records))]
+            # under heavy load the single-core leader can starve its
+            # heartbeat loop and briefly drop leadership; the redirect
+            # (empty leader while the election runs) is retryable, and
+            # fixed op_ids make the resubmit exactly-once
+            for attempt in range(50):
+                try:
+                    for res, err in cli.submit_batched(9, recs,
+                                                       batch=batch):
+                        if err is None:
+                            ok += 1
+                    break
+                except pkt.PacketError as e:
+                    if "leader=" not in str(e) or attempt == 49:
+                        raise
+                    time.sleep(0.2)
+        dt = time.perf_counter() - t0
+        cpu1 = resource.getrusage(resource.RUSAGE_SELF)
+        cli.close()
+        q.put({"widx": widx, "ok": ok, "secs": round(dt, 3),
+               "cpu_s": round((cpu1.ru_utime - cpu0.ru_utime)
+                              + (cpu1.ru_stime - cpu0.ru_stime), 3)})
+    except BaseException as e:  # noqa: BLE001 — relayed to the driver
+        q.put({"widx": widx, "error": f"{type(e).__name__}: {e}"})
+        raise
+
+
+def _wire_saturated_create(workdir: str, workers: int = 2,
+                           records_per_worker: int = 16000,
+                           batch: int = 256) -> dict:
+    """Multi-process saturated create over the packet wire: a server
+    process (replicated metanode pair, leader on the packet plane) and
+    `workers` loadgen processes pumping submit_batched. Aggregate
+    records/s plus per-side CPU attribution — worker CPU < server CPU
+    is the machine-checkable server-is-bottleneck claim."""
+    import multiprocessing as mp_mod
+
+    parent, child = mp_mod.Pipe()
+    srv = mp_mod.Process(target=_wire_sat_server_main,
+                         args=(child, workdir))
+    srv.start()
+    hello = parent.recv()
+    if "error" in hello:
+        srv.join()
+        raise TimeoutError(f"wire sat server: {hello['error']}")
+    addr = hello["addr"]
+    q = mp_mod.Queue()
+    ps = [mp_mod.Process(target=_wire_sat_worker_main,
+                         args=(i, addr, records_per_worker, batch, q))
+          for i in range(workers)]
+    t0 = time.perf_counter()
+    for p in ps:
+        p.start()
+    got = [q.get(timeout=600) for _ in ps]
+    for p in ps:
+        p.join()
+    dt = time.perf_counter() - t0
+    dead = [g for g in got if "error" in g]
+    if dead:
+        parent.send("stop")
+        srv.join(timeout=30)
+        raise RuntimeError(f"wire sat workers failed: {dead}")
+    parent.send("stop")
+    tail = parent.recv()
+    srv.join()
+    total = sum(g["ok"] for g in got)
+    worker_cpu = sorted(g["cpu_s"] for g in got)
+    return {
+        "workers": workers,
+        "records": total,
+        "records_per_s": round(total / dt, 1),
+        "batch": batch,
+        "worker_cpu_s": worker_cpu,
+        "server_cpu_s": tail["cpu_s"],
+        "server_is_bottleneck": tail["cpu_s"] > max(worker_cpu),
+    }
+
+
+def _wire_leg(workdir: str, blob: bytes, small: bytes,
+              n_objects: int = 6, n_meta_writes: int = 2000,
+              n_small_reads: int = 600) -> dict:
+    """One door position of the wire A/B: the four instrumented hot
+    paths over the packet plane, plus the serial FSM-digest tape. The
+    mux door was latched into the environment by the caller BEFORE
+    this runs — every packet client here is constructed fresh under
+    that door."""
+    import hashlib
+
+    from ..sdk import WireClient
+    from ..utils import packet as pkt
+
+    fs, view, metas, datas, psrvs = _wire_fs_cluster(workdir)
+    out: dict = {"mux": pkt.mux_enabled(),
+                 "window": pkt.window_size() if pkt.mux_enabled() else 1}
+    try:
+        # ---- FSM digest: serial deterministic tape over the wire ----
+        # (standalone partition, untouched by the benchmark traffic)
+        metas[0].create_partition(77, 1, 1 << 20)
+        ident = WireClient(view["meta_packet_addrs"]["meta0"])
+        for rec in _wire_digest_tape():
+            ident.call(pkt.OP_META_SUBMIT,
+                       args={"pid": 77, "record": dict(rec)})
+        out["fsm_digest"] = hashlib.sha256(
+            metas[0].partitions[77].state_bytes()).hexdigest()
+
+        # ---- meta write: windowed single-record submits, ops/s ----
+        metas[0].create_partition(78, 1, 1 << 20)
+        recs = [{"op": "mknod", "parent": 1, "name": f"mw_{i}",
+                 "type": "file", "mode": 0o644, "op_id": f"mw-{i}"}
+                for i in range(n_meta_writes)]
+        t0 = time.perf_counter()
+        got = ident.submit_many(78, recs)
+        dt = time.perf_counter() - t0
+        assert len(got) == n_meta_writes
+        out["meta_write_ops"] = round(n_meta_writes / dt, 1)
+        ident.close()
+
+        # ---- blob PUT / GET: large streaming objects, MB/s ----
+        # (continuation-frame trains + chunked CRC; pipelined pieces)
+        mb = len(blob) / (1 << 20)
+        t0 = time.perf_counter()
+        for i in range(n_objects):
+            fs.write_file(f"/obj{i}", blob)
+        dt = time.perf_counter() - t0
+        out["blob_put_mbps"] = round(n_objects * mb / dt, 1)
+        t0 = time.perf_counter()
+        shas = {hashlib.sha256(fs.read_file(f"/obj{i}")).hexdigest()
+                for i in range(n_objects)}
+        dt = time.perf_counter() - t0
+        out["blob_get_mbps"] = round(n_objects * mb / dt, 1)
+        assert shas == {hashlib.sha256(blob).hexdigest()}
+        out["blob_sha"] = shas.pop()
+
+        # ---- fs read: small-file reads, ops/s ----
+        n_files = 64
+        for i in range(n_files):
+            fs.write_file(f"/s{i}", small)
+        t0 = time.perf_counter()
+        for i in range(n_small_reads):
+            data = fs.read_file(f"/s{i % n_files}")
+        dt = time.perf_counter() - t0
+        assert data == small
+        out["fs_read_ops"] = round(n_small_reads / dt, 1)
+        out["fs_read_sha"] = hashlib.sha256(small).hexdigest()
+    finally:
+        # close this leg's packet clients first — otherwise each leg
+        # leaks a mux reader thread per plane (and the matching server
+        # conn thread) into every later leg
+        for wrapper in (fs.meta, fs.data):
+            for cli in wrapper._packet_clients.values():
+                try:
+                    cli.close()
+                except Exception:
+                    pass
+        for s in psrvs:
+            s.stop()
+        for n in metas + datas:
+            n.stop()
+    return out
+
+
+def wire_ab(workdir: str, n_objects: int = 6, n_meta_writes: int = 2000,
+            n_small_reads: int = 600, sat_records: int = 32000) -> dict:
+    """The PR 17 wire A/B: ABBA legs over CUBEFS_PKT_MUX=1,0,0,1 (the
+    multiplexed streaming plane vs the legacy serial one-packet-per-
+    round-trip plane) measuring blob PUT, blob GET, meta write and fs
+    read over real-TCP packet transports, with bit-identical FSM
+    digests at both door positions and the multi-process saturated-
+    create knee (server CPU vs loadgen CPU). ABBA ordering lands
+    thermal/cache drift on both doors evenly; a discarded warmup leg
+    absorbs the first-cluster penalty (allocator growth, page-cache
+    fill, pool spin-up) that would otherwise land on door A alone."""
+    import hashlib
+    import statistics
+
+    # deterministic payloads shared by every leg (identity checks
+    # compare digests ACROSS legs, so the bytes must not vary)
+    blob = hashlib.sha256(b"wire-ab-blob").digest()
+    blob = (blob * ((4 << 20) // len(blob) + 1))[:4 << 20]
+    small = hashlib.sha256(b"wire-ab-small").digest() * 128  # 4 KiB
+
+    legs = []
+    sat = {}
+    saved = os.environ.get("CUBEFS_PKT_MUX")
+    try:
+        # saturated create FIRST, once per door, while the driver heap
+        # is pristine: the server/worker children fork from this
+        # process, and a heap dirtied by earlier legs depresses them
+        # (copy-on-write faults + inherited collector state)
+        for door in ("1", "0"):
+            os.environ["CUBEFS_PKT_MUX"] = door
+            sat[door] = _wire_saturated_create(
+                os.path.join(workdir, f"sat{door}"),
+                records_per_worker=sat_records // 2)
+        os.environ["CUBEFS_PKT_MUX"] = "1"
+        _wire_leg(os.path.join(workdir, "warmup"), blob, small,
+                  n_objects=2, n_meta_writes=300, n_small_reads=100)
+        for i, door in enumerate(("1", "0", "0", "1")):
+            os.environ["CUBEFS_PKT_MUX"] = door
+            legs.append(_wire_leg(
+                os.path.join(workdir, f"leg{i}"), blob, small,
+                n_objects=n_objects, n_meta_writes=n_meta_writes,
+                n_small_reads=n_small_reads))
+    finally:
+        if saved is None:
+            os.environ.pop("CUBEFS_PKT_MUX", None)
+        else:
+            os.environ["CUBEFS_PKT_MUX"] = saved
+
+    on = [l for l in legs if l["mux"]]
+    off = [l for l in legs if not l["mux"]]
+
+    def med(ls, k):
+        return round(statistics.median(x[k] for x in ls), 1)
+
+    paths = ("blob_put_mbps", "blob_get_mbps", "meta_write_ops",
+             "fs_read_ops")
+    summary: dict = {"mux_on": {k: med(on, k) for k in paths},
+                     "mux_off": {k: med(off, k) for k in paths}}
+    summary["speedup"] = {
+        k: round(summary["mux_on"][k] / summary["mux_off"][k], 2)
+        if summary["mux_off"][k] else None for k in paths}
+    sat_on = sat["1"]["records_per_s"]
+    summary["fsm_digest_identical"] = (
+        len({l["fsm_digest"] for l in legs}) == 1)
+    summary["blob_bytes_identical"] = (
+        len({l["blob_sha"] for l in legs}) == 1)
+    summary["saturated_create"] = {
+        "r08_plateau_ops": 8000.0,
+        "mux_on_records_per_s": sat_on,
+        "mux_off_records_per_s": sat["0"]["records_per_s"],
+        "vs_r08": round(sat_on / 8000.0, 2),
+        "target_2x_met": sat_on >= 16000.0,
+    }
+    summary["server_is_bottleneck"] = all(
+        s["server_is_bottleneck"] for s in sat.values())
+    return {"cores": os.cpu_count(), "abba": ["1", "0", "0", "1"],
+            "saturated_create": sat, "legs": legs, "summary": summary}
 
 
 def _metric_sum(metric) -> float:
@@ -1243,6 +1611,11 @@ def main(argv=None):
     ap.add_argument("--cap-threads", type=int, default=384,
                     help="concurrent creates for the in-process "
                          "server-capacity leg")
+    ap.add_argument("--wire-ab", action="store_true",
+                    help="packet-plane mux A/B: ABBA CUBEFS_PKT_MUX "
+                         "1,0,0,1 over blob put/get, meta write, fs "
+                         "read + FSM digest identity + saturated "
+                         "create with CPU attribution")
     ap.add_argument("--obs-tail", action="store_true",
                     help="instrumentation overhead A/B (CUBEFS_TRACE=1 "
                          "vs 0) + per-stage meta.write tails + FSM "
@@ -1263,6 +1636,15 @@ def main(argv=None):
     ap.add_argument("--out", help="also write the result JSON here")
     args = ap.parse_args(argv)
     metas = []
+    if args.wire_ab:
+        workdir = tempfile.mkdtemp(prefix="cubefs-bench-wireab-")
+        res = wire_ab(workdir)
+        print(json.dumps(res, indent=1))
+        if args.out:
+            merge_artifact(args.out, "wire_ab", res)
+        ok = res["summary"]["fsm_digest_identical"] \
+            and res["summary"]["blob_bytes_identical"]
+        raise SystemExit(0 if ok else 1)
     if args.obs_tail:
         workdir = tempfile.mkdtemp(prefix="cubefs-bench-obs-")
         res = obs_tail(workdir, threads=args.threads, secs=args.secs,

@@ -42,11 +42,16 @@ from __future__ import annotations
 
 import argparse
 import bisect
+import ctypes
 import hashlib
 import heapq
 import json
 import math
+import multiprocessing as mp
+import os
 import random
+import resource
+import time
 from typing import NamedTuple
 
 from ..utils import metrics, qos, slo
@@ -361,6 +366,443 @@ def scale_run(clients: int = 100_000, seed: int = 7,
     return model.run(duration_s=duration_s, max_events=max_events)
 
 
+# --------------------------------------------------- wire mode (real bytes)
+#
+# The sim above models 10^6 clients on a fake clock; wire mode pushes
+# REAL packet bytes from pinned worker processes at a real QoS-gated
+# packet server, so the A/B artifacts can show the server — not the
+# loadgen — as the bottleneck. The op schedule stays seeded: each
+# client's k-th request (op, object, size) is a pure function of
+# (seed, worker, client, k), so the planned stream digests identically
+# run to run; wall-clock interleaving is real and therefore not part
+# of the digest.
+
+_WIRE_EDGES = (0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.15, 0.2,
+               0.25, 0.35, 0.5, 0.75, 1.0, 1.5, 2.5, 4.0, 8.0)
+_NB = len(_WIRE_EDGES) + 1
+_CTR = 3  # issued, shed, errors — per (worker, pair)
+_BURN_BUF = b"\xa5" * 65536
+_PLAN_OPS = 4096  # per-client planned op-stream length (execution
+                  # consumes a prefix; the digest covers the full plan)
+
+
+def _burn(cost: float, unit_loops: int) -> None:
+    """~`cost` cost-units of genuine CPU service work (crc32 sweeps —
+    the checksum work a real datanode write path does)."""
+    import zlib
+    for _ in range(max(1, int(cost * unit_loops))):
+        zlib.crc32(_BURN_BUF)
+
+
+def _client_plan_rng(seed: int, widx: int, cid: int) -> random.Random:
+    return random.Random((seed << 24) ^ (widx << 18) ^ cid)
+
+
+def _plan_digest(seed: int, widx: int, clients: list[tuple[int, int]],
+                 specs: list[TenantSpec]) -> str:
+    """sha256 over the full planned op stream of this worker's clients,
+    in (client, k) order — reproducible from the seed alone."""
+    h = hashlib.sha256()
+    for cid, tidx in clients:
+        spec = specs[tidx]
+        rng = _client_plan_rng(seed, widx, cid)
+        for k in range(_PLAN_OPS):
+            is_read = rng.random() < spec.read_fraction
+            obj = rng.randrange(4096)
+            h.update(f"{widx}|{cid}|{k}|{'get' if is_read else 'put'}"
+                     f"|{obj}\n".encode())
+    return h.hexdigest()
+
+
+def _pin_to_core(core: int) -> int | None:
+    """Pin the calling process to one core; returns the core or None
+    when the platform has no affinity API."""
+    if hasattr(os, "sched_setaffinity"):
+        try:
+            os.sched_setaffinity(0, {core})
+            return core
+        except OSError:
+            pass
+    return None
+
+
+def _cpu_seconds() -> float:
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return ru.ru_utime + ru.ru_stime
+
+
+def _wire_server_main(ctrl, qos_on: bool, unit_loops: int,
+                      bully_quota: float, slo_target_s: float,
+                      resp_bytes: dict, core: int) -> None:
+    """Server process: a real PacketServer whose handlers run per-tenant
+    QoS admission and burn genuine CPU per cost unit. Reports its own
+    rusage CPU over the control pipe at shutdown."""
+    from ..utils import packet
+
+    _pin_to_core(core)
+    base_cpu = _cpu_seconds()
+    hist = metrics.Histogram("wiregen_stage_seconds", "",
+                             ("path", "stage"))
+    tracker = slo.SloTracker(hist=hist, window_s=2.0, windows=5)
+    tracker.register("blob.get", slo_target_s, 0.999)
+    tracker.register("blob.put", 1.0, 0.999)
+    gate = None
+    if qos_on:
+        gate = qos.QosGate(tracker=tracker, blocking=False,
+                           max_inflight=100_000, refresh_s=0.5,
+                           shaping_timeout=0.05)
+        gate.configure("bully", rate=bully_quota, burst=bully_quota / 4)
+    import threading
+
+    counts = {"issued": 0, "shed": 0}
+    lock = threading.Lock()
+    resp_pool = _BURN_BUF * 4  # GET replies are slices of this
+
+    def serve(path: str):
+        def handler(hdr, args, payload):
+            cost = float(args.get("cost", 1.0))
+            tenant = args.get("tenant", "unknown")
+            try:
+                adm = (gate.admit(path, tenant=tenant, cost=cost)
+                       if gate is not None else qos.NOOP_ADMISSION)
+            except qos.QosRejected as e:
+                with lock:
+                    counts["shed"] += 1
+                raise packet.PacketError(
+                    packet.RESULT_RPC,
+                    json.dumps({"retry_after": e.retry_after}),
+                    code=429) from None
+            with adm:
+                if adm.throttle_s:
+                    time.sleep(adm.throttle_s)
+                _burn(cost, unit_loops)
+            with lock:
+                counts["issued"] += 1
+            # end-to-end latency from the CLIENT's send stamp (same
+            # host, shared clock): queue wait included, which is what
+            # the burn-rate brownout logic must react to
+            sent = args.get("t_sent")
+            if sent is not None:
+                hist.observe(max(0.0, time.time() - sent),
+                             path=path, stage="total")
+            n = min(int(args.get("resp", 0)), len(resp_pool))
+            return {}, memoryview(resp_pool)[:n] if n else b""
+        return handler
+
+    srv = packet.PacketServer({
+        packet.OP_READ: serve("blob.get"),
+        packet.OP_WRITE: serve("blob.put"),
+    }, service="wiregen").start()
+    ctrl.send(srv.addr)
+    ctrl.recv()  # block until the driver says stop
+    srv.stop()
+    ctrl.send({"cpu_s": _cpu_seconds() - base_cpu,
+               "issued": counts["issued"], "shed": counts["shed"],
+               "qos": "on" if qos_on else "off"})
+    ctrl.close()
+
+
+def _wire_worker_main(widx: int, core: int, addr: str, seed: int,
+                      duration_s: float, clients: list[tuple[int, int]],
+                      specs: list[TenantSpec], sizes: dict,
+                      buckets, ctrs, cpus, digests, barrier,
+                      max_retries: int = 8) -> None:
+    """One loadgen worker process: drives its client population's
+    seeded op streams over ONE mux connection (victim and bully frames
+    interleave on the same wire), windowed in-flight, open/closed
+    arrival mixing and capped 429 backoff carried over from the sim.
+    Results land in the shared-memory arrays; no pickling on the way
+    back."""
+    from ..utils import packet
+
+    _pin_to_core(core)
+    base_cpu = _cpu_seconds()
+    digest = _plan_digest(seed, widx, clients, specs)
+    digests[widx * 64:(widx + 1) * 64] = digest.encode()
+    trng = random.Random((seed << 8) ^ widx)  # timing only, not digested
+
+    def exp(mean: float) -> float:
+        return -mean * math.log(1.0 - trng.random()) if mean > 0 else 0.0
+
+    npairs = len(specs) * 2
+
+    def pair_idx(tidx: int, is_read: bool) -> int:
+        return tidx * 2 + (0 if is_read else 1)
+
+    def bucket(lat: float) -> int:
+        return bisect.bisect_left(_WIRE_EDGES, lat)
+
+    plans = {cid: _client_plan_rng(seed, widx, cid) for cid, _ in clients}
+    next_k = {cid: 0 for cid, _ in clients}
+    from ..sdk import WireClient
+    cli = WireClient(addr, timeout=10.0)
+    cap = len(clients) + 2 * packet.window_size()
+    barrier.wait()
+    t0 = time.monotonic()
+    # heap: (due, seq, cid, tidx, op or None, retries) — op is carried
+    # on 429 retries so a shed request retries ITSELF, not a fresh draw
+    heap: list[tuple] = []
+    seq = 0
+    for cid, tidx in clients:
+        heap.append((trng.random() * 0.2, seq, cid, tidx, None, 0))
+        seq += 1
+    heapq.heapify(heap)
+    inflight: list = []  # [fut, t_submit, pair, cid, tidx, op, retries]
+
+    def harvest(ent, block_s: float | None) -> bool:
+        fut, ts, pair, cid, tidx, op, retries = ent
+        nonlocal seq
+        try:
+            if block_s is not None:
+                fut.result(block_s)
+            elif not fut.done():
+                return False
+            else:
+                fut.result(0)
+            lat = time.monotonic() - ts
+            base = (widx * npairs + pair) * _NB
+            buckets[base + bucket(lat)] += 1
+            ctrs[(widx * npairs + pair) * _CTR + 0] += 1
+            spec = specs[tidx]
+            now = time.monotonic() - t0
+            if trng.random() < spec.open_fraction:
+                due = (ts - t0) + exp(spec.think_s)
+            else:
+                due = now + exp(spec.think_s)
+            heapq.heappush(heap, (due, seq, cid, tidx, None, 0))
+            seq += 1
+        except packet.PacketError as e:
+            now = time.monotonic() - t0
+            if e.code == 429:
+                ctrs[(widx * npairs + pair) * _CTR + 1] += 1
+                try:
+                    ra = json.loads(e.message).get("retry_after", 0.5)
+                except (ValueError, AttributeError):
+                    ra = 0.5
+                if retries < max_retries:
+                    backoff = min(5.0, ra * (2 ** retries))
+                    heapq.heappush(heap, (now + backoff + exp(backoff / 2),
+                                          seq, cid, tidx, op, retries + 1))
+                else:
+                    heapq.heappush(heap, (now + exp(specs[tidx].think_s),
+                                          seq, cid, tidx, None, 0))
+                seq += 1
+            else:
+                ctrs[(widx * npairs + pair) * _CTR + 2] += 1
+                heapq.heappush(heap, (now + exp(specs[tidx].think_s),
+                                      seq, cid, tidx, None, 0))
+                seq += 1
+        except (ConnectionError, OSError, TimeoutError):
+            ctrs[(widx * npairs + pair) * _CTR + 2] += 1
+        return True
+
+    try:
+        while True:
+            now = time.monotonic() - t0
+            if now >= duration_s:
+                break
+            while (heap and heap[0][0] <= now and len(inflight) < cap):
+                _, _, cid, tidx, op, retries = heapq.heappop(heap)
+                spec = specs[tidx]
+                if op is None:
+                    rng = plans[cid]
+                    # k-th planned draw for this client (digested above)
+                    is_read = rng.random() < spec.read_fraction
+                    obj = rng.randrange(4096)
+                    next_k[cid] += 1
+                    op = ("get" if is_read else "put", obj)
+                is_read = op[0] == "get"
+                pair = pair_idx(tidx, is_read)
+                name, size = (("blob.get", sizes.get("get_bytes", 8192))
+                              if is_read else
+                              ("blob.put", sizes.get("put_bytes", 65536)))
+                args = {"tenant": spec.name,
+                        "cost": spec.get_cost if is_read else spec.put_cost,
+                        "t_sent": time.time()}
+                payload = b""
+                if is_read:
+                    args["resp"] = size
+                else:
+                    payload = _BURN_BUF * (size // len(_BURN_BUF) + 1)
+                    payload = payload[:size]
+                try:
+                    fut = cli.call_async(
+                        packet.OP_READ if is_read else packet.OP_WRITE,
+                        extent=op[1], args=args, payload=payload,
+                        idempotent=False)
+                except (ConnectionError, OSError):
+                    ctrs[(widx * npairs + pair) * _CTR + 2] += 1
+                    continue
+                inflight.append([fut, time.monotonic(), pair, cid, tidx,
+                                 op, retries])
+            # reap whatever has completed, oldest first
+            inflight = [e for e in inflight if not harvest(e, None)]
+            if not inflight and heap:
+                time.sleep(min(0.005, max(0.0, heap[0][0] - now)))
+            elif inflight:
+                time.sleep(0.001)
+            elif not heap:
+                break
+        for ent in inflight:  # drain: bounded grace per in-flight op
+            harvest(ent, 3.0)
+    finally:
+        cli.close()
+        cpus[widx] = _cpu_seconds() - base_cpu
+
+
+def _wire_quantile(buckets, widx_range, pair: int, npairs: int,
+                   q: float) -> float:
+    """Approximate quantile (upper bucket edge) from the shared counts."""
+    counts = [0] * _NB
+    for w in widx_range:
+        base = (w * npairs + pair) * _NB
+        for b in range(_NB):
+            counts[b] += buckets[base + b]
+    total = sum(counts)
+    if not total:
+        return 0.0
+    acc = 0
+    for b, c in enumerate(counts):
+        acc += c
+        if acc / total >= q:
+            return _WIRE_EDGES[b] if b < len(_WIRE_EDGES) else float("inf")
+    return float("inf")
+
+
+def wire_brownout_leg(seed: int, qos_on: bool, *,
+                      duration_s: float = 6.0,
+                      workers: int | None = None,
+                      victim_clients: int = 12,
+                      bully_clients: int = 32,
+                      unit_loops: int = 12,
+                      bully_quota: float = 250.0) -> dict:
+    """One REAL-BYTES noisy-neighbor leg over the mux wire: victim and
+    bully streams share worker mux connections into a QoS-gated packet
+    server that burns genuine CPU per cost unit. Returns the same shape
+    of evidence as the simulated leg, plus per-process CPU seconds."""
+    ncores = os.cpu_count() or 1
+    nworkers = workers if workers is not None else max(1, min(ncores, 4))
+    specs = [
+        TenantSpec("victim", victim_clients, think_s=0.15,
+                   read_fraction=1.0, get_cost=1.0),
+        TenantSpec("bully", bully_clients, think_s=0.02,
+                   read_fraction=0.0, put_cost=16.0, open_fraction=0.3),
+    ]
+    sizes = {"get_bytes": 8192, "put_bytes": 65536}
+    ctx = mp.get_context("fork")
+    ctrl, srv_end = ctx.Pipe()
+    srv_proc = ctx.Process(
+        target=_wire_server_main,
+        args=(srv_end, qos_on, unit_loops, bully_quota,
+              VICTIM_SLO.target_s, sizes, 0),
+        daemon=True)
+    srv_proc.start()
+    addr = ctrl.recv()
+    npairs = len(specs) * 2
+    buckets = ctx.Array(ctypes.c_uint64, nworkers * npairs * _NB,
+                        lock=False)
+    ctrs = ctx.Array(ctypes.c_uint64, nworkers * npairs * _CTR,
+                     lock=False)
+    cpus = ctx.Array(ctypes.c_double, nworkers, lock=False)
+    digests = ctx.Array(ctypes.c_char, nworkers * 64, lock=False)
+    barrier = ctx.Barrier(nworkers)
+    # contiguous client ids; each tenant's population split round-robin
+    # across workers so every mux connection carries BOTH tenants'
+    # frames — the isolation claim is about streams, not sockets
+    assign: list[list[tuple[int, int]]] = [[] for _ in range(nworkers)]
+    cid = 0
+    for tidx, spec in enumerate(specs):
+        for _ in range(spec.clients):
+            assign[cid % nworkers].append((cid, tidx))
+            cid += 1
+    procs = []
+    for w in range(nworkers):
+        p = ctx.Process(
+            target=_wire_worker_main,
+            args=(w, w % ncores, addr, seed, duration_s, assign[w],
+                  specs, sizes, buckets, ctrs, cpus, digests, barrier),
+            daemon=True)
+        p.start()
+        procs.append(p)
+    for p in procs:
+        p.join(timeout=duration_s + 30.0)
+    ctrl.send("stop")
+    server_stats = ctrl.recv()
+    srv_proc.join(timeout=10.0)
+    wdigests = sorted(bytes(digests[w * 64:(w + 1) * 64]).decode()
+                      for w in range(nworkers))
+    combined = hashlib.sha256("".join(wdigests).encode()).hexdigest()
+
+    def pair_tot(pair: int, slot: int) -> int:
+        return sum(ctrs[(w * npairs + pair) * _CTR + slot]
+                   for w in range(nworkers))
+
+    p99 = _wire_quantile(buckets, range(nworkers), 0, npairs, 0.99)
+    return {
+        "qos": "on" if qos_on else "off",
+        "seed": seed,
+        "digest": combined,
+        "workers": nworkers,
+        "cores": ncores,
+        "worker_cpu_s": [round(cpus[w], 3) for w in range(nworkers)],
+        "server_cpu_s": round(server_stats["cpu_s"], 3),
+        "server_is_bottleneck": bool(
+            server_stats["cpu_s"] > max(cpus[:] or [0.0])),
+        "victim": {
+            "reads": pair_tot(0, 0),
+            "errors": pair_tot(0, 2),
+            "p99_s": p99,
+            "slo_target_s": VICTIM_SLO.target_s,
+            "within_budget": bool(p99 <= VICTIM_SLO.target_s),
+        },
+        "bully": {
+            "issued": pair_tot(3, 0),
+            "shed": pair_tot(3, 1),
+            "errors": pair_tot(3, 2),
+        },
+        "server": {"issued": server_stats["issued"],
+                   "shed": server_stats["shed"]},
+    }
+
+
+def wire_qos_ab(seed: int = 17, out: str | None = None,
+                duration_s: float = 6.0,
+                workers: int | None = None) -> dict:
+    """The ISSUE-17 brownout acceptance run: ABBA (on, off, off, on)
+    real-bytes legs over the mux wire. Same seed => same planned
+    schedule digest every leg (the plan is door-independent); QoS on
+    must hold the victim within budget while the bully is shed."""
+    from ..utils import packet
+
+    legs = [wire_brownout_leg(seed, on, duration_s=duration_s,
+                              workers=workers)
+            for on in (True, False, False, True)]
+    on_legs = [r for r in legs if r["qos"] == "on"]
+    off_legs = [r for r in legs if r["qos"] == "off"]
+    result = {
+        "bench": "WIRE_QOS_AB",
+        "seed": seed,
+        "order": ["on", "off", "off", "on"],
+        "transport": ("packet-mux" if packet.mux_enabled()
+                      else "packet-serial"),
+        "legs": legs,
+        "victim_slo": {"path": "blob.get",
+                       "target_s": VICTIM_SLO.target_s,
+                       "objective": VICTIM_SLO.objective},
+        "qos_on_within_budget": all(
+            r["victim"]["within_budget"] for r in on_legs),
+        "qos_off_violates": all(
+            not r["victim"]["within_budget"] for r in off_legs),
+        "reproducible": len({r["digest"] for r in legs}) == 1,
+        "server_is_bottleneck": all(
+            r["server_is_bottleneck"] for r in legs),
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2)
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="deterministic closed-loop traffic model / QoS drills")
@@ -368,9 +810,25 @@ def main(argv=None) -> int:
                     help="run the ABBA noisy-neighbor drill")
     ap.add_argument("--scale", type=int, default=0, metavar="CLIENTS",
                     help="run a CLIENTS-sized determinism check")
+    ap.add_argument("--wire", action="store_true",
+                    help="run the REAL-BYTES brownout ABBA over the "
+                         "mux packet wire (multi-process)")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="wire mode: loadgen worker processes "
+                         "(default: one per core, max 4)")
+    ap.add_argument("--duration", type=float, default=6.0,
+                    help="wire mode: seconds per leg")
     ap.add_argument("--seed", type=int, default=12)
     ap.add_argument("--out", default=None, help="write JSON artifact here")
     args = ap.parse_args(argv)
+    if args.wire:
+        result = wire_qos_ab(seed=args.seed, out=args.out,
+                             duration_s=args.duration,
+                             workers=args.workers)
+        print(json.dumps(result, indent=2))
+        return 0 if (result["qos_on_within_budget"]
+                     and result["qos_off_violates"]
+                     and result["reproducible"]) else 1
     if args.qos_ab:
         result = qos_ab(seed=args.seed, out=args.out)
         print(json.dumps(result, indent=2))

@@ -343,6 +343,26 @@ class FaultPlan:
             503, f"{addr}/{method}: injected drop-after-execute "
                  f"(reply lost; retry must dedup via op_id)")
 
+    def wire_frame(self, addr: str, op: str) -> str | None:
+        """Per-FRAME hook for the binary mux plane (packet.py _MuxConn):
+        frames are keyed method=``frame_<op>`` so plans target them
+        independently of the rpc-level hooks, and every injection lands
+        in the same schedule/digest. ``delay`` sleeps here; ``corrupt``
+        / ``drop_before`` / ``drop_after`` are returned for the
+        transport to apply at the byte level (flip a chunk byte under
+        its already-computed CRC, or sever the connection before/after
+        the frame leaves)."""
+        method = f"frame_{op}"
+        rule = self._decide(addr, method)
+        if rule is None:
+            return None
+        if rule.kind == "delay":
+            self._sleep_for(rule, addr, method)
+            return None
+        if rule.kind in ("drop_before", "drop_after", "corrupt"):
+            return rule.kind
+        return None
+
     # ---- in-process fault points (non-RPC) ----
     def gate(self, addr: str, method: str) -> None:
         """One named in-process fault point — code that wants to be

@@ -503,3 +503,32 @@ disk_quarantine_transitions = DEFAULT.counter(
     "disk health state transitions: `quarantine` (io-error or latency "
     "outlier tripped), `probe_pass` (probe healed it back), "
     "`probe_fail` (probe kept it quarantined)", ("node", "event"))
+
+# multiplexed streaming packet plane (utils/packet.py): frame/chunk
+# traffic on both sides of the binary wire, mux session health, and the
+# per-frame send-slot queue wait (how long a chunk waited for the
+# shared connection). `cubefs-cli metrics wire` renders these.
+pkt_frames = DEFAULT.counter(
+    "cubefs_pkt_frames_total",
+    "binary-plane frames moved, by direction (`tx`/`rx`) and side "
+    "(`client`/`server`)", ("dir", "side"))
+pkt_chunk_bytes = DEFAULT.counter(
+    "cubefs_pkt_chunk_bytes_total",
+    "binary-plane bytes moved (headers + args + payload chunks), by "
+    "direction and side", ("dir", "side"))
+pkt_mux_conns = DEFAULT.gauge(
+    "cubefs_pkt_mux_conns",
+    "live client-side mux connections (one shared socket per address)")
+pkt_mux_streams = DEFAULT.gauge(
+    "cubefs_pkt_mux_streams",
+    "requests currently in flight across all mux connections (streams "
+    "registered and not yet resolved)")
+pkt_mux_queue_wait = DEFAULT.histogram(
+    "cubefs_pkt_mux_queue_wait_seconds",
+    "wait for the shared connection's per-frame send slot — how long "
+    "one chunk queued behind other streams' frames",
+    buckets=(0.00005, 0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2))
+pkt_stream_drops = DEFAULT.counter(
+    "cubefs_pkt_stream_drops_total",
+    "streams failed by a per-chunk CRC mismatch while the connection "
+    "itself was kept (framing intact)", ("side",))

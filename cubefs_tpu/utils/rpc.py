@@ -357,6 +357,7 @@ def _http_call(addr, method, args, body, timeout,
     if span is not None:
         headers["X-Trace"] = span.header()
     if _corrupt and body:
+        body = bytes(body)  # may be a zero-copy memoryview
         body = bytes([body[0] ^ 0xFF]) + body[1:]
     if _stale:
         with _POOL._lock:

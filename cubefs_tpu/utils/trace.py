@@ -34,6 +34,7 @@ directly — timestamps come from an injectable Clock (the
 from __future__ import annotations
 
 import contextvars
+import heapq
 import json
 import os
 import random
@@ -60,6 +61,14 @@ _traces: dict[str, dict] = {}
 _span_total = 0
 _arrival_seq = 0
 MAX_KEPT = 2048
+# eviction order (oldest-root-first, arrival tie-break) as a lazy-
+# deletion heap of (root_start-or-inf, seq, trace_id): a linear
+# min() scan per collected span turns every packet-plane request
+# into an O(MAX_KEPT) stall once the store fills — at wire rates
+# that is a hard throughput cliff, not an observability tax.
+# Entries go stale when a trace's root_start improves or the trace
+# is evicted; pops skip entries whose key no longer matches.
+_evict_heap: list[tuple] = []
 
 # slow-request forensics: in-memory index for `cubefs-cli trace slow`
 # plus a rotating JSONL capture (configured beside the audit log).
@@ -465,6 +474,11 @@ def observe_stage(name: str, path: str, seconds) -> None:
 
 # ------------------------------------------------------------- collector
 
+def _heap_key(t: dict) -> float:
+    rs = t["root_start"]
+    return rs if rs is not None else float("inf")
+
+
 def _collect(span: Span) -> None:
     global _span_total, _arrival_seq
     d = span.to_dict()
@@ -474,26 +488,26 @@ def _collect(span: Span) -> None:
             _arrival_seq += 1
             t = {"root_start": None, "seq": _arrival_seq, "spans": []}
             _traces[span.trace_id] = t
+            heapq.heappush(_evict_heap,
+                           (float("inf"), _arrival_seq, span.trace_id))
         t["spans"].append(d)
         if span.parent_id is None:
             rs = t["root_start"]
             t["root_start"] = span.start if rs is None else min(rs, span.start)
+            if t["root_start"] != rs:
+                # key improved: push a fresh entry, the old one goes
+                # stale and is skipped at pop time
+                heapq.heappush(_evict_heap,
+                               (t["root_start"], t["seq"], span.trace_id))
         _span_total += 1
         metrics.trace_spans_total.inc()
         # evict WHOLE traces, oldest-root-first, so a reconstructed
         # tree is never torn by dropping only its early spans
-        while _span_total > MAX_KEPT and len(_traces) > 1:
-            victim = min(
-                _traces,
-                key=lambda tid: (
-                    _traces[tid]["root_start"]
-                    if _traces[tid]["root_start"] is not None
-                    else float("inf"),
-                    _traces[tid]["seq"],
-                ),
-            )
-            if victim == span.trace_id and len(_traces) == 1:
-                break
+        while _span_total > MAX_KEPT and len(_traces) > 1 and _evict_heap:
+            key, seq, victim = heapq.heappop(_evict_heap)
+            vt = _traces.get(victim)
+            if vt is None or (_heap_key(vt), vt["seq"]) != (key, seq):
+                continue  # stale entry (evicted, or root_start improved)
             _span_total -= len(_traces.pop(victim)["spans"])
             metrics.trace_evictions.inc()
 
@@ -513,6 +527,7 @@ def reset_collector() -> None:
         _traces.clear()
         _span_total = 0
         _arrival_seq = 0
+        del _evict_heap[:]
         del _slow_index[:]
 
 

@@ -1,0 +1,21 @@
+"""Fixture: wire-discipline true positives (CFX001 x3, CFX002 x1)."""
+
+from cubefs_tpu.utils import packet
+from cubefs_tpu.utils import packet as pkt
+from cubefs_tpu.utils.packet import PacketClient
+
+
+def private_conn_module_name(addr):
+    return packet.PacketClient(addr)  # CFX001
+
+
+def private_conn_alias(addr):
+    return pkt.PacketClient(addr, timeout=5.0)  # CFX001
+
+
+def private_conn_direct(addr):
+    return PacketClient(addr)  # CFX001
+
+
+def concat_send(sock, hdr, payload):
+    sock.sendall(hdr + payload)  # CFX002

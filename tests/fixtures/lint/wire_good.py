@@ -1,0 +1,24 @@
+"""Fixture: wire-discipline true negatives — the sanctioned shapes."""
+
+from cubefs_tpu.sdk import WireClient
+from cubefs_tpu.utils import packet
+
+
+def shared_conn(addr):
+    # the sdk surface owns the one mux connection per target
+    return WireClient(addr, timeout=5.0)
+
+
+def scatter_gather(sock, hdr, payload):
+    # buffer list through the transport's sendmsg path: no coalescing
+    return packet._sendmsg_all(sock, [hdr, payload])
+
+
+def plain_send(sock, frame):
+    # a single pre-built buffer is fine — no concat copy at the call
+    sock.sendall(frame)
+
+
+def server_side(handlers):
+    # servers are not fenced; only client connection construction is
+    return packet.PacketServer(handlers, service="fixture")

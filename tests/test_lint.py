@@ -36,6 +36,7 @@ from tool.lint.checkers.tier1_purity import Tier1PurityChecker
 from tool.lint.checkers.tiering_discipline import TieringDisciplineChecker
 from tool.lint.checkers.tracer_safety import (TraceClockChecker,
                                               TracerSafetyChecker)
+from tool.lint.checkers.wire_discipline import WireDisciplineChecker
 from tool.lint.checkers.witness_discipline import WitnessDisciplineChecker
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
@@ -551,6 +552,29 @@ def test_witness_discipline_scope():
     assert not c.applies("cubefs_tpu/utils/rpc.py")
     # ... and the witness module is exempt from its own rule
     assert not c.applies("cubefs_tpu/utils/lockwitness.py")
+
+
+# ---------------- wire-discipline (CFX00x) ----------------
+
+def test_wire_discipline_true_positives():
+    mod = _module("wire_bad.py", "cubefs_tpu/tool/fx.py")
+    found = WireDisciplineChecker().check(mod)
+    assert _codes(found) == ["CFX001", "CFX001", "CFX001", "CFX002"]
+
+
+def test_wire_discipline_true_negative():
+    mod = _module("wire_good.py", "cubefs_tpu/tool/fx.py")
+    assert WireDisciplineChecker().check(mod) == []
+
+
+def test_wire_discipline_sanctums_exempt():
+    c = WireDisciplineChecker()
+    assert c.applies("cubefs_tpu/tool/loadgen.py")
+    assert c.applies("cubefs_tpu/fs/metanode.py")
+    # the transport itself and its two sanctioned consumers are home
+    assert not c.applies("cubefs_tpu/utils/packet.py")
+    assert not c.applies("cubefs_tpu/fs/client.py")
+    assert not c.applies("cubefs_tpu/sdk/clients.py")
 
 
 # ---------------- baseline ordering + summary cache + wall time ----------------

@@ -1,0 +1,310 @@
+"""Mux-mode packet plane (smux analog): many streams on one persistent
+connection, demuxed by req_id. Covers the ISSUE-17 interleaving matrix:
+out-of-order delivery, mid-stream peer death semantics, per-chunk CRC
+poisoning one stream (not the connection), and a seeded chaos drill
+whose injected-fault schedule digest reproduces exactly."""
+
+import threading
+import time
+
+import pytest
+
+from cubefs_tpu.utils import faultinject as fi
+from cubefs_tpu.utils import packet
+from cubefs_tpu.utils.faultinject import FaultPlan
+
+
+@pytest.fixture
+def echo_srv():
+    """Packet server with handlers built for interleaving tests:
+    op_ping echoes, OP_READ echoes its payload back after an optional
+    args-driven sleep, OP_WRITE parks on an Event until released."""
+    release = threading.Event()
+
+    def slow_echo(hdr, args, payload):
+        ms = args.get("sleep_ms", 0)
+        if ms:
+            time.sleep(ms / 1000.0)
+        return {"echo": args.get("tag")}, bytes(payload)
+
+    def parked(hdr, args, payload):
+        release.wait(timeout=30)
+        return {"parked": True}, b""
+
+    srv = packet.PacketServer({
+        packet.OP_PING: lambda h, a, p: ({"pong": a.get("tag")}, b""),
+        packet.OP_READ: slow_echo,
+        packet.OP_WRITE: parked,
+    }, service="echo").start()
+    yield srv, release
+    release.set()
+    srv.stop()
+
+
+def test_out_of_order_responses_reach_right_futures(echo_srv):
+    srv, _ = echo_srv
+    cli = packet.PacketClient(srv.addr)
+    assert cli.mux  # default door position
+    try:
+        done_order = []
+        # slow stream enters the wire FIRST, fast ones after it; with
+        # one shared connection the fast replies must overtake the slow
+        # one and land on their own futures
+        slow = cli.call_async(packet.OP_READ,
+                              args={"sleep_ms": 300, "tag": "slow"},
+                              payload=b"S")
+        fast = [cli.call_async(packet.OP_READ, args={"tag": f"f{i}"},
+                               payload=b"F%d" % i)
+                for i in range(4)]
+        for i, f in enumerate(fast):
+            a, p = f.result(10)
+            done_order.append(a["echo"])
+            assert (a["echo"], bytes(p)) == (f"f{i}", b"F%d" % i)
+        a, p = slow.result(10)
+        done_order.append(a["echo"])
+        assert (a["echo"], bytes(p)) == ("slow", b"S")
+        assert done_order[-1] == "slow"  # overtaken, not serialized
+        # everything rode ONE connection
+        assert cli._mux is not None and cli._mux.dead is None
+    finally:
+        cli.close()
+
+
+def test_peer_death_fails_exactly_inflight_not_queued(echo_srv):
+    srv, release = echo_srv
+    cli = packet.PacketClient(srv.addr, timeout=5.0)
+    try:
+        # two requests parked server-side = the in-flight set
+        inflight = [cli.call_async(packet.OP_WRITE, idempotent=False)
+                    for _ in range(2)]
+        time.sleep(0.05)  # let the frames reach the server
+        conn = cli._mux
+        conn.sock.shutdown(2)  # mid-stream peer death (RST/EOF shape)
+        for f in inflight:
+            with pytest.raises(ConnectionError):
+                f.result(5)
+        # requests issued AFTER the death are not poisoned: they dial a
+        # fresh connection and succeed
+        release.set()
+        a, _ = cli.call(packet.OP_PING, args={"tag": "post"})
+        assert a["pong"] == "post"
+        assert cli._mux is not conn
+    finally:
+        cli.close()
+
+
+def test_chunk_crc_corruption_drops_only_afflicted_stream(echo_srv,
+                                                          monkeypatch):
+    monkeypatch.setenv("CUBEFS_PKT_CHUNK", "4096")
+    srv, _ = echo_srv
+    cli = packet.PacketClient(srv.addr, timeout=10.0)
+    try:
+        plan = FaultPlan(seed=5)
+        # exactly ONE reply frame of the echo handler gets a payload
+        # byte flipped under its already-computed chunk CRC
+        plan.on("echo", "frame_reply_read", kind="corrupt", times=1)
+        with fi.installed(plan):
+            victim = cli.call_async(packet.OP_READ,
+                                    args={"sleep_ms": 50, "tag": "v"},
+                                    payload=b"V" * 20_000)
+            time.sleep(0.15)  # victim's multi-chunk reply train first
+            bystander = cli.call_async(packet.OP_READ,
+                                       args={"tag": "b"}, payload=b"B")
+            conn = cli._mux
+            with pytest.raises(packet.PacketError) as ei:
+                victim.result(10)
+            assert isinstance(ei.value, packet.CrcError)
+            a, p = bystander.result(10)
+            assert (a["echo"], bytes(p)) == ("b", b"B")
+        # the CONNECTION survived the poisoned stream
+        assert cli._mux is conn and conn.dead is None
+        a, _ = cli.call(packet.OP_PING, args={"tag": "alive"})
+        assert a["pong"] == "alive"
+    finally:
+        cli.close()
+
+
+def test_interleaved_big_write_does_not_block_meta_ops(echo_srv,
+                                                       monkeypatch):
+    """The HOL-blocking criterion: a multi-megabyte continuation train
+    on the shared connection must not serialize a small op behind it."""
+    monkeypatch.setenv("CUBEFS_PKT_CHUNK", "65536")
+    srv, _ = echo_srv
+    cli = packet.PacketClient(srv.addr, timeout=30.0)
+    try:
+        big = cli.call_async(packet.OP_READ, args={"tag": "big"},
+                             payload=b"x" * (4 << 20))
+        t0 = time.perf_counter()
+        a, _ = cli.call(packet.OP_PING, args={"tag": "small"})
+        small_dt = time.perf_counter() - t0
+        assert a["pong"] == "small"
+        a, p = big.result(30)
+        assert len(p) == 4 << 20 and a["echo"] == "big"
+        # the small op completed while the train was in flight; allow
+        # generous slack for a loaded 1-core CI box
+        assert small_dt < 5.0
+    finally:
+        cli.close()
+
+
+def _chaos_drill(seed: int, srv) -> tuple[str, list]:
+    """One deterministic op sequence under frame-level chaos; returns
+    (schedule digest, outcome shapes). Serial issue order keeps the
+    per-(addr, method) fault counters deterministic."""
+    plan = FaultPlan(seed=seed)
+    mux_addr = None
+    outcomes = []
+    cli = packet.PacketClient(srv.addr, timeout=5.0)
+    try:
+        mux_addr = f"{cli.host}:{cli.port}"
+        # client-send faults key on the socket addr, reply faults on the
+        # service name; mix all three kinds across both directions
+        plan.on(mux_addr, "frame_ping", kind="drop_before", after=2,
+                times=1)
+        plan.on(mux_addr, "frame_ping", kind="delay", delay=0.01,
+                every=3)
+        plan.on("echo", "frame_reply_read", kind="corrupt", after=1, times=1)
+        plan.on("echo", "frame_reply_ping", kind="drop_after", after=8,
+                times=1)
+        with fi.installed(plan):
+            for i in range(12):
+                try:
+                    if i % 3 == 2:
+                        a, p = cli.call(packet.OP_READ,
+                                        args={"tag": f"r{i}"},
+                                        payload=b"p%d" % i)
+                        outcomes.append(("read_ok", a["echo"]))
+                    else:
+                        a, _ = cli.call(packet.OP_PING,
+                                        args={"tag": f"t{i}"})
+                        outcomes.append(("ping_ok", a["pong"]))
+                except packet.PacketError as e:
+                    outcomes.append(("pkt_err", e.result))
+                except (ConnectionError, OSError):
+                    outcomes.append(("conn_err", None))
+                except TimeoutError:
+                    outcomes.append(("timeout", None))
+            digest = plan.schedule_digest()
+            assert plan.schedule(), "drill injected no faults"
+        return digest, outcomes
+    finally:
+        cli.close()
+
+
+def test_seeded_chaos_drill_digest_reproducible():
+    """Same seed + same op sequence => identical injected-fault schedule
+    digest AND identical outcome shapes, run to run (two fresh servers,
+    two fresh clients — nothing carries over but the seed). The port is
+    pinned across runs: client-side frame faults key on host:port, and
+    the digest hashes the injection sites."""
+    import socket as _socket
+
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    runs = []
+    for _ in range(2):
+        handlers = {
+            packet.OP_PING: lambda h, a, p: ({"pong": a.get("tag")}, b""),
+            packet.OP_READ: lambda h, a, p: ({"echo": a.get("tag")},
+                                             bytes(p)),
+        }
+        for attempt in range(100):  # prior run's conns drain from the port
+            try:
+                srv = packet.PacketServer(handlers, port=port,
+                                          service="echo")
+                break
+            except OSError:
+                time.sleep(0.05)
+        else:
+            pytest.fail(f"port {port} never freed")
+        srv.start()
+        try:
+            runs.append(_chaos_drill(seed=1701, srv=srv))
+        finally:
+            srv.stop()
+    (d1, o1), (d2, o2) = runs
+    assert d1 == d2
+    assert o1 == o2
+
+
+def test_mux_door_off_keeps_legacy_serial_semantics(echo_srv,
+                                                    monkeypatch):
+    """CUBEFS_PKT_MUX=0 is the A/B control: same results, no mux conn,
+    call_async degrades to an eager resolved future."""
+    monkeypatch.setenv("CUBEFS_PKT_MUX", "0")
+    srv, _ = echo_srv
+    cli = packet.PacketClient(srv.addr)
+    try:
+        assert not cli.mux
+        fut = cli.call_async(packet.OP_READ, args={"tag": "legacy"},
+                             payload=b"L")
+        assert fut.done()
+        a, p = fut.result(0)
+        assert (a["echo"], bytes(p)) == ("legacy", b"L")
+        assert cli._mux is None
+    finally:
+        cli.close()
+
+
+def test_ordered_ops_execute_in_arrival_order_per_lane():
+    """Opcodes in ordered_ops must run in arrival order per
+    (partition, extent) lane even when the worker pool would reorder
+    them — the datanode's append-vs-overwrite classifier depends on
+    it. A handler-side jitter makes pool reordering near-certain for
+    unordered dispatch."""
+    applied: dict[tuple, list] = {}
+    lock = threading.Lock()
+
+    def op_write(hdr, args, payload):
+        # first-arrived piece sleeps longest: an unordered pool would
+        # finish later pieces first and invert the log
+        time.sleep(args["jitter_ms"] / 1000.0)
+        with lock:
+            applied.setdefault(
+                (hdr["partition"], hdr["extent"]), []).append(hdr["offset"])
+        return {}, b""
+
+    srv = packet.PacketServer(
+        {packet.OP_WRITE: op_write}, service="lane",
+        ordered_ops={packet.OP_WRITE}).start()
+    cli = packet.PacketClient(srv.addr, timeout=10.0)
+    try:
+        n = 8
+        futs = []
+        for ext in (1, 2):
+            for i in range(n):
+                futs.append(cli.call_async(
+                    packet.OP_WRITE, partition=7, extent=ext, offset=i,
+                    args={"jitter_ms": (n - i) * 5}))
+        for f in futs:
+            f.result(10.0)
+        # each lane saw its pieces strictly in send order
+        assert applied[(7, 1)] == list(range(n))
+        assert applied[(7, 2)] == list(range(n))
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_cli_wire_view_renders_packet_metrics():
+    from cubefs_tpu.cli import _wire_view
+    from cubefs_tpu.utils import metrics
+
+    srv = packet.PacketServer(
+        {packet.OP_PING: lambda h, a, p: ({"ok": 1}, b"")},
+        service="view").start()
+    cli = packet.PacketClient(srv.addr, timeout=5.0)
+    try:
+        for _ in range(4):
+            cli.call(packet.OP_PING)
+        view = _wire_view(metrics.DEFAULT.render_text())
+        assert view["frames"]["client/tx"] >= 4.0
+        assert view["frames"]["server/rx"] >= 4.0
+        if cli.mux:
+            assert view["mux"]["conns"] >= 1.0
+            assert view["mux"]["send_queue_waits"] >= 4.0
+    finally:
+        cli.close()
+        srv.stop()

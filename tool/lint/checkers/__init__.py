@@ -14,6 +14,7 @@ from .rpc_idempotency import RpcIdempotencyChecker
 from .tier1_purity import Tier1PurityChecker
 from .tiering_discipline import TieringDisciplineChecker
 from .tracer_safety import TraceClockChecker, TracerSafetyChecker
+from .wire_discipline import WireDisciplineChecker
 from .witness_discipline import WitnessDisciplineChecker
 
 ALL_CHECKERS = (
@@ -31,6 +32,7 @@ ALL_CHECKERS = (
     TieringDisciplineChecker,
     IntegrityDisciplineChecker,
     WitnessDisciplineChecker,
+    WireDisciplineChecker,
 )
 
 # Checkers that need the whole-program graph (tool/lint/graph.py); the

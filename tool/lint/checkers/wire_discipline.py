@@ -1,0 +1,84 @@
+"""Wire-plane discipline (rule: wire-discipline, codes CFX00x).
+
+The binary packet plane multiplexes many streams over one persistent
+connection per peer (utils/packet.py). That property only holds if
+connections are actually SHARED: a stray `PacketClient(...)` spawns a
+private socket + reader thread per call site, silently splitting the
+mux and defeating the windowed pipelining the fs client and sdk tune
+around. Frame assembly has the same trap in the other direction — the
+transport ships scatter-gather buffer lists through sendmsg, so a
+`sock.sendall(a + b)` that coalesces by concatenation reintroduces the
+copy the wire layer exists to avoid.
+
+  CFX001  `PacketClient(...)` constructed outside the wire sanctums
+          (utils/packet.py itself, the fs client plumbing, the sdk's
+          WireClient) — route it through sdk.WireClient, or the fs
+          client's per-plane cache, so connections stay shared and
+          accounted
+  CFX002  `.sendall(a + b)` — a concatenated send copies the payload
+          to glue a header on; build a buffer list and use the
+          transport's scatter-gather path (packet._sendmsg_all)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+_SANCTUMS = (
+    "cubefs_tpu/utils/packet.py",
+    "cubefs_tpu/fs/client.py",
+    "cubefs_tpu/sdk/clients.py",
+)
+
+
+class WireDisciplineChecker(Checker):
+    rule = "wire-discipline"
+    dirs = ("cubefs_tpu/",)
+
+    def applies(self, relpath: str) -> bool:
+        return super().applies(relpath) and relpath not in _SANCTUMS
+
+    def check(self, mod: Module) -> list[Violation]:
+        # names bound to the packet module: `import ...utils.packet
+        # [as pkt]` or `from ..utils import packet [as pkt]`
+        pkt_aliases = {a for a, full in mod.import_aliases.items()
+                       if full == "packet" or full.endswith("utils.packet")}
+        pkt_aliases |= {a for a, full in mod.from_imports.items()
+                        if full == "utils.packet"
+                        or full.endswith(".utils.packet")}
+        # names bound to the class itself: `from ...packet import
+        # PacketClient [as PC]`
+        ctor_names = {a for a, full in mod.from_imports.items()
+                      if full.endswith("packet.PacketClient")}
+        out: list[Violation] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = False
+            if isinstance(func, ast.Attribute) and \
+                    func.attr == "PacketClient":
+                if isinstance(func.value, ast.Name) and \
+                        func.value.id in pkt_aliases:
+                    hit = True
+            elif isinstance(func, ast.Name) and func.id in ctor_names:
+                hit = True
+            if hit:
+                out.append(self.violation(
+                    mod, "CFX001", node,
+                    "PacketClient() outside the wire sanctums spawns a "
+                    "private connection + reader thread per call site — "
+                    "go through sdk.WireClient (or the fs client's "
+                    "per-plane cache) so the mux stays shared"))
+                continue
+            if isinstance(func, ast.Attribute) and func.attr == "sendall" \
+                    and node.args and isinstance(node.args[0], ast.BinOp) \
+                    and isinstance(node.args[0].op, ast.Add):
+                out.append(self.violation(
+                    mod, "CFX002", node,
+                    "sendall(a + b) copies the payload to glue buffers "
+                    "together — pass a buffer list through the "
+                    "transport's scatter-gather send instead"))
+        return out
