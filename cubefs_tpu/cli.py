@@ -306,6 +306,57 @@ def _wire_view(text: str) -> dict:
     }
 
 
+def _geo_view(text: str) -> dict:
+    """The geo-replication digest: per-partition lag and at-risk bytes
+    (the live RPO), applied/duplicate/gap/corrupt outcome counts on the
+    follower, backfill mode split (ring vs full bootstrap), fencing
+    rejections (a healed old primary replaying a divergent tail — each
+    one is a double-apply that did NOT happen), and this node's
+    promote/failback state + fencing epoch."""
+    series = _parse_metrics(text)
+
+    def by_label(name, label):
+        out = {}
+        for n, lb, v in series:
+            if n == name:
+                key = lb.get(label, "")
+                out[key] = out.get(key, 0) + v
+        return out
+
+    parts = sorted({lb["part"] for n, lb, _ in series
+                    if n in ("cubefs_geo_lag_seconds",
+                             "cubefs_geo_rpo_bytes") and "part" in lb})
+    per_part = {}
+    for p in parts:
+        outcomes = {lb.get("outcome", ""): v for n, lb, v in series
+                    if n == "cubefs_geo_applied_total"
+                    and lb.get("part") == p}
+        per_part[p] = {
+            "lag_s": sum(v for n, lb, v in series
+                         if n == "cubefs_geo_lag_seconds"
+                         and lb.get("part") == p),
+            "rpo_bytes": sum(v for n, lb, v in series
+                             if n == "cubefs_geo_rpo_bytes"
+                             and lb.get("part") == p),
+            "applied": outcomes,
+        }
+    states = by_label("cubefs_geo_state", "cluster")
+    from .utils.georepl import STATES
+    return {
+        "clusters": {c: {"state": STATES[int(v)]
+                         if 0 <= int(v) < len(STATES) else v,
+                         "epoch": by_label("cubefs_geo_epoch",
+                                           "cluster").get(c, 0)}
+                     for c, v in states.items()},
+        "parts": per_part,
+        "shipped": by_label("cubefs_geo_shipped_total", "part"),
+        "backfills": by_label("cubefs_geo_backfills_total", "kind"),
+        "fencing_rejections": by_label(
+            "cubefs_geo_fencing_rejections_total", "part"),
+        "redirects": by_label("cubefs_geo_redirects_total", "part"),
+    }
+
+
 def _qos_view(text: str) -> dict:
     """The overload-protection digest: per-tenant admit/shed/throttle
     counters, shaping waits, and burn-rate brownout state per path —
@@ -570,11 +621,21 @@ def main(argv=None):
     p_topo.add_argument("--max-moves", type=int,
                         help="cap unit migrations queued this sweep")
 
+    p_geo = sub.add_parser("geo")  # cross-cluster replication / DR
+    p_geo.add_argument("action",
+                       choices=["status", "fence", "promote", "demote",
+                                "failback-sync", "resume-following"])
+    p_geo.add_argument("--gateway", required=True,
+                       help="this region's geo gateway RPC addr")
+    p_geo.add_argument("--op-id",
+                       help="idempotency key for transitions (a retried "
+                            "promote replays instead of re-fencing)")
+
     p_metrics = sub.add_parser("metrics")  # node observability views
     p_metrics.add_argument("action",
                            choices=["write-path", "codec", "repair", "slo",
                                     "read-path", "qos", "tiering",
-                                    "integrity", "wire", "raw"])
+                                    "integrity", "wire", "geo", "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
 
@@ -891,8 +952,21 @@ def main(argv=None):
             print(json.dumps(_integrity_view(text), indent=2))
         elif args.action == "wire":
             print(json.dumps(_wire_view(text), indent=2))
+        elif args.action == "geo":
+            print(json.dumps(_geo_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
+
+    elif args.group == "geo":
+        from .sdk.clients import GeoClient
+
+        geo = GeoClient(args.gateway)
+        if args.action == "status":
+            out = geo.status()
+        else:
+            out = geo.transition(args.action.replace("-", "_"),
+                                 op_id=args.op_id)
+        print(json.dumps(out, indent=2))
 
     elif args.group == "scrub":
         sched = rpc.Client(args.scheduler)

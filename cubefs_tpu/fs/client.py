@@ -344,7 +344,13 @@ class MetaWrapper:
             if e.code == 499 and e.message.startswith("errno="):
                 errno_ = int(e.message[len("errno="):].split(":", 1)[0])
                 raise FsError(errno_, e.message) from None
-            if 400 <= e.code < 500 and e.code not in (404, self.REDIRECT):
+            if (400 <= e.code < 500
+                    and e.code not in (404, self.REDIRECT,
+                                       rpc.GEO_REDIRECT)):
+                # 452 (GeoRedirect) is a ROUTING code like 421, not an
+                # errno: call_replicas already retried the advertised
+                # primary; if it still surfaces, bubble the transport
+                # error instead of minting a bogus errno-52
                 raise FsError(e.code - 400, e.message) from None
             raise
 

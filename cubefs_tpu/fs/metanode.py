@@ -18,7 +18,7 @@ import time
 import uuid
 import zlib
 
-from ..utils import lockwitness, rpc
+from ..utils import lockwitness, metrics, rpc
 from ..utils import trace as tracelib
 
 ROOT_INO = 1
@@ -93,6 +93,15 @@ class MetaPartition:
         # (meta_quota_manager.go analog) — NOT part of the FSM: they gate
         # the leader's submit door, never the deterministic apply
         self.enforce = {"vol_full": False, "exceeded": set()}
+        # geo-replication hooks (fs/georepl.py). The tap fires post-
+        # apply under self._lock on the serving side so the shipped
+        # sequence mirrors commit order; follower mode bounces every
+        # mutation with GeoRedirect while reads keep serving locally.
+        # All None/off by default: with CUBEFS_GEO shut nothing here
+        # ever fires and the FSM digest is byte-identical to pre-geo.
+        self.geo_tap = None
+        self.geo_mode: str | None = None
+        self.geo_primary: str | None = None
         self.data_dir = data_dir
         # native read-plane mirror (runtime/src/metaserve.cc): when
         # attached, every apply re-states its tree mutation into the C++
@@ -113,6 +122,37 @@ class MetaPartition:
                         "mode": 0o755, "ts": 0.0})
 
     # ---------------- apply door (replication interface) ----------------
+    def _geo_gate(self) -> None:
+        """Follower fence: a geo-follower partition serves reads but
+        bounces every mutation to the primary region with GeoRedirect
+        (452, "primary=<addr>") — the ONE mutation choke point on this
+        class (lint CFG002 pins its presence in the commit doors).
+        Shipped records from the primary enter through `geo_apply`,
+        never here."""
+        if self.geo_mode == "follower":
+            metrics.geo_redirects.inc(
+                part=getattr(self, "geo_part", str(self.pid)))
+            raise rpc.RpcError(rpc.GEO_REDIRECT,
+                               f"primary={self.geo_primary or ''}")
+
+    def geo_apply(self, record: dict) -> dict:
+        """The GeoApplier's sanctioned commit door on a follower
+        partition (lint CFG001): same apply+oplog discipline as submit,
+        minus the follower fence (shipped records ARE the primary's
+        committed mutations — they must land) and minus the shipper tap
+        (a follower never echoes the stream back). Records arrive with
+        the primary's ts stamped; op_id dedup absorbs stream replays."""
+        with self._lock:
+            result = self.apply(dict(record))
+            if self._oplog is not None:
+                self._oplog.write(json.dumps(
+                    {"aid": self.apply_id, **record}) + "\n")
+                self._oplog.flush()
+                self._oplog_records += 1
+                if self._oplog_records >= self.SNAPSHOT_EVERY:
+                    self.snapshot()
+            return result
+
     def submit(self, record: dict) -> dict:
         """Validate + apply + log one mutation; returns the result.
         Auto-checkpoints every SNAPSHOT_EVERY records so oplog replay
@@ -122,6 +162,7 @@ class MetaPartition:
         record: apply handlers must never read it themselves, or
         replicas/WAL replays stamp divergent mtimes (fsm-purity CFM001).
         Records arriving via oplog replay or raft already carry ts."""
+        self._geo_gate()
         record.setdefault("ts", time.time())
         with self._lock:
             result = self.apply(record)
@@ -136,6 +177,10 @@ class MetaPartition:
                 self._oplog_records += 1
                 if self._oplog_records >= self.SNAPSHOT_EVERY:
                     self.snapshot()
+            if self.geo_tap is not None:
+                # under the partition lock, post-apply: the shipper's
+                # per-partition sequence mirrors commit order
+                self.geo_tap(record)
             return result
 
     def submit_many(self, records: list[dict]) -> list:
@@ -145,6 +190,7 @@ class MetaPartition:
         its own apply-id — a batch is a commit-door optimization, not a
         WAL format, so crash replay is identical to N separate submits.
         Returns per-op outcomes [[result, None] | [None, [code, msg]]]."""
+        self._geo_gate()
         now = time.time()
         for rec in records:
             rec.setdefault("ts", now)  # one proposer-side clock read
@@ -158,6 +204,10 @@ class MetaPartition:
                     # single-op door, whose replay assumes every oplog
                     # record re-applies cleanly
                     lines.append(json.dumps({"aid": self.apply_id, **rec}))
+                    if self.geo_tap is not None:
+                        # per ok constituent, in apply order: the geo
+                        # stream has no batch framing, only sequence
+                        self.geo_tap(rec)
                 except MetaError as e:
                     outs.append([None, [e.code, str(e)]])
             if self._oplog is not None and lines:
@@ -525,6 +575,7 @@ class MetaPartition:
         drop-after-execute / duplicate delivery (faultinject.FaultPlan)
         on alloc_ino must mint exactly one ino — the _alloc_cache door
         here is what makes the rpc.call idempotency contract hold."""
+        self._geo_gate()
         with self._lock:
             if op_id is not None and op_id in self._alloc_cache:
                 return self._alloc_cache[op_id]

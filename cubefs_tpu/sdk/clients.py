@@ -251,6 +251,36 @@ class MetaNodeClient(_Base):
         return self._call("stat")[0]
 
 
+class GeoClient(_Base):
+    """Geo-replication gateway surface (fs/georepl.GeoGateway): status
+    for the CLI views, op_id-stamped transitions for the fenced
+    promote/failback runbook — the stamp is what makes a retried
+    `promote` replay its recorded outcome instead of minting a second
+    fencing epoch."""
+
+    def status(self) -> dict:
+        return self._call("geo_status")[0]
+
+    def transition(self, op: str, op_id: str | None = None) -> dict:
+        return self._call("geo_transition", {
+            "op": op, "op_id": op_id or uuid.uuid4().hex})[0]
+
+    def fence(self, op_id: str | None = None) -> dict:
+        return self.transition("fence", op_id)
+
+    def promote(self, op_id: str | None = None) -> dict:
+        return self.transition("promote", op_id)
+
+    def demote(self, op_id: str | None = None) -> dict:
+        return self.transition("demote", op_id)
+
+    def failback_sync(self, op_id: str | None = None) -> dict:
+        return self.transition("failback_sync", op_id)
+
+    def resume_following(self, op_id: str | None = None) -> dict:
+        return self.transition("resume_following", op_id)
+
+
 class WireClient:
     """Packet-plane client surface (sdk/data streamer analog): the
     sanctioned home for raw binary-plane connections outside the fs

@@ -4,7 +4,8 @@ Role parity: blobstore/testing/dial's live prober and the per-disk
 fault hooks on BlobNode, generalized: one ``FaultPlan`` describes every
 fault a scenario injects — transport drops, delays, 5xx brownouts,
 CRC-corrupt bodies, stale keep-alive sockets, duplicate delivery,
-symmetric network partitions, and broken disks — keyed by
+symmetric and one-way network partitions, seeded WAN latency edges,
+and broken disks — keyed by
 ``(addr, method, invocation_index)`` so the schedule is a pure function
 of the seed and the call sequence.
 
@@ -47,7 +48,7 @@ _SENDER: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "faultinject_sender", default=None)
 
 KINDS = ("drop_before", "drop_after", "delay", "error", "corrupt",
-         "stale", "duplicate")
+         "stale", "duplicate", "wan")
 
 # at-rest faults: data already ON DISK goes bad, keyed like disk faults
 # by (node_addr, disk_id) plus a unit key naming the payload —
@@ -78,15 +79,20 @@ class Rule:
     times: int | None = None  # max injections (None = unlimited)
     every: int = 1            # then inject every Nth matching invocation
     prob: float | None = None  # seeded per-invocation probability
-    delay: float = 0.0        # seconds, kind == "delay"
-    jitter: float = 0.0       # extra seconds, seeded draw, kind == "delay"
+    delay: float = 0.0        # seconds, kind in ("delay", "wan")
+    jitter: float = 0.0       # extra seconds, seeded draw, delay/wan
     code: int = 503           # kind == "error"
     message: str | None = None
+    src: str = "*"            # sender identity filter (kind == "wan":
+    #                           a WAN edge is keyed (src, dst); senders
+    #                           declare identity via sender())
     hits: int = 0
 
-    def matches_site(self, addr: str, method: str) -> bool:
+    def matches_site(self, addr: str, method: str,
+                     sender: str | None = None) -> bool:
         return (self.addr in ("*", addr)
-                and self.method in ("*", method))
+                and self.method in ("*", method)
+                and (self.src == "*" or self.src == sender))
 
 
 class FaultPlan:
@@ -107,6 +113,7 @@ class FaultPlan:
         self._counters: dict[tuple[str, str], int] = {}
         self._lock = threading.Lock()
         self._partitions: list[tuple[frozenset, frozenset]] = []
+        self._oneway: list[tuple[frozenset, frozenset]] = []
         self._isolated: set[str] = set()
         self._broken_disks: set[tuple[str, int]] = set()
         # (node_addr, disk_id, unit) -> at-rest fault kind
@@ -135,9 +142,35 @@ class FaultPlan:
             self._partitions.append((frozenset(group_a), frozenset(group_b)))
         return self
 
+    def partition_oneway(self, src_group, dst_group) -> "FaultPlan":
+        """Asymmetric partition: traffic FROM src_group TO dst_group
+        drops; the reverse direction flows. Models a region that can
+        hear but not be heard — the split-brain-inducing case geo
+        fencing epochs must survive. Enforcement requires sender()
+        identity on the src side (the geo pump and raft declare it);
+        anonymous senders are never in src_group."""
+        with self._lock:
+            self._oneway.append((frozenset(src_group), frozenset(dst_group)))
+        return self
+
+    def wan(self, group_a, group_b, delay: float = 0.001,
+            jitter: float = 0.0002) -> "FaultPlan":
+        """Seeded WAN emulation between two regions: every rpc crossing
+        the (src, dst) edge in either direction pays `delay` plus a
+        seeded jitter draw. A distinct fault kind ("wan") so the
+        schedule digest distinguishes geography from injected delay
+        faults. Needs sender() identity, like one-way partitions."""
+        for src, dst in [(group_a, group_b), (group_b, group_a)]:
+            for s in src:
+                for d in dst:
+                    self.rules.append(Rule(addr=d, src=s, kind="wan",
+                                           delay=delay, jitter=jitter))
+        return self
+
     def heal(self) -> "FaultPlan":
         with self._lock:
             self._partitions.clear()
+            self._oneway.clear()
             self._isolated.clear()
         return self
 
@@ -230,32 +263,38 @@ class FaultPlan:
     def _check_partition(self, addr: str, method: str) -> None:
         src = _SENDER.get()
         with self._lock:
-            cut = False
+            cut = None
             if addr in self._isolated and src != addr:
-                cut = True
+                cut = "partition"
             elif src is not None:
                 if src in self._isolated and addr != src:
-                    cut = True
+                    cut = "partition"
                 else:
                     for a, b in self._partitions:
                         if ((src in a and addr in b)
                                 or (src in b and addr in a)):
-                            cut = True
+                            cut = "partition"
                             break
+                    if cut is None:
+                        for a, b in self._oneway:
+                            if src in a and addr in b:
+                                cut = "partition_oneway"
+                                break
             if cut:
                 idx = self._counters.get((addr, method), 0)
-                self._log("partition", addr, method, idx)
+                self._log(cut, addr, method, idx)
         if cut:
             raise rpc.ServiceUnavailable(
                 503, f"{addr}/{method}: injected network partition "
                      f"(from {src or 'anonymous'})")
 
     def _decide(self, addr: str, method: str) -> Rule | None:
+        sender_ = _SENDER.get()
         with self._lock:
             idx = self._counters.get((addr, method), 0)
             self._counters[(addr, method)] = idx + 1
             for rule in self.rules:
-                if not rule.matches_site(addr, method):
+                if not rule.matches_site(addr, method, sender_):
                     continue
                 if idx < rule.after:
                     continue
@@ -285,7 +324,7 @@ class FaultPlan:
         if rule is None:
             return inner(addr, method, args, body, timeout)
         k = rule.kind
-        if k == "delay":
+        if k in ("delay", "wan"):
             self._sleep_for(rule, addr, method)
             return inner(addr, method, args, body, timeout)
         if k == "drop_before":
@@ -319,7 +358,7 @@ class FaultPlan:
         if rule is None:
             return invoke()
         k = rule.kind
-        if k == "delay":
+        if k in ("delay", "wan"):
             self._sleep_for(rule, addr, method)
             return invoke()
         if k == "drop_before":
@@ -356,7 +395,7 @@ class FaultPlan:
         rule = self._decide(addr, method)
         if rule is None:
             return None
-        if rule.kind == "delay":
+        if rule.kind in ("delay", "wan"):
             self._sleep_for(rule, addr, method)
             return None
         if rule.kind in ("drop_before", "drop_after", "corrupt"):
@@ -375,7 +414,7 @@ class FaultPlan:
         rule = self._decide(addr, method)
         if rule is None:
             return
-        if rule.kind == "delay":
+        if rule.kind in ("delay", "wan"):
             self._sleep_for(rule, addr, method)
             return
         raise InjectedCrash(

@@ -111,6 +111,12 @@ class ReplicatedFsm:
         self._fsm_op_cache: dict[str, tuple] = {}  # op_id -> (result, exc)
         self.raft = None
         self.extra_routes: dict = {}
+        # geo-replication hooks (utils/georepl.py): the shipper tap is
+        # invoked post-apply inside the commit doors; follower mode
+        # fences mutations behind GeoRedirect (see _geo_gate)
+        self.geo_tap = None
+        self._geo_mode: str | None = None
+        self.geo_primary: str | None = None
         self._fsm_dirty: set[str] = set()
         self._segmented = hasattr(self, "_segments_of")
         self._seg_store = None
@@ -145,6 +151,49 @@ class ReplicatedFsm:
         if self.raft is not None and not self.is_leader():
             raise rpc.RpcError(self.REDIRECT,
                                f"leader={self.leader_addr() or ''}")
+
+    # ---------------- geo-replication (utils/georepl.py) ----------------
+    def _geo_gate(self) -> None:
+        """Follower fence: a geo-follower FSM serves reads but bounces
+        every mutation to the primary region with GeoRedirect (452,
+        "primary=<addr>") — the ONE mutation choke point on this class
+        (lint CFG002 pins its presence in the commit doors). Shipped
+        records from the primary enter through `geo_apply`, never
+        here."""
+        if self._geo_mode == "follower":
+            metrics.geo_redirects.inc(
+                part=getattr(self, "geo_part", ""))
+            raise rpc.RpcError(rpc.GEO_REDIRECT,
+                               f"primary={self.geo_primary or ''}")
+
+    def geo_set_mode(self, mode: str | None,
+                     primary: str | None = None) -> None:
+        """Flip this FSM between primary service (None) and geo-follower
+        ("follower", mutations fenced to `primary`)."""
+        if mode not in (None, "follower"):
+            raise ValueError(f"unknown geo mode {mode!r}")
+        self._geo_mode = mode
+        self.geo_primary = primary
+
+    def geo_apply(self, record: dict):
+        """The GeoApplier's sanctioned commit door on a follower FSM
+        (lint CFG001): apply + wal-append exactly like the standalone
+        `_commit`, but bypassing the follower fence (shipped records ARE
+        the primary's already-fenced mutations) and never re-entering
+        `geo_tap` (a follower must not echo the stream back at its
+        source). Raft-replicated hosts are not geo-apply targets — geo
+        replicates cluster-to-cluster, raft replicates within one."""
+        if self.raft is not None:
+            raise rpc.RpcError(
+                500, "geo_apply on a raft-replicated host")
+        with self._wal_lock:
+            out = self._apply_deduped(dict(record))
+            if self._segmented:
+                self._fsm_dirty.update(self._segments_of(record))
+            if self._wal is not None:
+                self._wal.write(_frame(json.dumps(record)))
+                self._wal.flush()
+        return out
 
     # ---------------- commit door ----------------
     FSM_OP_CACHE_SIZE = 4096
@@ -199,6 +248,7 @@ class ReplicatedFsm:
                 del self._fsm_op_cache[k]
 
     def _commit(self, record: dict):
+        self._geo_gate()
         if self.raft is None:
             # apply and wal-append must be one atomic step, else
             # concurrent commits can log in a different order than they
@@ -210,14 +260,21 @@ class ReplicatedFsm:
                 if self._wal is not None:
                     self._wal.write(_frame(json.dumps(record)))
                     self._wal.flush()
+                if self.geo_tap is not None:
+                    # under the wal lock: the shipper's per-partition
+                    # sequence must match commit order
+                    self.geo_tap(record)
             return out
         from ..parallel.raft import NotLeaderError
 
         try:
-            return self.raft.propose(record)
+            out = self.raft.propose(record)
         except NotLeaderError as e:
             raise rpc.RpcError(self.REDIRECT,
                                f"leader={e.leader or ''}") from None
+        if self.geo_tap is not None:
+            self.geo_tap(record)
+        return out
 
     def _commit_many(self, records: list[dict]) -> list:
         """Batch commit door: ONE raft entry (or one wal-lock round in
@@ -226,6 +283,7 @@ class ReplicatedFsm:
         fanned back in order. The wal still records constituents as
         individual lines — a batch entry replays as its constituent
         records, so the replay contract is unchanged."""
+        self._geo_gate()
         if self.raft is None:
             with self._wal_lock:
                 outs = self._apply_deduped(
@@ -243,15 +301,23 @@ class ReplicatedFsm:
                     self._wal.write(
                         "".join(_frame(json.dumps(r)) for r in ok))
                     self._wal.flush()
+                if self.geo_tap is not None:
+                    for r in ok:  # ship applied constituents only
+                        self.geo_tap(r)
             return outs
         from ..parallel.raft import NotLeaderError
 
         try:
-            return self.raft.propose(
+            outs = self.raft.propose(
                 {"op": "__batch__", "records": list(records)})
         except NotLeaderError as e:
             raise rpc.RpcError(self.REDIRECT,
                                f"leader={e.leader or ''}") from None
+        if self.geo_tap is not None:
+            for r, (res, err) in zip(records, outs):
+                if err is None:
+                    self.geo_tap(r)
+        return outs
 
     # ---------------- persistence ----------------
     def _wal_path(self) -> str:

@@ -532,3 +532,46 @@ pkt_stream_drops = DEFAULT.counter(
     "cubefs_pkt_stream_drops_total",
     "streams failed by a per-chunk CRC mismatch while the connection "
     "itself was kept (framing intact)", ("side",))
+
+# cross-cluster geo-replication (utils/georepl.py + fs/georepl.py):
+# per-partition WAL shipping, fenced promote/failback, follower-region
+# read serving. `cubefs-cli metrics geo` renders these.
+geo_lag = DEFAULT.gauge(
+    "cubefs_geo_lag_seconds",
+    "replication lag per shipped partition: ship-stamp age of the last "
+    "record the follower applied (tenant-scoped RPO clock)",
+    ("part", "tenant"))
+geo_rpo_bytes = DEFAULT.gauge(
+    "cubefs_geo_rpo_bytes",
+    "bytes committed on the primary but not yet acknowledged by the "
+    "follower — the data at risk if the region dies right now",
+    ("part", "tenant"))
+geo_shipped = DEFAULT.counter(
+    "cubefs_geo_shipped_total",
+    "records shipped to the peer region, per partition", ("part",))
+geo_applied = DEFAULT.counter(
+    "cubefs_geo_applied_total",
+    "follower-side stream outcomes per partition: `applied`, "
+    "`duplicate` (seq <= applied, idempotent skip), `gap` (backfill "
+    "triggered), `corrupt` (framing/CRC rejected)", ("part", "outcome"))
+geo_fencing_rejections = DEFAULT.counter(
+    "cubefs_geo_fencing_rejections_total",
+    "shipped records rejected for carrying a stale fencing epoch (a "
+    "healed old primary replaying into a promoted follower)", ("part",))
+geo_backfills = DEFAULT.counter(
+    "cubefs_geo_backfills_total",
+    "gap recoveries per partition by kind: `ring` (bounded backfill "
+    "from the shipper's ring) or `bootstrap` (full snapshot transfer "
+    "over the packet mux)", ("part", "kind"))
+geo_state = DEFAULT.gauge(
+    "cubefs_geo_state",
+    "promote/failback state machine position per cluster: 0=PRIMARY "
+    "1=FOLLOWING 2=FENCED 3=PROMOTED 4=FAILBACK_SYNC", ("cluster",))
+geo_epoch = DEFAULT.gauge(
+    "cubefs_geo_epoch",
+    "current fencing epoch per cluster (monotonic; bumps on every "
+    "promote so stale-primary appends are rejectable)", ("cluster",))
+geo_redirects = DEFAULT.counter(
+    "cubefs_geo_redirects_total",
+    "mutations bounced off a follower region with GeoRedirect (the sdk "
+    "retries them against the primary)", ("part",))

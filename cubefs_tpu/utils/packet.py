@@ -88,6 +88,14 @@ OP_META_ALLOC_INO = 0x25
 OP_META_WALK = 0x26
 OP_META_SUBMIT_BATCH = 0x27
 
+# opcodes (geo-replication plane — fs/georepl.py): the cross-cluster
+# snapshot payload rides FLAG_MORE chunk trains like any large frame,
+# so a multi-MB bootstrap never monopolizes the shared mux connection
+# and a corrupt chunk poisons one transfer, not the conn
+OP_GEO_SNAPSHOT = 0x30
+OP_GEO_SHIP = 0x31
+OP_GEO_BACKFILL = 0x32
+
 RESULT_OK = 0
 RESULT_RPC = 0xE1  # structured rpc error: code+message ride the args
 
@@ -102,6 +110,8 @@ OP_NAMES = {
     OP_META_DENTRY_COUNT: "meta_dentry_count",
     OP_META_ALLOC_INO: "meta_alloc_ino", OP_META_WALK: "meta_walk",
     OP_META_SUBMIT_BATCH: "meta_submit_batch",
+    OP_GEO_SNAPSHOT: "geo_snapshot", OP_GEO_SHIP: "geo_ship",
+    OP_GEO_BACKFILL: "geo_backfill",
 }
 
 # opcodes whose transport-level retry is harmless with NO dedup token:
@@ -113,6 +123,9 @@ IDEMPOTENT_OPS = frozenset({
     OP_READ, OP_FINGERPRINT, OP_PING,
     OP_META_LOOKUP, OP_META_INODE_GET, OP_META_READDIR,
     OP_META_DENTRY_COUNT, OP_META_WALK,
+    # geo snapshot/backfill are pure reads of primary state; geo_ship
+    # is retried safely because the applier skips seq <= applied
+    OP_GEO_SNAPSHOT, OP_GEO_BACKFILL, OP_GEO_SHIP,
 })
 
 

@@ -46,15 +46,24 @@ class ServiceUnavailable(RpcError):
     pass
 
 
+# Geo-replication redirect (utils/georepl.py): a follower-region
+# service bounces mutations with this code and a "primary=<addr>"
+# message; the redirect loops below follow it like a 421 leader
+# redirect, so a client pointed at the follower region transparently
+# writes to the primary while its reads keep serving locally.
+GEO_REDIRECT = 452
+
+
 def errno_error(errno_: int, msg: str) -> RpcError:
     """THE errno-on-the-wire encoding, shared by every plane that maps
     POSIX errnos onto RPC statuses: 400+errno for small errnos, except
-    that 404 (not-found pass-through) and 421 (leader redirect, whose
-    message is parsed as an address) are reserved transport codes — those
-    and errnos >= 100 (EDQUOT=122 must not collide with 5xx failover
-    semantics) ride 499 with an "errno=NN: " message prefix. Decoders:
-    fs/client.py MetaWrapper._call and native_client.cc status_to_errno."""
-    if errno_ < 99 and 400 + errno_ not in (404, 421):
+    that 404 (not-found pass-through), 421 (leader redirect, whose
+    message is parsed as an address) and 452 (geo redirect, same) are
+    reserved transport codes — those and errnos >= 100 (EDQUOT=122 must
+    not collide with 5xx failover semantics) ride 499 with an
+    "errno=NN: " message prefix. Decoders: fs/client.py
+    MetaWrapper._call and native_client.cc status_to_errno."""
+    if errno_ < 99 and 400 + errno_ not in (404, 421, GEO_REDIRECT):
         return RpcError(400 + errno_, msg)
     return RpcError(499, f"errno={errno_}: {msg}")
 
@@ -536,6 +545,18 @@ class Client:
                     raise RpcError(
                         503, f"{self._addr}/{method}: leader unresolved"
                     ) from e
+                if e.code == GEO_REDIRECT:
+                    # follower-region fence: mutations bounce to the
+                    # primary region. NOT cached in _leader — reads must
+                    # keep hitting the local (follower) address.
+                    primary = e.message.removeprefix("primary=").strip()
+                    if primary and primary != addr:
+                        addr = primary
+                        if r.tick(reason="geo-redirect", sleep=False):
+                            continue
+                    raise RpcError(
+                        503, f"{self._addr}/{method}: geo primary "
+                             f"unresolved") from e
                 if isinstance(e, ServiceUnavailable) and addr != self._addr:
                     # learned leader died: fall back to the configured addr
                     with self._lock:
@@ -604,6 +625,16 @@ def call_replicas(pool: NodePool, addrs: list[str], method: str,
                     r.tick(reason="election")
                 last = e
                 continue
+            if e.code == GEO_REDIRECT:
+                # mutation hit a geo follower: retry against the primary
+                # region's replica (the follower stays good for reads)
+                primary = e.message.removeprefix("primary=").strip()
+                if primary and primary not in tried:
+                    queue.insert(0, primary)
+                    r.tick(reason="geo-redirect", sleep=False)
+                    last = e
+                    continue
+                raise
             if e.code == 503 and "leader unresolved" in e.message:
                 # a fresh/failed-over raft group mid-election: the node
                 # is ALIVE, just leaderless — wait it out within the

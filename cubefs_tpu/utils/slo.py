@@ -159,6 +159,12 @@ DEFAULT_TARGETS: dict[str, SloTarget] = {
     "blob.get": SloTarget(0.25, 0.999),
     "blob.repair": SloTarget(5.0, 0.99),
     "meta.write": SloTarget(0.25, 0.999),
+    # geo-replication lag rides the same stage histogram: the applier
+    # observes each record's ship-stamp age as a "geo.replication"
+    # total-stage sample, so a lagging follower burns this budget and
+    # trips the SAME brownout machinery as a burning latency SLO
+    # (utils/georepl.py)
+    "geo.replication": SloTarget(2.0, 0.99),
 }
 
 

@@ -1,6 +1,6 @@
 """Suppression fixture: a bare allow[...] with no justification.
 
-Expected: CFG001 on the allow line, AND the underlying CFL001 still
+Expected: CFA001 on the allow line, AND the underlying CFL001 still
 reported — an unjustified allow suppresses nothing.
 """
 import time

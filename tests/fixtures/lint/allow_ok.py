@@ -1,7 +1,7 @@
 """Suppression fixture: a justified allow silences the finding.
 
 Expected: zero findings — the CFL001 is suppressed by the comment on
-the line above the flagged call, and the justification prevents CFG001.
+the line above the flagged call, and the justification prevents CFA001.
 """
 import time
 

@@ -1,0 +1,40 @@
+"""Geo-discipline fixture: both CFG violations.
+
+Expected: CFG001 on the rpc handler's direct geo_apply AND
+restore_state calls (shipped records bypassing GeoApplier.deliver),
+and CFG002 on Partition.submit / Partition.alloc_ino (commit doors on
+a geo-replicable host with no _geo_gate call — submit_many has one and
+must NOT be flagged).
+"""
+
+
+class Gateway:
+    def rpc_geo_ship(self, args, body):
+        part = self.parts[args["part"]]
+        for rec in args["lines"]:
+            part.geo_apply(rec)  # bypasses epoch fence + dedup + gaps
+        return {"ok": True}
+
+    def rpc_geo_resync(self, args, body):
+        self.parts[args["part"]].restore_state(body)
+        return {"ok": True}
+
+
+class Partition:
+    def submit(self, record):
+        with self._lock:
+            return self.apply(record)
+
+    def submit_many(self, records):
+        self._geo_gate()
+        with self._lock:
+            return [self.apply(r) for r in records]
+
+    def alloc_ino(self, op_id=None):
+        with self._lock:
+            self._next_ino += 1
+            return self._next_ino
+
+    def geo_apply(self, record):
+        with self._lock:
+            return self.apply(record)

@@ -1,0 +1,44 @@
+"""Geo-discipline fixture: the sanctioned shapes.
+
+Expected: clean. The rpc handler routes shipped records through the
+applier's deliver door (never a raw apply), every commit door on the
+geo-replicable host calls _geo_gate(), and a class WITHOUT geo_apply
+(plain batcher) owes no gates at all.
+"""
+
+
+class Gateway:
+    def rpc_geo_ship(self, args, body):
+        part = self.parts[args["part"]]
+        return part.applier.deliver(args["lines"])
+
+    def rpc_geo_status(self, args, body):
+        return {"parts": sorted(self.parts)}
+
+
+class Partition:
+    def submit(self, record):
+        self._geo_gate()
+        with self._lock:
+            return self.apply(record)
+
+    def submit_many(self, records):
+        self._geo_gate()
+        with self._lock:
+            return [self.apply(r) for r in records]
+
+    def alloc_ino(self, op_id=None):
+        self._geo_gate()
+        with self._lock:
+            self._next_ino += 1
+            return self._next_ino
+
+    def geo_apply(self, record):
+        with self._lock:
+            return self.apply(record)
+
+
+class Batcher:
+    # no geo_apply: not a replicable host, submit owes no gate
+    def submit(self, record):
+        self.queue.append(record)

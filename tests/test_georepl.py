@@ -1,0 +1,658 @@
+"""Cross-cluster geo-replication: WAL shipping, fenced promote/failback.
+
+The edge matrix the PR's acceptance pins:
+
+* gap -> bounded ring backfill (``cubefs_geo_backfills_total{kind="ring"}``),
+  ring miss -> full snapshot bootstrap (``kind="bootstrap"``), over both
+  the rpc fallback and the PR 17 packet mux (FLAG_MORE chunk trains; a
+  poisoned transfer never poisons the shared connection);
+* duplicate (seq <= applied) -> idempotent skip, byte-identical state;
+* stale fencing epoch from a healed old primary -> REJECTED
+  (``cubefs_geo_fencing_rejections_total``), never double-applied;
+* torn follower WAL tail -> the PR 14 truncation door
+  (``cubefs_wal_torn_tail_total``) then the stream resumes and
+  converges;
+* the seeded region-blackout drill: one-way + full partitions at every
+  promote/failback phase boundary under load, zero acked-write loss
+  within the measured RPO ledger, zero double-applies, byte-identical
+  FSM digests after heal + failback, reproducible schedule digest.
+
+Everything runs on FakeClock with explicit pump() calls — no threads,
+no wall clock — so two runs with the same seed produce byte-identical
+fault schedules AND byte-identical outcome facts.
+"""
+
+import json
+import os
+import zlib
+from types import SimpleNamespace
+
+import pytest
+
+from cubefs_tpu.fs import georepl as fsgeo
+from cubefs_tpu.fs.metanode import FILE, MetaPartition
+from cubefs_tpu.utils import faultinject as fi
+from cubefs_tpu.utils import fsm as fsmlib
+from cubefs_tpu.utils import georepl as geo
+from cubefs_tpu.utils import metrics, packet, rpc, slo
+from cubefs_tpu.utils.faultinject import FaultPlan
+from cubefs_tpu.utils.retry import FakeClock
+from cubefs_tpu.utils.rpc import NodePool
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    assert rpc._fault is None
+    yield
+    fi.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _geo_on(monkeypatch):
+    monkeypatch.setenv("CUBEFS_GEO", "1")
+
+
+# ---------------------------------------------------------------- rig
+
+
+def _mk(mp, ino, target=None):
+    """One deterministic mutation: explicit ino AND ts so replicas,
+    replays and digest comparisons are byte-identical (CFM001 contract:
+    the wall clock never enters the apply path), and a deterministic
+    op_id — the FSM dedup door is what absorbs stream re-presentation
+    after a follower rolls back behind its own durable position."""
+    return mp.submit({"op": "mk_inode", "ino": ino, "type": FILE,
+                      "mode": 0o644, "target": target, "ts": float(ino),
+                      "op_id": f"mk-{ino}"})
+
+
+def _pair(clock, pid=1, data_dir=None, tmp=None):
+    """Two single-partition regions on ONE NodePool: r1 primary,
+    r2 follower, peered gateways. Standalone partitions (no raft —
+    geo refuses raft hosts by contract)."""
+    pool = NodePool()
+    mp1 = MetaPartition(pid, 100, 10**6,
+                        data_dir=str(tmp / "r1-mp") if data_dir else None)
+    mp2 = MetaPartition(pid, 100, 10**6,
+                        data_dir=str(tmp / "r2-mp") if data_dir else None)
+    n1 = SimpleNamespace(partitions={pid: mp1}, rafts={})
+    n2 = SimpleNamespace(partitions={pid: mp2}, rafts={})
+    gw1 = fsgeo.GeoGateway("r1", pool, "geo-r1", peer_addr="geo-r2",
+                           role="primary", clock=clock,
+                           data_dir=str(tmp / "r1-gw") if data_dir else None)
+    gw2 = fsgeo.GeoGateway("r2", pool, "geo-r2", peer_addr="geo-r1",
+                           role="follower", clock=clock,
+                           data_dir=str(tmp / "r2-gw") if data_dir else None)
+    if data_dir:
+        os.makedirs(str(tmp / "r1-gw"), exist_ok=True)
+        os.makedirs(str(tmp / "r2-gw"), exist_ok=True)
+    gw1.attach_metanode(n1, primaries={pid: "mn-r1"})
+    gw2.attach_metanode(n2, primaries={pid: "mn-r1"})
+    return pool, mp1, mp2, gw1, gw2
+
+
+def _inos(mp):
+    return sorted(mp.inodes)
+
+
+# ------------------------------------------------- flag gate (default off)
+
+
+def test_gateway_refuses_without_flag(monkeypatch):
+    monkeypatch.setenv("CUBEFS_GEO", "0")
+    with pytest.raises(RuntimeError, match="CUBEFS_GEO"):
+        fsgeo.GeoGateway("r1", NodePool(), "geo-r1")
+
+
+def test_geo_off_is_digest_identical(monkeypatch):
+    """With the door shut nothing fires: a partition that was never geo-
+    attached and a geo-attached primary produce byte-identical digests
+    for the same record stream — the tap/gate are invisible to the FSM."""
+    clock = FakeClock()
+    _, mp1, _, _, _ = _pair(clock)
+    monkeypatch.setenv("CUBEFS_GEO", "0")
+    plain = MetaPartition(1, 100, 10**6)
+    for ino in (201, 202, 203):
+        _mk(mp1, ino)
+        _mk(plain, ino)
+    assert geo.fsm_digest(mp1) == geo.fsm_digest(plain)
+    assert plain.geo_tap is None and plain.geo_mode is None
+
+
+# ------------------------------------------------- ship / fence basics
+
+
+def test_ship_apply_converges_and_follower_fences(tmp_path):
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    red0 = metrics.geo_redirects.value(part="mp:1")
+    for ino in (201, 202, 203, 204, 205):
+        _mk(mp1, ino)
+    out = gw1.pump()
+    assert out["mp:1"]["applied_seq"] == 5 and out["mp:1"]["acked"] == 5
+    assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+    # mutations bounce off the follower with GeoRedirect toward the
+    # primary region's metanode; reads keep serving locally
+    with pytest.raises(rpc.RpcError) as ei:
+        _mk(mp2, 299)
+    assert ei.value.code == rpc.GEO_REDIRECT
+    assert ei.value.message == "primary=mn-r1"
+    assert metrics.geo_redirects.value(part="mp:1") == red0 + 1
+    assert _inos(mp2) == [201, 202, 203, 204, 205]  # local read serving
+    # the RPO ledger drained: everything shipped is acked
+    assert gw1.status()["parts"]["mp:1"]["pending_bytes"] == 0
+
+
+def test_follower_redirect_is_followed_by_call_replicas():
+    """End-to-end routing check for 452: a client pointed at the
+    follower region's metanode transparently lands its mutation on the
+    primary (and the redirect is NOT cached — reads stay local)."""
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+
+    class _Shim:
+        def __init__(self, mp):
+            self.mp = mp
+
+        def rpc_submit(self, args, body):
+            return self.mp.submit(dict(args["record"]))
+
+    pool.bind("mn-r1", _Shim(mp1))
+    pool.bind("mn-r2", _Shim(mp2))
+    rec = {"op": "mk_inode", "ino": 333, "type": FILE, "mode": 0o644,
+           "ts": 333.0}
+    reply, _ = rpc.call_replicas(pool, ["mn-r2"], "submit",
+                                 {"record": rec}, deadline=5.0)
+    assert reply["ino"] == 333
+    assert 333 in mp1.inodes and 333 not in mp2.inodes  # until shipped
+    gw1.pump()
+    assert 333 in mp2.inodes
+
+
+def test_ship_format_is_the_wal_frame():
+    """The on-disk WAL framing IS the ship format: every shipped line
+    carries its own CRC and parses through the PR 14 frame door."""
+    clock = FakeClock(start=7.0)
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    _mk(mp1, 240)
+    part = gw1._parts["mp:1"]
+    (line,) = part.shipper.pending()
+    env = fsmlib._parse_frame(line.encode().rstrip(b"\n"))
+    assert env["seq"] == 1 and env["epoch"] == 0 and env["ts"] == 7.0
+    assert env["rec"]["ino"] == 240
+
+
+# ------------------------------------------------- the edge matrix
+
+
+def test_duplicate_batch_is_idempotent():
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    for ino in (201, 202, 203):
+        _mk(mp1, ino)
+    lines = gw1._parts["mp:1"].shipper.pending()
+    applier = gw2._parts["mp:1"].applier
+    dup0 = metrics.geo_applied.value(part="mp:1", outcome="duplicate")
+    assert applier.deliver(lines)["applied_seq"] == 3
+    digest = geo.fsm_digest(mp2)
+    # the whole batch replays (transport retry of an acked ship)
+    out = applier.deliver(lines)
+    assert out["applied_seq"] == 3 and out["need"] is None
+    assert metrics.geo_applied.value(
+        part="mp:1", outcome="duplicate") == dup0 + 3
+    assert geo.fsm_digest(mp2) == digest  # byte-identical: no double-apply
+    assert mp2.apply_id == 3
+
+
+def test_gap_heals_from_the_ring():
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    for ino in (201, 202, 203, 204):
+        _mk(mp1, ino)
+    lines = gw1._parts["mp:1"].shipper.pending()
+    applier = gw2._parts["mp:1"].applier
+    gap0 = metrics.geo_applied.value(part="mp:1", outcome="gap")
+    # records 1-2 lost in flight: the partial batch reports the gap and
+    # applies NOTHING past it (in-order apply is the invariant)
+    out = applier.deliver(lines[2:])
+    assert out["need"] == 1 and out["applied_seq"] == 0
+    assert metrics.geo_applied.value(part="mp:1", outcome="gap") == gap0 + 1
+    assert mp2.inodes == {}
+    # the unacked tail is still pending: the next pump re-presents the
+    # full contiguous batch and the follower converges
+    out = gw1.pump()
+    assert out["mp:1"]["applied_seq"] == 4
+    assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+
+
+def test_corrupt_line_poisons_itself_then_backfill_heals():
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    for ino in (201, 202, 203):
+        _mk(mp1, ino)
+    lines = gw1._parts["mp:1"].shipper.pending()
+    corrupted = list(lines)
+    corrupted[1] = corrupted[1][:-8] + "XXXX" + corrupted[1][-4:]
+    applier = gw2._parts["mp:1"].applier
+    c0 = metrics.geo_applied.value(part="mp:1", outcome="corrupt")
+    out = applier.deliver(corrupted)
+    # record 1 applied, record 2 torn -> skipped, record 3 is a gap
+    assert out["applied_seq"] == 1 and out["need"] == 2
+    assert metrics.geo_applied.value(
+        part="mp:1", outcome="corrupt") == c0 + 1
+    out = gw1.pump()  # ring backfill re-presents the intact lines
+    assert out["mp:1"]["applied_seq"] == 3
+    assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+
+
+def test_ring_miss_falls_back_to_snapshot_bootstrap():
+    """A follower that lost sidecar progress past the ring's horizon
+    bootstraps from a full snapshot instead of an unbounded backfill."""
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    part = gw1._parts["mp:1"]
+    # shrink the ring so the horizon is observable without 512 writes
+    part.shipper = geo.GeoShipper(
+        part.key, epoch_fn=lambda: gw1.controller.epoch, clock=clock,
+        ring=4)
+    part.set_role(serving=True, fenced=False)
+    for ino in range(201, 211):
+        _mk(mp1, ino)
+    assert gw1.pump()["mp:1"]["applied_seq"] == 10
+    boot0 = metrics.geo_backfills.value(part="mp:1", kind="bootstrap")
+    # follower crashes back to an old position: seq 3 is long out of
+    # the 4-deep ring, so ring backfill reports a miss
+    gw2._parts["mp:1"].applier.adopt(2, 0)
+    _mk(mp1, 211)
+    out = gw1.pump()
+    assert out["mp:1"]["applied_seq"] == 11
+    assert metrics.geo_backfills.value(
+        part="mp:1", kind="bootstrap") == boot0 + 1
+    assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+    # a few more records land via the STREAM (so the follower's dedup
+    # door has them cached — bootstrap-landed records have no cache
+    # entries, which is why regression below a bootstrap point must
+    # re-bootstrap, never replay)
+    _mk(mp1, 212)
+    _mk(mp1, 213)
+    assert gw1.pump()["mp:1"]["applied_seq"] == 13
+    # within-ring rollback heals via the ring, not another bootstrap:
+    # the replayed records hit the FSM's op_id cache, not EEXIST
+    ring0 = metrics.geo_backfills.value(part="mp:1", kind="ring")
+    gw2._parts["mp:1"].applier.adopt(11, 0)
+    _mk(mp1, 214)
+    assert gw1.pump()["mp:1"]["applied_seq"] == 14
+    assert metrics.geo_backfills.value(
+        part="mp:1", kind="ring") == ring0 + 1
+    assert metrics.geo_backfills.value(
+        part="mp:1", kind="bootstrap") == boot0 + 1
+    assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+
+
+def test_stale_epoch_is_rejected_never_double_applied():
+    """The fencing drill's core: a healed old primary replaying its
+    unshipped tail into the promoted follower is REJECTED record by
+    record — the counter is the proof each one did NOT double-apply."""
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    for ino in (201, 202, 203):
+        _mk(mp1, ino)
+    gw1.pump()
+    # region 1 goes dark; its last write never ships
+    _mk(mp1, 204)
+    gw2.transition("fence")
+    assert gw2.transition("promote")["epoch"] == 1
+    _mk(mp2, 301)  # the promoted side serves and sequences epoch-1 writes
+    rej0 = metrics.geo_fencing_rejections.value(part="mp:1")
+    before = geo.fsm_digest(mp2)
+    out = gw1.pump()  # the healed old primary replays its epoch-0 tail
+    assert out["mp:1"]["applied_seq"] == 3  # unchanged: nothing landed
+    assert metrics.geo_fencing_rejections.value(part="mp:1") == rej0 + 1
+    assert geo.fsm_digest(mp2) == before
+    assert 204 not in mp2.inodes
+    # the rejected tail stays in region 1's pending queue: it IS the
+    # RPO ledger of what the blackout cost
+    assert gw1.status()["parts"]["mp:1"]["pending_bytes"] > 0
+
+
+def test_torn_follower_wal_tail_truncates_then_stream_resumes(tmp_path):
+    """PR 14 truncation on the follower's geo-written WAL: a crash mid-
+    append leaves a torn frame; recovery truncates it (counted), the
+    sidecar still points at the last COMPLETE record, and the resumed
+    stream re-ships the tail to convergence."""
+
+    class _Kv(fsmlib.ReplicatedFsm):
+        def __init__(self, data_dir):
+            self.kv = {}
+            self._init_fsm("kv", data_dir, None, None, None)
+
+        def _apply(self, record):
+            self.kv[record["k"]] = record["v"]
+            return {"ok": True}
+
+        def _state_dict(self):
+            return {"kv": dict(self.kv)}
+
+        def _load_state_dict(self, d):
+            self.kv = dict(d.get("kv", {}))
+
+        def set(self, k, v):
+            return self._commit({"op": "set", "k": k, "v": v})
+
+    clock = FakeClock()
+    pool = NodePool()
+    h1 = _Kv(str(tmp_path / "kv-r1"))
+    h2 = _Kv(str(tmp_path / "kv-r2"))
+    os.makedirs(str(tmp_path / "gw-r2"), exist_ok=True)
+    gw1 = fsgeo.GeoGateway("r1", pool, "geo-r1", peer_addr="geo-r2",
+                           role="primary", clock=clock)
+    gw2 = fsgeo.GeoGateway("r2", pool, "geo-r2", peer_addr="geo-r1",
+                           role="follower", clock=clock,
+                           data_dir=str(tmp_path / "gw-r2"))
+    gw1.attach_fsm("kv", h1, primary="kv-r1")
+    gw2.attach_fsm("kv", h2, primary="kv-r1")
+    for i in range(4):
+        h1.set(f"k{i}", i)
+    assert gw1.pump()["fsm:kv"]["applied_seq"] == 4
+    # two more commits land on the primary but never ship pre-crash
+    h1.set("k4", 4)
+    h1.set("k5", 5)
+    # crash mid-append on the follower: half a frame hits the platter
+    h2._wal.close()
+    torn = fsmlib._frame(json.dumps({"op": "set", "k": "torn", "v": 9}))
+    with open(h2._wal_path(), "a") as f:
+        f.write(torn[: len(torn) // 2])
+    t0 = metrics.wal_torn_tail.value()
+    h2b = _Kv(str(tmp_path / "kv-r2"))  # recovery truncates the tail
+    assert metrics.wal_torn_tail.value() == t0 + 1
+    assert h2b.kv == {f"k{i}": i for i in range(4)}
+    # rebuild the follower gateway on the same sidecar dir: the applier
+    # resumes at the last complete record, and the stream re-ships
+    gw2b = fsgeo.GeoGateway("r2", pool, "geo-r2", peer_addr="geo-r1",
+                            role="follower", clock=clock,
+                            data_dir=str(tmp_path / "gw-r2"))
+    gw2b.attach_fsm("kv", h2b, primary="kv-r1")
+    assert gw2b._parts["fsm:kv"].applier.applied_seq == 4
+    assert gw1.pump()["fsm:kv"]["applied_seq"] == 6
+    assert geo.fsm_digest(h1) == geo.fsm_digest(h2b)
+    assert h2b.kv["k5"] == 5
+
+
+# ------------------------------------------------- controller edges
+
+
+def test_controller_op_id_replay_and_invalid_edges():
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    # invalid edges are 409s, state untouched
+    for op in ("promote", "failback_sync", "resume_following"):
+        with pytest.raises(rpc.RpcError) as ei:
+            gw2.transition(op)
+        assert ei.value.code == 409
+    assert gw2.controller.state == "FOLLOWING"
+    gw1.transition("fence")  # planned-cutover quiesce is legal from PRIMARY
+    with pytest.raises(rpc.RpcError) as ei:
+        gw1.transition("failback_sync")
+    assert ei.value.code == 409
+
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    gw2.transition("fence")
+    out1 = gw2.transition("promote", op_id="op-promote-1")
+    assert (out1["state"], out1["epoch"], out1["replayed"]) == \
+        ("PROMOTED", 1, False)
+    # shipper adopted the applier position at promote; a write advances it
+    _mk(mp2, 301)
+    seq = gw2._parts["mp:1"].shipper.seq
+    # transport retry of the SAME promote: recorded outcome replays,
+    # no second epoch, no re-adoption (seq untouched)
+    out2 = gw2.transition("promote", op_id="op-promote-1")
+    assert (out2["state"], out2["epoch"], out2["replayed"]) == \
+        ("PROMOTED", 1, True)
+    assert gw2._parts["mp:1"].shipper.seq == seq
+    # a NEW promote op from PROMOTED is still an invalid edge
+    with pytest.raises(rpc.RpcError):
+        gw2.transition("promote", op_id="op-promote-2")
+
+
+def test_fenced_follower_quiesces_the_stream():
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    _mk(mp1, 201)
+    gw1.pump()
+    gw2.transition("fence")
+    _mk(mp1, 202)
+    out = gw1.pump()
+    assert out["mp:1"]["fenced"] is True
+    assert 202 not in mp2.inodes
+    # aborted promote: resume_following reopens the door
+    gw2.transition("resume_following")
+    assert gw1.pump()["mp:1"]["applied_seq"] == 2
+    assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+
+
+# ------------------------------------------------- packet-plane transfers
+
+
+def test_snapshot_bootstrap_rides_the_packet_mux(monkeypatch, tmp_path):
+    """A multi-chunk partition image streams over OP_GEO_SNAPSHOT as a
+    FLAG_MORE train (chunk floor forced low so the train is real), and
+    the bootstrapped follower is byte-identical."""
+    monkeypatch.setenv("CUBEFS_PKT_CHUNK", "4096")
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    for ino in range(300, 420):  # ~30 KiB of state: several chunks
+        _mk(mp1, ino, target="n" * 64)
+    srv = gw1.serve_packets()
+    try:
+        assert len(mp1.state_bytes()) > 3 * 4096
+        boot0 = metrics.geo_backfills.value(part="mp:1", kind="bootstrap")
+        gw2._parts["mp:1"].needs_bootstrap = True  # demote-shaped ask
+        out = gw1.pump()
+        assert out["mp:1"]["applied_seq"] == 120
+        assert metrics.geo_backfills.value(
+            part="mp:1", kind="bootstrap") == boot0 + 1
+        assert gw2._wires, "bootstrap should ride the packet plane"
+        assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+        # stream resumes seamlessly after the packet bootstrap
+        _mk(mp1, 500)
+        assert gw1.pump()["mp:1"]["applied_seq"] == 121
+        assert 500 in mp2.inodes
+    finally:
+        gw2.close()
+        gw1.close()
+
+
+def test_corrupt_snapshot_poisons_one_transfer_not_the_conn():
+    """First pull returns a payload whose CRC lies -> that transfer
+    fails (502) and the follower stays untouched; the SAME mux
+    connection then serves the honest retry to convergence."""
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    for ino in (201, 202, 203):
+        _mk(mp1, ino)
+    lie = {"armed": True}
+
+    def snap(hdr, args, payload):
+        part = gw1._parts[args["part"]]
+        data, seq = part.snapshot_with_seq()
+        crc = zlib.crc32(data)
+        if lie.pop("armed", False):
+            crc ^= 0xDEAD
+        return ({"crc": crc, "seq": seq,
+                 "epoch": gw1.controller.epoch}, data)
+
+    srv = packet.PacketServer({packet.OP_GEO_SNAPSHOT: snap},
+                              "127.0.0.1", 0, service="geo",
+                              workers=1).start()
+    try:
+        args = {"part": "mp:1", "packet_addr": srv.addr}
+        with pytest.raises(rpc.RpcError) as ei:
+            gw2.rpc_geo_resync(args, b"")
+        assert ei.value.code == 502
+        assert mp2.inodes == {}  # poisoned transfer landed nothing
+        wire = gw2._wires[srv.addr]
+        gw2.rpc_geo_resync(args, b"")  # retry on the SAME cached wire
+        assert gw2._wires[srv.addr] is wire
+        assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+        assert gw2._parts["mp:1"].applier.applied_seq == 3
+    finally:
+        gw2.close()
+        gw1.close()
+        srv.stop()
+
+
+# ------------------------------------------------- lag SLO wiring
+
+
+def test_replication_lag_burns_the_geo_slo():
+    assert "geo.replication" in slo.DEFAULT_TARGETS
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    _mk(mp1, 201)
+    clock.advance(3.5)  # the record ages past the 2s objective in flight
+    gw1.pump()
+    assert metrics.geo_lag.value(part="mp:1", tenant="fs") >= 3.5
+    # the lag sample rides the shared stage histogram under the
+    # registered "geo.replication" path: the SLO tracker sees it with
+    # zero extra wiring
+    assert any(k[0] == "geo.replication"
+               for k, _ in metrics.request_stage_seconds.samples())
+
+
+# ------------------------------------------------- the blackout drill
+
+
+def _drill(seed: int):
+    """Seeded region-blackout DR drill under load: WAN jitter on every
+    cross-region call, a one-way partition (r1 can hear but not be
+    heard) escalating to a full partition at the promote boundary, a
+    fenced promote with an op_id retry, the healed old primary's tail
+    rejected, failback over a drained fence, and primacy returned to
+    r1. Returns (schedule_digest, facts) — both must be byte-identical
+    across runs with the same seed."""
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    rej0 = metrics.geo_fencing_rejections.value(part="mp:1")
+    facts = {}
+    plan = FaultPlan(seed=seed, clock=clock)
+    # seeded duplicate deliveries of the ship RPC (both directions):
+    # harmless by the applier's dedup contract — which is the point —
+    # and they make the fault schedule genuinely seed-dependent.
+    # Authored BEFORE the wan rules: the first matching rule wins, so
+    # a wan rule on the same edge would shadow these entirely.
+    plan.on("geo-r2", "geo_ship", kind="duplicate", prob=0.4)
+    plan.on("geo-r1", "geo_ship", kind="duplicate", prob=0.4)
+    plan.wan(["geo-r1"], ["geo-r2"], delay=0.002, jitter=0.001)
+    with fi.installed(plan):
+        # phase A: steady state under load
+        for ino in range(201, 207):
+            _mk(mp1, ino)
+        gw1.pump()
+        acked = set(_inos(mp2))
+        # phase B: one-way blackout — r1 keeps acking writes locally it
+        # can no longer ship; the pending queue is the live RPO ledger
+        plan.partition_oneway(["geo-r1"], ["geo-r2"])
+        for ino in range(207, 211):
+            _mk(mp1, ino)
+        out = gw1.pump()
+        assert "error" in out["mp:1"]
+        at_risk = gw1.status()["parts"]["mp:1"]["pending_bytes"]
+        assert at_risk > 0
+        facts["rpo_records"] = len(gw1._parts["mp:1"].shipper.pending(999))
+        # phase C: full partition at the promote boundary; fenced
+        # promote on r2 (with a duplicated op retried mid-blackout)
+        plan.partition(["geo-r1"], ["geo-r2"])
+        gw2.transition("fence", op_id=f"d{seed}-fence")
+        out = gw2.transition("promote", op_id=f"d{seed}-promote")
+        assert (out["epoch"], out["replayed"]) == (1, False)
+        out = gw2.transition("promote", op_id=f"d{seed}-promote")
+        assert (out["epoch"], out["replayed"]) == (1, True)
+        for ino in range(301, 305):
+            _mk(mp2, ino)
+        _mk(mp1, 211)  # split brain: old primary still accepts writes
+        assert "error" in gw1.pump()["mp:1"]
+        # phase D: heal -> the old primary's epoch-0 tail is fenced out
+        plan.heal()
+        before = geo.fsm_digest(mp2)
+        gw1.pump()
+        assert geo.fsm_digest(mp2) == before
+        rejected = metrics.geo_fencing_rejections.value(
+            part="mp:1") - rej0
+        # the stale tail is 5 records (the 4-record ledger + the 211
+        # split-brain write); every PRESENTATION rejects the full batch,
+        # so a seeded duplicate delivery doubles the count — always a
+        # whole multiple of the batch, never a partial apply
+        batch = facts["rpo_records"] + 1
+        assert rejected >= batch and rejected % batch == 0
+        facts["fencing_rejections"] = rejected
+        # phase E: old primary folds in — divergent tail DISCARDED via
+        # bootstrap, never merged (one-way partition flickers at this
+        # boundary too, then heals)
+        plan.partition_oneway(["geo-r2"], ["geo-r1"])
+        gw1.transition("demote", op_id=f"d{seed}-demote")
+        assert "error" in gw2.pump()["mp:1"]
+        plan.heal()
+        gw2.pump()  # instructs the bootstrap resync
+        assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+        lost = sorted(set(range(207, 212)) - set(_inos(mp1)))
+        assert lost == [207, 208, 209, 210, 211]  # exactly the ledger
+        assert acked <= set(_inos(mp1))  # zero acked-and-shipped loss
+        # phase F: failback — drain under FAILBACK_SYNC, quiesce, swap
+        gw2.transition("failback_sync", op_id=f"d{seed}-fb")
+        for ino in range(305, 308):
+            _mk(mp2, ino)
+        out = gw2.pump()
+        assert out["mp:1"]["pending_bytes"] == 0  # drained
+        gw2.transition("fence", op_id=f"d{seed}-fence2")
+        gw1.transition("fence", op_id=f"d{seed}-fence3")
+        out = gw1.transition("promote", op_id=f"d{seed}-promote2")
+        assert out["epoch"] == 2  # monotonic across the whole incident
+        gw2.transition("demote", op_id=f"d{seed}-demote2")
+        gw1.pump()  # r2 bootstraps from r1 (drained: identical image)
+        for ino in range(221, 224):
+            _mk(mp1, ino)
+        gw1.pump()
+    assert geo.fsm_digest(mp1) == geo.fsm_digest(mp2)
+    # zero double-applies anywhere: every surviving ino appears exactly
+    # once and both FSMs counted the same number of applies
+    assert _inos(mp1) == _inos(mp2)
+    facts["final_inos"] = _inos(mp1)
+    facts["digest"] = geo.fsm_digest(mp1)
+    facts["epochs"] = (gw1.controller.epoch, gw2.controller.epoch)
+    facts["states"] = (gw1.controller.state, gw2.controller.state)
+    return plan.schedule_digest(), facts
+
+
+def test_blackout_drill_full_cycle_and_reproducible_schedule():
+    d1, f1 = _drill(seed=42)
+    d2, f2 = _drill(seed=42)
+    assert d1 == d2, "same seed must replay the exact fault schedule"
+    assert f1 == f2, "same seed must reproduce every outcome fact"
+    assert f1["states"] == ("PROMOTED", "FOLLOWING")
+    assert f1["epochs"] == (2, 2)
+    d3, _ = _drill(seed=7)
+    assert d3 != d1, "the schedule digest must actually cover the seed"
+
+
+# ------------------------------------------------- operator surface
+
+
+def test_status_and_cli_geo_view():
+    clock = FakeClock()
+    pool, mp1, mp2, gw1, gw2 = _pair(clock)
+    _mk(mp1, 201)
+    gw1.pump()
+    st, _ = pool.get("geo-r2").call("geo_status", {})
+    assert st["cluster"] == "r2" and st["state"] == "FOLLOWING"
+    assert st["parts"]["mp:1"]["applied_seq"] == 1
+    out, _ = pool.get("geo-r2").call(
+        "geo_transition", {"op": "fence", "op_id": "cli-1"})
+    assert out["state"] == "FENCED"
+    from cubefs_tpu.cli import _geo_view
+    view = _geo_view(metrics.DEFAULT.render_text())
+    assert view["clusters"]["r2"]["state"] == "FENCED"
+    assert "mp:1" in view["parts"]
+    assert view["parts"]["mp:1"]["applied"].get("applied", 0) >= 1

@@ -24,6 +24,7 @@ from tool.lint.checkers.batch_discipline import BatchDisciplineChecker
 from tool.lint.checkers.fanout_discipline import FanoutDisciplineChecker
 from tool.lint.checkers.fs_placement import FsPlacementChecker
 from tool.lint.checkers.fsm_purity import FsmPurityChecker, apply_roots
+from tool.lint.checkers.geo_discipline import GeoDisciplineChecker
 from tool.lint.checkers.integrity_discipline import (
     IntegrityDisciplineChecker)
 from tool.lint.checkers.lock_discipline import LockDisciplineChecker
@@ -280,12 +281,12 @@ def test_batch_discipline_scoped_to_blob_plane():
 
 # ---------------- suppressions ----------------
 
-def test_bare_allow_is_cfg001_and_does_not_suppress():
+def test_bare_allow_is_cfa001_and_does_not_suppress():
     mod = _module("allow_bare.py", "cubefs_tpu/fs/fx.py")
     lock = LockDisciplineChecker().check(mod)
     assert _codes(lock) == ["CFL001"]
     assert not mod.suppressed(lock[0])          # bare allow is inert
-    assert _codes(core.bare_allow_violations(mod)) == ["CFG001"]
+    assert _codes(core.bare_allow_violations(mod)) == ["CFA001"]
 
 
 def test_justified_allow_suppresses():
@@ -575,6 +576,42 @@ def test_wire_discipline_sanctums_exempt():
     assert not c.applies("cubefs_tpu/utils/packet.py")
     assert not c.applies("cubefs_tpu/fs/client.py")
     assert not c.applies("cubefs_tpu/sdk/clients.py")
+
+
+# ---------------- geo-discipline ----------------
+
+def test_geo_discipline_true_positives():
+    mod = _module("geo_bad.py", "cubefs_tpu/fs/fx.py")
+    found = GeoDisciplineChecker().check(mod)
+    # two raw-door calls in rpc handlers + two ungated commit doors
+    # (submit_many carries its gate and must stay silent)
+    assert _codes(found) == ["CFG001", "CFG001", "CFG002", "CFG002"]
+    assert any("geo_apply" in v.message for v in found)
+    assert any("Partition.submit" in v.message for v in found)
+    assert any("Partition.alloc_ino" in v.message for v in found)
+    assert not any("submit_many" in v.message for v in found)
+
+
+def test_geo_discipline_true_negative():
+    mod = _module("geo_good.py", "cubefs_tpu/fs/fx.py")
+    assert GeoDisciplineChecker().check(mod) == []
+
+
+def test_geo_discipline_applier_modules_sanctioned():
+    # the SAME raw-door handler source is legal where the applier
+    # lives: the gateway IS the one sanctioned entry point
+    mod = _module("geo_bad.py", "cubefs_tpu/fs/georepl.py")
+    found = GeoDisciplineChecker().check(mod)
+    assert "CFG001" not in _codes(found)  # CFG002 still applies
+
+
+def test_geo_mutations_classified_for_idempotency():
+    # the geo stream surface rides the same transport retry; its
+    # mutating ops must be classified so CFR001 sees bare call sites
+    assert is_mutating("geo_ship")
+    assert is_mutating("geo_resync")
+    assert is_mutating("geo_transition")
+    assert not is_mutating("geo_status")
 
 
 # ---------------- baseline ordering + summary cache + wall time ----------------

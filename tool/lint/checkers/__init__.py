@@ -5,6 +5,7 @@ from .batch_discipline import BatchDisciplineChecker
 from .fanout_discipline import FanoutDisciplineChecker
 from .fs_placement import FsPlacementChecker
 from .fsm_purity import FsmPurityChecker
+from .geo_discipline import GeoDisciplineChecker
 from .integrity_discipline import IntegrityDisciplineChecker
 from .lock_discipline import LockDisciplineChecker
 from .lock_graph import LockGraphChecker
@@ -33,6 +34,7 @@ ALL_CHECKERS = (
     IntegrityDisciplineChecker,
     WitnessDisciplineChecker,
     WireDisciplineChecker,
+    GeoDisciplineChecker,
 )
 
 # Checkers that need the whole-program graph (tool/lint/graph.py); the

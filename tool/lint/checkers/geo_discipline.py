@@ -1,0 +1,103 @@
+"""Geo-replication discipline checker (rule: geo-discipline, CFG0xx).
+
+Geo-replication splits every FSM host into two mutation surfaces: the
+commit doors (submit/_commit) for the serving side, and ``geo_apply``
+for shipped records on the follower side. The safety of the whole
+design — no double-applies after a region heals, no divergent follower
+state — rests on TWO structural invariants this checker pins:
+
+  CFG001  no raw FSM apply door (``geo_apply``, ``_apply_deduped``,
+          ``restore_state``, ``fsm_recover_from_state``) is called
+          from an RPC handler (``rpc_*``) outside the sanctioned geo
+          modules. Shipped records must reach a follower's FSM through
+          ``GeoApplier.deliver`` — the ONE door that enforces fencing
+          epochs, duplicate skips and gap detection. An rpc handler
+          that applies directly bypasses all three (the double-apply a
+          healed old primary's replay would cause).
+
+  CFG002  every geo-replicable host class (marked by defining a
+          ``geo_apply`` method) gates EACH commit door it defines
+          (``submit``/``submit_many``/``_commit``/``_commit_many``/
+          ``alloc_ino``) with a ``_geo_gate()`` call — one missing gate
+          and a follower accepts local mutations that fork it from the
+          stream (fs/metanode.py MetaPartition, utils/fsm.py
+          ReplicatedFsm).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+# the only modules allowed to touch raw apply doors from rpc handlers:
+# the applier core and the gateway that wraps it
+_SANCTIONED = {
+    "cubefs_tpu/utils/georepl.py",
+    "cubefs_tpu/fs/georepl.py",
+}
+
+_RAW_DOORS = {
+    "geo_apply", "_apply_deduped", "restore_state",
+    "fsm_recover_from_state",
+}
+
+# commit doors a geo-replicable host may define; each present one must
+# call _geo_gate() somewhere in its body
+_COMMIT_DOORS = ("submit", "submit_many", "_commit", "_commit_many",
+                 "alloc_ino")
+
+
+def _calls_attr(fn: ast.AST, attr: str) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == attr):
+            return True
+    return False
+
+
+class GeoDisciplineChecker(Checker):
+    rule = "geo-discipline"
+    dirs = ("cubefs_tpu/",)
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+        if mod.relpath not in _SANCTIONED:
+            for fn in ast.walk(mod.tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if not fn.name.startswith("rpc_"):
+                    continue
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _RAW_DOORS):
+                        out.append(self.violation(
+                            mod, "CFG001", node,
+                            f"rpc handler {fn.name!r} calls raw FSM "
+                            f"apply door '{node.func.attr}' directly; "
+                            f"shipped records must enter through "
+                            f"GeoApplier.deliver (utils/georepl.py), "
+                            f"which enforces the fencing epoch, "
+                            f"duplicate skip and gap detection"))
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)}
+            if "geo_apply" not in methods:
+                continue  # not a geo-replicable host class
+            for name in _COMMIT_DOORS:
+                door = methods.get(name)
+                if door is None:
+                    continue
+                if not _calls_attr(door, "_geo_gate"):
+                    out.append(self.violation(
+                        mod, "CFG002", door,
+                        f"commit door {cls.name}.{name} on a "
+                        f"geo-replicable host has no _geo_gate() call; "
+                        f"a follower would accept local mutations and "
+                        f"fork from the replication stream"))
+        return out

@@ -6,7 +6,7 @@ a `Checker` interface, inline suppressions, and the baseline store.
 Inline suppression: append ``# lint: allow[CODE] <justification>`` to
 the flagged line (or the line directly above it). The justification is
 MANDATORY — a bare ``allow[...]`` does not suppress and is itself
-reported (CFG001), so every intentional violation carries its why.
+reported (CFA001), so every intentional violation carries its why.
 """
 
 from __future__ import annotations
@@ -145,14 +145,16 @@ def iter_py_files(roots: list[str]) -> list[str]:
 
 
 def bare_allow_violations(mod: Module) -> list[Violation]:
-    """CFG001: an allow[...] comment with no justification — it does NOT
-    suppress anything, and silently believing it does is worse."""
+    """CFA001: an allow[...] comment with no justification — it does NOT
+    suppress anything, and silently believing it does is worse.
+    (Renamed from CFG001 when the geo-discipline family claimed the CFG
+    prefix; the baseline carries no fingerprints under either code.)"""
     out = []
     for i, text in enumerate(mod.lines, start=1):
         m = _ALLOW_RE.search(text)
         if m and not m.group("why").strip():
             out.append(Violation(
-                "CFG001", "lint-config", mod.relpath, i,
+                "CFA001", "lint-config", mod.relpath, i,
                 "allow[...] suppression without a justification "
                 "(write `# lint: allow[CODE] <why>`)"))
     return out

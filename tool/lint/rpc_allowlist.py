@@ -96,6 +96,18 @@ ALLOWLIST: dict[tuple[str, str], str] = {
         "_propose_lock and returns None if someone (incl. a retry's "
         "first send) already split past it",
 
+    # ---- geo-replication stream (fs/georepl.py) ----
+    ("*", "geo_ship"):
+        "sequence-numbered stream records: the GeoApplier skips every "
+        "record with seq <= applied_seq, so a transport retry "
+        "re-presenting a shipped batch is absorbed as duplicates "
+        "(utils/georepl.py GeoApplier.deliver)",
+    ("*", "geo_resync"):
+        "convergent by contract: the bootstrap pull lands the "
+        "primary's CURRENT snapshot with its atomic (state, seq, "
+        "epoch) triple — replaying the transfer re-lands the same or "
+        "a newer consistent image, never a fork",
+
     # ---- server-side guards ----
     ("*", "register"):
         "addr-keyed registry refresh (master/scheduler register): a "
