@@ -22,7 +22,7 @@ import numpy as np
 
 from ..ops import rs_kernel
 from ..codec import codemode as cm
-from ..codec.batcher import admit
+from ..codec.batcher import admit, last_dispatch
 from ..utils import metrics, rpc
 from ..utils import trace as tracelib
 from . import topology
@@ -266,6 +266,10 @@ class RepairWorker:
                         len(chunk), len(wanted_out), size)
                 else:
                     recovered = self.codec.matrix_apply(rows, batch)
+                # which leg actually decoded (post-fallback, post-door):
+                # the degraded-mode evidence the XOR_AB drill reads back
+                metrics.repair_codec_leg.inc(
+                    leg=last_dispatch.get("served") or "unknown")
                 for (bid, shards), rec in zip(chunk, recovered):
                     if len(subs) > n_solve:
                         expect = np.frombuffer(shards[n_solve], dtype=np.uint8)
@@ -358,6 +362,8 @@ class RepairWorker:
                         for b in chunk
                     ])  # (B, d, beta)
                     out = self.codec.matrix_apply(rows, batch)
+                    metrics.repair_codec_leg.inc(
+                        leg=last_dispatch.get("served") or "unknown")
                     for i, b in enumerate(chunk):
                         if extra is not None:
                             expect = np.frombuffer(

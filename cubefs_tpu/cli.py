@@ -191,6 +191,27 @@ def _codec_view(text: str) -> dict:
         e: total("cubefs_codec_bytes_total", engine=e)
         for e in sorted({lb.get("engine") for n, lb, _ in series
                          if n == "cubefs_codec_bytes_total"} - {None})}
+    fams = sorted({lb.get("family") for n, lb, _ in series
+                   if n == "cubefs_codec_program_cache_total"} - {None})
+    if fams:
+        view["program_cache"] = {
+            fam: {
+                "hits": total("cubefs_codec_program_cache_total",
+                              family=fam, event="hit"),
+                "misses": total("cubefs_codec_program_cache_total",
+                                family=fam, event="miss"),
+                "evictions": total("cubefs_codec_program_cache_total",
+                                   family=fam, event="evict"),
+            }
+            for fam in fams}
+        view["program_cache"]["entries"] = total(
+            "cubefs_codec_program_cache_entries")
+    legs = sorted({lb.get("leg") for n, lb, _ in series
+                   if n == "cubefs_repair_codec_leg_total"} - {None})
+    if legs:
+        view["repair_decode_by_leg"] = {
+            leg: total("cubefs_repair_codec_leg_total", leg=leg)
+            for leg in legs}
     return view
 
 

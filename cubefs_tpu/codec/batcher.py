@@ -51,7 +51,8 @@ import numpy as np
 
 from ..utils import metrics
 from ..utils import trace as tracelib
-from .engine import Engine, _call_with_fallback, engine_for, get_engine
+from .engine import (Engine, _call_with_fallback, engine_for, get_engine,
+                     last_dispatch, resolve_leg)
 
 
 class CodecAdmissionError(Exception):
@@ -431,6 +432,9 @@ class BatchCodec:
             # the COALESCED size, so concurrent tiny submissions ride
             # the engine measured best for the batch they became
             name = engine_for(int(arr.nbytes)).name
+        # stamp metrics with the leg the XOR door resolves to, so the
+        # per-engine step counters distinguish numpy from numpy-xor
+        name = resolve_leg(name)
         if op == "encode":
             m = int(key[3])
             dp_out = self._maybe_dp(name, None, arr, m)

@@ -13,16 +13,26 @@ Engines:
     on whatever backend jax selects (TPU on hardware, CPU in tests).
   * ``cpp``  — native C++ engine (cubefs_tpu/runtime), registered when the
     shared library has been built.
+  * ``numpy-xor`` / ``cpp-xor`` — compiled XOR-program legs
+    (ops/xorprog.py): the coding matrix is lowered once into a
+    CSE'd, cache-blocked XOR schedule and replayed word-wide. These are
+    the degraded-mode (device-lost) hot paths; the ``CUBEFS_CODEC_XOR``
+    door (default on, ``=0`` disables) decides whether routed host
+    dispatches take them. Explicit ``get_engine("numpy")`` stays the
+    naive golden either way.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Callable, Protocol
 
 import numpy as np
 
-from ..ops import gf256, rs_kernel
+from ..ops import gf256, rs_kernel, xorprog
+
+_log = logging.getLogger("cubefs.codec")
 
 
 class Engine(Protocol):
@@ -103,10 +113,73 @@ class CppEngine:
             gf256.parity_matrix(data.shape[-2], n_parity), data)
 
 
+class XorNumpyEngine:
+    """Scheduled-XOR host engine: each coefficient matrix compiles once
+    (ops/xorprog.py, cached in the shared program cache) into a CSE'd,
+    cache-blocked straight-line XOR program replayed with word-wide
+    ``np.bitwise_xor`` on uint64 views. Bit-identical to NumpyEngine;
+    ~4-6x its throughput — the difference between a degraded (TPU-lost)
+    cluster repairing at a crawl and repairing at production speed."""
+
+    name = "numpy-xor"
+
+    def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        return xorprog.apply(coeff, shards)
+
+    def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
+        return xorprog.apply(
+            gf256.parity_matrix(data.shape[-2], n_parity), data)
+
+
+class XorCppEngine:
+    """The same compiled XOR schedules replayed by the native executor
+    (runtime/src/gfcpu.cc xor_apply): batched word-wide XOR over the
+    plane workspace, one schedule shared with the numpy-xor leg (same
+    digest, same op stream)."""
+
+    name = "cpp-xor"
+
+    def __init__(self):
+        from ..runtime import build as rt_build
+
+        self._lib = rt_build.load()
+        if not hasattr(self._lib, "xor_apply"):  # stale .so
+            raise RuntimeError("libcubefs_rt.so lacks xor_apply")
+
+    def matrix_apply(self, coeff: np.ndarray, shards: np.ndarray) -> np.ndarray:
+        prog = xorprog.program_for(coeff)
+        shards = np.ascontiguousarray(np.asarray(shards, dtype=np.uint8))
+        lead, (c, s) = shards.shape[:-2], shards.shape[-2:]
+        if c != prog.cols:
+            raise ValueError(f"program is {prog.rows}x{prog.cols}, "
+                             f"shards have {c} rows")
+        batch = int(np.prod(lead)) if lead else 1
+        flat = shards.reshape(batch, c, s)
+        s2 = (s + 63) & ~63  # native executor wants 64-byte multiples
+        if s2 != s:
+            padded = np.zeros((batch, c, s2), dtype=np.uint8)
+            padded[:, :, :s] = flat
+            flat = padded
+        out = np.empty((batch, prog.rows, s2), dtype=np.uint8)
+        ops = prog.opstream()
+        self._lib.xor_apply(ops.ctypes.data, len(ops), flat.ctypes.data,
+                            out.ctypes.data, c, prog.rows, prog.nslots,
+                            s2, batch, prog.block_bytes)
+        if s2 != s:
+            out = np.ascontiguousarray(out[:, :, :s])
+        return out.reshape(*lead, prog.rows, s)
+
+    def encode_parity(self, data: np.ndarray, n_parity: int) -> np.ndarray:
+        return self.matrix_apply(
+            gf256.parity_matrix(data.shape[-2], n_parity), data)
+
+
 _REGISTRY: dict[str, Callable[[], Engine]] = {
     "numpy": NumpyEngine,
     "tpu": JaxEngine,
     "cpp": CppEngine,
+    "numpy-xor": XorNumpyEngine,
+    "cpp-xor": XorCppEngine,
 }
 
 
@@ -164,25 +237,31 @@ def _policy_path() -> str:
 
 def measure_crossover(sizes=_POLICY_SIZES, repeats: int = 3,
                       save: bool = True) -> list:
-    """Times the cpp vs device engine on RS(6+3)-shaped single stripes
-    per total-size class; returns [[max_total_bytes, engine], ...]
-    sorted ascending. Persisted so later processes inherit the policy
-    without re-measuring."""
+    """Times the host legs (cpp, and the compiled-XOR legs cpp-xor /
+    numpy-xor) against the device engine on RS(6+3)-shaped single
+    stripes per total-size class; returns [[max_total_bytes, engine],
+    ...] sorted ascending. Persisted (with per-engine timings and the
+    host-vs-device crossover point) so later processes inherit the
+    policy without re-measuring."""
     import json
     import time
 
     table = []
-    candidates = ["tpu"]
-    try:
-        get_engine("cpp")
-        candidates.insert(0, "cpp")
-    except Exception:
-        pass
+    timings: dict[str, dict[str, float]] = {}
+    candidates = []
+    for name in ("cpp", "cpp-xor", "numpy-xor"):
+        try:
+            get_engine(name)
+            candidates.append(name)
+        except Exception:
+            pass
+    candidates.append("tpu")
     rng = np.random.default_rng(11)
     for total in sizes:
         s = max(1, total // 6)
         stripe = rng.integers(0, 256, (6, s), dtype=np.uint8)
         best, best_dt = candidates[0], float("inf")
+        per = {}
         for name in candidates:
             eng = get_engine(name)
             eng.encode_parity(stripe, 3)  # warm (compile/dispatch)
@@ -190,19 +269,47 @@ def measure_crossover(sizes=_POLICY_SIZES, repeats: int = 3,
             for _ in range(repeats):
                 eng.encode_parity(stripe, 3)
             dt = (time.perf_counter() - t0) / repeats
+            per[name] = round(dt, 6)
             if dt < best_dt:
                 best, best_dt = name, dt
+        timings[str(total)] = per
         table.append([total, best])
+    # the size class where the device leg first beats the best host
+    # leg; None = the host wins the whole sweep (the faster the host
+    # legs, the higher this moves)
+    crossover = None
+    for total in sizes:
+        per = timings[str(total)]
+        host = min((v for k, v in per.items() if k != "tpu"),
+                   default=None)
+        if host is not None and per.get("tpu", float("inf")) < host:
+            crossover = total
+            break
     if save:
         try:
             os.makedirs(os.path.dirname(_policy_path()), exist_ok=True)
             with open(_policy_path(), "w") as f:
-                json.dump({"table": table, "platform": _platform()}, f)
+                json.dump({"table": table, "platform": _platform(),
+                           "timings_s": timings,
+                           "device_crossover_bytes": crossover}, f,
+                          indent=1)
         except OSError:
             pass
     global _policy
     _policy = table
     return table
+
+
+def _static_policy() -> list:
+    """Unmeasured host: conservative static split — native CPU for
+    sub-MiB stripes, device beyond."""
+    have_cpp = True
+    try:
+        get_engine("cpp")
+    except Exception:
+        have_cpp = False
+    small = "cpp" if have_cpp else "numpy"
+    return [[1 << 20, small], [1 << 62, "tpu"]]
 
 
 def _load_policy() -> list:
@@ -213,24 +320,40 @@ def _load_policy() -> list:
         try:
             with open(_policy_path()) as f:
                 data = json.load(f)
-            # an unstamped (legacy) table is assumed cpu-measured; a
-            # cpu-measured table in a tpu-attached process is refused —
-            # it would pin every size class to the host engine on the
-            # one machine where the device path wins. Re-measure lazily
-            # on first use rather than trust it.
-            if data.get("platform", "cpu") != "tpu" and _platform() == "tpu":
-                return measure_crossover()
-            _policy = data["table"]
-        except Exception:
-            # unmeasured host: conservative static split — native CPU
-            # for sub-MiB stripes, device beyond
-            have_cpp = True
-            try:
-                get_engine("cpp")
-            except Exception:
-                have_cpp = False
-            small = "cpp" if have_cpp else "numpy"
-            _policy = [[1 << 20, small], [1 << 62, "tpu"]]
+        except FileNotFoundError:
+            _policy = _static_policy()
+            return _policy
+        except Exception as e:
+            _log.warning("unreadable crossover policy %s (%s); falling "
+                         "back to the static size split — re-run "
+                         "measure_crossover() to refresh it",
+                         _policy_path(), e)
+            _policy = _static_policy()
+            return _policy
+        # a table measured on a different device class is refused, not
+        # silently applied: a cpu-measured table in a tpu-attached
+        # process pins every size class to the host engine on the one
+        # machine where the device path wins, and a tpu-measured table
+        # on a cpu host routes small stripes to a device that is not
+        # there. Log it and re-measure lazily on first use. An
+        # unstamped (legacy) table is assumed cpu-measured.
+        stamped = data.get("platform", "cpu")
+        here = _platform()
+        if stamped != here:
+            _log.warning("stale crossover policy %s: measured on %r but "
+                         "this process dispatches to %r; re-measuring",
+                         _policy_path(), stamped, here)
+            return measure_crossover()
+        try:
+            table = data["table"]
+            if not (isinstance(table, list) and table
+                    and all(len(row) == 2 for row in table)):
+                raise ValueError(f"malformed table {table!r}")
+            _policy = table
+        except (KeyError, TypeError, ValueError) as e:
+            _log.warning("stale crossover policy %s (%s); falling back "
+                         "to the static size split", _policy_path(), e)
+            _policy = _static_policy()
     return _policy
 
 
@@ -239,8 +362,61 @@ def _load_policy() -> list:
 _dead_engines: set[str] = set()
 
 # Degradation order on device loss: pallas kernels -> plain jax ->
-# native SIMD -> table-driven host math (always available).
-_FALLBACK_CHAIN = ("tpu-pallas", "tpu", "cpp", "numpy")
+# native SIMD -> native XOR programs -> host XOR programs ->
+# table-driven host math (always available).
+_FALLBACK_CHAIN = ("tpu-pallas", "tpu", "cpp", "cpp-xor",
+                   "numpy-xor", "numpy")
+
+# CUBEFS_CODEC_XOR door aliasing. Upgrades are asymmetric on purpose:
+# routed `numpy` dispatches upgrade to the compiled-XOR leg (a strict
+# ~4x win — same answer, no table gathers), but `cpp` is NOT statically
+# aliased — on AVX2 hosts the nibble-shuffle gather beats the XOR
+# replay, and the measured crossover sweep (which times cpp-xor as a
+# candidate) is the one allowed to decide that, not an alias.
+_XOR_UP = {"numpy": "numpy-xor"}
+# Door closed: any routed xor leg drops back to its naive base.
+_XOR_BASE = {"numpy-xor": "numpy", "cpp-xor": "cpp"}
+
+# Last routed dispatch (best-effort, process-wide): which leg a
+# _call_with_fallback actually served vs what was requested — the
+# repair path's evidence that degraded-mode math ran where the policy
+# and the XOR door say it did.
+last_dispatch: dict = {"method": None, "requested": None, "served": None}
+
+
+def _xor_enabled() -> bool:
+    """The CUBEFS_CODEC_XOR A/B door (default ON; =0 reverts routed
+    host dispatches to the naive table legs). Read per call so a drill
+    can flip it mid-process."""
+    return os.environ.get("CUBEFS_CODEC_XOR", "1") != "0"
+
+
+def _drilled_dead() -> set[str]:
+    """CUBEFS_CODEC_DEAD: comma-separated engine names a chaos drill
+    declares lost. Routed dispatch treats them exactly like a dead
+    device, but transiently — clearing the env var revives them
+    (unlike _dead_engines, which quarantines for the process life)."""
+    v = os.environ.get("CUBEFS_CODEC_DEAD", "")
+    return {x.strip() for x in v.split(",") if x.strip()}
+
+
+def resolve_leg(name: str) -> str:
+    """Door-aware leg for a routed host dispatch: `numpy` upgrades to
+    its compiled-XOR leg while the door is open, and xor legs drop back
+    to their naive bases when it is closed. Explicit `get_engine(...)`
+    calls bypass this — only routed paths (_call_with_fallback /
+    engine_for / the batcher) alias."""
+    if _xor_enabled():
+        alias = _XOR_UP.get(name)
+        if (alias and alias not in _dead_engines
+                and alias not in _drilled_dead()):
+            try:
+                get_engine(alias)
+                return alias
+            except Exception:
+                return name
+        return name
+    return _XOR_BASE.get(name, name)
 
 
 def _fallback_for(name: str) -> str | None:
@@ -249,9 +425,12 @@ def _fallback_for(name: str) -> str | None:
         i = _FALLBACK_CHAIN.index(name)
     except ValueError:
         return None
+    drilled = _drilled_dead()
     for nxt in _FALLBACK_CHAIN[i + 1:]:
-        if nxt in _dead_engines:
+        if nxt in _dead_engines or nxt in drilled:
             continue
+        if nxt in _XOR_BASE and not _xor_enabled():
+            continue  # door closed: xor legs are not in the chain
         try:
             get_engine(nxt)
         except Exception:
@@ -265,11 +444,24 @@ def _call_with_fallback(name: str, method: str, *args):
     Only RuntimeError/OSError trigger fallback (XLA device loss
     surfaces as a RuntimeError subclass) — semantic errors like shape
     mismatches would fail identically on every engine and must not
-    quarantine one."""
+    quarantine one. Drilled-dead engines (CUBEFS_CODEC_DEAD) are
+    skipped before dispatch without being quarantined."""
+    requested = name
     while True:
+        name = resolve_leg(name)
+        if name in _drilled_dead():
+            nxt = _fallback_for(name)
+            if nxt is None:
+                raise RuntimeError(
+                    f"engine {name!r} drilled dead and no fallback left")
+            name = nxt
+            continue
         eng = get_engine(name)
         try:
-            return getattr(eng, method)(*args)
+            out = getattr(eng, method)(*args)
+            last_dispatch.update(
+                method=method, requested=requested, served=name)
+            return out
         except (RuntimeError, OSError):
             nxt = _fallback_for(name)
             if nxt is None:
@@ -280,9 +472,11 @@ def _call_with_fallback(name: str, method: str, *args):
 
 def engine_for(nbytes: int) -> Engine:
     """The measured-best engine for a stripe of `nbytes` total."""
+    drilled = _drilled_dead()
     for limit, name in _load_policy():
         if nbytes <= limit:
-            if name in _dead_engines:
+            name = resolve_leg(name)
+            if name in _dead_engines or name in drilled:
                 name = _fallback_for(name) or name
             try:
                 return get_engine(name)

@@ -27,19 +27,20 @@ so their repair symbols and decode payloads vanish from every matrix
 Everything here is tiny exact host math producing coefficient matrices;
 byte throughput rides the engine/batcher matrix_apply path exactly like
 RS (cubefs_tpu/ops/rs_kernel.py). Every public *_rows function is
-lru-cached, so the per-repair inverse for a (geometry, failed_slot,
-helper-set) key is solved once per process, not once per stripe.
+cached in the shared capped codec program cache (ops/progcache.py,
+family "msr"), so the per-repair inverse for a (geometry, failed_slot,
+helper-set) key is solved once per process, not once per stripe —
+while hit/miss/evict counts stay observable and the footprint bounded.
 """
 
 from __future__ import annotations
 
-import functools
 import math
 from dataclasses import dataclass
 
 import numpy as np
 
-from . import gf256
+from . import gf256, progcache
 
 
 def feasible_nodes(alpha: int) -> int:
@@ -90,7 +91,7 @@ class MsrParams:
     lambdas: tuple[int, ...]  # parent-node Vandermonde points
 
 
-@functools.lru_cache(maxsize=None)
+@progcache.cached("msr")
 def params(k: int, total: int, d: int) -> MsrParams:
     validate_geometry(k, total, d)
     j = d - (2 * k - 2)
@@ -129,7 +130,7 @@ def _sym_index(alpha: int, a: int, b: int) -> int:
     return a * alpha - a * (a - 1) // 2 + (b - a)
 
 
-@functools.lru_cache(maxsize=None)
+@progcache.cached("msr")
 def _generator(k: int, total: int, d: int) -> np.ndarray:
     """Systematic generator G (nbar*alpha, kbar*alpha) of the parent
     code: G = E . inv(A), where E maps the B free message symbols to
@@ -154,7 +155,7 @@ def _generator(k: int, total: int, d: int) -> np.ndarray:
     return g
 
 
-@functools.lru_cache(maxsize=None)
+@progcache.cached("msr")
 def encode_rows(k: int, total: int, d: int) -> np.ndarray:
     """((total-k)*alpha, k*alpha) parity generator over the sub-shard
     space: apply to a (.., k*alpha, beta) stack of data sub-shards to
@@ -168,7 +169,7 @@ def encode_rows(k: int, total: int, d: int) -> np.ndarray:
     return rows
 
 
-@functools.lru_cache(maxsize=None)
+@progcache.cached("msr")
 def helper_rows(k: int, total: int, d: int, failed: int) -> np.ndarray:
     """(1, alpha) helper-side combination for repairing `failed`: each
     helper applies this to its own alpha sub-shards and ships the single
@@ -198,7 +199,7 @@ def _psi_rep_inv(p: MsrParams, failed: int,
     return gf256.gf_inv_matrix(psi[np.asarray(parent)])
 
 
-@functools.lru_cache(maxsize=None)
+@progcache.cached("msr")
 def repair_rows(k: int, total: int, d: int, failed: int,
                 helpers: tuple[int, ...]) -> np.ndarray:
     """(alpha, d) repair matrix: apply to the (.., d, beta) stack of
@@ -221,7 +222,7 @@ def repair_rows(k: int, total: int, d: int, failed: int,
     return rows
 
 
-@functools.lru_cache(maxsize=None)
+@progcache.cached("msr")
 def verify_rows(k: int, total: int, d: int, failed: int,
                 helpers: tuple[int, ...], extra: int) -> np.ndarray:
     """(1, d) consistency row: applied to the same d helper symbols, it
@@ -239,7 +240,7 @@ def verify_rows(k: int, total: int, d: int, failed: int,
     return row
 
 
-@functools.lru_cache(maxsize=None)
+@progcache.cached("msr")
 def reconstruct_rows(k: int, total: int, d: int, present: tuple[int, ...],
                      wanted: tuple[int, ...]) -> np.ndarray:
     """(len(wanted)*alpha, k*alpha) conventional-decode matrix over the

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import bitlin, gf256, msr
+from . import bitlin, gf256, msr, progcache
 
 _BITS = (1 << np.arange(8)).astype(np.int32)
 
@@ -125,7 +125,7 @@ def _as_const(bits: np.ndarray) -> jax.Array:
     return jnp.asarray(bits, dtype=jnp.int8)
 
 
-@functools.lru_cache(maxsize=None)
+@progcache.cached("rs_jit")
 def _encode_fn(n: int, m: int):
     w = bitlin.gf_matrix_to_bits(gf256.parity_matrix(n, m))
 
@@ -149,7 +149,7 @@ def encode_parity(data: jax.Array, n_parity: int) -> jax.Array:
     return _encode_fn(n, n_parity)(data)
 
 
-@functools.lru_cache(maxsize=None)
+@progcache.cached("rs_jit")
 def _matrix_apply_fn(coeff_bytes: bytes, rows: int, cols: int):
     coeff = np.frombuffer(coeff_bytes, dtype=np.uint8).reshape(rows, cols)
     w = bitlin.gf_matrix_to_bits(coeff)

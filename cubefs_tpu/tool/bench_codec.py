@@ -324,6 +324,137 @@ def run_repair_ab(stripes: int = 96, k: int = 6, m: int = 6, d: int = 11,
     }
 
 
+def run_fallback_ab(rounds: int = 3, stripes: int = 8,
+                    shard_ec: int = 1 << 18, shard_msr: int = 49152,
+                    seed: int = 0x19AB, wait_ms: float = 0.25) -> dict:
+    """Degraded-mode XOR-door A/B (the XOR_AB_r19 artifact).
+
+    Not a microbenchmark: every timed call rides the real admission →
+    dispatch → fallback machinery while a simulated device-loss drill
+    (CUBEFS_CODEC_DEAD) declares the tpu AND native legs transiently
+    dead — the exact cluster posture where codec throughput becomes
+    repair MTTR. What remains is the numpy host leg, and the
+    CUBEFS_CODEC_XOR door decides whether it serves as the compiled
+    XOR schedule (numpy-xor) or the naive GF(256) table path. Four
+    production-shaped workloads: EC6P3 encode + worst-case repair
+    decode, EC6P6MSR sub-shard encode + d=11 regenerating repair.
+    ABBA-ordered alternating rounds, per-leg medians, bit-identity
+    across both door positions AND against the gf_matmul golden,
+    reproducible schedule digests, and the served-leg evidence from
+    engine.last_dispatch."""
+    from ..codec import engine as eng
+    from ..ops import gf256, msr, xorprog
+
+    k1, m1 = 6, 3
+    k2, m2, d2 = 6, 6, 11
+    total2 = k2 + m2
+    alpha = d2 - k2 + 1
+    if shard_msr % alpha:
+        raise SystemExit(f"--shard-size {shard_msr} not divisible by "
+                         f"alpha={alpha}")
+    beta = shard_msr // alpha
+    rng = np.random.default_rng(seed)
+    helpers = tuple(range(1, d2 + 1))
+
+    # (label, coeff, input batch): each coeff is a real production
+    # matrix, each input the shape that matrix sees in the field
+    workloads = [
+        ("ec6p3_encode", gf256.parity_matrix(k1, m1),
+         rng.integers(0, 256, (stripes, k1, shard_ec), dtype=np.uint8)),
+        ("ec6p3_repair", gf256.decode_matrix(k1, k1 + m1,
+                                             list(range(m1, m1 + k1))),
+         rng.integers(0, 256, (stripes, k1, shard_ec), dtype=np.uint8)),
+        ("ec6p6msr_encode", msr.encode_rows(k2, total2, d2),
+         rng.integers(0, 256, (stripes, k2 * alpha, beta), dtype=np.uint8)),
+        ("ec6p6msr_repair", msr.repair_rows(k2, total2, d2, 0, helpers),
+         rng.integers(0, 256, (stripes, d2, beta), dtype=np.uint8)),
+    ]
+
+    saved_dead = os.environ.get("CUBEFS_CODEC_DEAD")
+    saved_door = os.environ.get("CUBEFS_CODEC_XOR")
+    drill = "tpu-pallas,tpu,cpp,cpp-xor"
+    walls: dict[str, dict[str, list[float]]] = {
+        lbl: {"xor": [], "naive": []} for lbl, _, _ in workloads}
+    outs: dict[str, dict[str, np.ndarray]] = {lbl: {} for lbl, _, _ in
+                                              workloads}
+    served: dict[str, str] = {}
+    try:
+        os.environ["CUBEFS_CODEC_DEAD"] = drill
+        codec = BatchCodec(enabled=True, max_wait_ms=wait_ms)
+        # warm both legs outside the timed window: program compiles,
+        # lib loads, crossover read — none of it is drill throughput
+        for door in ("1", "0"):
+            os.environ["CUBEFS_CODEC_XOR"] = door
+            for lbl, coeff, data in workloads:
+                codec.submit_apply("tpu", coeff, data[:1])
+        # ABBA pair ordering: monotone host drift cancels per pair
+        order: list[bool] = []
+        for i in range(rounds):
+            order += [True, False] if i % 2 == 0 else [False, True]
+        for use_xor in order:
+            os.environ["CUBEFS_CODEC_XOR"] = "1" if use_xor else "0"
+            leg = "xor" if use_xor else "naive"
+            for lbl, coeff, data in workloads:
+                t0 = time.perf_counter()
+                out = codec.submit_apply("tpu", coeff, data)
+                walls[lbl][leg].append(time.perf_counter() - t0)
+                outs[lbl][leg] = out
+                served[f"{lbl}:{leg}"] = eng.last_dispatch["served"]
+    finally:
+        for key, val in (("CUBEFS_CODEC_DEAD", saved_dead),
+                         ("CUBEFS_CODEC_XOR", saved_door)):
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+    bit_identical = True
+    per_workload = {}
+    agg_bytes = agg_xor_s = agg_naive_s = 0.0
+    for lbl, coeff, data in workloads:
+        golden = np.stack([gf256.gf_matmul(coeff, b) for b in data])
+        same = (np.array_equal(outs[lbl]["xor"], golden)
+                and np.array_equal(outs[lbl]["naive"], golden))
+        bit_identical = bit_identical and same
+        prog = xorprog.program_for(np.ascontiguousarray(coeff,
+                                                        dtype=np.uint8))
+        mx, mn = _median(walls[lbl]["xor"]), _median(walls[lbl]["naive"])
+        nbytes = float(data.nbytes)
+        agg_bytes += nbytes
+        agg_xor_s += mx
+        agg_naive_s += mn
+        per_workload[lbl] = {
+            "input_mib": round(nbytes / 2**20, 2),
+            "xor": {"median_wall_s": round(mx, 4),
+                    "gibs": round(nbytes / mx / 2**30, 4),
+                    "served_leg": served[f"{lbl}:xor"]},
+            "naive": {"median_wall_s": round(mn, 4),
+                      "gibs": round(nbytes / mn / 2**30, 4),
+                      "served_leg": served[f"{lbl}:naive"]},
+            "speedup_x": round(mn / mx, 2),
+            "bit_identical": bool(same),
+            "schedule_digest": prog.schedule_digest,
+            "schedule": prog.stats(),
+        }
+    return {
+        "mode": "fallback-ab",
+        "drill": {"dead_engines": drill.split(","),
+                  "requested_engine": "tpu",
+                  "note": "transient drill deaths — no quarantine; the "
+                          "door picks which surviving numpy leg serves"},
+        "rounds": rounds,
+        "stripes": stripes,
+        "workloads": per_workload,
+        "aggregate": {
+            "total_input_mib": round(agg_bytes / 2**20, 2),
+            "xor_gibs": round(agg_bytes / agg_xor_s / 2**30, 4),
+            "naive_gibs": round(agg_bytes / agg_naive_s / 2**30, 4),
+            "speedup_x": round(agg_naive_s / agg_xor_s, 2),
+        },
+        "bit_identical": bool(bit_identical),
+    }
+
+
 def _blob_cluster(tmpdir: str, n_nodes: int = 4, disks_per_node: int = 3):
     """Fresh in-process blob cluster (the test_blob_e2e shape) — one per
     obs-tail leg, since the repair phase breaks a disk."""
@@ -471,6 +602,10 @@ def main(argv=None):
     ap.add_argument("--repair-ab", action="store_true",
                     help="run the MSR sub-shard vs conventional k-shard "
                          "repair-traffic A/B instead of the encode bench")
+    ap.add_argument("--fallback-ab", action="store_true",
+                    help="degraded-mode XOR-door A/B: encode+repair on "
+                         "the surviving numpy leg under a device-loss "
+                         "drill, CUBEFS_CODEC_XOR on vs off")
     ap.add_argument("--obs-tail", action="store_true",
                     help="blob-plane instrumentation overhead A/B "
                          "(CUBEFS_TRACE=1 vs 0) + per-stage tails; "
@@ -513,6 +648,16 @@ def main(argv=None):
         print(json.dumps(result, indent=1))
         if args.out:
             merge_artifact(args.out, "blob", result)
+        return
+    if args.fallback_ab:
+        result = run_fallback_ab(rounds=args.rounds,
+                                 wait_ms=args.wait_ms)
+        text = json.dumps(result, indent=1)
+        print(text)
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            with open(args.out, "w") as f:
+                f.write(text + "\n")
         return
     if args.repair_ab:
         # repair-ab defaults to the EC6P6MSR production geometry; the

@@ -301,6 +301,29 @@ codec_batch_dp_steps = DEFAULT.counter(
     "cubefs_codec_batch_dp_steps_total",
     "device steps sharded dp-wise across the mesh", ("dp",))
 
+# shared compiled-program cache (ops/progcache.py): one process-wide
+# capped LRU behind the msr product-matrix rows, the jitted rs_kernel
+# closures and the scheduled XOR programs (ops/xorprog.py) — the bound
+# that keeps long-lived repair processes from growing one cache entry
+# per unique coefficient matrix forever. `cubefs-cli metrics codec`
+# renders the hit ratio.
+codec_program_cache = DEFAULT.counter(
+    "cubefs_codec_program_cache_total",
+    "compiled-program cache traffic by kernel family and event "
+    "(hit / miss / evict)", ("family", "event"))
+codec_program_cache_entries = DEFAULT.gauge(
+    "cubefs_codec_program_cache_entries",
+    "entries resident in the shared compiled-program cache")
+
+# degraded-mode codec legs (codec/engine.py): which engine actually
+# served repair decode math after the fallback chain and the
+# CUBEFS_CODEC_XOR door resolved — the drill artifact's proof that
+# repairs ran where the A/B says they did.
+repair_codec_leg = DEFAULT.counter(
+    "cubefs_repair_codec_leg_total",
+    "repair decode dispatches by the engine leg that served them "
+    "(post-fallback, post-XOR-door)", ("leg",))
+
 # repair-bandwidth observability (blob/worker.py): what a single-shard
 # repair actually pulls over the network, split by failure-domain scope
 # — the numbers the MSR sub-shard protocol (CUBEFS_CODEC_MSR) exists to

@@ -1,0 +1,15 @@
+"""Known-bad fixture for the XOR-program fence (CFC004).
+
+Parsed by tests under a cubefs_tpu/codec/ relpath; never imported."""
+
+from ..ops.bitlin import gf_matrix_to_bits  # CFC004: expansion import
+from ..ops.xorprog import XorProgram  # CFC004: program class import
+
+
+def hand_rolled_schedule(coeff, shards):
+    # CFC004: ad-hoc bitmatrix expansion — bypasses the program cache,
+    # the CSE pass, and the schedule digest the chaos drill replays
+    bits = gf_matrix_to_bits(coeff)
+    # CFC004: constructing the program outside the fenced module
+    prog = XorProgram(coeff)
+    return bits, prog.apply(shards)

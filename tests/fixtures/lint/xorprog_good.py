@@ -1,0 +1,16 @@
+"""Known-good fixture for the XOR-program fence (CFC004).
+
+Consuming COMPILED programs through the xorprog module facade is the
+sanctioned shape — only expansion/construction is fenced."""
+
+from ..ops import xorprog
+
+
+def scheduled_apply(coeff, shards):
+    # fine: the fenced module compiles (and caches) the schedule
+    return xorprog.apply(coeff, shards)
+
+
+def warm_cache(coeff):
+    prog = xorprog.program_for(coeff)  # fine: cached compile via facade
+    return prog.schedule_digest

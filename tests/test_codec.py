@@ -275,12 +275,14 @@ def test_autoengine_degrades_on_device_loss(monkeypatch, rng):
 
 def test_fallback_lands_on_numpy_when_cpp_unavailable(monkeypatch, rng):
     """A host without the native .so (or with a broken one) degrades
-    cpp -> numpy; the healthy engines are NOT quarantined along the
-    way — only the engine that actually failed is."""
+    cpp-xor -> cpp -> numpy leg; the healthy engines are NOT
+    quarantined along the way — only the engines that actually failed
+    are."""
     from cubefs_tpu.codec import engine as eng
 
-    class BrokenCpp:
-        name = "cpp"
+    class BrokenNative:
+        def __init__(self, name):
+            self.name = name
 
         def encode_parity(self, data, n_parity):
             raise OSError("libgfcpu.so: cannot open shared object file")
@@ -289,14 +291,17 @@ def test_fallback_lands_on_numpy_when_cpp_unavailable(monkeypatch, rng):
             raise OSError("libgfcpu.so: cannot open shared object file")
 
     monkeypatch.setattr(eng, "_dead_engines", set())
-    monkeypatch.setattr(eng, "_instances", {"cpp": BrokenCpp()})
+    monkeypatch.setattr(eng, "_instances",
+                        {"cpp": BrokenNative("cpp"),
+                         "cpp-xor": BrokenNative("cpp-xor")})
     data = rng.integers(0, 256, (6, 64)).astype(np.uint8)
     parity = eng._call_with_fallback("cpp", "encode_parity", data, 3)
     assert np.array_equal(parity, eng.NumpyEngine().encode_parity(data, 3))
-    assert eng._dead_engines == {"cpp"}  # tpu/numpy stay in rotation
+    # both broken native legs quarantined; tpu/numpy stay in rotation
+    assert eng._dead_engines == {"cpp", "cpp-xor"}
     # the router now routes around the dead native engine too
     monkeypatch.setattr(eng, "_policy", [[1 << 62, "cpp"]])
-    assert eng.engine_for(64).name in ("tpu", "numpy")
+    assert eng.engine_for(64).name in ("tpu", "numpy", "numpy-xor")
 
 
 def test_crossover_policy_routes_by_size(monkeypatch, rng):
@@ -308,7 +313,13 @@ def test_crossover_policy_routes_by_size(monkeypatch, rng):
     monkeypatch.setattr(eng, "_dead_engines", set())
     monkeypatch.setattr(eng, "_policy",
                         [[1024, "numpy"], [1 << 62, "tpu"]])
-    assert eng.engine_for(1024).name == "numpy"  # inclusive upper bound
+    # a policy's host leg aliases to its compiled-XOR twin while the
+    # CUBEFS_CODEC_XOR door is open (the default)
+    monkeypatch.delenv("CUBEFS_CODEC_XOR", raising=False)
+    assert eng.engine_for(1024).name == "numpy-xor"  # inclusive bound
+    monkeypatch.setenv("CUBEFS_CODEC_XOR", "0")
+    assert eng.engine_for(1024).name == "numpy"
+    monkeypatch.delenv("CUBEFS_CODEC_XOR", raising=False)
     assert eng.engine_for(1025).name == "tpu"
     auto = eng.AutoEngine()
     small = rng.integers(0, 256, (4, 64)).astype(np.uint8)   # 256 B
@@ -318,6 +329,92 @@ def test_crossover_policy_routes_by_size(monkeypatch, rng):
                           golden.encode_parity(small, 2))
     assert np.array_equal(auto.encode_parity(big, 2),
                           golden.encode_parity(big, 2))
+
+
+def test_chaos_drill_full_fallback_chain_both_door_positions(monkeypatch):
+    """Seeded device-loss drill: with every device/native leg declared
+    transiently dead (CUBEFS_CODEC_DEAD), a tpu-requested decode walks
+    the whole tpu→cpp→numpy chain and lands on the surviving numpy leg
+    the XOR door selects — byte-identical either way, reproducible
+    schedule digest, and NO permanent quarantine (a drill is not an
+    engine failure)."""
+    from cubefs_tpu.codec import engine as eng
+    from cubefs_tpu.ops import gf256, xorprog
+
+    rng = np.random.default_rng(0xD12)
+    t = cm.tactic("EC6P6MSR")
+    k, total, d = t.n, t.n + t.m, t.d
+    from cubefs_tpu.ops import msr
+    helpers = tuple(h for h in range(total) if h != 0)[:d]
+    rows = msr.repair_rows(k, total, d, 0, helpers)
+    recv = rng.integers(0, 256, (d, 3 * 64), dtype=np.uint8)
+    gold = gf256.gf_matmul(rows, recv)
+
+    monkeypatch.setattr(eng, "_dead_engines", set())
+    monkeypatch.setenv("CUBEFS_CODEC_DEAD", "tpu-pallas,tpu,cpp,cpp-xor")
+
+    monkeypatch.delenv("CUBEFS_CODEC_XOR", raising=False)
+    out_on = eng._call_with_fallback("tpu", "matrix_apply", rows, recv)
+    assert eng.last_dispatch["served"] == "numpy-xor"
+    assert np.array_equal(out_on, gold)
+    digest1 = xorprog.program_for(rows).schedule_digest
+
+    monkeypatch.setenv("CUBEFS_CODEC_XOR", "0")
+    out_off = eng._call_with_fallback("tpu", "matrix_apply", rows, recv)
+    assert eng.last_dispatch["served"] == "numpy"
+    assert np.array_equal(out_off, out_on)  # byte-identical across door
+
+    monkeypatch.delenv("CUBEFS_CODEC_XOR", raising=False)
+    digest2 = xorprog.program_for(rows).schedule_digest
+    assert digest1 == digest2  # the drill replays ONE schedule
+    assert eng._dead_engines == set()  # transient death ≠ quarantine
+
+
+def test_stale_policy_is_logged_not_silently_kept(tmp_path, monkeypatch,
+                                                  caplog):
+    """A policy file whose platform stamp mismatches the running
+    process must be LOGGED as stale and re-measured — never silently
+    trusted (satellite: the refusal now covers every mismatch
+    direction, not just cpu-table-in-tpu-process)."""
+    import json
+    import logging
+
+    from cubefs_tpu.codec import engine as eng
+
+    path = tmp_path / "CROSSOVER.json"
+    path.write_text(json.dumps(
+        {"table": [[1 << 62, "tpu"]], "platform": "tpu"}))
+    monkeypatch.setattr(eng, "_policy_path", lambda: str(path))
+    monkeypatch.setattr(eng, "_platform", lambda: "cpu")
+    monkeypatch.setattr(eng, "_policy", None)
+    remeasured = [[1 << 62, "numpy-xor"]]
+
+    def fake_measure(*a, **kw):
+        eng._policy = remeasured
+        return remeasured
+
+    monkeypatch.setattr(eng, "measure_crossover", fake_measure)
+    with caplog.at_level(logging.WARNING, logger="cubefs.codec"):
+        assert eng._load_policy() == remeasured
+    assert any("stale crossover policy" in r.message for r in caplog.records)
+
+
+def test_measure_crossover_times_xor_legs(tmp_path, monkeypatch):
+    """The refreshed sweep must time the compiled-XOR host legs as
+    first-class candidates and persist per-size timings, so the saved
+    policy documents WHY each size class routes where it does."""
+    import json
+
+    from cubefs_tpu.codec import engine as eng
+
+    path = tmp_path / "CROSSOVER.json"
+    monkeypatch.setattr(eng, "_policy_path", lambda: str(path))
+    monkeypatch.setattr(eng, "_policy", None)
+    eng.measure_crossover(sizes=(4096,), repeats=1)
+    saved = json.loads(path.read_text())
+    timed = set(saved["timings_s"]["4096"])
+    assert "numpy-xor" in timed
+    assert "device_crossover_bytes" in saved
 
 
 def test_lrc_local_reconstruct_edge_cases(rng):

@@ -20,7 +20,8 @@ import pytest
 from tool.lint import cli, core
 from tool.lint import graph as graphlib
 from tool.lint.checkers.admission_discipline import AdmissionDisciplineChecker
-from tool.lint.checkers.batch_discipline import BatchDisciplineChecker
+from tool.lint.checkers.batch_discipline import (BatchDisciplineChecker,
+                                                 XorProgFenceChecker)
 from tool.lint.checkers.fanout_discipline import FanoutDisciplineChecker
 from tool.lint.checkers.fs_placement import FsPlacementChecker
 from tool.lint.checkers.fsm_purity import FsmPurityChecker, apply_roots
@@ -277,6 +278,28 @@ def test_batch_discipline_scoped_to_blob_plane():
     # the codec package itself holds raw engines by design
     assert not c.applies("cubefs_tpu/codec/batcher.py")
     assert not c.applies("cubefs_tpu/fs/master.py")
+
+
+def test_xorprog_fence_true_positives():
+    mod = _module("xorprog_bad.py", "cubefs_tpu/codec/fx.py")
+    found = XorProgFenceChecker().check(mod)
+    assert _codes(found) == ["CFC004", "CFC004", "CFC004", "CFC004"]
+
+
+def test_xorprog_fence_true_negative():
+    mod = _module("xorprog_good.py", "cubefs_tpu/codec/fx.py")
+    assert XorProgFenceChecker().check(mod) == []
+
+
+def test_xorprog_fence_scope():
+    c = XorProgFenceChecker()
+    # both the blob plane and the codec package are fenced...
+    assert c.applies("cubefs_tpu/blob/worker.py")
+    assert c.applies("cubefs_tpu/codec/engine.py")
+    # ...but the ops plane is not: xorprog.py IS the fenced module, and
+    # rs_kernel.py expands bitmatrices for the device path by design
+    assert not c.applies("cubefs_tpu/ops/xorprog.py")
+    assert not c.applies("cubefs_tpu/ops/rs_kernel.py")
 
 
 # ---------------- suppressions ----------------

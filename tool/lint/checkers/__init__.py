@@ -1,7 +1,7 @@
 """Checker registry: every family the suite ships, in report order."""
 
 from .admission_discipline import AdmissionDisciplineChecker
-from .batch_discipline import BatchDisciplineChecker
+from .batch_discipline import BatchDisciplineChecker, XorProgFenceChecker
 from .fanout_discipline import FanoutDisciplineChecker
 from .fs_placement import FsPlacementChecker
 from .fsm_purity import FsmPurityChecker
@@ -28,6 +28,7 @@ ALL_CHECKERS = (
     PlacementDisciplineChecker,
     FsPlacementChecker,
     BatchDisciplineChecker,
+    XorProgFenceChecker,
     FanoutDisciplineChecker,
     AdmissionDisciplineChecker,
     TieringDisciplineChecker,

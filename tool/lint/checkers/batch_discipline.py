@@ -23,6 +23,11 @@ metrics and to backpressure. The regression shape:
           repair-traffic metrics; a second call-site forks that
           protocol (helpers serve opaque coefficient rows over
           read_subshard, they never build repair matrices themselves)
+  CFC004  ad-hoc XOR-program construction (XorProgFenceChecker, below)
+          outside ops/xorprog.py — bitmatrix expansion and schedule
+          compilation are fenced there so every leg replays ONE cached,
+          CSE'd, digest-stamped schedule; a second expansion site can
+          silently disagree with the compiled program
 
 The analysis is syntactic. The admitted receiver convention is a final
 attribute/name of ``codec`` (``self.codec``, ``enc.codec``) or an
@@ -116,4 +121,55 @@ class BatchDisciplineChecker(Checker):
                         f"admitted facade (codec.batcher.admit(), held "
                         f"as `.codec`) so submissions coalesce into "
                         f"device-sized steps"))
+        return out
+
+
+# names whose call (or import) means "I am expanding GF(256) rows into
+# GF(2) bitmatrices / building an XOR schedule by hand"
+_XORPROG_NAMES = {"gf_matrix_to_bits", "coeff_bitmatrix", "XorProgram"}
+_XORPROG_HOME = "cubefs_tpu/ops/xorprog.py"
+
+
+class XorProgFenceChecker(Checker):
+    """CFC004: XOR-program construction is fenced to ops/xorprog.py.
+
+    The scheduled-XOR path (ops/xorprog.py) owns the bitmatrix
+    expansion, the CSE pass, and the slot layout shared with the native
+    executor; blob- and codec-plane modules consume compiled programs
+    via ``xorprog.apply`` / ``xorprog.program_for`` only. A second
+    expansion site (calling ``gf_matrix_to_bits`` on coefficient rows,
+    or constructing ``XorProgram`` ad hoc) forks the schedule contract:
+    it bypasses the program cache, the schedule digest the chaos drill
+    replays, and the bit-identity guarantee the compiled program
+    carries. Note rs_kernel.py (ops plane, device bit-matmul) also uses
+    gf_matrix_to_bits legitimately — only blob/ and codec/ are fenced.
+    """
+
+    rule = "batch-discipline"
+    dirs = ("cubefs_tpu/blob/", "cubefs_tpu/codec/")
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name in _XORPROG_NAMES:
+                        out.append(self.violation(
+                            mod, "CFC004", node,
+                            f"import of `{a.name}` outside "
+                            f"{_XORPROG_HOME} — XOR schedules are "
+                            f"compiled there; consume them via "
+                            f"xorprog.apply()/program_for()"))
+            elif isinstance(node, ast.Call):
+                func = node.func
+                called = (func.attr if isinstance(func, ast.Attribute)
+                          else func.id if isinstance(func, ast.Name) else "")
+                if called in _XORPROG_NAMES:
+                    out.append(self.violation(
+                        mod, "CFC004", node,
+                        f"`{called}()` outside {_XORPROG_HOME} — ad-hoc "
+                        f"bitmatrix expansion forks the compiled-schedule "
+                        f"contract (program cache, schedule digest, "
+                        f"bit-identity); call xorprog.apply() or "
+                        f"xorprog.program_for() instead"))
         return out
