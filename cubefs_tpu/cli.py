@@ -378,6 +378,34 @@ def _geo_view(text: str) -> dict:
     }
 
 
+def _meta_view(text: str) -> dict:
+    """The elastic-metadata digest: actionable partition imbalance (the
+    gauge the balance sweep drives to zero), completed migrations by
+    kind, pre-commit aborts by reason, and 453 range-moved bounces —
+    whether the plane is rebalancing and whether handoffs are clean."""
+    series = _parse_metrics(text)
+
+    def by_label(name, label):
+        out = {}
+        for n, lb, v in series:
+            if n == name:
+                key = lb.get(label, "")
+                out[key] = out.get(key, 0) + v
+        return out
+
+    return {
+        "imbalance": sum(v for n, _, v in series
+                         if n == "cubefs_meta_partition_imbalance"),
+        "migrations": by_label("cubefs_meta_range_migrations_total",
+                               "kind"),
+        "aborts": by_label("cubefs_meta_range_migration_aborts_total",
+                           "reason"),
+        "range_redirects": sum(
+            v for n, _, v in series
+            if n == "cubefs_meta_range_redirects_total"),
+    }
+
+
 def _qos_view(text: str) -> dict:
     """The overload-protection digest: per-tenant admit/shed/throttle
     counters, shaping waits, and burn-rate brownout state per path —
@@ -600,6 +628,22 @@ def main(argv=None):
     p_mp.add_argument("--master", required=True)
     p_mp.add_argument("--vol", help="volume name (for split)")
 
+    p_meta = sub.add_parser("meta")  # elastic metadata plane
+    p_meta.add_argument("action",
+                        choices=["split", "merge", "balance", "status"])
+    p_meta.add_argument("--master", required=True)
+    p_meta.add_argument("--vol", help="volume name")
+    p_meta.add_argument("--pid", type=int,
+                        help="donor partition (split/merge); auto-picked "
+                             "when omitted")
+    p_meta.add_argument("--split-ino", type=int,
+                        help="explicit split point (split)")
+    p_meta.add_argument("--absorber", type=int,
+                        help="absorbing partition (merge); defaults to "
+                             "the donor's left-adjacent neighbour")
+    p_meta.add_argument("--max-moves", type=int, default=1,
+                        help="migration cap for one balance sweep")
+
     p_user = sub.add_parser("user")
     p_user.add_argument("action",
                         choices=["create", "grant", "revoke", "list",
@@ -656,7 +700,8 @@ def main(argv=None):
     p_metrics.add_argument("action",
                            choices=["write-path", "codec", "repair", "slo",
                                     "read-path", "qos", "tiering",
-                                    "integrity", "wire", "geo", "raw"])
+                                    "integrity", "wire", "geo", "meta",
+                                    "raw"])
     p_metrics.add_argument("--addr", required=True,
                            help="any node's RPC addr (serves /metrics)")
 
@@ -790,6 +835,26 @@ def main(argv=None):
             out = master.call("split_meta_partition", {"name": args.vol})[0]
         else:
             out = master.call("check_meta_partitions", {})[0]
+        print(json.dumps(out, indent=2))
+
+    elif args.group == "meta":
+        from .sdk import MasterClient
+
+        mc = MasterClient(args.master)
+        if args.action == "split":
+            if not args.vol:
+                sys.exit("meta split needs --vol")
+            out = mc.meta_split(args.vol, pid=args.pid,
+                                split_ino=args.split_ino)
+        elif args.action == "merge":
+            if not args.vol:
+                sys.exit("meta merge needs --vol")
+            out = mc.meta_merge(args.vol, donor_pid=args.pid,
+                                absorber_pid=args.absorber)
+        elif args.action == "balance":
+            out = mc.meta_balance(max_moves=args.max_moves)
+        else:
+            out = mc.meta_status(args.vol)
         print(json.dumps(out, indent=2))
 
     elif args.group == "user":
@@ -975,6 +1040,8 @@ def main(argv=None):
             print(json.dumps(_wire_view(text), indent=2))
         elif args.action == "geo":
             print(json.dumps(_geo_view(text), indent=2))
+        elif args.action == "meta":
+            print(json.dumps(_meta_view(text), indent=2))
         else:
             print(json.dumps(_write_path_view(text), indent=2))
 

@@ -18,6 +18,7 @@ import uuid
 from ..utils import lockwitness
 from ..utils import metrics as _metrics
 from ..utils import packet as pkt
+from ..utils import retry as retrylib
 from ..utils import rpc
 from ..utils import trace as tracelib
 from . import metanode as mn
@@ -27,6 +28,13 @@ class FsError(Exception):
     def __init__(self, errno_: int, msg: str):
         super().__init__(msg)
         self.errno = errno_
+
+
+# backoff while chasing a 453 RANGE_MOVED whose commit hasn't published
+# a new owner yet: the freeze window is tens of milliseconds, so the
+# chase stays well under the cap
+_MOVED_BACKOFF = retrylib.RetryPolicy(base=0.03, cap=0.25, jitter=0.5,
+                                      deadline=None)
 
 
 # meta ops served on the binary packet plane (manager_op.go analog);
@@ -56,6 +64,39 @@ def _op_ids_stamped(method: str, payload: dict) -> bool:
     if method == "alloc_ino":
         return "op_id" in payload
     return False
+
+
+def _routing_ino(method: str, payload: dict) -> int | None:
+    """The inode a meta call routes by — what a 453/EMOVED range
+    redirect re-resolves against the refreshed partition table. None
+    for calls with no single routing inode (alloc_ino rotates instead;
+    submit_batch falls back to per-record submits in the fanout)."""
+    if method == "submit":
+        r = payload.get("record") or {}
+        for k in ("parent", "src_parent", "ino"):
+            v = r.get(k)
+            if isinstance(v, int):
+                return v
+        return None
+    if method in ("lookup", "readdir", "dentry_count"):
+        v = payload.get("parent")
+        return v if isinstance(v, int) else None
+    if method in ("inode_get", "walk"):
+        v = payload.get("ino")
+        return v if isinstance(v, int) else None
+    return None
+
+
+def _moved_exc(e: Exception) -> bool:
+    """Is this a live-range-migration routing bounce? Either the
+    proposer-side fence (453 RANGE_MOVED) or the deterministic
+    apply-side errno (EMOVED rides the 499 errno encoding)."""
+    if isinstance(e, rpc.RpcError):
+        if e.code == rpc.RANGE_MOVED:
+            return True
+        return (e.code == 499
+                and e.message.startswith(f"errno={mn.EMOVED}:"))
+    return isinstance(e, FsError) and e.errno == mn.EMOVED
 
 
 
@@ -237,13 +278,28 @@ class SubmitFanout:
                     mp, "submit", {"record": batch[0].record})
                 batch[0].finish(meta["result"], None)
                 return
-            meta, _ = self.wrapper._call_wire(
-                mp, "submit_batch",
-                {"records": [w.record for w in batch]})
+            try:
+                meta, _ = self.wrapper._call_wire(
+                    mp, "submit_batch",
+                    {"records": [w.record for w in batch]})
+            except (rpc.RpcError, FsError) as e:
+                if not _moved_exc(e):
+                    raise
+                # batch-level range fence: the partition no longer owns
+                # every record's inode, so the envelope can't land as
+                # one unit — fall back to per-record submits, each
+                # re-routed through the 453-chasing single-op path
+                self._resubmit_moved(batch)
+                return
             _metrics.meta_fanout_batches.inc(pid=pid)
             _metrics.meta_fanout_ops.inc(len(batch), pid=pid)
             for w, (result, err) in zip(batch, meta["results"]):
-                if err is not None:
+                if err is not None and err[0] == mn.EMOVED:
+                    # apply-side fence caught a record already in the
+                    # raft queue when the freeze landed: it bounced
+                    # (never applied) — land it on the new owner
+                    self._resubmit_moved([w])
+                elif err is not None:
                     w.finish(None, FsError(err[0], err[1]))
                 else:
                     w.finish(result, None)
@@ -256,6 +312,25 @@ class SubmitFanout:
         finally:
             self._gate.release()
 
+    def _resubmit_moved(self, waiters: list[_FanoutWaiter]) -> None:
+        """Land records bounced by a live range migration one at a time,
+        each routed by its own inode against a fresh partition table.
+        The bounced attempt never applied (the fence is checked before
+        — or deterministically instead of — the handler), so a fresh
+        op_id on the new owner keeps exactly-once intact."""
+        for w in waiters:
+            try:
+                ino = _routing_ino("submit", {"record": w.record})
+                if ino is None:
+                    raise FsError(
+                        mn.EMOVED, "record has no routing inode")
+                nmp = self.wrapper._mp_for(ino)
+                meta, _ = self.wrapper._call_wire(
+                    nmp, "submit", {"record": w.record})
+                w.finish(meta["result"], None)
+            except BaseException as e:  # noqa: BLE001 - per-record fate
+                w.finish(None, e)
+
 
 class MetaWrapper:
     """Routes inode/dentry ops to the owning meta partition by range."""
@@ -265,6 +340,14 @@ class MetaWrapper:
         self.nodes = node_pool
         self._rr = 0
         self._lock = lockwitness.make_lock("MetaWrapper._lock")
+        # range-table watermark: every committed split/merge bumps it
+        # exactly once on the master, so staleness is one compare
+        self.mp_version = vol_view.get("mp_version", 0)
+        # FileSystem wires this to the master's client_view when it
+        # knows a master address; a range miss or 453 redirect re-pulls
+        # the table through it before giving up
+        self._refresh_cb = None
+        self._refresh_ts = 0.0
         # binary meta plane (manager_op.go): metanodes that advertise a
         # packet address serve the hot ops over persistent TCP; HTTP
         # stays as the per-address fallback (same negative-cache
@@ -291,7 +374,40 @@ class MetaWrapper:
         for mp in self.mps:
             if mp["start"] <= ino < mp["end"]:
                 return mp
+        # a miss usually means the table is stale (a split/merge landed
+        # since the last view pull): re-fetch ONCE before giving up —
+        # a freshly migrated inode must not surface as ENOENT
+        if self.refresh_view(force=True):
+            for mp in self.mps:
+                if mp["start"] <= ino < mp["end"]:
+                    return mp
         raise FsError(mn.ENOENT, f"no meta partition owns inode {ino}")
+
+    def refresh_view(self, force: bool = False) -> bool:
+        """Re-pull the volume view from the master (when FileSystem
+        wired a callback). Throttled so a burst of misses costs one
+        master round-trip; returns True when a pull happened."""
+        cb = self._refresh_cb
+        if cb is None:
+            return False
+        now = time.monotonic()
+        if not force and now - self._refresh_ts < 1.0:
+            return False
+        self._refresh_ts = now
+        try:
+            view = cb()
+        except Exception:  # noqa: BLE001 - stale table, retried later
+            return False
+        if (view.get("mp_version", 0) != self.mp_version
+                or len(view.get("mps") or []) != len(self.mps)):
+            self.update_mps(view["mps"], view.get("mp_version", 0))
+        # migrations can land partitions on nodes this client has never
+        # talked to: adopt their advertised planes too
+        for a, p in (view.get("meta_packet_addrs") or {}).items():
+            self.packet_addrs.setdefault(a, p)
+        for a, p in (view.get("meta_read_addrs") or {}).items():
+            self.read_addrs.setdefault(a, p)
+        return True
 
     REDIRECT = 421  # metanode "not leader" status
 
@@ -328,31 +444,76 @@ class MetaWrapper:
             payload["records"] = [dict(r) for r in payload["records"]]
             for r in payload["records"]:
                 r.setdefault("op_id", uuid.uuid4().hex)
+        for attempt in range(self.MOVED_RETRIES + 1):
+            try:
+                if ((self.packet_addrs or self.read_addrs)
+                        and method in _META_PACKET_OPS):
+                    # same replica/redirect loop, per-address call
+                    # swapped for the packet transport (with per-address
+                    # HTTP fallback inside _packet_one)
+                    return rpc.call_replicas(
+                        self.nodes, addrs, method, payload, deadline=10.0,
+                        call_fn=lambda a: (
+                            self._packet_one(a, method, payload), b""))
+                return rpc.call_replicas(self.nodes, addrs, method,
+                                         payload, deadline=10.0)
+            except rpc.RpcError as e:
+                if _moved_exc(e) and attempt < self.MOVED_RETRIES:
+                    nmp = self._moved_reroute(method, payload, attempt)
+                    if nmp is not None:
+                        mp = nmp
+                        addrs = list(mp.get("addrs") or [mp["addr"]])
+                        payload["pid"] = mp["pid"]
+                        continue
+                if _moved_exc(e) and method == "alloc_ino":
+                    # no routing inode to chase: surface the standard
+                    # range-exhausted errno so inode_create rotates to
+                    # the next partition (and picks up the new one on
+                    # its next view refresh)
+                    raise FsError(
+                        28, f"mp {payload['pid']} inode range "
+                            f"migrating: {e.message}") from None
+                if e.code == 499 and e.message.startswith("errno="):
+                    errno_ = int(
+                        e.message[len("errno="):].split(":", 1)[0])
+                    raise FsError(errno_, e.message) from None
+                if (400 <= e.code < 500
+                        and e.code not in (404, self.REDIRECT,
+                                           rpc.GEO_REDIRECT,
+                                           rpc.RANGE_MOVED)):
+                    # 452/453 are ROUTING codes like 421, not errnos:
+                    # if one still surfaces here the retries above are
+                    # exhausted — bubble the transport error instead of
+                    # minting a bogus errno-52/53
+                    raise FsError(e.code - 400, e.message) from None
+                raise
+
+    # bounded chase of a migrating range: the freeze window is the
+    # donor's delta drain + target replay + master commit — short, but
+    # real; each retry re-pulls the table and backs off a little
+    MOVED_RETRIES = 8
+
+    def _moved_reroute(self, method: str, payload: dict,
+                       attempt: int) -> dict | None:
+        """Resolve a 453/EMOVED bounce against a fresh partition table.
+        Returns the partition to retry against, or None when this call
+        has no single routing inode (the caller falls back: alloc_ino
+        rotates, submit_batch re-lands per record)."""
+        ino = _routing_ino(method, payload)
+        if ino is None:
+            return None
+        self.refresh_view(force=True)
         try:
-            if ((self.packet_addrs or self.read_addrs)
-                    and method in _META_PACKET_OPS):
-                # same replica/redirect loop, per-address call swapped
-                # for the packet transport (with per-address HTTP
-                # fallback inside _packet_one)
-                return rpc.call_replicas(
-                    self.nodes, addrs, method, payload, deadline=10.0,
-                    call_fn=lambda a: (self._packet_one(a, method, payload),
-                                       b""))
-            return rpc.call_replicas(self.nodes, addrs, method, payload,
-                                     deadline=10.0)
-        except rpc.RpcError as e:
-            if e.code == 499 and e.message.startswith("errno="):
-                errno_ = int(e.message[len("errno="):].split(":", 1)[0])
-                raise FsError(errno_, e.message) from None
-            if (400 <= e.code < 500
-                    and e.code not in (404, self.REDIRECT,
-                                       rpc.GEO_REDIRECT)):
-                # 452 (GeoRedirect) is a ROUTING code like 421, not an
-                # errno: call_replicas already retried the advertised
-                # primary; if it still surfaces, bubble the transport
-                # error instead of minting a bogus errno-52
-                raise FsError(e.code - 400, e.message) from None
-            raise
+            nmp = self._mp_for(ino)
+        except FsError:
+            return None
+        if nmp["pid"] == payload["pid"]:
+            # the commit hasn't published yet: wait out a slice of the
+            # freeze window before re-presenting the same op_id
+            r = _MOVED_BACKOFF.start(op="meta.moved_chase")
+            r.attempt = attempt
+            r.tick(reason="range-moved")
+        return nmp
 
     def _packet_one(self, addr: str, method: str, payload: dict) -> dict:
         """One meta call to one node, trying the fastest advertised
@@ -407,34 +568,43 @@ class MetaWrapper:
         # range-exhausted mp (ENOSPC from alloc_ino) is skipped — the
         # master's split sweep appends fresh partitions, which a view
         # refresh picks up
-        mps = list(self.mps)
-        with self._lock:
-            offset = self._rr
-            self._rr += 1
         last: FsError | None = None
-        for step in range(len(mps)):
-            mp = mps[(offset + step) % len(mps)]
-            try:
-                ino = self._call(mp, "alloc_ino",
-                                 {"op_id": uuid.uuid4().hex})[0]["ino"]
-            except FsError as e:
-                if e.errno == 28:  # inode range exhausted
-                    last = e
-                    continue
-                raise
-            rec = {"op": "mk_inode", "ino": ino, "type": typ, "mode": mode,
-                   "ts": time.time()}
-            if target is not None:
-                rec["target"] = target
-            if quota_ids:
-                rec["quota_ids"] = list(quota_ids)
-            self._call(mp, "submit", {"record": rec})
-            return self.inode_get(ino)
+        for sweep in range(2):
+            mps = list(self.mps)
+            with self._lock:
+                offset = self._rr
+                self._rr += 1
+            for step in range(len(mps)):
+                mp = mps[(offset + step) % len(mps)]
+                try:
+                    ino = self._call(mp, "alloc_ino",
+                                     {"op_id": uuid.uuid4().hex})[0]["ino"]
+                except FsError as e:
+                    if e.errno == 28:  # inode range exhausted/migrating
+                        last = e
+                        continue
+                    raise
+                rec = {"op": "mk_inode", "ino": ino, "type": typ,
+                       "mode": mode, "ts": time.time()}
+                if target is not None:
+                    rec["target"] = target
+                if quota_ids:
+                    rec["quota_ids"] = list(quota_ids)
+                self._call(mp, "submit", {"record": rec})
+                return self.inode_get(ino)
+            # every partition we KNOW is exhausted — but a split/merge
+            # may have republished the table since our last view pull;
+            # re-fetch once and re-rotate before giving up
+            if sweep or not self.refresh_view(force=True):
+                break
         raise last if last else FsError(28, "no meta partition has free inodes")
 
-    def update_mps(self, mps: list[dict]) -> None:
+    def update_mps(self, mps: list[dict],
+                   version: int | None = None) -> None:
         """Adopt a refreshed partition table (e.g. after an mp split)."""
         self.mps = mps
+        if version is not None:
+            self.mp_version = version
 
     def walk(self, ino: int, names: list[str],
              stat: bool = False) -> tuple[int, dict | None]:
@@ -1220,6 +1390,10 @@ class FileSystem:
         self.nodes = node_pool
         self.master_addr = master_addr
         self.client_az = client_az
+        if master_addr is not None:
+            # lets the meta router chase live range migrations (and
+            # satisfy range misses) by re-pulling the view on demand
+            self.meta._refresh_cb = self._fetch_view
         # A/B door for the AZ-local hot-read tier: CUBEFS_READ_CACHE=1
         # (plus a flash ring handle) routes reads through CachedReader;
         # off (default) is byte-for-byte the plain ExtentClient path.
@@ -1263,17 +1437,24 @@ class FileSystem:
             table.setdefault(int(q["dir_ino"]), []).append(int(qid))
         self.quotas = table
 
+    def _fetch_view(self) -> dict:
+        return self.nodes.get(self.master_addr).call(
+            "client_view", {"name": self.vol_name})[0]["volume"]
+
     def _maybe_refresh_quotas(self) -> None:
         if (self.master_addr is None
                 or time.time() - self._quota_ts < self.QUOTA_TTL):
             return
         self._quota_ts = time.time()  # even on failure: don't hammer
         try:
-            view = self.nodes.get(self.master_addr).call(
-                "client_view", {"name": self.vol_name})[0]["volume"]
+            view = self._fetch_view()
             self.update_quotas(view.get("quotas") or {})
-            if len(view.get("mps") or []) > len(self.meta.mps):
-                self.meta.update_mps(view["mps"])  # mp split landed
+            # mp_version is the single range-table watermark: a merge
+            # SHRINKS the table, so a length compare alone would miss it
+            if (view.get("mp_version", 0) != self.meta.mp_version
+                    or len(view.get("mps") or []) != len(self.meta.mps)):
+                self.meta.update_mps(view["mps"],
+                                     view.get("mp_version", 0))
         except Exception:
             pass  # stale table; retried after the next TTL
 

@@ -65,6 +65,13 @@ class Master(ReplicatedFsm):
         # AK/SK user registry with per-volume grants (master/user.go):
         # replicated FSM state, served to gateways for authentication
         self.users: dict[str, dict] = {}  # ak -> {user_id, sk, volumes}
+        # in-flight metapartition range migrations (fs/split.py):
+        # REPLICATED state — split_prepare lands the fence (and the
+        # target pid reservation) durably BEFORE any metanode RPC, so a
+        # crash mid-PREPARE can neither mint a duplicate pid nor lose
+        # track of a half-built target partition
+        self.splits: dict[str, dict] = {}  # split_id -> plan
+        self._split_engine = None  # lazy SplitEngine (fs/split.py)
         self._next_pid = 1
         self._next_dp = 1
         self.data_dir = data_dir
@@ -74,13 +81,35 @@ class Master(ReplicatedFsm):
         return {"volumes": self.volumes,
                 "next": [self._next_pid, self._next_dp],
                 "decommissioned": sorted(self.decommissioned),
-                "users": self.users}
+                "users": self.users,
+                "splits": self.splits}
 
     def _load_state_dict(self, state: dict) -> None:
         self.volumes = state["volumes"]
         self._next_pid, self._next_dp = state["next"]
         self.decommissioned = set(state.get("decommissioned", []))
         self.users = state.get("users", {})
+        self.splits = state.get("splits", {})
+        self._recalc_next_pid()
+
+    def _recalc_next_pid(self) -> None:
+        """Re-derive the pid high-water mark from every committed source.
+
+        Committed volume mps are not enough: a split that crashed between
+        split_prepare and split_commit has reserved a target pid that
+        lives only in ``self.splits``.  Recovery (or a follower catching
+        up from a snapshot) must scan those too, or the next volume
+        create could mint a duplicate pid and two partitions would fight
+        over one raft group directory.
+        """
+        hi = self._next_pid
+        for vol in self.volumes.values():
+            for m in vol["mps"]:
+                hi = max(hi, m["pid"] + 1)
+        for s in self.splits.values():
+            for tp in s.get("target_pids", []):
+                hi = max(hi, tp + 1)
+        self._next_pid = hi
 
     def _state_bytes(self) -> bytes:
         with self._lock:
@@ -102,6 +131,8 @@ class Master(ReplicatedFsm):
         "put_user": ("user", "ak"),
         "delete_user": ("user", "ak"),
         "set_grant": ("user", "ak"),
+        "split_commit": ("vol", "name"),
+        "merge_commit": ("vol", "name"),
     }
 
     def _segments_of(self, rec: dict) -> list[str]:
@@ -110,8 +141,9 @@ class Master(ReplicatedFsm):
         ent = self._SEG_OPS.get(op)
         if ent is not None:
             segs.append(f"{ent[0]}:{rec[ent[1]]}")
-        if op in ("put_volume", "add_mp", "decommission"):
-            segs.append("meta")  # id counters / drain set moved
+        if op in ("put_volume", "add_mp", "decommission", "split_prepare",
+                  "split_commit", "split_abort", "merge_commit"):
+            segs.append("meta")  # id counters / drain set / splits moved
         return segs or ["meta"]  # unknown future op: at least the meta
 
     def _segment_state(self, seg: str):
@@ -122,7 +154,8 @@ class Master(ReplicatedFsm):
             if kind == "user":
                 return self.users.get(key)
             return {"next": [self._next_pid, self._next_dp],
-                    "decommissioned": sorted(self.decommissioned)}
+                    "decommissioned": sorted(self.decommissioned),
+                    "splits": self.splits}
 
     def _load_segment_state(self, seg: str, value) -> None:
         kind, _, key = seg.partition(":")
@@ -133,6 +166,8 @@ class Master(ReplicatedFsm):
         else:
             self._next_pid, self._next_dp = value["next"]
             self.decommissioned = set(value["decommissioned"])
+            self.splits = value.get("splits", {})
+            self._recalc_next_pid()
 
     def _all_segments(self) -> list[str]:
         with self._lock:
@@ -148,10 +183,67 @@ class Master(ReplicatedFsm):
 
     def _apply_put_volume(self, name: str, vol: dict) -> None:
         self.volumes[name] = vol
-        self._next_pid = max([self._next_pid]
-                             + [m["pid"] + 1 for m in vol["mps"]])
+        # scan in-flight splits too: a crash mid-PREPARE has reserved
+        # target pids in self.splits that no committed mp lists yet
+        self._recalc_next_pid()
         self._next_dp = max([self._next_dp]
                             + [d["dp_id"] + 1 for d in vol["dps"]])
+
+    # ------- elastic metadata plane (fs/split.py drives these) ---------
+    # The three-phase migration commits its routing change as ONE master
+    # FSM apply (split_commit / merge_commit): clients observe either the
+    # old range table or the new one, never a torn intermediate, and
+    # re-route on a single mp_version watermark bump.
+
+    def _apply_split_prepare(self, name: str, split: dict) -> dict:
+        """Reserve the split plan in the replicated ledger. Target pids
+        are (re)assigned HERE, inside the apply: the engine plans
+        without holding the proposal door, so a volume create can mint
+        pids between plan and prepare — the apply is the one place the
+        assignment is serial with every other pid source, and it is
+        deterministic (same FSM state on every replica). Returns the
+        stored record; the engine drives with the assigned pids."""
+        sid = split["split_id"]
+        split = dict(split, name=name)
+        if split.get("target_pids"):
+            split["target_pids"] = [self._next_pid]
+            self._next_pid += 1
+        self.splits[sid] = split
+        return dict(split)
+
+    def _apply_split_commit(self, split_id: str, name: str = "") -> None:
+        s = self.splits.pop(split_id, None)
+        if s is None:  # replayed / already aborted: nothing to do
+            return
+        vol = self.volumes.get(s["name"])
+        if vol is None:
+            return
+        mps = vol["mps"]
+        if s.get("kind") == "merge":
+            # absorber extends over the donor's range; donor mp vanishes
+            donor = next(m for m in mps if m["pid"] == s["donor_pid"])
+            absorber = next(m for m in mps
+                            if m["pid"] == s["absorber_pid"])
+            absorber["end"] = max(absorber["end"], donor["end"])
+            mps[:] = [m for m in mps if m["pid"] != s["donor_pid"]]
+        else:
+            donor = next(m for m in mps if m["pid"] == s["donor_pid"])
+            hi = donor["end"]
+            donor["end"] = s["split_ino"]
+            mps.append({"pid": s["target_pids"][0],
+                        "start": s["split_ino"], "end": hi,
+                        "addr": s["addrs"][0], "addrs": s["addrs"]})
+            mps.sort(key=lambda m: (m["start"], m["pid"]))
+        vol["mp_version"] = vol.get("mp_version", 0) + 1
+
+    def _apply_split_abort(self, split_id: str, name: str = "",
+                           reason: str = "") -> None:
+        self.splits.pop(split_id, None)
+
+    # merge rides the same splits ledger; a distinct commit op keeps the
+    # WAL legible and lets _SEG_OPS tag the volume segment it touches
+    def _apply_merge_commit(self, split_id: str, name: str = "") -> None:
+        self._apply_split_commit(split_id, name)
 
     # ---------------- users (master/user.go analog) --------------------
     def _apply_put_user(self, ak: str, user: dict) -> None:
@@ -682,6 +774,10 @@ class Master(ReplicatedFsm):
                                if i.get("read_addr")}
             return {"name": name, "mps": [dict(m) for m in vol["mps"]],
                     "dps": [dict(d) for d in vol["dps"]],
+                    # single watermark: every committed range-table
+                    # change (split/merge) bumps it exactly once, so
+                    # clients refresh on compare instead of len()
+                    "mp_version": vol.get("mp_version", 0),
                     "quotas": dict(vol.get("quotas", {})),
                     "packet_addrs": packet_addrs,
                     "meta_packet_addrs": meta_packet_addrs,
@@ -1095,6 +1191,44 @@ class Master(ReplicatedFsm):
             return {"pid": self.split_meta_partition(args["name"])}
         except MasterError as e:
             raise rpc.RpcError(404, str(e)) from None
+
+    # ------------- elastic metadata plane (fs/split.py) -------------
+    def split_engine(self):
+        """Lazy SplitEngine: masters that never migrate pay nothing,
+        and tests reach the same instance the RPCs drive."""
+        with self._lock:
+            if self._split_engine is None:
+                from .split import SplitEngine
+                self._split_engine = SplitEngine(self)
+            return self._split_engine
+
+    def rpc_meta_split(self, args, body):
+        self._leader_gate()
+        try:
+            return self.split_engine().split(
+                args["name"], pid=args.get("pid"),
+                split_ino=args.get("split_ino"))
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+
+    def rpc_meta_merge(self, args, body):
+        self._leader_gate()
+        try:
+            return self.split_engine().merge(
+                args["name"], donor_pid=args.get("donor_pid"),
+                absorber_pid=args.get("absorber_pid"))
+        except MasterError as e:
+            raise rpc.RpcError(404, str(e)) from None
+
+    def rpc_meta_balance(self, args, body):
+        self._leader_gate()
+        return self.split_engine().balance(
+            int(args.get("max_moves", 1)),
+            auto=bool(args.get("auto", False)))
+
+    def rpc_meta_status(self, args, body):
+        self._leader_gate()
+        return self.split_engine().status(args.get("name"))
 
     def rpc_create_volume(self, args, body):
         self._leader_gate()

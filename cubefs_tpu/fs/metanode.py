@@ -44,10 +44,36 @@ ENOTDIR = 20
 ENOSPC = 28
 ENOTEMPTY = 39
 EDQUOT = 122
+# live range migration (fs/split.py): the inode the op routes by sits
+# in a sub-range that is frozen for, or already handed off by, a
+# metapartition split/merge. >= 99 so it rides the 499 "errno=NN"
+# encoding; the message carries "pid=<target>" and the sdk re-routes
+# exactly like the 453 transport code (rpc.RANGE_MOVED).
+EMOVED = 121
 
 
 def _rpc_err(e: "MetaError") -> "rpc.RpcError":
     return rpc.errno_error(e.code, str(e))
+
+
+def _record_inos(r: dict) -> list[int]:
+    """The inode ids whose state THIS record mutates on this partition —
+    the keys the range-migration fences check. Dentry-plane ops
+    (mk_dentry/rm_dentry/mknod/unlink2/rename) live under their parent
+    keys: the child "ino" they may also carry can legitimately belong
+    to ANOTHER partition (classic alloc-elsewhere create), so gating on
+    it would bounce valid cross-partition ops. Pure inode ops carry no
+    parent and gate on "ino". tx records carry theirs per sub-op."""
+    out = [v for k in ("parent", "src_parent", "dst_parent")
+           if isinstance((v := r.get(k)), int)]
+    if r.get("op") in ("tx_prepare", "tx_commit", "tx_abort"):
+        for o in r.get("ops") or []:
+            v = o.get("parent")
+            if isinstance(v, int):
+                out.append(v)
+    if not out and isinstance((v := r.get("ino")), int):
+        out.append(v)
+    return out
 
 
 class MetaPartition:
@@ -102,6 +128,16 @@ class MetaPartition:
         self.geo_tap = None
         self.geo_mode: str | None = None
         self.geo_primary: str | None = None
+        # live range migration (fs/split.py). `frozen`/`moved` are FSM
+        # state (replicated + checkpointed in the "range" segment):
+        # frozen sub-ranges bounce mutations with EMOVED while the
+        # handoff copies them; moved sub-ranges redirect forever (the
+        # inodes live on the target partition now). `_range_taps` is
+        # leader-local scratch — the delta tap registered by
+        # range_export, drained at freeze — never serialized.
+        self.frozen: dict[str, dict] = {}  # split_id -> {lo, hi, target_pid}
+        self.moved: dict[str, int] = {}  # "lo-hi" -> target_pid
+        self._range_taps: dict[str, dict] = {}
         self.data_dir = data_dir
         # native read-plane mirror (runtime/src/metaserve.cc): when
         # attached, every apply re-states its tree mutation into the C++
@@ -251,8 +287,21 @@ class MetaPartition:
             self.apply_id += 1
             op = record["op"]
             try:
+                if (self.frozen or self.moved) and \
+                        op not in self._RANGE_EXEMPT_OPS:
+                    # apply-side fence: records already in the raft
+                    # queue when the freeze landed must not mutate the
+                    # migrating sub-range (the proposer-side gate can't
+                    # see an in-flight freeze) — deterministic, so
+                    # replicas agree the op bounced
+                    self._range_check(record)
                 result = getattr(self, f"_apply_{op}")(record)
                 self._dirty |= self._DIRTY_MAP.get(op, set(self._SEGMENTS))
+                if self._range_taps:
+                    # post-apply, under the partition lock: the delta
+                    # tap sees mutations in commit order, exactly like
+                    # the geo tap below the submit doors
+                    self._tap_record(record, result)
                 if self._mir is not None:
                     self._mirror_op(record, result)
                 outcome = (result, None)
@@ -272,6 +321,253 @@ class MetaPartition:
             for k in list(self._op_cache)[: self.OP_CACHE_SIZE // 2]:
                 del self._op_cache[k]
 
+    # ---------------- live range migration (fs/split.py) ----------------
+    # The donor side of a metapartition split/merge: range_export
+    # captures a scoped snapshot + registers a leader-local delta tap,
+    # range_freeze fences the migrating sub-range (mutations outside it
+    # never stall), range_drop hands the sub-range off for good. The
+    # target side loads via range_load and claims the range via
+    # range_activate. All five are ordinary FSM applies — replicated,
+    # op_id-idempotent, replayed from the oplog/WAL like any mutation.
+
+    # ops the apply-side range fence skips: the migration's own applies,
+    # plus background reclamation/tx-bookkeeping that carries no
+    # client-visible tree mutation for the migrating inodes
+    _RANGE_EXEMPT_OPS = frozenset({
+        "range_freeze", "range_thaw", "range_load", "range_activate",
+        "range_drop", "free_done", "blob_free_done",
+        "blob_reconcile_enqueue", "tx_finish", "tx_commit", "tx_abort",
+    })
+    RANGE_TAP_MAX = 50000  # delta records before the tap poisons itself
+
+    @staticmethod
+    def _key_ino(key: str) -> int:
+        """Owner ino of a freelist/blob_freelist key ("<ino>" or
+        "<ino>:t<aid>"/"<ino>:b<aid>")."""
+        try:
+            return int(key.split(":", 1)[0])
+        except ValueError:
+            return -1
+
+    def range_moved_target(self, ino: int) -> int | None:
+        for key, tpid in self.moved.items():
+            lo, hi = key.split("-")
+            if int(lo) <= ino < int(hi):
+                return tpid
+        return None
+
+    def range_target(self, ino: int) -> int | None:
+        """Target pid when `ino` sits in a moved OR frozen sub-range;
+        None when this partition still serves it."""
+        t = self.range_moved_target(ino)
+        if t is not None:
+            return t
+        for f in self.frozen.values():
+            if f["lo"] <= ino < f["hi"]:
+                return f["target_pid"]
+        return None
+
+    def _range_check(self, r: dict) -> None:
+        for ino in _record_inos(r):
+            t = self.range_target(ino)
+            if t is not None:
+                raise MetaError(
+                    EMOVED,
+                    f"inode {ino} range moved from mp {self.pid} (pid={t})")
+
+    def _tap_record(self, r: dict, result) -> None:
+        """Feed one successfully-applied record to every registered
+        delta tap. Records are normalized so they replay verbatim on the
+        target: mknod (allocates inside apply) becomes explicit
+        mk_inode/mk_dentry, unlink2 splits into its dentry/inode halves.
+        A record that straddles the migrating boundary (rename with one
+        parent inside, a tx touching the range) POISONS the tap — the
+        engine aborts that split attempt cleanly rather than replay a
+        record whose other half isn't on the target."""
+        op = r.get("op")
+        if op in ("range_freeze", "range_thaw", "range_load",
+                  "range_activate", "range_drop", "free_done",
+                  "blob_free_done", "blob_reconcile_enqueue", "tx_finish"):
+            return
+        for tap in self._range_taps.values():
+            lo, hi = tap["lo"], tap["hi"]
+            if tap.get("poisoned"):
+                continue
+
+            def inr(v):
+                return isinstance(v, int) and lo <= v < hi
+
+            base = r.get("op_id") or f"rtap-{tap['split_id']}-{self.apply_id}"
+            if op in ("tx_prepare", "tx_commit", "tx_abort"):
+                if any(inr(o.get("parent")) for o in r.get("ops") or []):
+                    tap["poisoned"] = f"tx {op} touched the migrating range"
+                continue
+            if op == "rename_local":
+                sp, dp = r.get("src_parent"), r.get("dst_parent")
+                if inr(sp) and inr(dp):
+                    tap["records"].append(dict(r))
+                elif inr(sp) or inr(dp):
+                    tap["poisoned"] = "rename straddles the migrating range"
+                continue
+            if op == "mknod":
+                ino = result["ino"]
+                if inr(ino):
+                    tap["records"].append({
+                        "op": "mk_inode", "ino": ino, "type": r["type"],
+                        "mode": r.get("mode", 0o644),
+                        "uid": r.get("uid", 0), "gid": r.get("gid", 0),
+                        "target": r.get("target"),
+                        "quota_ids": list(r.get("quota_ids") or []),
+                        "ts": r.get("ts", 0.0), "op_id": base + "#i"})
+                if inr(r["parent"]):
+                    tap["records"].append({
+                        "op": "mk_dentry", "parent": r["parent"],
+                        "name": r["name"], "ino": ino,
+                        "ts": r.get("ts", 0.0), "op_id": base + "#d"})
+                continue
+            if op == "unlink2":
+                if inr(r.get("parent")):
+                    tap["records"].append({
+                        "op": "rm_dentry", "parent": r["parent"],
+                        "name": r["name"], "ts": r.get("ts", 0.0),
+                        "op_id": base + "#d"})
+                if inr(result.get("ino")):
+                    half = ({"op": "rm_inode", "ino": result["ino"]}
+                            if result.get("removed", True)
+                            else {"op": "dec_nlink", "ino": result["ino"]})
+                    tap["records"].append({**half, "ts": r.get("ts", 0.0),
+                                           "op_id": base + "#r"})
+                continue
+            # same owner-key rule as _record_inos: a dentry op's child
+            # "ino" may be foreign — only ops whose state lives in the
+            # range belong in the delta
+            if any(inr(i) for i in _record_inos(r)):
+                tap["records"].append(dict(r))
+        for tap in self._range_taps.values():
+            if (not tap.get("poisoned")
+                    and len(tap["records"]) > self.RANGE_TAP_MAX):
+                tap["poisoned"] = "delta outran the copy (tap overflow)"
+
+    def range_export(self, lo: int, hi: int, split_id: str) -> tuple[bytes, int]:
+        """Scoped snapshot of [lo, hi): inodes in range, dentry maps of
+        in-range parents, freelist entries owned by in-range inodes —
+        serialized as CRC-framed records (utils/fsm.frame_records, one
+        CRC per record) so a torn chunk is refused, not loaded. Captured
+        under ONE lock acquisition together with the delta-tap
+        registration, so the tap sees exactly the mutations the
+        snapshot missed. Refuses while a prepared tx holds the range —
+        its outcome could not replay on the target."""
+        with self._lock:
+            for tx in self.tx_pending.values():
+                if any(isinstance((p := o.get("parent")), int)
+                       and lo <= p < hi for o in tx.get("ops") or []):
+                    raise MetaError(
+                        EBUSY, f"prepared tx holds [{lo},{hi}) on mp "
+                               f"{self.pid}; retry the split later")
+            recs: list[dict] = [{
+                "k": "head", "lo": lo, "hi": hi, "split_id": split_id,
+                "next_ino": self._next_ino,
+            }]
+            recs.extend({"k": "inode", "v": v}
+                        for i, v in self.inodes.items() if lo <= i < hi)
+            recs.extend({"k": "dent", "parent": p, "entries": d}
+                        for p, d in self.dentries.items() if lo <= p < hi)
+            recs.extend({"k": "free", "key": k, "v": v}
+                        for k, v in self.freelist.items()
+                        if lo <= self._key_ino(k) < hi)
+            recs.extend({"k": "bfree", "key": k, "v": v}
+                        for k, v in self.blob_freelist.items()
+                        if lo <= self._key_ino(k) < hi)
+            from ..utils import fsm as fsmlib
+
+            payload = fsmlib.frame_records(recs)
+            # (re-)register the tap: an idempotent re-export resets it
+            self._range_taps[split_id] = {
+                "split_id": split_id, "lo": lo, "hi": hi,
+                "records": [], "poisoned": None}
+            return payload, self.apply_id
+
+    def range_drain(self, split_id: str) -> tuple[list[dict], str | None]:
+        """Close the delta tap (called right after the freeze apply
+        landed — nothing can mutate the range anymore) and hand back the
+        collected delta, or the poison reason."""
+        with self._lock:
+            tap = self._range_taps.pop(split_id, None)
+            if tap is None:
+                return [], "no delta tap registered (donor leader moved?)"
+            return tap["records"], tap.get("poisoned")
+
+    def _apply_range_freeze(self, r: dict) -> dict:
+        self.frozen[r["split_id"]] = {
+            "lo": r["lo"], "hi": r["hi"], "target_pid": r["target_pid"]}
+        return {}
+
+    def _apply_range_thaw(self, r: dict) -> dict:
+        self.frozen.pop(r["split_id"], None)
+        self._range_taps.pop(r["split_id"], None)
+        return {}
+
+    def _apply_range_load(self, r: dict) -> dict:
+        """Target-side bulk import of a shipped range snapshot. The
+        whole decoded state rides IN the record, so replicas (and the
+        oplog/WAL replay) load identical bytes through the ordinary
+        commit door. Does NOT claim the range — range_activate does,
+        after the delta replay, so readers never see a stale copy."""
+        st = r["state"]
+        for k, v in st.get("inodes", {}).items():
+            self.inodes[int(k)] = v
+        for k, v in st.get("dentries", {}).items():
+            self.dentries[int(k)] = v
+        self.freelist.update(st.get("freelist", {}))
+        self.blob_freelist.update(st.get("blob_freelist", {}))
+        self._next_ino = max(self._next_ino,
+                             int(st.get("next_ino", 0)), r["lo"])
+        if self._mir is not None:
+            self._mirror_full()
+        return {"inodes": len(st.get("inodes", {}))}
+
+    def _apply_range_activate(self, r: dict) -> dict:
+        lo, hi = r["lo"], r["hi"]
+        self.end = max(self.end, hi)
+        # a range can come BACK (split handed it away, a later merge
+        # returns it): tombstones covering the re-claimed span would
+        # shadow the live trees with redirects to a retired partition
+        for k in [k for k in self.moved
+                  if not (int(k.split("-")[1]) <= lo
+                          or hi <= int(k.split("-")[0]))]:
+            del self.moved[k]
+        for sid in [s for s, f in self.frozen.items()
+                    if not (f["hi"] <= lo or hi <= f["lo"])]:
+            del self.frozen[sid]
+            self._range_taps.pop(sid, None)
+        return {"start": self.start, "end": self.end}
+
+    def _apply_range_drop(self, r: dict) -> dict:
+        """Donor-side handoff: forget the migrated sub-range and shrink
+        the served range. The moved marker makes every later touch of
+        these inos redirect (EMOVED/453) instead of lying ENOENT to a
+        client holding a pre-split partition map."""
+        lo, hi, tpid = r["lo"], r["hi"], r["target_pid"]
+        for ino in [i for i in self.inodes if lo <= i < hi]:
+            del self.inodes[ino]
+        for p in [p for p in self.dentries if lo <= p < hi]:
+            del self.dentries[p]
+        for k in [k for k in self.freelist if lo <= self._key_ino(k) < hi]:
+            del self.freelist[k]
+        for k in [k for k in self.blob_freelist
+                  if lo <= self._key_ino(k) < hi]:
+            del self.blob_freelist[k]
+        if self.end == hi:
+            self.end = lo
+        for sid in [s for s, f in self.frozen.items()
+                    if lo <= f["lo"] and f["hi"] <= hi]:
+            del self.frozen[sid]
+            self._range_taps.pop(sid, None)
+        self.moved[f"{lo}-{hi}"] = tpid
+        if self._mir is not None:
+            self._mirror_full()
+        return {"start": self.start, "end": self.end}
+
     # ---------------- raft FSM snapshot interface ----------------
     def _state_dict(self) -> dict:
         """The ONE serialized form of the FSM state — used by raft
@@ -285,6 +581,10 @@ class MetaPartition:
             "tx_committed": self.tx_committed,
             "freelist": self.freelist,
             "blob_freelist": self.blob_freelist,
+            "frozen": self.frozen,
+            "moved": self.moved,
+            "range_start": self.start,
+            "range_end": self.end,
         }
 
     def _load_state_dict(self, st: dict) -> None:
@@ -296,6 +596,13 @@ class MetaPartition:
         self.tx_committed = st.get("tx_committed", {})
         self.freelist = st.get("freelist", {})
         self.blob_freelist = st.get("blob_freelist", {})
+        self.frozen = st.get("frozen", {})
+        self.moved = st.get("moved", {})
+        # a range apply may have shifted [start, end) past what the
+        # creator knew (a raft snapshot install on a freshly re-created
+        # member, a checkpoint reload mid-migration)
+        self.start = st.get("range_start", self.start)
+        self.end = st.get("range_end", self.end)
 
     def export_state(self) -> tuple[bytes, int]:
         """(serialized state, apply_id) captured under ONE lock
@@ -416,8 +723,13 @@ class MetaPartition:
     # fires every SNAPSHOT_EVERY records, so per-op cost is amortized
     # O(1) instead of O(partition) on every external snapshot call.
     SNAPSHOT_EVERY = 4096
-    _SEGMENTS = ("inodes", "dentries", "tx", "freelist")
+    _SEGMENTS = ("inodes", "dentries", "tx", "freelist", "range")
     _DIRTY_MAP = {
+        "range_freeze": {"range"},
+        "range_thaw": {"range"},
+        "range_load": {"inodes", "dentries", "freelist"},
+        "range_activate": {"range"},
+        "range_drop": {"inodes", "dentries", "freelist", "range"},
         "mk_inode": {"inodes", "dentries"},
         "rm_inode": {"inodes", "dentries", "freelist"},
         "inc_nlink": {"inodes"},
@@ -453,6 +765,9 @@ class MetaPartition:
         if name == "freelist":
             return {"freelist": self.freelist,
                     "blob_freelist": self.blob_freelist}
+        if name == "range":
+            return {"frozen": self.frozen, "moved": self.moved,
+                    "range_start": self.start, "range_end": self.end}
         return {"tx_pending": self.tx_pending,
                 "tx_committed": self.tx_committed}
 
@@ -583,6 +898,12 @@ class MetaPartition:
                 self._next_ino += 1
             if self._next_ino >= self.end:
                 raise MetaError(28, f"mp {self.pid} inode range exhausted")
+            if self.range_target(self._next_ino) is not None:
+                # the allocation cursor sits in a frozen/moved sub-range:
+                # this partition can't mint inos anymore — same fallback
+                # contract as a genuinely exhausted range
+                raise MetaError(
+                    28, f"mp {self.pid} alloc cursor inside a migrating range")
             ino = self._next_ino
             self._next_ino += 1  # reserve: concurrent creates stay unique
             if op_id is not None:
@@ -655,6 +976,12 @@ class MetaPartition:
             self._next_ino += 1
         if self._next_ino >= self.end:
             raise MetaError(28, f"mp {self.pid} inode range exhausted")
+        if self.range_target(self._next_ino) is not None:
+            # deterministic apply-side fence: a compound create must not
+            # mint an ino inside a frozen/moved sub-range (replicas all
+            # refuse identically; the client falls back to alloc-elsewhere)
+            raise MetaError(
+                28, f"mp {self.pid} alloc cursor inside a migrating range")
         ino = self._next_ino
         self._next_ino += 1
         now = r.get("ts", 0.0)
@@ -682,6 +1009,17 @@ class MetaPartition:
         if d is None or name not in d:
             raise MetaError(ENOENT, f"{name!r} not in {parent}")
         ino = d[name]
+        t = self.range_target(ino)
+        if t is not None:
+            # the dentry's parent stayed but the child inode is in a
+            # migrating sub-range (the generic fence only sees the
+            # parent): refuse the compound removal with the SAME errno
+            # as a foreign child — the client's two-op fallback routes
+            # the rm_inode half by the child ino, and the 453 chase
+            # lands it on the new owner
+            raise MetaError(
+                18, f"inode {ino} migrating off mp {self.pid} "
+                    f"(pid={t})")
         inode = self.inodes.get(ino)
         if inode is None:
             raise MetaError(18, f"inode {ino} not in mp {self.pid}")
@@ -1407,6 +1745,7 @@ class MetaNode:
         self._batchers: dict[int, _SubmitBatcher] = {}  # pid -> coalescer
         self._coalesce = os.environ.get("CUBEFS_META_COALESCE", "1") != "0"
         self.dp_view_fn = None  # set_dp_view: enables the free scan
+        self._wires: dict[str, object] = {}  # packet addr -> WireClient
         self.extra_routes: dict = {}  # live raft handlers (rpc.resolve_route)
         self._lock = lockwitness.make_rlock("MetaNode._lock")
         self._stop = threading.Event()
@@ -1444,6 +1783,28 @@ class MetaNode:
                          peers: list[str] | None = None) -> MetaPartition:
         with self._lock:
             if pid not in self.partitions:
+                # creation-time bounds are part of replay determinism:
+                # every bounds-checked apply in the wal ran against
+                # them, so a restart must replay against them too — NOT
+                # against a post-migration (shrunk/grown) table row.
+                # The wal's own range_load/range_drop applies re-evolve
+                # the bounds in order during replay.
+                if self.data_dir:
+                    rngf = os.path.join(self.data_dir,
+                                        f"mp_{pid}.range.json")
+                    try:
+                        if os.path.exists(rngf):
+                            with open(rngf) as f:
+                                rec = json.load(f)
+                            start, end = int(rec["start"]), int(rec["end"])
+                        else:
+                            os.makedirs(self.data_dir, exist_ok=True)
+                            tmp = rngf + ".tmp"
+                            with open(tmp, "w") as f:
+                                json.dump({"start": start, "end": end}, f)
+                            os.replace(tmp, rngf)
+                    except (OSError, ValueError, KeyError):
+                        pass
                 replicated = bool(peers and len(peers) > 1)
                 # replicated partitions persist via the raft wal (replayed
                 # into apply on restart) — a second mp-level oplog would
@@ -1520,10 +1881,49 @@ class MetaNode:
                 raise rpc.RpcError(self.REDIRECT, f"leader={st['leader'] or ''}")
         return mp
 
+    def _range_gate(self, pid: int, inos) -> None:
+        """Donor-side routing fence for live range migration: every
+        mutation door (rpc_submit / rpc_submit_batch / rpc_alloc_ino —
+        lint CFE002 pins this reachability) bounces ops aimed at a
+        frozen or handed-off sub-range with the 453 range-moved code and
+        a "pid=<target>" message the sdk follows. Fast path: partitions
+        with no migration in flight pay one falsy check."""
+        mp = self.partitions.get(pid)
+        if mp is None or not (mp.frozen or mp.moved):
+            return
+        for ino in inos:
+            if isinstance(ino, int):
+                t = mp.range_target(ino)
+                if t is not None:
+                    metrics.meta_range_redirects.inc()
+                    raise rpc.RpcError(rpc.RANGE_MOVED, f"pid={t}")
+
+    def _range_gate_read(self, pid: int, inos) -> None:
+        """Read-side fence: a frozen range still serves reads from the
+        donor (its copy is current while mutations are fenced), but a
+        MOVED range must redirect — answering ENOENT from dropped trees
+        would lie to a client holding a pre-split partition map."""
+        mp = self.partitions.get(pid)
+        if mp is None or not mp.moved:
+            return
+        for ino in inos:
+            if isinstance(ino, int):
+                t = mp.range_moved_target(ino)
+                if t is not None:
+                    metrics.meta_range_redirects.inc()
+                    raise rpc.RpcError(rpc.RANGE_MOVED, f"pid={t}")
+
     def stop(self) -> None:
         self._stop.set()
         for r in self.rafts.values():
             r.stop()
+        with self._lock:
+            wires, self._wires = dict(self._wires), {}
+        for wc in wires.values():
+            try:
+                wc.close()
+            except Exception:
+                pass
         if self._native_h is not None:
             # stop the listener + connections; the store handle is NOT
             # destroyed — partitions still hold mirror references, and a
@@ -1741,6 +2141,7 @@ class MetaNode:
         # so every replica (and every WAL replay) applies the same
         # timestamp; apply handlers never read the clock (CFM001)
         args["record"].setdefault("ts", time.time())
+        self._range_gate(pid, _record_inos(args["record"]))
         try:
             self._mp(pid).check_limits(args["record"])
             if raft_node is None:
@@ -1785,6 +2186,11 @@ class MetaNode:
         now = time.time()  # one proposer-side stamp for the whole batch
         for rec in records:
             rec.setdefault("ts", now)
+        # batch-level range fence: a single 453 fails the whole call and
+        # the client fan-out re-routes record by record (same contract
+        # as the leader redirect below)
+        for rec in records:
+            self._range_gate(pid, _record_inos(rec))
         raft_node = self.rafts.get(pid)
         mp = self._mp(pid)
         outs: list = [None] * len(records)
@@ -1827,9 +2233,13 @@ class MetaNode:
         return {"results": outs}
 
     def rpc_alloc_ino(self, args, body):
+        mp = self._mp_leader(args["pid"])
+        # advisory redirect when the cursor sits in a migrating range —
+        # routes fresh creates straight at the target; the deterministic
+        # errno-28 fence inside alloc_ino stays authoritative
+        self._range_gate(args["pid"], (mp._next_ino,))
         try:
-            return {"ino": self._mp_leader(args["pid"]).alloc_ino(
-                op_id=args.get("op_id"))}
+            return {"ino": mp.alloc_ino(op_id=args.get("op_id"))}
         except MetaError as e:
             raise _rpc_err(e) from None
 
@@ -1859,7 +2269,10 @@ class MetaNode:
         try:
             while names:
                 mp = self._local_leader_for_ino(ino)
-                if mp is None:
+                if mp is None or mp.range_moved_target(ino) is not None:
+                    # a moved range hands back a partial: the client
+                    # resumes via its (refreshed) partition map instead
+                    # of walking a dropped tree
                     break
                 ino = mp.lookup(ino, names[0])
                 names.pop(0)
@@ -1874,24 +2287,28 @@ class MetaNode:
 
 
     def rpc_inode_get(self, args, body):
+        self._range_gate_read(args["pid"], (args["ino"],))
         try:
             return {"inode": self._mp_leader(args["pid"]).inode_get(args["ino"])}
         except MetaError as e:
             raise _rpc_err(e) from None
 
     def rpc_lookup(self, args, body):
+        self._range_gate_read(args["pid"], (args["parent"],))
         try:
             return {"ino": self._mp_leader(args["pid"]).lookup(args["parent"], args["name"])}
         except MetaError as e:
             raise _rpc_err(e) from None
 
     def rpc_readdir(self, args, body):
+        self._range_gate_read(args["pid"], (args["parent"],))
         try:
             return {"entries": self._mp_leader(args["pid"]).readdir(args["parent"])}
         except MetaError as e:
             raise _rpc_err(e) from None
 
     def rpc_dentry_count(self, args, body):
+        self._range_gate_read(args["pid"], (args["parent"],))
         return {"count": self._mp_leader(args["pid"]).dentry_count(args["parent"])}
 
     def rpc_tx_status(self, args, body):
@@ -1951,6 +2368,12 @@ class MetaNode:
             if raft_node is not None:
                 raft_node.stop()
             self.partitions.pop(pid, None)
+            if self.data_dir:
+                try:  # dropped pids never come back: retire the bounds
+                    os.remove(os.path.join(self.data_dir,
+                                           f"mp_{pid}.range.json"))
+                except OSError:
+                    pass
             if self._native_h is not None:
                 # lint: allow[CFL003] teardown must drain native readers BEFORE the trees free — intentionally atomic with the partition removal
                 self._native_lib.ms_drop_partition(self._native_h, pid)
@@ -1976,6 +2399,170 @@ class MetaNode:
         mp = self._mp_leader(args["pid"])
         state, apply_id = mp.export_state()
         return {"crc": zlib.crc32(state), "apply_id": apply_id}, state
+
+    # ---------------- live range migration rpcs (fs/split.py) ----------
+    def _propose_door(self, pid: int, record: dict):
+        """Range-migration commit door: push one migration apply through
+        the partition's normal replication path, mapping raft/Meta
+        errors exactly like rpc_submit."""
+        from ..parallel.raft import NotLeaderError
+
+        try:
+            return self._submit_local(pid, record)
+        except NotLeaderError as e:
+            raise rpc.RpcError(self.REDIRECT,
+                               f"leader={e.leader or ''}") from None
+        except MetaError as e:
+            raise _rpc_err(e) from None
+
+    def _wire(self, addr: str):
+        with self._lock:
+            wc = self._wires.get(addr)
+            if wc is None:
+                from ..sdk.clients import WireClient
+
+                wc = WireClient(addr)
+                self._wires[addr] = wc
+            return wc
+
+    def rpc_range_export(self, args, body):
+        """Donor leader: scoped [lo, hi) snapshot + delta-tap
+        registration in one locked capture. The payload is CRC-framed
+        per record AND summarized by a whole-payload CRC in the meta;
+        over the packet plane it rides FLAG_MORE chunk trains."""
+        mp = self._mp_leader(args["pid"])
+        try:
+            payload, aid = mp.range_export(
+                args["lo"], args["hi"], args["split_id"])
+        except MetaError as e:
+            raise _rpc_err(e) from None
+        return {"crc": zlib.crc32(payload), "apply_id": aid}, payload
+
+    def rpc_range_fetch(self, args, body):
+        """Target-side bootstrap (the geo `_pull_snapshot` idiom): pull
+        the donor leader's range snapshot over the packet mux — HTTP
+        fallback when no packet addr is known — verify both CRC layers,
+        then propose range_load through THIS partition's own commit door
+        so every replica loads identical bytes."""
+        from ..utils import fsm as fsmlib
+        from ..utils import packet
+
+        pid, lo, hi = args["pid"], args["lo"], args["hi"]
+        sid = args["split_id"]
+        donor = args["donor"]
+        meta = payload = None
+        last: Exception | None = None
+        for addr in donor.get("addrs") or [None]:
+            pk = (donor.get("packet_addrs") or {}).get(addr)
+            try:
+                if pk:
+                    # the mux hands back a memoryview over its receive
+                    # buffer — materialize before the buffer recycles
+                    meta, payload = self._wire(pk).call(
+                        packet.OP_META_RANGE_EXPORT,
+                        args={"pid": donor["pid"], "lo": lo, "hi": hi,
+                              "split_id": sid})
+                    payload = bytes(payload)
+                elif addr and self.pool is not None:
+                    meta, payload = self.pool.get(addr).call(
+                        "range_export",
+                        {"pid": donor["pid"], "lo": lo, "hi": hi,
+                         "split_id": sid}, timeout=30.0)
+                else:
+                    continue
+                break
+            except Exception as e:  # noqa: BLE001 - try the next replica
+                last = e
+                meta = payload = None
+        if meta is None:
+            raise rpc.RpcError(
+                503, f"range export from donor mp {donor.get('pid')} "
+                     f"failed: {last}")
+        if zlib.crc32(payload) != meta["crc"]:
+            raise rpc.RpcError(
+                502, f"range snapshot crc mismatch for split {sid}")
+        recs = fsmlib.parse_records(payload)
+        state = {"inodes": {}, "dentries": {}, "freelist": {},
+                 "blob_freelist": {}, "next_ino": 0}
+        for rec in recs:
+            k = rec.get("k")
+            if k == "head":
+                state["next_ino"] = rec.get("next_ino", 0)
+            elif k == "inode":
+                state["inodes"][str(rec["v"]["ino"])] = rec["v"]
+            elif k == "dent":
+                state["dentries"][str(rec["parent"])] = rec["entries"]
+            elif k == "free":
+                state["freelist"][rec["key"]] = rec["v"]
+            elif k == "bfree":
+                state["blob_freelist"][rec["key"]] = rec["v"]
+        self._propose_door(pid, {
+            "op": "range_load", "lo": lo, "hi": hi, "state": state,
+            "op_id": f"rload-{sid}"})
+        return {"inodes": len(state["inodes"]),
+                "donor_apply_id": meta["apply_id"]}
+
+    def rpc_range_freeze(self, args, body):
+        """Donor leader: fence the migrating sub-range (a replicated
+        apply — survives restarts and leader changes) and drain the
+        delta tap closed by it. The tap-presence check runs FIRST: a
+        leadership change since range_export lost the tap, and freezing
+        without it would strand the delta — the engine aborts instead."""
+        pid, sid = args["pid"], args["split_id"]
+        mp = self._mp_leader(pid)
+        if sid not in mp._range_taps:
+            raise rpc.RpcError(
+                409, f"no delta tap for split {sid} on mp {pid} "
+                     f"(donor leadership moved since export?)")
+        self._propose_door(pid, {
+            "op": "range_freeze", "lo": args["lo"], "hi": args["hi"],
+            "target_pid": args["target_pid"], "split_id": sid,
+            "op_id": f"rfreeze-{sid}"})
+        delta, poisoned = mp.range_drain(sid)
+        return {"delta": delta, "poisoned": poisoned}
+
+    def rpc_range_thaw(self, args, body):
+        """Abort path: unfreeze the donor sub-range (idempotent)."""
+        self._propose_door(args["pid"], {
+            "op": "range_thaw", "split_id": args["split_id"],
+            "op_id": f"rthaw-{args['split_id']}"})
+        return {}
+
+    def rpc_range_replay(self, args, body):
+        """Target leader: replay the drained delta through the normal
+        commit door. Records carry the donor-side op_ids (or synthesized
+        "#i/#d/#r" derivatives), so a retried replay dedups instead of
+        double-applying; a record that failed identically at donor apply
+        time fails identically here."""
+        pid = args["pid"]
+        applied = failed = 0
+        for rec in args.get("records") or []:
+            try:
+                self._propose_door(pid, dict(rec))
+                applied += 1
+            except rpc.RpcError as e:
+                if 400 <= e.code < 500 and e.code != self.REDIRECT:
+                    failed += 1  # deterministic per-record refusal
+                else:
+                    raise
+        return {"applied": applied, "failed": failed}
+
+    def rpc_range_activate(self, args, body):
+        """Target leader: claim [lo, hi) — runs only after the delta
+        replay, so a reader routed here never sees a stale copy."""
+        self._propose_door(args["pid"], {
+            "op": "range_activate", "lo": args["lo"], "hi": args["hi"],
+            "op_id": f"ractivate-{args['split_id']}"})
+        return {}
+
+    def rpc_range_drop(self, args, body):
+        """Donor leader: forget the handed-off sub-range and leave the
+        moved marker that keeps redirecting stale clients."""
+        self._propose_door(args["pid"], {
+            "op": "range_drop", "lo": args["lo"], "hi": args["hi"],
+            "target_pid": args["target_pid"],
+            "op_id": f"rdrop-{args['split_id']}"})
+        return {}
 
     # ---------------- binary packet plane (manager_op.go analog) --------
     # The reference serves EVERY meta op over the 64-byte binary packet
@@ -2015,6 +2602,7 @@ class MetaNode:
             packet.OP_META_DENTRY_COUNT: wrap(self.rpc_dentry_count),
             packet.OP_META_ALLOC_INO: wrap(self.rpc_alloc_ino),
             packet.OP_META_WALK: wrap(self.rpc_walk),
+            packet.OP_META_RANGE_EXPORT: wrap(self.rpc_range_export),
             packet.OP_PING: lambda hdr, a, p: ({}, b""),
         }, host, port, service="metanode", audit=audit, workers=workers)
         return srv.start()

@@ -76,6 +76,33 @@ class MasterClient(_Base):
     def check_meta_partitions(self) -> list:
         return self._call("check_meta_partitions")[0]["actions"]
 
+    # elastic metadata plane (fs/split.py): live inode-range migration
+    def meta_split(self, name: str, pid: int | None = None,
+                   split_ino: int | None = None) -> dict:
+        args: dict = {"name": name}
+        if pid is not None:
+            args["pid"] = pid
+        if split_ino is not None:
+            args["split_ino"] = split_ino
+        return self._call("meta_split", args)[0]
+
+    def meta_merge(self, name: str, donor_pid: int | None = None,
+                   absorber_pid: int | None = None) -> dict:
+        args: dict = {"name": name}
+        if donor_pid is not None:
+            args["donor_pid"] = donor_pid
+        if absorber_pid is not None:
+            args["absorber_pid"] = absorber_pid
+        return self._call("meta_merge", args)[0]
+
+    def meta_balance(self, max_moves: int = 1, auto: bool = False) -> dict:
+        return self._call("meta_balance",
+                          {"max_moves": max_moves, "auto": auto})[0]
+
+    def meta_status(self, name: str | None = None) -> dict:
+        args = {"name": name} if name is not None else {}
+        return self._call("meta_status", args)[0]
+
     # users (master/user.go surface)
     def create_user(self, user_id: str) -> dict:
         return self._call("create_user", {"user_id": user_id})[0]

@@ -2043,6 +2043,291 @@ def deployed_ab(workdir: str, files: int = 300, threads: int = 8,
     return out
 
 
+# ------------- elastic metadata plane A/B (fs/split.py) -------------
+
+def _mk_split_cluster(workdir: str, mp_count: int, ino_range: int):
+    """Master + 2 replicated metanodes + 3 datanodes + one volume —
+    the fs/split.py elastic-plane cluster. The per-partition inode
+    range is shrunk (instance override, same knob the tests use) so
+    saturated creates actually reach the fill bar inside a bench
+    window instead of after 16M inodes."""
+    from ..fs.datanode import DataNode
+    from ..fs.master import Master
+    from ..fs.metanode import MetaNode
+    from ..utils.rpc import NodePool
+
+    pool = NodePool()
+    master = Master(pool, data_dir=os.path.join(workdir, "master"))
+    master.INO_RANGE = ino_range
+    pool.bind("master", master)
+    nodes = []
+    for i in range(2):
+        n = MetaNode(900 + i, data_dir=os.path.join(workdir, f"meta{i}"),
+                     addr=f"bm{i}", node_pool=pool)
+        pool.bind(f"bm{i}", n)
+        master.register_metanode(f"bm{i}")
+        nodes.append(n)
+    datas = []
+    for i in range(3):
+        d = DataNode(900 + i, os.path.join(workdir, f"data{i}"),
+                     f"bd{i}", pool)
+        pool.bind(f"bd{i}", d)
+        master.register_datanode(f"bd{i}")
+        datas.append(d)
+    view = master.create_volume("vol1", mp_count=mp_count, dp_count=2)
+    return pool, master, nodes, datas, view
+
+
+def _split_leg(workdir: str, mode: str, threads: int, secs: float,
+               ino_range: int = 256) -> dict:
+    """One saturated-create round against a fresh WAL-backed cluster.
+
+    ``elastic``  — 4-mp volume, CUBEFS_META_SPLIT=1, a sweeper thread
+    drives ``check_meta_partitions`` (fresh ranges appended when the
+    tail partition fills) plus ``SplitEngine.balance`` (live range
+    migration off hot partitions); ``static`` — the same 4-mp volume
+    with the door off and no sweeper, so creates hit the fixed-space
+    wall and plateau; ``static64`` — the pre-provisioned 64-partition
+    control (META_PIPELINE_AB_r08's scaling ceiling)."""
+    import threading as _th
+
+    from ..fs import split as splitmod
+    from ..fs.client import FileSystem, FsError
+    from ..utils import metrics
+    from ..utils import retry as retrylib
+
+    # constant 2 ms jittered backoff while every partition is
+    # exhausted/frozen (multiplier 1.0: a stalled loadgen should poll,
+    # not exponentiate itself out of the measurement window)
+    stall_policy = retrylib.RetryPolicy(base=0.002, cap=0.004,
+                                        multiplier=1.0, deadline=None)
+    mp_count = 64 if mode == "static64" else 4
+    # the bench shrinks the WORLD (inode ranges) so the fill bar is
+    # reachable at disk-fsync create rates; the minimum splittable span
+    # must shrink with it or the shrunk world could never migrate
+    saved_span = splitmod.MIN_SPLIT_SPAN
+    splitmod.MIN_SPLIT_SPAN = max(32, ino_range // 8)
+    pool, master, nodes, datas, view = _mk_split_cluster(
+        workdir, mp_count, ino_range)
+    fs = FileSystem(view, pool, master_addr="master")
+    wrapper = fs.meta
+    base_migr = _metric_sum(metrics.meta_range_migrations)
+    base_redir = _metric_sum(metrics.meta_range_redirects)
+
+    stop_at = time.perf_counter() + secs
+    stop_evt = _th.Event()
+    counts = [0] * threads
+    stalls = [0] * threads
+    errors: list[str] = []
+    sweep = {"appends": 0, "splits": 0, "merges": 0, "failed": 0}
+
+    def sweeper():
+        eng = master.split_engine()
+        while not stop_evt.is_set():
+            try:
+                # registration doubles as the heartbeat the liveness
+                # window wants when a leg outlives HEARTBEAT_TIMEOUT
+                for i in range(len(nodes)):
+                    master.register_metanode(f"bm{i}")
+                sweep["appends"] += len(master.check_meta_partitions())
+                out = eng.balance(max_moves=2, auto=True)
+                for act in out["actions"]:
+                    k = "splits" if act["kind"] == "split" else "merges"
+                    sweep[k] += 1
+                sweep["failed"] += len(out["failed"])
+            except Exception:  # noqa: BLE001 - sweep must not die
+                pass
+            stop_evt.wait(0.05)
+
+    def worker(t):
+        r = stall_policy.start(op="bench.split_ab.create")
+        while time.perf_counter() < stop_at:
+            try:
+                wrapper.inode_create("file")
+                counts[t] += 1
+            except FsError as e:
+                if e.errno == 28:
+                    # every partition exhausted (the static wall) or
+                    # momentarily frozen mid-migration: back off
+                    stalls[t] += 1
+                    r.tick(reason="range-exhausted")
+                    continue
+                errors.append(f"worker{t}: errno {e.errno}: {e}")
+                return
+            except Exception as e:  # noqa: BLE001 - keep the AB honest
+                errors.append(f"worker{t}: {type(e).__name__}: {e}")
+                return
+
+    sw = None
+    if mode == "elastic":
+        sw = _th.Thread(target=sweeper)
+        sw.start()
+    ths = [_th.Thread(target=worker, args=(t,)) for t in range(threads)]
+    t0 = time.perf_counter()
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    dt = time.perf_counter() - t0
+    stop_evt.set()
+    if sw is not None:
+        sw.join()
+    final_mps = len(master.client_view("vol1")["mps"])
+    for n in nodes:
+        n.stop()
+    for d in datas:
+        d.stop()
+    splitmod.MIN_SPLIT_SPAN = saved_span
+    return {
+        "mode": mode, "threads": threads, "secs": round(dt, 3),
+        "creates": sum(counts),
+        "create_ops": round(sum(counts) / dt, 1),
+        "alloc_stalls": sum(stalls),
+        "mps_start": mp_count, "mps_final": final_mps,
+        "sweep": dict(sweep),
+        "migrations": int(_metric_sum(metrics.meta_range_migrations)
+                          - base_migr),
+        "redirects": int(_metric_sum(metrics.meta_range_redirects)
+                         - base_redir),
+        "errors": errors,
+    }
+
+
+def _split_identity_leg(workdir: str, records_per_part: int = 250) -> dict:
+    """CUBEFS_META_SPLIT=0 (the shipped default): drive a FIXED
+    mutation tape (fixed op_ids, fixed timestamps, serial order) with
+    an auto-balance sweep wedged in the middle. The sweep must report
+    itself skipped, and the final per-partition FSM digests must be
+    byte-identical across replicas AND across two independent runs —
+    the door-off build is bit-for-bit the pre-elastic build."""
+    import hashlib
+
+    from ..fs.client import MetaWrapper
+
+    digests: dict[str, dict] = {}
+    sweeps = []
+    for run_idx in ("a", "b"):
+        pool, master, nodes, datas, view = _mk_split_cluster(
+            os.path.join(workdir, f"ident_{run_idx}"), 2, 1 << 13)
+        wrapper = MetaWrapper(view, pool)
+        mps = sorted(view["mps"], key=lambda m: m["start"])
+        for mp in mps:
+            for i in range(records_per_part):
+                # explicit deterministic inos inside the partition's
+                # range (disjoint master-minted ranges: only mp 1 holds
+                # the root dir, so dentry ops can't span the tape)
+                wrapper._call(mp, "submit", {"record": {
+                    "op": "mk_inode", "ino": mp["start"] + 1 + i,
+                    "type": "file" if i % 2 else "dir", "mode": 0o644,
+                    "ts": 1000.0 + i,
+                    "op_id": f"ident-{mp['pid']}-{i}"}})
+                if i == records_per_part // 2 and mp is mps[0]:
+                    # mid-tape: every partition looks hot, yet the
+                    # door-off auto sweep must not move a byte
+                    master.MP_SPLIT_THRESHOLD = 0.0
+                    out = master.split_engine().balance(max_moves=4,
+                                                        auto=True)
+                    sweeps.append({"skipped": bool(out.get("skipped")),
+                                   "actions": len(out["actions"])})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            ids = {mp["pid"]: {n.addr: n.partitions[mp["pid"]].apply_id
+                               for n in nodes} for mp in mps}
+            if all(len(set(v.values())) == 1 for v in ids.values()):
+                break
+            time.sleep(0.05)
+        digests[run_idx] = {
+            str(mp["pid"]): {n.addr: hashlib.sha256(
+                n.partitions[mp["pid"]].state_bytes()).hexdigest()
+                for n in nodes}
+            for mp in mps}
+        for n in nodes:
+            n.stop()
+        for d in datas:
+            d.stop()
+    replicas_agree = all(
+        len(set(per_node.values())) == 1
+        for run in digests.values() for per_node in run.values())
+    runs_agree = all(
+        set(digests["a"][pid].values()) == set(digests["b"][pid].values())
+        for pid in digests["a"])
+    return {"sweeps_inert": all(s["skipped"] and not s["actions"]
+                                for s in sweeps),
+            "replicas_agree": replicas_agree,
+            "runs_agree": runs_agree,
+            "bit_identical": replicas_agree and runs_agree,
+            "records_per_partition": records_per_part,
+            "digests": digests}
+
+
+def split_ab(workdir: str, threads: int = 12, secs: float = 4.0,
+             rounds: int = 2, ino_range: int = 256) -> dict:
+    """Elastic metadata plane A/B: ABBA rounds of saturated creates on
+    a 4-mp volume that auto-splits under load vs the same volume held
+    static (the fixed-space plateau), a pre-provisioned static-64
+    ceiling reference with a half-threads loadgen probe (server-bound
+    evidence), and the door-off digest-identity leg."""
+    legs: dict[str, list] = {"elastic": [], "static": []}
+    order: list[str] = []
+    for r in range(max(1, rounds)):
+        order += (["elastic", "static"] if r % 2 == 0
+                  else ["static", "elastic"])
+    saved = os.environ.get("CUBEFS_META_SPLIT")
+    try:
+        for i, mode in enumerate(order):
+            os.environ["CUBEFS_META_SPLIT"] = \
+                "1" if mode == "elastic" else "0"
+            legs[mode].append(_split_leg(
+                os.path.join(workdir, f"{mode}{i}"), mode, threads,
+                secs, ino_range))
+        os.environ["CUBEFS_META_SPLIT"] = "0"
+        ceiling = _split_leg(os.path.join(workdir, "ceil"), "static64",
+                             threads, secs, ino_range)
+        probe = _split_leg(os.path.join(workdir, "probe"), "static64",
+                           max(1, threads // 2), secs, ino_range)
+        os.environ.pop("CUBEFS_META_SPLIT", None)
+        identity = _split_identity_leg(workdir)
+    finally:
+        if saved is None:
+            os.environ.pop("CUBEFS_META_SPLIT", None)
+        else:
+            os.environ["CUBEFS_META_SPLIT"] = saved
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    e_ops = med([l["create_ops"] for l in legs["elastic"]])
+    s_ops = med([l["create_ops"] for l in legs["static"]])
+    e_creates = med([l["creates"] for l in legs["elastic"]])
+    s_creates = med([l["creates"] for l in legs["static"]])
+    # doubling the loadgen must NOT double throughput, else the bench
+    # measured the client, not the server
+    server_bound = (ceiling["create_ops"]
+                    < 1.5 * max(1.0, probe["create_ops"]))
+    summary = {
+        "elastic_create_ops": e_ops, "static_create_ops": s_ops,
+        "elastic_creates": e_creates, "static_creates": s_creates,
+        "static64_ceiling_ops": ceiling["create_ops"],
+        "elastic_final_mps": med([l["mps_final"]
+                                  for l in legs["elastic"]]),
+        "elastic_migrations": med([l["migrations"]
+                                   for l in legs["elastic"]]),
+        "scaling_past_plateau": e_creates > s_creates and e_ops > s_ops,
+        "server_bound": server_bound,
+        "door_off_identical": identity["bit_identical"],
+        "ok": (e_creates > s_creates and e_ops > s_ops and server_bound
+               and identity["bit_identical"]
+               and not any(l["errors"] for ls in legs.values()
+                           for l in ls)),
+    }
+    return {"config": {"threads": threads, "secs": secs,
+                       "rounds": rounds, "order": order,
+                       "ino_range": ino_range},
+            "legs": legs, "static64_ceiling": ceiling,
+            "loadgen_probe": probe, "identity": identity,
+            "summary": summary}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="cubefs-tpu-fs-bench")
     ap.add_argument("--master")
@@ -2082,6 +2367,11 @@ def main(argv=None):
                          "baseline, bounded ship lag under saturated "
                          "creates with WAN delay, CUBEFS_GEO=0 digest "
                          "identity; merges into --out")
+    ap.add_argument("--split-ab", action="store_true",
+                    help="elastic metadata plane A/B: ABBA saturated "
+                         "creates on a 4-mp auto-splitting volume vs "
+                         "the static plateau + static-64 ceiling, "
+                         "door-off FSM digest identity")
     ap.add_argument("--scale-partitions", action="store_true",
                     help="aggregate creates/s at 1..256 metapartitions: "
                          "pipelined replication + client fan-out vs the "
@@ -2124,6 +2414,16 @@ def main(argv=None):
         print(json.dumps(res, indent=1))
         if args.out:
             merge_artifact(args.out, "geo_ab", res)
+        raise SystemExit(0 if res["summary"]["ok"] else 1)
+    if args.split_ab:
+        workdir = tempfile.mkdtemp(prefix="cubefs-bench-splitab-")
+        res = split_ab(workdir, threads=args.threads, secs=args.secs,
+                       rounds=args.rounds)
+        text = json.dumps(res, indent=1)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(text)
+        print(text)
         raise SystemExit(0 if res["summary"]["ok"] else 1)
     if args.scale_partitions:
         workdir = tempfile.mkdtemp(prefix="cubefs-bench-scale-")

@@ -90,6 +90,30 @@ def _parse_frame(line: bytes) -> dict:
     return json.loads(line)
 
 
+def frame_records(records: list[dict]) -> bytes:
+    """Serialize a record list into the CRC-framed WAL wire form, one
+    `!<crc><len>|<json>` line per record. This is the range-scoped
+    snapshot/delta encoding for live metapartition migration
+    (fs/split.py): each record is independently checksummed, so a
+    corrupt chunk in a shipped range snapshot is detected per record,
+    not just by the whole-payload CRC."""
+    return "".join(
+        _frame(json.dumps(r, sort_keys=True)) for r in records
+    ).encode()
+
+
+def parse_records(data: bytes) -> list[dict]:
+    """Decode a `frame_records` payload, verifying every record's CRC.
+    Raises ValueError on any framing/CRC/JSON failure — a range
+    migration must refuse a torn or corrupt snapshot outright rather
+    than load a prefix."""
+    out: list[dict] = []
+    for line in data.split(b"\n"):
+        if line:
+            out.append(_parse_frame(line))
+    return out
+
+
 class ReplicatedFsm:
     REDIRECT = 421
 

@@ -406,6 +406,27 @@ fs_placement_misplaced = DEFAULT.gauge(
     "dp replicas colocated in an AZ beyond the one-per-AZ fair share; "
     "the rate-limited misplaced-replica sweep drives this to zero")
 
+# elastic metadata plane (fs/split.py). `cubefs-cli metrics meta`
+# renders these; the imbalance gauge is the meta balance sweep's
+# 0-contract, mirroring the fs placement sweep above.
+meta_partition_imbalance = DEFAULT.gauge(
+    "cubefs_meta_partition_imbalance",
+    "actionable metapartitions: hot/oversized ones the split engine "
+    "would split plus cold adjacent pairs it would merge; the "
+    "rate-limited balance sweep drives this to zero")
+meta_range_migrations = DEFAULT.counter(
+    "cubefs_meta_range_migrations_total",
+    "completed live inode-range migrations, by kind", ("kind",))
+meta_range_migration_aborts = DEFAULT.counter(
+    "cubefs_meta_range_migration_aborts_total",
+    "in-flight migrations aborted before COMMIT (poisoned delta tap, "
+    "donor leadership change, crash recovery); aborts are clean — the "
+    "range table never moved", ("reason",))
+meta_range_redirects = DEFAULT.counter(
+    "cubefs_meta_range_redirects_total",
+    "requests bounced with the 453 range-moved routing code (frozen "
+    "sub-range during handoff, or a stale client map after COMMIT)")
+
 # token-bucket shaping (utils/ratelimit.py) — every shaped reservation
 # is observable, whether the bucket itself sleeps or the QoS gate
 # carries the wait as an admission delay.

@@ -96,6 +96,12 @@ OP_GEO_SNAPSHOT = 0x30
 OP_GEO_SHIP = 0x31
 OP_GEO_BACKFILL = 0x32
 
+# opcode (elastic metadata plane — fs/split.py): the scoped inode-range
+# snapshot a split target pulls from the donor leader rides the same
+# FLAG_MORE chunk trains as the geo bootstrap; the reply meta carries a
+# whole-payload CRC the puller verifies before proposing range_load
+OP_META_RANGE_EXPORT = 0x33
+
 RESULT_OK = 0
 RESULT_RPC = 0xE1  # structured rpc error: code+message ride the args
 
@@ -112,6 +118,7 @@ OP_NAMES = {
     OP_META_SUBMIT_BATCH: "meta_submit_batch",
     OP_GEO_SNAPSHOT: "geo_snapshot", OP_GEO_SHIP: "geo_ship",
     OP_GEO_BACKFILL: "geo_backfill",
+    OP_META_RANGE_EXPORT: "meta_range_export",
 }
 
 # opcodes whose transport-level retry is harmless with NO dedup token:
@@ -126,6 +133,9 @@ IDEMPOTENT_OPS = frozenset({
     # geo snapshot/backfill are pure reads of primary state; geo_ship
     # is retried safely because the applier skips seq <= applied
     OP_GEO_SNAPSHOT, OP_GEO_BACKFILL, OP_GEO_SHIP,
+    # range export is a pure read of donor state (the tap it registers
+    # is reset, not duplicated, by a re-read of the same split_id)
+    OP_META_RANGE_EXPORT,
 })
 
 

@@ -53,17 +53,26 @@ class ServiceUnavailable(RpcError):
 # writes to the primary while its reads keep serving locally.
 GEO_REDIRECT = 452
 
+# Elastic-metadata routing redirect (fs/split.py): a metanode bounces
+# mutations/reads aimed at an inode range that is frozen for, or has
+# been handed off by, a live metapartition split/merge with this code
+# and a "pid=<target>" message; the sdk refreshes its partition map
+# and re-routes (fs/client.py MetaWrapper._call_wire) the same way it
+# follows a 421 leader redirect.
+RANGE_MOVED = 453
+
 
 def errno_error(errno_: int, msg: str) -> RpcError:
     """THE errno-on-the-wire encoding, shared by every plane that maps
     POSIX errnos onto RPC statuses: 400+errno for small errnos, except
     that 404 (not-found pass-through), 421 (leader redirect, whose
-    message is parsed as an address) and 452 (geo redirect, same) are
+    message is parsed as an address), 452 (geo redirect, same) and 453
+    (range-moved redirect, whose message is parsed as a pid) are
     reserved transport codes — those and errnos >= 100 (EDQUOT=122 must
     not collide with 5xx failover semantics) ride 499 with an
     "errno=NN: " message prefix. Decoders: fs/client.py
     MetaWrapper._call and native_client.cc status_to_errno."""
-    if errno_ < 99 and 400 + errno_ not in (404, 421, GEO_REDIRECT):
+    if errno_ < 99 and 400 + errno_ not in (404, 421, GEO_REDIRECT, RANGE_MOVED):
         return RpcError(400 + errno_, msg)
     return RpcError(499, f"errno={errno_}: {msg}")
 

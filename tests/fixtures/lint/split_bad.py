@@ -1,0 +1,38 @@
+"""Known-bad fixture for split-discipline: range-table mutations
+outside FSM applies (direct, aliased, and rewriting), plus an unfenced
+metanode mutation door."""
+
+
+class BadMaster:
+    def __init__(self):
+        self.volumes = {}
+
+    def rpc_grow(self, args, body):  # CFE001: direct append in handler
+        vol = self.volumes[args["name"]]
+        vol["mps"].append({"pid": 9})
+        return {}
+
+    def sweep(self, name):  # CFE001 twice: aliased mutation + rewrite
+        vol = self.volumes[name]
+        mps = vol["mps"]
+        mps.sort(key=lambda m: m["start"])
+        mps[:] = [m for m in mps if m["pid"] != 2]
+
+    def rebuild(self, name, rows):  # CFE001: wholesale table swap
+        self.volumes[name]["mps"] = rows
+
+    def _apply_add_mp(self, name, mp):  # sanctioned: FSM apply
+        self.volumes[name]["mps"].append(mp)
+
+
+class BadMetaNode:
+    def _range_gate(self, pid, inos):
+        pass
+
+    def rpc_submit(self, args, body):  # CFE002: unfenced mutation door
+        return {"result": self._mp(args["pid"]).submit(args["record"])}
+
+    def rpc_submit_batch(self, args, body):  # fenced: silent
+        for rec in args["records"]:
+            self._range_gate(args["pid"], [rec.get("ino")])
+        return {}

@@ -1,0 +1,42 @@
+"""Known-good fixture for split-discipline: the table mutates only in
+FSM applies, reads/copies are free, and every mutation door the class
+defines checks the donor fence."""
+
+
+class GoodMaster:
+    def __init__(self):
+        self.volumes = {}
+
+    def client_view(self, name):  # reads and copies never flag
+        vol = self.volumes[name]
+        return {"mps": [dict(m) for m in vol["mps"]]}
+
+    def plan(self, name):  # a COPY of the table is not a handle
+        mps = [dict(p) for p in self.volumes[name]["mps"]]
+        mps.sort(key=lambda m: m["start"])
+        return mps
+
+    def _apply_split_commit(self, split_id, name=""):
+        vol = self.volumes[name]
+        mps = vol["mps"]
+        mps.append({"pid": 3})
+        mps.sort(key=lambda m: (m["start"], m["pid"]))
+        vol["mp_version"] = vol.get("mp_version", 0) + 1
+
+
+class GoodMetaNode:
+    def _range_gate(self, pid, inos):
+        pass
+
+    def rpc_submit(self, args, body):
+        self._range_gate(args["pid"], [args["record"].get("ino")])
+        return {}
+
+    def rpc_alloc_ino(self, args, body):
+        self._range_gate(args["pid"], (0,))
+        return {}
+
+
+class PlainNode:  # no _range_gate defined: doors are not CFE002 targets
+    def rpc_submit(self, args, body):
+        return {}

@@ -34,6 +34,7 @@ from tool.lint.checkers.placement_discipline import PlacementDisciplineChecker
 from tool.lint.checkers.retry_discipline import RetryDisciplineChecker
 from tool.lint.checkers.rpc_idempotency import (RpcIdempotencyChecker,
                                                 is_mutating)
+from tool.lint.checkers.split_discipline import SplitDisciplineChecker
 from tool.lint.checkers.tier1_purity import Tier1PurityChecker
 from tool.lint.checkers.tiering_discipline import TieringDisciplineChecker
 from tool.lint.checkers.tracer_safety import (TraceClockChecker,
@@ -635,6 +636,35 @@ def test_geo_mutations_classified_for_idempotency():
     assert is_mutating("geo_resync")
     assert is_mutating("geo_transition")
     assert not is_mutating("geo_status")
+
+
+# ---------------- split-discipline ----------------
+
+def test_split_discipline_true_positives():
+    mod = _module("split_bad.py", "cubefs_tpu/fs/fx.py")
+    found = SplitDisciplineChecker().check(mod)
+    # direct append + aliased sort + aliased rewrite + wholesale swap,
+    # and ONE unfenced mutation door (rpc_submit_batch is fenced)
+    assert _codes(found) == ["CFE001", "CFE001", "CFE001", "CFE001",
+                             "CFE002"]
+    assert any("rpc_grow" in v.message for v in found)
+    assert any("mps.sort()" in v.message for v in found)
+    assert any("BadMetaNode.rpc_submit" in v.message for v in found)
+    assert not any("_apply_add_mp" in v.message for v in found)
+    assert not any("rpc_submit_batch" in v.message for v in found)
+
+
+def test_split_discipline_true_negative():
+    mod = _module("split_good.py", "cubefs_tpu/fs/fx.py")
+    assert SplitDisciplineChecker().check(mod) == []
+
+
+def test_split_discipline_scope():
+    c = SplitDisciplineChecker()
+    assert c.applies("cubefs_tpu/fs/master.py")
+    assert c.applies("cubefs_tpu/fs/split.py")
+    assert not c.applies("cubefs_tpu/sdk/clients.py")
+    assert not c.applies("tool/snapshot.py")
 
 
 # ---------------- baseline ordering + summary cache + wall time ----------------

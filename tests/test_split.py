@@ -1,0 +1,432 @@
+"""Elastic metadata plane drills: three-phase metapartition split/merge
+with live inode-range migration (fs/split.py) — basic round-trips,
+racing mutations (exactly-once across the handoff), stale-client
+re-routing, pid-recovery after a crash mid-PREPARE, and the seeded
+phase-boundary chaos drill (kill master + both metanodes at every
+stage boundary under a zipf hot-tenant create mix)."""
+
+import hashlib
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cubefs_tpu.blob.access import NodePool
+from cubefs_tpu.fs import metanode as mn
+from cubefs_tpu.fs.client import FileSystem, FsError
+from cubefs_tpu.fs.datanode import DataNode
+from cubefs_tpu.fs.master import Master, MasterError
+from cubefs_tpu.fs.metanode import MetaNode
+
+
+class SplitCluster:
+    """Master (WAL-backed) + 2 metanodes (WAL-backed, restartable) +
+    3 datanodes; every piece can be killed and rebuilt from disk."""
+
+    def __init__(self, tmp_path, mp_count=1, packet=False):
+        self.tmp = tmp_path
+        self.packet = packet
+        self.pool = NodePool()
+        self.metas: list[MetaNode] = []
+        self.packet_srvs = []
+        self.datas = []
+        self.master = Master(self.pool, data_dir=str(tmp_path / "master"))
+        self.pool.bind("master", self.master)
+        for i in range(2):
+            self._start_meta(i)
+        for i in range(3):
+            d = DataNode(i, str(tmp_path / f"data{i}"), f"data{i}",
+                         self.pool)
+            self.pool.bind(f"data{i}", d)
+            self.master.register_datanode(f"data{i}")
+            self.datas.append(d)
+        self.view = self.master.create_volume("vol1", mp_count=mp_count,
+                                              dp_count=2)
+        self.fs = FileSystem(self.view, self.pool, master_addr="master")
+
+    def _start_meta(self, i):
+        node = MetaNode(i, data_dir=str(self.tmp / f"meta{i}"),
+                        addr=f"meta{i}", node_pool=self.pool)
+        self.pool.bind(f"meta{i}", node)
+        if self.packet:
+            srv = node.serve_packets()
+            self.packet_srvs.append(srv)
+            self.master.register_metanode(f"meta{i}",
+                                          packet_addr=srv.addr)
+        else:
+            self.master.register_metanode(f"meta{i}")
+        self.metas.append(node)
+
+    def meta_by_addr(self, addr: str) -> MetaNode:
+        return self.metas[int(addr.removeprefix("meta"))]
+
+    def kill_and_restart_all(self):
+        """Crash the whole control+meta plane: stop master and both
+        metanodes, then rebuild every one of them from its WAL."""
+        for s in self.packet_srvs:
+            s.stop()
+        self.packet_srvs = []
+        for node in self.metas:
+            node.stop()
+        self.metas = []
+        # master: new object over the same data dir replays wal+snap
+        self.master = Master(self.pool,
+                             data_dir=str(self.tmp / "master"))
+        self.pool.bind("master", self.master)
+        for i in range(2):
+            self._start_meta(i)
+        for i in range(len(self.datas)):
+            self.master.register_datanode(f"data{i}")
+        # metanode partitions restart from the COMMITTED table; raft
+        # wal replay restores each partition's true range state
+        for mp in self.master.client_view("vol1")["mps"]:
+            for a in mp.get("addrs") or [mp["addr"]]:
+                self.meta_by_addr(a).create_partition(
+                    mp["pid"], mp["start"], mp["end"],
+                    peers=mp.get("addrs") or [mp["addr"]])
+
+    def fresh_fs(self) -> FileSystem:
+        return FileSystem(self.master.client_view("vol1"), self.pool,
+                          master_addr="master")
+
+    def stop(self):
+        for s in self.packet_srvs:
+            s.stop()
+        for node in self.metas:
+            node.stop()
+        for d in self.datas:
+            d.stop()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = SplitCluster(tmp_path)
+    yield c
+    c.stop()
+
+
+def _mp_digest(node: MetaNode, pid: int) -> str:
+    part = node.partitions[pid]
+    with part._lock:
+        blob = json.dumps(part._state_dict(), sort_keys=True,
+                          default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _assert_replicas_identical(c: SplitCluster):
+    """Every partition's FSM digest must be byte-identical across its
+    replicas (raft apply is async: wait briefly for convergence)."""
+    for mp in c.master.client_view("vol1")["mps"]:
+        addrs = mp.get("addrs") or [mp["addr"]]
+        deadline = time.time() + 8
+        while True:
+            digs = {_mp_digest(c.meta_by_addr(a), mp["pid"])
+                    for a in addrs
+                    if mp["pid"] in c.meta_by_addr(a).partitions}
+            if len(digs) == 1:
+                break
+            if time.time() > deadline:
+                raise AssertionError(
+                    f"mp {mp['pid']} replicas diverged: {digs}")
+            time.sleep(0.05)
+
+
+# ---------------- basic split / merge round trips ----------------
+
+def test_split_moves_used_upper_half(cluster):
+    fs = cluster.fs
+    fs.mkdir("/t")
+    for j in range(24):
+        fs.create(f"/t/f{j}")
+    eng = cluster.master.split_engine()
+    res = eng.split("vol1")
+    assert res["copied_inodes"] > 0
+    view = cluster.master.client_view("vol1")
+    assert len(view["mps"]) == 2
+    assert view["mp_version"] == 1
+    donor, target = sorted(view["mps"], key=lambda m: m["start"])
+    assert donor["end"] == target["start"] == res["split_ino"]
+    # STALE client (pre-split table) keeps working: reads re-route via
+    # 453/refresh, creates rotate onto the new partition
+    for j in range(24):
+        assert fs.stat(f"/t/f{j}")["type"] == mn.FILE
+    for j in range(24, 32):
+        fs.create(f"/t/f{j}")
+    assert set(fs.readdir("/t")) == {f"f{j}" for j in range(32)}
+    assert fs.meta.mp_version == 1  # the chase adopted the watermark
+    # a fresh client sees the same namespace
+    assert set(cluster.fresh_fs().readdir("/t")) == \
+        {f"f{j}" for j in range(32)}
+    _assert_replicas_identical(cluster)
+
+
+def test_split_packet_plane_bootstrap(tmp_path):
+    """Range snapshot ships over the binary packet mux (FLAG_MORE chunk
+    trains) when the donor advertises a packet address."""
+    c = SplitCluster(tmp_path, packet=True)
+    try:
+        c.fs.mkdir("/p")
+        for j in range(16):
+            c.fs.create(f"/p/f{j}")
+        res = c.master.split_engine().split("vol1")
+        assert res["copied_inodes"] > 0
+        assert set(c.fresh_fs().readdir("/p")) == \
+            {f"f{j}" for j in range(16)}
+    finally:
+        c.stop()
+
+
+def test_merge_inverse_restores_single_partition(cluster):
+    fs = cluster.fs
+    fs.mkdir("/m")
+    for j in range(20):
+        fs.create(f"/m/f{j}")
+    eng = cluster.master.split_engine()
+    res = eng.split("vol1")
+    fs.unlink("/m/f7")
+    fs.rename("/m/f8", "/m/g8")
+    mres = eng.merge("vol1", donor_pid=res["target_pid"])
+    assert mres["copied_inodes"] > 0
+    view = cluster.master.client_view("vol1")
+    assert len(view["mps"]) == 1
+    assert view["mp_version"] == 2
+    expect = {f"f{j}" for j in range(20)} - {"f7", "f8"} | {"g8"}
+    assert set(cluster.fresh_fs().readdir("/m")) == expect
+    # the STALE pre-split client also converges across BOTH moves
+    assert set(fs.readdir("/m")) == expect
+    fs.create("/m/after")
+    assert cluster.fresh_fs().stat("/m/after")["type"] == mn.FILE
+    _assert_replicas_identical(cluster)
+
+
+def test_racing_mutations_exactly_once(cluster):
+    """Creates racing the migration always win or land on the new
+    owner — zero lost, zero double-applied."""
+    fs = cluster.fs
+    fs.mkdir("/r")
+    for j in range(16):
+        fs.create(f"/r/seed{j}")
+    errors, done = [], []
+    stop = threading.Event()
+
+    def writer():
+        # errno 28 during the brief frozen window means "alloc range
+        # migrating, table not yet committed" — a real SDK retries it;
+        # alloc never mutated state, so the retry cannot double-apply
+        k = 0
+        while not stop.is_set() and k < 200:
+            try:
+                fs.create(f"/r/race{k}")
+                done.append(f"race{k}")
+                k += 1
+            except FsError as e:  # noqa: PERF203
+                if e.errno == 28:
+                    time.sleep(0.01)
+                    continue
+                errors.append((k, e.errno, str(e)))
+                break
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        res = cluster.master.split_engine().split("vol1")
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not errors, errors
+    assert res["copied_inodes"] > 0
+    names = set(cluster.fresh_fs().readdir("/r"))
+    expect = {f"seed{j}" for j in range(16)} | set(done)
+    assert names == expect  # nothing lost, nothing duplicated
+    _assert_replicas_identical(cluster)
+
+
+# ---------------- satellite regressions ----------------
+
+def test_mp_for_refetches_before_enoent(cluster):
+    """Satellite 1: a range miss re-pulls the partition map from the
+    master once before surfacing ENOENT."""
+    fs = cluster.fs
+    fs.mkdir("/s")
+    ino = fs.resolve("/s")
+    # cripple the client's table: nothing owns ANY inode anymore
+    fs.meta.update_mps([], version=-1)
+    got = fs.meta._mp_for(ino)  # refetched from master and found
+    assert got["start"] <= ino < got["end"]
+    fs.meta.update_mps([], version=-1)
+    with pytest.raises(FsError) as ei:
+        fs.meta._mp_for(1 << 60)  # genuinely unowned: still ENOENT
+    assert ei.value.errno == mn.ENOENT
+    assert fs.stat("/s")["type"] == mn.DIR  # table repaired in passing
+
+
+def test_mp_for_without_master_still_raises(tmp_path):
+    """No master wired (bare MetaWrapper): the miss path must not
+    explode, just raise ENOENT as before."""
+    c = SplitCluster(tmp_path)
+    try:
+        fs = FileSystem(c.view, c.pool)  # no master_addr
+        with pytest.raises(FsError) as ei:
+            fs.meta._mp_for(1 << 60)
+        assert ei.value.errno == mn.ENOENT
+    finally:
+        c.stop()
+
+
+def test_next_pid_survives_crash_mid_prepare(tmp_path):
+    """Satellite 2: the target pid reserved by split_prepare must not
+    be re-minted after a master restart — not by volume creation, not
+    by the legacy append-split."""
+    c = SplitCluster(tmp_path)
+    try:
+        c.fs.mkdir("/q")
+        for j in range(8):
+            c.fs.create(f"/q/f{j}")
+        eng = c.master.split_engine()
+
+        class Boom(RuntimeError):
+            pass
+
+        def hook(stage, sid):
+            if stage == "prepared":
+                raise Boom(stage)
+        eng.fault_hook = hook
+        with pytest.raises(Boom):
+            eng.split("vol1")
+        (split,) = c.master.splits.values()
+        reserved = split["target_pids"][0]
+        # crash + restart: the ledger survives, and so must the fence
+        c.kill_and_restart_all()
+        assert c.master.splits, "split ledger lost across restart"
+        assert c.master._next_pid > reserved
+        c.master.create_volume("vol2", mp_count=2, dp_count=1)
+        pids = {m["pid"] for v in c.master.volumes.values()
+                for m in v["mps"]}
+        assert reserved not in pids, "reserved pid re-minted"
+        assert len(pids) == len([m for v in c.master.volumes.values()
+                                 for m in v["mps"]])
+    finally:
+        c.stop()
+
+
+def test_door_off_auto_sweep_is_inert(cluster, monkeypatch):
+    """CUBEFS_META_SPLIT=0 (default): the automatic sweep does nothing
+    and partition FSM state stays bit-identical; explicit operator
+    split still works."""
+    monkeypatch.delenv("CUBEFS_META_SPLIT", raising=False)
+    fs = cluster.fs
+    fs.mkdir("/d")
+    for j in range(12):
+        fs.create(f"/d/f{j}")
+    # partitions span 1<<24 inodes: a dozen creates never reach the
+    # real 0.8 fill bar, so force EVERY partition to look hot
+    cluster.master.MP_SPLIT_THRESHOLD = 0.0
+    before = {(i, pid): _mp_digest(node, pid)
+              for i, node in enumerate(cluster.metas)
+              for pid in node.partitions}
+    eng = cluster.master.split_engine()
+    out = eng.balance(max_moves=4, auto=True)
+    assert out["skipped"]
+    assert not out["actions"]
+    after = {(i, pid): _mp_digest(node, pid)
+             for i, node in enumerate(cluster.metas)
+             for pid in node.partitions}
+    assert before == after  # bit-identical door-off
+    monkeypatch.setenv("CUBEFS_META_SPLIT", "1")
+    out = eng.balance(max_moves=4, auto=True)
+    assert [a["kind"] for a in out["actions"]] == ["split"]
+    assert len(cluster.master.client_view("vol1")["mps"]) == 2
+
+
+# ---------------- seeded phase-boundary chaos drill ----------------
+
+TENANTS = ("t0", "t1", "t2", "t3")
+STAGES = ("prepared", "created", "copied", "frozen", "activated",
+          "committed")
+
+
+def _schedule(seed: int, n: int) -> list[tuple[str, str]]:
+    """Deterministic zipf hot-tenant create mix: tenant rank drawn
+    zipf(1.4), so t0 sees most of the creates — the hot-partition
+    shape the split engine exists for."""
+    rng = np.random.default_rng(seed)
+    ranks = (rng.zipf(1.4, size=n) - 1) % len(TENANTS)
+    return [("create", f"/{TENANTS[int(r)]}/f{i}")
+            for i, r in enumerate(ranks)]
+
+
+def _schedule_digest(sched) -> str:
+    return hashlib.sha256(json.dumps(sched).encode()).hexdigest()
+
+
+def test_schedule_digest_reproducible():
+    a, b = _schedule(20, 96), _schedule(20, 96)
+    assert a == b
+    assert _schedule_digest(a) == _schedule_digest(b)
+    assert _schedule(21, 96) != a
+
+
+@pytest.mark.parametrize("stage", STAGES)
+def test_phase_boundary_chaos(tmp_path, stage):
+    """Kill the driver, the master, AND both metanodes at one phase
+    boundary; restart everything from disk; recover; resume the seeded
+    zipf load. Invariants: zero lost creates, zero double-applied
+    creates, byte-identical FSM digests across replicas."""
+    c = SplitCluster(tmp_path)
+    try:
+        sched = _schedule(20, 72)
+        for t in TENANTS:
+            c.fs.mkdir(f"/{t}")
+        created = []
+        for _, path in sched[:48]:
+            c.fs.create(path)
+            created.append(path)
+        eng = c.master.split_engine()
+
+        class Boom(RuntimeError):
+            pass
+
+        def hook(st, sid):
+            if st == stage:
+                raise Boom(st)
+        eng.fault_hook = hook
+        with pytest.raises((Boom, MasterError)):
+            eng.split("vol1")
+        committed = stage == "committed"  # fault landed AFTER commit
+        assert bool(c.master.splits) == (not committed)
+
+        c.kill_and_restart_all()
+        eng2 = c.master.split_engine()
+        recovered = eng2.recover()
+        assert bool(recovered) == (not committed)
+        assert not c.master.splits  # ledger drained either way
+
+        fs2 = c.fresh_fs()
+        # zero lost: every pre-fault create still resolves
+        for path in created:
+            assert fs2.stat(path)["type"] == mn.FILE, path
+        # resume the remaining schedule on the recovered plane
+        for _, path in sched[48:]:
+            fs2.create(path)
+            created.append(path)
+        # zero lost + zero double-applied: listings match exactly
+        for t in TENANTS:
+            expect = sorted(p.rsplit("/", 1)[1] for p in created
+                            if p.startswith(f"/{t}/"))
+            assert sorted(fs2.readdir(f"/{t}")) == expect, t
+        _assert_replicas_identical(c)
+        # the plane is still elastic after the crash: a clean split
+        # (or the already-committed one) leaves a working 2-mp table
+        if not committed:
+            eng2.fault_hook = None
+            eng2.split("vol1")
+        assert len(c.master.client_view("vol1")["mps"]) == 2
+        fs3 = c.fresh_fs()
+        for t in TENANTS:
+            expect = sorted(p.rsplit("/", 1)[1] for p in created
+                            if p.startswith(f"/{t}/"))
+            assert sorted(fs3.readdir(f"/{t}")) == expect, t
+    finally:
+        c.stop()

@@ -12,6 +12,7 @@ from .lock_graph import LockGraphChecker
 from .placement_discipline import PlacementDisciplineChecker
 from .retry_discipline import RetryDisciplineChecker
 from .rpc_idempotency import RpcIdempotencyChecker
+from .split_discipline import SplitDisciplineChecker
 from .tier1_purity import Tier1PurityChecker
 from .tiering_discipline import TieringDisciplineChecker
 from .tracer_safety import TraceClockChecker, TracerSafetyChecker
@@ -36,6 +37,7 @@ ALL_CHECKERS = (
     WitnessDisciplineChecker,
     WireDisciplineChecker,
     GeoDisciplineChecker,
+    SplitDisciplineChecker,
 )
 
 # Checkers that need the whole-program graph (tool/lint/graph.py); the

@@ -36,8 +36,11 @@ _PROPOSE_SANCTUMS = {"_land", "_submit_local", "rpc_submit",
                      "rpc_submit_batch"}
 # enclosing functions allowed to dial the wire layer directly
 # (_land_wire is the fan-out lander's wire half, split from _land so the
-# drain span can wrap exactly the wire leg)
-_WIRE_SANCTUMS = {"_call", "_call_wire", "_land", "_land_wire"}
+# drain span can wrap exactly the wire leg; _resubmit_moved is the
+# fan-out's per-record 453 re-lander — it re-presents the same op_id at
+# the partition the range migrated to, sibling of _land_wire)
+_WIRE_SANCTUMS = {"_call", "_call_wire", "_land", "_land_wire",
+                  "_resubmit_moved"}
 
 
 class FanoutDisciplineChecker(Checker):

@@ -1,0 +1,140 @@
+"""Split-discipline checker (rule: split-discipline, codes CFE0xx).
+
+The elastic metadata plane (fs/split.py) moves live inode ranges
+between metapartitions. Its two safety anchors are structural and
+therefore lintable:
+
+  CFE001  the master's range table (``vol["mps"]``) mutates ONLY inside
+          replicated FSM applies (``_apply_*`` functions). The whole
+          three-phase design hangs on the table changing as ONE
+          deterministic apply with ONE ``mp_version`` bump — a direct
+          mutation from an rpc handler or the engine would fork
+          replicas and strand clients mid-handoff. Aliases count:
+          ``mps = vol["mps"]; mps.append(...)`` is the same mutation.
+
+  CFE002  every metanode class that defines the donor fence
+          (``_range_gate``) must call it from EACH mutation door it
+          defines (``rpc_submit``/``rpc_submit_batch``/
+          ``rpc_alloc_ino``). One unfenced door and a racing mutation
+          lands on a frozen/moved sub-range — the lost-update the
+          453/EMOVED routing contract exists to prevent.
+
+The analysis is syntactic (single-scope alias tracking for CFE001, the
+CFG002 reachability shape for CFE002); a new mutation surface must
+either route through an FSM apply / the gate, or carry a justified
+``lint: allow``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Module, Violation
+
+# list-mutating method calls on a range-table handle
+_MUTATORS = {"append", "pop", "remove", "insert", "sort", "clear",
+             "extend"}
+
+# metanode mutation doors that must check the donor fence when the
+# class defines one
+_GATED_DOORS = ("rpc_submit", "rpc_submit_batch", "rpc_alloc_ino")
+
+
+def _is_mps_subscript(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Subscript)
+            and isinstance(node.slice, ast.Constant)
+            and node.slice.value == "mps")
+
+
+def _calls_attr(fn: ast.AST, attr: str) -> bool:
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == attr):
+            return True
+    return False
+
+
+def _scoped_nodes(root: ast.AST):
+    """Walk one function (or module) body WITHOUT descending into
+    nested function/class scopes — each scope is checked on its own."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+class SplitDisciplineChecker(Checker):
+    rule = "split-discipline"
+    dirs = ("cubefs_tpu/fs/",)
+
+    def check(self, mod: Module) -> list[Violation]:
+        out: list[Violation] = []
+
+        scopes: list[tuple[str, ast.AST]] = [("<module>", mod.tree)]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((node.name, node))
+
+        for name, scope in scopes:
+            if name.startswith("_apply"):
+                continue  # replicated FSM applies own the table
+            # pass 1 — alias tracking: x = vol["mps"] makes x a handle
+            aliases = {t.id for node in _scoped_nodes(scope)
+                       if isinstance(node, ast.Assign)
+                       and _is_mps_subscript(node.value)
+                       for t in node.targets if isinstance(t, ast.Name)}
+            # pass 2 — flag mutations of the table or a handle to it
+            for node in _scoped_nodes(scope):
+                mutated = None
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATORS):
+                    base = node.func.value
+                    if _is_mps_subscript(base):
+                        mutated = f'["mps"].{node.func.attr}()'
+                    elif isinstance(base, ast.Name) and base.id in aliases:
+                        mutated = f"{base.id}.{node.func.attr}()"
+                elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        # vol["mps"] = ... or alias[...] = ... rewrites
+                        if _is_mps_subscript(t):
+                            mutated = '["mps"] assignment'
+                        elif (isinstance(t, ast.Subscript)
+                              and isinstance(t.value, ast.Name)
+                              and t.value.id in aliases):
+                            mutated = f"{t.value.id}[...] assignment"
+                if mutated:
+                    out.append(self.violation(
+                        mod, "CFE001", node,
+                        f"range-table mutation ({mutated}) in `{name}` "
+                        f"— vol[\"mps\"] changes only inside replicated "
+                        f"FSM applies (_apply_*) so every replica "
+                        f"rewrites the table in ONE deterministic step "
+                        f"with ONE mp_version bump"))
+
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)}
+            if "_range_gate" not in methods:
+                continue  # class hosts no donor fence
+            for name in _GATED_DOORS:
+                door = methods.get(name)
+                if door is None:
+                    continue
+                if not _calls_attr(door, "_range_gate"):
+                    out.append(self.violation(
+                        mod, "CFE002", door,
+                        f"mutation door {cls.name}.{name} has no "
+                        f"_range_gate() call; a racing mutation would "
+                        f"land on a frozen/moved sub-range instead of "
+                        f"bouncing 453/EMOVED to the new owner"))
+        return out
